@@ -1,0 +1,21 @@
+"""t3fs — a TPU-native distributed file system with the capabilities of 3FS.
+
+Architecture (see SURVEY.md for the reference structural analysis):
+  - ops/      math-dense data plane: CRC32C + RS(8+2) erasure coding expressed as
+              GF(2) bit-matrix matmuls (MXU-friendly), with JAX/Pallas TPU backends
+              and a native C++ CPU backend behind one codec seam.
+  - utils/    foundations: status/result error model, TOML config w/ hot update,
+              metric recorders, serde.
+  - net/      asyncio RPC fabric: framed transport, service dispatch, RemoteBuf
+              one-sided bulk-data emulation (RDMA-shaped API).
+  - kv/       transactional KV abstraction + in-memory engine (SSI).
+  - storage/  chunk engine (size-class allocator, COW chunk store, meta store) and
+              the CRAQ storage service (version-gated replica updates, reliable
+              forwarding, resync).
+  - client/   storage/meta/mgmtd client libraries (+ in-memory fakes for tests).
+  - mgmtd/    cluster manager: routing info, heartbeats, lease, chain state machine.
+  - meta/     metadata service: inode/dirent schema on KV transactions.
+  - parallel/ device-mesh sharding of the codec data plane (dp x cp, psum combine).
+"""
+
+__version__ = "0.1.0"

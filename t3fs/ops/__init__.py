@@ -1,0 +1,13 @@
+"""Data-plane math: CRC32C and Reed-Solomon RS(k+m) over GF(2^8).
+
+Everything here is built on one observation: both CRC32C and GF(2^8)
+multiply-by-constant are linear maps over GF(2).  Batched checksumming and
+erasure coding therefore become *bit-matrix matmuls* — the natural shape for
+the TPU MXU — rather than the per-byte table lookups the reference uses on CPU
+(folly::crc32c at src/fbs/storage/Common.h:158; no RS data path exists in the
+reference at all, see SURVEY.md preamble).
+"""
+
+from t3fs.ops.gf256 import GF256
+from t3fs.ops.crc32c import crc32c_ref, crc32c_combine_ref, Crc32cMatrix
+from t3fs.ops.rs import RSCode

@@ -1,0 +1,161 @@
+"""JAX/XLA batched CRC32C + RS(k+m) — the TPU data plane.
+
+Design: all hot math is int8 0/1 matmuls with int32 accumulation (MXU), with
+bit unpack/pack as vector ops around them.  Matrices come from the host-side
+builders in crc32c.py / rs.py and are closed over as constants so XLA folds
+them into the compiled executable.
+
+Shapes are static per (batch, chunk_len) pair; first call compiles, repeats
+hit the cache.  This module is the portable XLA path; a fused Pallas kernel
+(unpack+matmul in VMEM, avoiding the 8x HBM blowup of materialized bit
+planes) is the planned fast path — until it lands, this is what runs.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from t3fs.ops.crc32c import default_matrices
+from t3fs.ops.rs import RSCode, default_rs
+
+DEFAULT_SEG_BYTES = 512
+
+
+def unpack_bits(x: jax.Array) -> jax.Array:
+    """uint8 (..., B) -> int8 (..., 8B), LSB-first per byte."""
+    shifts = jnp.arange(8, dtype=jnp.uint8)
+    bits = (x[..., None] >> shifts) & jnp.uint8(1)
+    return bits.reshape(*x.shape[:-1], x.shape[-1] * 8).astype(jnp.int8)
+
+
+def pack_bits_u32(bits: jax.Array) -> jax.Array:
+    """int32 0/1 (..., 32) -> uint32 (...)."""
+    weights = (jnp.uint32(1) << jnp.arange(32, dtype=jnp.uint32))
+    return jnp.sum(bits.astype(jnp.uint32) * weights, axis=-1, dtype=jnp.uint32)
+
+
+def pack_bits_u8(bits: jax.Array) -> jax.Array:
+    """int32 0/1 (..., 8B) -> uint8 (..., B)."""
+    b = bits.reshape(*bits.shape[:-1], bits.shape[-1] // 8, 8).astype(jnp.uint8)
+    weights = (jnp.uint8(1) << jnp.arange(8, dtype=jnp.uint8))
+    return jnp.sum(b * weights, axis=-1, dtype=jnp.uint8)
+
+
+def _mod2(x: jax.Array) -> jax.Array:
+    return jnp.bitwise_and(x, 1)
+
+
+@functools.lru_cache(maxsize=64)
+def _crc_consts(chunk_len: int, seg_bytes: int):
+    mats = default_matrices()
+    nseg = -(-chunk_len // seg_bytes)
+    pad = nseg * seg_bytes - chunk_len
+    L = mats.segment_matrix(seg_bytes).astype(np.int8)          # (8B, 32)
+    P = mats.combine_stack(nseg, seg_bytes).astype(np.int32)    # (S, 32, 32)
+    affine = np.uint32(mats.affine_const(chunk_len))
+    return nseg, pad, L, P, affine
+
+
+def make_crc32c_batch(chunk_len: int, seg_bytes: int = DEFAULT_SEG_BYTES):
+    """Build a jittable fn: (n, chunk_len) uint8 -> (n,) uint32 CRC32C.
+
+    Leading-zero padding trick: crc_raw is 0-preserving, so chunks are
+    front-padded to a whole number of segments while the affine constant uses
+    the true length — bit-exact with the scalar reference for any length."""
+    nseg, pad, L, P, affine = _crc_consts(chunk_len, seg_bytes)
+    Lj = jnp.asarray(L)
+    Pj = jnp.asarray(P)
+
+    def crc(chunks: jax.Array) -> jax.Array:
+        n = chunks.shape[0]
+        if pad:
+            chunks = jnp.pad(chunks, ((0, 0), (pad, 0)))
+        segs = chunks.reshape(n, nseg, seg_bytes)
+        bits = unpack_bits(segs)                                 # (n, S, 8B)
+        seg_crc = _mod2(
+            jax.lax.dot_general(
+                bits, Lj,
+                (((2,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32,
+            )
+        )                                                        # (n, S, 32)
+        raw = _mod2(jnp.einsum("skl,nsl->nk", Pj, seg_crc))      # (n, 32)
+        return pack_bits_u32(raw) ^ affine
+
+    return crc
+
+
+@functools.lru_cache(maxsize=64)
+def crc32c_batch_jit(chunk_len: int, seg_bytes: int = DEFAULT_SEG_BYTES):
+    return jax.jit(make_crc32c_batch(chunk_len, seg_bytes))
+
+
+def crc32c(data: bytes | np.ndarray) -> int:
+    """Single-buffer convenience (device path, any length).
+
+    NOTE: compiles one executable per distinct length — fine for tests and
+    fixed-size chunks, wrong for arbitrary variable-length streams (use
+    fixed-size batches + Crc32cMatrix.combine there)."""
+    arr = np.frombuffer(bytes(data), dtype=np.uint8)
+    if arr.size == 0:
+        return 0
+    fn = crc32c_batch_jit(arr.size)
+    return int(fn(jnp.asarray(arr)[None, :])[0])
+
+
+# --- Reed-Solomon ---
+
+def make_rs_encode(rs: RSCode | None = None):
+    """(n, k, L) uint8 data shards -> (n, m, L) parity shards."""
+    rs = rs or default_rs()
+    B = jnp.asarray(rs.parity_bitmatrix.astype(np.int8))         # (8k, 8m)
+
+    def encode(data: jax.Array) -> jax.Array:
+        n, k, Lb = data.shape
+        x = jnp.swapaxes(data, 1, 2)                             # (n, L, k)
+        bits = unpack_bits(x)                                    # (n, L, 8k)
+        pbits = _mod2(
+            jax.lax.dot_general(
+                bits, B, (((2,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32,
+            )
+        )                                                        # (n, L, 8m)
+        parity = pack_bits_u8(pbits)                             # (n, L, m)
+        return jnp.swapaxes(parity, 1, 2)
+
+    return encode
+
+
+def make_rs_reconstruct(present: tuple[int, ...], want: tuple[int, ...],
+                        rs: RSCode | None = None):
+    """(n, k, L) uint8 present shards (rows in `present` order) -> (n, |want|, L)."""
+    rs = rs or default_rs()
+    W = jnp.asarray(rs.reconstruct_bitmatrix(list(present), list(want)).astype(np.int8))
+
+    def reconstruct(shards: jax.Array) -> jax.Array:
+        x = jnp.swapaxes(shards, 1, 2)
+        bits = unpack_bits(x)
+        out = _mod2(
+            jax.lax.dot_general(
+                bits, W, (((2,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32,
+            )
+        )
+        return jnp.swapaxes(pack_bits_u8(out), 1, 2)
+
+    return reconstruct
+
+
+@functools.lru_cache(maxsize=8)
+def rs_encode_jit(k: int = 8, m: int = 2):
+    return jax.jit(make_rs_encode(default_rs(k, m)))
+
+
+@functools.lru_cache(maxsize=128)
+def rs_reconstruct_jit(present: tuple[int, ...], want: tuple[int, ...],
+                       k: int = 8, m: int = 2):
+    return jax.jit(make_rs_reconstruct(present, want, default_rs(k, m)))

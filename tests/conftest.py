@@ -1,0 +1,11 @@
+"""Test harness config: force JAX onto a virtual 8-device CPU mesh so all
+sharding/collective paths are exercised without TPU hardware (the driver
+separately dry-run-compiles the multi-chip path; bench.py runs on the real
+chip and must NOT import this)."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
