@@ -1,0 +1,69 @@
+#!/usr/bin/env python
+"""t3fs headline bench: RS(8+2)+CRC32C stripe encode GB/s on one TPU chip.
+
+This is BASELINE.json's metric — the storage-node write-path offload: for each
+stripe of 8 data chunks, compute 2 RS parity shards plus CRC32C of all 10
+shards.  Baseline is 2x200 Gbps line rate = 50 GB/s of data per storage node
+(the reference's per-node NIC budget, README.md:30).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+LINE_RATE_GBPS = 50.0  # 2 x 200 Gbps = 50 GB/s per storage node
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from t3fs.ops.jax_codec import make_stripe_encode_step
+
+    k, m = 8, 2
+    chunk_len = 1 << 20          # 1 MiB shards -> 8 MiB data per stripe
+    n = 8                        # 64 MiB data per step
+    step = jax.jit(make_stripe_encode_step(chunk_len, k, m))
+
+    rng = np.random.default_rng(0)
+    stripes = jax.device_put(
+        jnp.asarray(rng.integers(0, 256, (n, k, chunk_len), dtype=np.uint8)))
+
+    # compile + warmup
+    parity, crcs = step(stripes)
+    jax.block_until_ready((parity, crcs))
+
+    iters = 10
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        parity, crcs = step(stripes)
+    jax.block_until_ready((parity, crcs))
+    dt = time.perf_counter() - t0
+
+    data_bytes = n * k * chunk_len * iters
+    gbps = data_bytes / dt / 1e9
+    print(json.dumps({
+        "metric": "rs8+2_crc32c_stripe_encode",
+        "value": round(gbps, 3),
+        "unit": "GB/s/chip",
+        "vs_baseline": round(gbps / LINE_RATE_GBPS, 4),
+        "device": str(jax.devices()[0]),  # guards against silent CPU fallback
+    }))
+
+
+if __name__ == "__main__":
+    try:
+        main()
+    except Exception as e:  # never leave the driver without a JSON line
+        print(json.dumps({
+            "metric": "rs8+2_crc32c_stripe_encode",
+            "value": 0.0,
+            "unit": "GB/s/chip",
+            "vs_baseline": 0.0,
+            "error": f"{type(e).__name__}: {e}",
+        }))
+        sys.exit(0)

@@ -1,0 +1,3 @@
+"""Device-mesh parallel codec data plane (dp x cp shardings, psum combine)."""
+
+from t3fs.parallel.codec_mesh import make_mesh, make_sharded_encode_step

@@ -1,0 +1,95 @@
+"""Mesh-sharded RS(8+2)+CRC32C encode — the multi-chip data plane.
+
+Parallelism mapping (SURVEY.md §2.9/§5.7): a file-system's "parallelism" is
+data distribution.  On a TPU pod slice the codec pipeline shards two ways:
+
+  dp — stripe batch across devices (independent stripes, no comms)
+  cp — chunk length across devices ("long-sequence" axis).  RS parity is
+       byte-position-local so it needs NO communication under cp.  CRC is a
+       GF(2) linear scan, so each device computes the raw CRC of its local
+       span, multiplies by its tail shift matrix Mb^(bytes_after), and the
+       chunk CRC is a psum (XOR under mod 2) over cp — one small collective
+       of (n, k+m, 32) int32, riding ICI.
+
+This mirrors how the reference distributes bulk data over chains/stripes
+(meta/components/ChainAllocator.h:48-81) while the consistency math rides a
+separate small-control path.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from t3fs.ops.crc32c import default_matrices
+from t3fs.ops.jax_codec import (
+    DEFAULT_SEG_BYTES, unpack_bits, pack_bits_u32, _mod2,
+    make_crc32c_raw, make_rs_encode,
+)
+from t3fs.ops.rs import default_rs
+
+
+def make_mesh(n_devices: int | None = None, dp: int | None = None) -> Mesh:
+    """Build a (dp, cp) mesh over the available devices, favoring cp (the
+    chunk axis) so the CRC-combine collective is exercised widely."""
+    devs = jax.devices()
+    n = n_devices or len(devs)
+    assert n <= len(devs), f"requested {n} devices, only {len(devs)} available"
+    if dp is None:
+        cp = 1
+        for cand in (4, 2, 1):
+            if n % cand == 0:
+                cp = cand
+                break
+        dp = n // cp
+    assert n % dp == 0, f"dp={dp} must divide n_devices={n}"
+    cp = n // dp
+    arr = np.array(devs[:n]).reshape(dp, cp)
+    return Mesh(arr, ("dp", "cp"))
+
+
+def make_sharded_encode_step(mesh: Mesh, chunk_len: int, k: int = 8, m: int = 2,
+                             seg_bytes: int = DEFAULT_SEG_BYTES):
+    """Full sharded encode step: stripes (n, k, chunk_len) uint8, sharded
+    P('dp', None, 'cp') -> (parity (n, m, chunk_len) same sharding,
+                            crcs (n, k+m) uint32 replicated over cp).
+
+    Returns (jitted_fn, in_sharding) — callers place inputs with in_sharding.
+    """
+    cp = mesh.shape["cp"]
+    assert chunk_len % cp == 0 and (chunk_len // cp) % seg_bytes == 0, (
+        f"chunk_len {chunk_len} must split into {cp} cp shards of whole "
+        f"{seg_bytes}-byte segments")
+    local_len = chunk_len // cp
+    mats = default_matrices()
+    # tail-shift matrix per cp rank: Mb^(bytes strictly after this shard)
+    tails = jnp.asarray(np.stack([
+        mats.shift_matrix(local_len * (cp - 1 - r)).astype(np.int32)
+        for r in range(cp)
+    ]))
+    affine = np.uint32(mats.affine_const(chunk_len))
+    raw_local = make_crc32c_raw(local_len, seg_bytes)
+    rs_encode = make_rs_encode(default_rs(k, m))
+
+    def local_step(stripes: jax.Array):
+        # stripes: (n_local, k, local_len); byte-concat then unpack inside the
+        # CRC core — see make_stripe_encode_step for why not bit planes
+        n = stripes.shape[0]
+        parity = rs_encode(stripes)                              # local: RS is positionwise
+        allsh = jnp.concatenate([stripes, parity], axis=1)
+        raw = raw_local(allsh.reshape(n * (k + m), local_len))
+        r = jax.lax.axis_index("cp")
+        shifted = _mod2(jnp.einsum("kl,nl->nk", tails[r], raw))
+        total = _mod2(jax.lax.psum(shifted, axis_name="cp"))
+        crcs = pack_bits_u32(total).reshape(n, k + m) ^ affine
+        return parity, crcs
+
+    mapped = jax.shard_map(
+        local_step, mesh=mesh,
+        in_specs=P("dp", None, "cp"),
+        out_specs=(P("dp", None, "cp"), P("dp", None)),
+    )
+    in_sharding = jax.NamedSharding(mesh, P("dp", None, "cp"))
+    return jax.jit(mapped), in_sharding

@@ -1,11 +1,18 @@
 """Test harness config: force JAX onto a virtual 8-device CPU mesh so all
 sharding/collective paths are exercised without TPU hardware (the driver
 separately dry-run-compiles the multi-chip path; bench.py runs on the real
-chip and must NOT import this)."""
+chip and must NOT import this).
+
+The ambient environment pins JAX to the real TPU (JAX_PLATFORMS=axon, set
+again by sitecustomize after env vars), so plain env overrides don't stick —
+jax.config.update is the reliable knob."""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
