@@ -1,0 +1,203 @@
+"""Transactional KV: interface + in-memory SSI engine + retry driver.
+
+Reference analogs: common/kv/IKVEngine.h / ITransaction.h (snapshot get/range,
+set, conflict ranges), common/kv/mem/ MemKVEngine (STM-style store used by
+meta/mgmtd tests and single-node deploys), WithTransaction retry driver
+(meta MetaStore.h:54-66 retryMaybeCommitted).
+
+Concurrency model (serializable snapshot isolation, FDB-like):
+  - a transaction reads at its snapshot version;
+  - reads (point + range) are recorded as conflict ranges unless snapshot_*;
+  - commit (atomic under the engine lock) aborts with TXN_CONFLICT if any
+    conflict range saw a write with version > snapshot.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import bisect
+import random
+import threading
+from typing import Awaitable, Callable
+
+from t3fs.utils.status import StatusCode, StatusError, make_error
+
+
+class Transaction:
+    """One transaction against a MemKVEngine."""
+
+    def __init__(self, engine: "MemKVEngine"):
+        self.engine = engine
+        self.read_version = engine._version
+        self._writes: dict[bytes, bytes | None] = {}   # None = clear
+        self._range_clears: list[tuple[bytes, bytes]] = []
+        self._read_keys: set[bytes] = set()
+        self._read_ranges: list[tuple[bytes, bytes]] = []
+        self._committed = False
+
+    # --- reads ---
+
+    def get(self, key: bytes, *, snapshot: bool = False) -> bytes | None:
+        if key in self._writes:
+            return self._writes[key]
+        if not snapshot:
+            self._read_keys.add(key)
+        if any(b <= key < e for b, e in self._range_clears):
+            return None  # read-your-writes across clear_range
+        return self.engine._get_at(key, self.read_version)
+
+    def snapshot_get(self, key: bytes) -> bytes | None:
+        return self.get(key, snapshot=True)
+
+    def get_range(self, begin: bytes, end: bytes, *, limit: int = 0,
+                  snapshot: bool = False) -> list[tuple[bytes, bytes]]:
+        """Keys in [begin, end), sorted; limit 0 = unlimited."""
+        if not snapshot:
+            self._read_ranges.append((begin, end))
+        base = dict(self.engine._range_at(begin, end, self.read_version))
+        for k, v in self._writes.items():
+            if begin <= k < end:
+                if v is None:
+                    base.pop(k, None)
+                else:
+                    base[k] = v
+        for b, e in self._range_clears:
+            for k in [k for k in base if b <= k < e and k not in self._writes]:
+                base.pop(k)
+        out = sorted(base.items())
+        return out[:limit] if limit else out
+
+    # --- writes ---
+
+    def set(self, key: bytes, value: bytes) -> None:
+        self._writes[key] = bytes(value)
+
+    def clear(self, key: bytes) -> None:
+        self._writes[key] = None
+
+    def clear_range(self, begin: bytes, end: bytes) -> None:
+        self._range_clears.append((begin, end))
+        for k in list(self._writes):
+            if begin <= k < end:
+                self._writes[k] = None
+
+    def add_read_conflict_key(self, key: bytes) -> None:
+        self._read_keys.add(key)
+
+    def add_read_conflict_range(self, begin: bytes, end: bytes) -> None:
+        self._read_ranges.append((begin, end))
+
+    # --- commit ---
+
+    def commit(self) -> None:
+        assert not self._committed, "transaction reused after commit"
+        self.engine._commit(self)
+        self._committed = True
+
+
+class KVEngine:
+    def transaction(self) -> Transaction:
+        raise NotImplementedError
+
+    def clear_all(self) -> None:
+        raise NotImplementedError
+
+
+class MemKVEngine(KVEngine):
+    """In-memory multi-version store with SSI conflict checking."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._version = 0
+        # key -> list of (version, value|None) appends, newest last
+        self._data: dict[bytes, list[tuple[int, bytes | None]]] = {}
+        self._sorted_keys: list[bytes] = []
+
+    def transaction(self) -> Transaction:
+        return Transaction(self)
+
+    def clear_all(self) -> None:
+        with self._lock:
+            self._data.clear()
+            self._sorted_keys.clear()
+            self._version = 0
+
+    # --- internals ---
+
+    def _get_at(self, key: bytes, version: int) -> bytes | None:
+        with self._lock:
+            versions = self._data.get(key)
+            if not versions:
+                return None
+            for ver, val in reversed(versions):
+                if ver <= version:
+                    return val
+            return None
+
+    def _range_at(self, begin: bytes, end: bytes, version: int) -> list[tuple[bytes, bytes]]:
+        with self._lock:
+            lo = bisect.bisect_left(self._sorted_keys, begin)
+            hi = bisect.bisect_left(self._sorted_keys, end)
+            keys = self._sorted_keys[lo:hi]
+        out = []
+        for k in keys:
+            v = self._get_at(k, version)
+            if v is not None:
+                out.append((k, v))
+        return out
+
+    def _latest_write_version(self, key: bytes) -> int:
+        versions = self._data.get(key)
+        return versions[-1][0] if versions else 0
+
+    def _commit(self, txn: Transaction) -> None:
+        with self._lock:
+            # conflict check: any tracked read invalidated after snapshot?
+            for key in txn._read_keys:
+                if self._latest_write_version(key) > txn.read_version:
+                    raise make_error(StatusCode.TXN_CONFLICT, f"key {key!r}")
+            for begin, end in txn._read_ranges:
+                lo = bisect.bisect_left(self._sorted_keys, begin)
+                hi = bisect.bisect_left(self._sorted_keys, end)
+                for k in self._sorted_keys[lo:hi]:
+                    if self._latest_write_version(k) > txn.read_version:
+                        raise make_error(StatusCode.TXN_CONFLICT, f"range key {k!r}")
+            if not txn._writes and not txn._range_clears:
+                return
+            self._version += 1
+            ver = self._version
+            # expand range clears against current live keys
+            for begin, end in txn._range_clears:
+                lo = bisect.bisect_left(self._sorted_keys, begin)
+                hi = bisect.bisect_left(self._sorted_keys, end)
+                for k in self._sorted_keys[lo:hi]:
+                    if k not in txn._writes:
+                        self._data.setdefault(k, []).append((ver, None))
+            for key, val in txn._writes.items():
+                if key not in self._data:
+                    bisect.insort(self._sorted_keys, key)
+                    self._data[key] = []
+                self._data[key].append((ver, val))
+
+
+async def with_transaction(engine: KVEngine,
+                           fn: Callable[[Transaction], Awaitable],
+                           *, max_retries: int = 10,
+                           backoff_s: float = 0.001):
+    """Run fn(txn) and commit, retrying on TXN_CONFLICT/TXN_RETRYABLE with
+    jittered backoff (reference: TransactionRetry / retryMaybeCommitted)."""
+    attempt = 0
+    while True:
+        txn = engine.transaction()
+        try:
+            result = await fn(txn)
+            txn.commit()
+            return result
+        except StatusError as e:
+            if e.code not in (StatusCode.TXN_CONFLICT, StatusCode.TXN_RETRYABLE,
+                              StatusCode.TXN_TOO_OLD):
+                raise
+            attempt += 1
+            if attempt > max_retries:
+                raise
+            await asyncio.sleep(backoff_s * (2 ** min(attempt, 8)) * random.random())

@@ -1,0 +1,22 @@
+"""Key-prefix table for the shared transactional KV (reference:
+common/kv/KeyPrefix-def.h:6-7 — "INOD", "DENT", ... 4-byte prefixes)."""
+
+import enum
+
+
+class KeyPrefix(bytes, enum.Enum):
+    INODE = b"INOD"
+    DENTRY = b"DENT"
+    INODE_SESSION = b"INOS"      # file write sessions
+    CHAIN = b"CHAN"              # mgmtd chain records
+    CHAIN_TABLE = b"CHTB"
+    NODE = b"NODE"               # mgmtd node records
+    LEASE = b"LEAS"              # mgmtd primary lease
+    CONFIG = b"CONF"             # distributed config templates
+    ROUTING_VER = b"ROUV"
+    IDEMPOTENT = b"IDEM"         # meta request dedupe records
+    ALLOCATOR = b"ALOC"          # inode-id allocator state
+    USER = b"USER"
+
+    def key(self, *parts: bytes) -> bytes:
+        return self.value + b"".join(parts)

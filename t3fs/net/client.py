@@ -1,0 +1,62 @@
+"""RPC client with per-address connection pooling.
+
+Reference analogs: common/net/Client.h:16, TransportPool (per-peer pooling),
+serde ClientContext::call (common/serde/ClientContext.h:40).  The client may
+also register local services (e.g. the buffer service that lets storage
+servers pull/push bulk data — the RDMA emulation).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Any
+
+from t3fs.net.conn import Connection
+from t3fs.net.server import build_dispatcher
+from t3fs.utils.status import StatusCode, make_error
+
+log = logging.getLogger("t3fs.net")
+
+
+class Client:
+    def __init__(self, connect_timeout: float = 5.0):
+        self.connect_timeout = connect_timeout
+        self.dispatcher: dict = {}
+        self._conns: dict[str, Connection] = {}
+        self._locks: dict[str, asyncio.Lock] = {}
+
+    def add_service(self, svc: Any) -> None:
+        """Expose a local service to servers (reverse-direction RPC)."""
+        self.dispatcher.update(build_dispatcher(svc))
+
+    async def _get_conn(self, address: str) -> Connection:
+        conn = self._conns.get(address)
+        if conn is not None and not conn.closed:
+            return conn
+        lock = self._locks.setdefault(address, asyncio.Lock())
+        async with lock:
+            conn = self._conns.get(address)
+            if conn is not None and not conn.closed:
+                return conn
+            host, port = address.rsplit(":", 1)
+            try:
+                reader, writer = await asyncio.wait_for(
+                    asyncio.open_connection(host, int(port)), self.connect_timeout)
+            except (OSError, asyncio.TimeoutError) as e:
+                raise make_error(StatusCode.RPC_CONNECT_FAILED,
+                                 f"connect {address}: {e}") from None
+            conn = Connection(reader, writer, self.dispatcher, name=f"cli->{address}")
+            conn.start()
+            self._conns[address] = conn
+            return conn
+
+    async def call(self, address: str, method: str, body: object = None,
+                   payload: bytes = b"", timeout: float = 30.0) -> tuple[object, bytes]:
+        conn = await self._get_conn(address)
+        return await conn.call(method, body, payload, timeout)
+
+    async def close(self) -> None:
+        for conn in list(self._conns.values()):
+            await conn.close()
+        self._conns.clear()

@@ -1,0 +1,164 @@
+"""Duplex framed connection: waiter table + request dispatch, both directions.
+
+Reference analogs: common/net/Transport.h:22 (connection object),
+common/net/Processor.h:28-50 (decode -> dispatch), common/net/Waiter
+(uuid -> coroutine wakeup).  Unlike the reference's client->server-only RPC
+plus one-sided RDMA verbs, a t3fs connection lets EITHER side issue requests:
+that is the TCP emulation of RDMA READ/WRITE (see net/__init__ docstring).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import logging
+import time
+from typing import Awaitable, Callable
+
+from t3fs.net.wire import (
+    HEADER_SIZE, FLAG_IS_REQ, FrameError, MessagePacket, WireStatus,
+    pack_header, unpack_header,
+)
+from t3fs.utils import serde
+from t3fs.utils.status import Status, StatusCode, StatusError, make_error
+
+log = logging.getLogger("t3fs.net")
+
+# handler(body, payload, conn) -> (rsp_body, rsp_payload)
+Handler = Callable[[object, bytes, "Connection"], Awaitable[tuple[object, bytes]]]
+
+
+class Connection:
+    """One duplex framed stream; safe for concurrent calls."""
+
+    _uuid_counter = itertools.count(1)
+
+    def __init__(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter,
+                 dispatcher: dict[str, Handler] | None = None, name: str = "?",
+                 on_close: Callable[["Connection"], None] | None = None):
+        self.reader = reader
+        self.writer = writer
+        self.dispatcher = dispatcher if dispatcher is not None else {}
+        self.name = name
+        self.on_close = on_close
+        self._waiters: dict[int, asyncio.Future] = {}
+        self._send_lock = asyncio.Lock()
+        self._closed = False
+        self._loop_task: asyncio.Task | None = None
+        # asyncio holds only weak refs to tasks; keep handlers alive here
+        self._tasks: set[asyncio.Task] = set()
+
+    def start(self) -> None:
+        self._loop_task = asyncio.create_task(self._read_loop(), name=f"conn-{self.name}")
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    def _spawn(self, coro, name: str) -> asyncio.Task:
+        task = asyncio.create_task(coro, name=name)
+        self._tasks.add(task)
+        task.add_done_callback(self._tasks.discard)
+        return task
+
+    async def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        if self._loop_task:
+            self._loop_task.cancel()
+        if self.on_close is not None:
+            try:
+                self.on_close(self)
+            except Exception:
+                pass
+        try:
+            self.writer.close()
+            await self.writer.wait_closed()
+        except Exception:
+            pass
+        err = make_error(StatusCode.RPC_SEND_FAILED, f"connection {self.name} closed")
+        for fut in self._waiters.values():
+            if not fut.done():
+                fut.set_exception(err)
+        self._waiters.clear()
+
+    async def _send_frame(self, packet: MessagePacket, payload: bytes, flags: int) -> None:
+        msg = serde.dumps(packet)
+        async with self._send_lock:
+            if self._closed:
+                raise make_error(StatusCode.RPC_SEND_FAILED, "connection closed")
+            self.writer.write(pack_header(len(msg), len(payload), flags))
+            self.writer.write(msg)
+            if payload:
+                self.writer.write(payload)
+            await self.writer.drain()
+
+    async def call(self, method: str, body: object = None, payload: bytes = b"",
+                   timeout: float = 30.0) -> tuple[object, bytes]:
+        """Issue a request, await the typed response (+ raw payload).
+        Raises StatusError on non-OK response or transport failure."""
+        uuid = next(self._uuid_counter)
+        packet = MessagePacket(uuid=uuid, method=method, is_req=True).stamp_called()
+        packet.body = body
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._waiters[uuid] = fut
+        try:
+            await self._send_frame(packet, payload, FLAG_IS_REQ)
+            try:
+                rsp, rsp_payload = await asyncio.wait_for(fut, timeout)
+            except asyncio.TimeoutError:
+                raise make_error(StatusCode.RPC_TIMEOUT,
+                                 f"{method} timed out after {timeout}s") from None
+            status = rsp.status.to_status()
+            status.raise_if_error()
+            return rsp.body, rsp_payload
+        finally:
+            self._waiters.pop(uuid, None)
+
+    async def _read_loop(self) -> None:
+        try:
+            while True:
+                head = await self.reader.readexactly(HEADER_SIZE)
+                msg_len, payload_len, flags = unpack_header(head)
+                msg = await self.reader.readexactly(msg_len) if msg_len else b""
+                payload = await self.reader.readexactly(payload_len) if payload_len else b""
+                packet = serde.loads(msg)
+                if packet.is_req:
+                    self._spawn(self._handle_request(packet, payload),
+                                f"req-{packet.method}")
+                else:
+                    fut = self._waiters.get(packet.uuid)
+                    if fut is not None and not fut.done():
+                        fut.set_result((packet, payload))
+        except (asyncio.IncompleteReadError, ConnectionResetError, BrokenPipeError):
+            pass
+        except asyncio.CancelledError:
+            raise
+        except FrameError as e:
+            log.warning("conn %s: frame error: %s", self.name, e)
+        except Exception:
+            log.exception("conn %s: read loop died", self.name)
+        finally:
+            if not self._closed:
+                self._spawn(self.close(), f"close-{self.name}")
+
+    async def _handle_request(self, packet: MessagePacket, payload: bytes) -> None:
+        rsp = MessagePacket(uuid=packet.uuid, method=packet.method, is_req=False)
+        rsp.ts_server_received = time.time()
+        rsp_payload = b""
+        handler = self.dispatcher.get(packet.method)
+        try:
+            if handler is None:
+                raise make_error(StatusCode.RPC_METHOD_NOT_FOUND, packet.method)
+            rsp.body, rsp_payload = await handler(packet.body, payload, self)
+        except StatusError as e:
+            rsp.status = WireStatus.from_status(e.status)
+        except Exception as e:
+            log.exception("handler %s failed", packet.method)
+            rsp.status = WireStatus(int(StatusCode.INTERNAL), f"{type(e).__name__}: {e}")
+        rsp.ts_server_replied = time.time()
+        try:
+            await self._send_frame(rsp, rsp_payload, 0)
+        except Exception:
+            pass  # peer gone; response dropped like a lost ack

@@ -1,0 +1,96 @@
+"""RemoteBuf: registered-buffer indirection + one-sided transfer emulation.
+
+Reference analogs: common/net/ib/RDMABuf.h (pooled registered memory,
+RDMARemoteBuf (addr,rkey) serde handle), IBSocket::rdmaRead/rdmaWrite
+batched one-sided verbs (IBSocket.h:81-180).
+
+Over TCP the "one-sided" ops become reverse-direction RPCs on the duplex
+connection: a server holding a RemoteBuf handle calls Buf.read / Buf.write
+back at the peer that registered it.  The handle shape (id, offset, length)
+is kept serde-serializable so a real verbs/EFA backend can replace the
+emulation without touching callers — same seam the reference keeps between
+IBSocket and TcpSocket.
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass
+
+from t3fs.net.server import rpc_method, service
+from t3fs.utils.serde import serde_struct
+from t3fs.utils.status import StatusCode, make_error
+
+
+@serde_struct
+@dataclass
+class RemoteBuf:
+    """Serializable handle to a peer-registered buffer region."""
+    buf_id: int = 0
+    offset: int = 0
+    length: int = 0
+
+    def slice(self, off: int, length: int) -> "RemoteBuf":
+        if off < 0 or length < 0 or off + length > self.length:
+            raise make_error(StatusCode.INVALID_ARG, "RemoteBuf slice out of range")
+        return RemoteBuf(self.buf_id, self.offset + off, length)
+
+
+@service("Buf")
+class BufferRegistry:
+    """Per-process registry of registered buffers; exposes the Buf service
+    that peers use to emulate one-sided access."""
+
+    def __init__(self):
+        self._bufs: dict[int, bytearray] = {}
+        self._ids = itertools.count(1)
+
+    def register(self, size_or_data: int | bytes | bytearray) -> RemoteBuf:
+        buf = bytearray(size_or_data)  # int -> zeroed buffer, bytes -> copy
+        buf_id = next(self._ids)
+        self._bufs[buf_id] = buf
+        return RemoteBuf(buf_id, 0, len(buf))
+
+    def deregister(self, handle: RemoteBuf) -> None:
+        self._bufs.pop(handle.buf_id, None)
+
+    def local_view(self, handle: RemoteBuf) -> memoryview:
+        buf = self._bufs.get(handle.buf_id)
+        if buf is None:
+            raise make_error(StatusCode.NOT_FOUND, f"buf {handle.buf_id} not registered")
+        if (handle.offset < 0 or handle.length < 0
+                or handle.offset + handle.length > len(buf)):
+            raise make_error(StatusCode.INVALID_ARG,
+                             f"buf {handle.buf_id}: region [{handle.offset}, "
+                             f"+{handle.length}) outside {len(buf)}B buffer")
+        return memoryview(buf)[handle.offset: handle.offset + handle.length]
+
+    # --- Buf service (called by the remote peer over the duplex conn) ---
+
+    @rpc_method
+    async def read(self, body: RemoteBuf, payload: bytes, conn):
+        """Peer pulls bytes from our registered buffer (RDMA READ analog)."""
+        return None, bytes(self.local_view(body))
+
+    @rpc_method
+    async def write(self, body: RemoteBuf, payload: bytes, conn):
+        """Peer pushes bytes into our registered buffer (RDMA WRITE analog)."""
+        view = self.local_view(body)
+        if len(payload) != len(view):
+            raise make_error(StatusCode.INVALID_ARG,
+                             f"payload {len(payload)} != region {len(view)}")
+        view[:] = payload
+        return None, b""
+
+
+async def remote_read(conn, handle: RemoteBuf, timeout: float = 30.0) -> bytes:
+    """Pull the bytes behind a peer's RemoteBuf (server-side doUpdate analog,
+    StorageOperator.cc:560-591)."""
+    _, payload = await conn.call("Buf.read", handle, timeout=timeout)
+    return payload
+
+
+async def remote_write(conn, handle: RemoteBuf, data: bytes, timeout: float = 30.0) -> None:
+    """Push bytes into a peer's RemoteBuf (batchRead result delivery analog,
+    StorageOperator.cc:178-226)."""
+    await conn.call("Buf.write", handle, payload=data, timeout=timeout)

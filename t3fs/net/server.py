@@ -1,0 +1,82 @@
+"""RPC server: service registry + listener.
+
+Reference analogs: common/net/Server.h:19-41, ServiceGroup.h:20-38 (services
+registered on a server), Processor dispatch.  Services are classes whose
+@rpc_method coroutines take (req_body, payload, conn) and return
+(rsp_body, rsp_payload).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Any
+
+from t3fs.net.conn import Connection, Handler
+
+log = logging.getLogger("t3fs.net")
+
+
+def rpc_method(fn):
+    """Mark a coroutine method as RPC-exposed."""
+    fn.__rpc_method__ = True
+    return fn
+
+
+def service(name: str):
+    """Class decorator: set the wire service name."""
+    def deco(cls):
+        cls.__service_name__ = name
+        return cls
+    return deco
+
+
+def build_dispatcher(*services: Any) -> dict[str, Handler]:
+    """Collect {Service.method: bound coroutine} from service objects."""
+    table: dict[str, Handler] = {}
+    for svc in services:
+        sname = getattr(type(svc), "__service_name__", type(svc).__name__)
+        for attr in dir(svc):
+            fn = getattr(svc, attr)
+            if callable(fn) and getattr(fn, "__rpc_method__", False):
+                table[f"{sname}.{attr}"] = fn
+    return table
+
+
+class Server:
+    """Asyncio TCP server hosting a set of serde services."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.host = host
+        self.port = port
+        self.dispatcher: dict[str, Handler] = {}
+        self._server: asyncio.AbstractServer | None = None
+        self._conns: set[Connection] = set()
+
+    def add_service(self, svc: Any) -> None:
+        self.dispatcher.update(build_dispatcher(svc))
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(self._on_conn, self.host, self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        log.info("server listening on %s:%d (%d methods)",
+                 self.host, self.port, len(self.dispatcher))
+
+    async def _on_conn(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        peer = writer.get_extra_info("peername")
+        conn = Connection(reader, writer, self.dispatcher, name=f"srv<-{peer}",
+                          on_close=self._conns.discard)
+        self._conns.add(conn)
+        conn.start()
+
+    async def stop(self) -> None:
+        if self._server:
+            self._server.close()
+            await self._server.wait_closed()
+        for conn in list(self._conns):
+            await conn.close()
+        self._conns.clear()
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"

@@ -1,0 +1,79 @@
+"""Wire framing: MessageHeader + MessagePacket envelope.
+
+Reference analogs: common/net/MessageHeader.h:13-33 (CRC-magic framing) and
+common/serde/MessagePacket.h:12-63 (uuid, flags, version, timestamps).
+"""
+
+from __future__ import annotations
+
+import struct
+import time
+from dataclasses import dataclass, field
+
+from t3fs.ops.crc32c import crc32c_ref
+from t3fs.utils.serde import serde_struct
+from t3fs.utils.status import Status, StatusCode
+
+MAGIC = 0x74336673  # "t3fs"
+HEADER_FMT = "<IIIII"  # magic, msg_len, payload_len, flags, header_crc
+HEADER_SIZE = struct.calcsize(HEADER_FMT)
+
+FLAG_IS_REQ = 1 << 0
+FLAG_COMPRESS = 1 << 1
+FLAG_CONTROL = 1 << 2
+
+MAX_FRAME = 512 << 20  # hard cap against corrupt length fields
+
+
+class FrameError(Exception):
+    pass
+
+
+def pack_header(msg_len: int, payload_len: int, flags: int) -> bytes:
+    head = struct.pack("<IIII", MAGIC, msg_len, payload_len, flags)
+    crc = crc32c_ref(head)
+    return head + struct.pack("<I", crc)
+
+
+def unpack_header(data: bytes) -> tuple[int, int, int]:
+    magic, msg_len, payload_len, flags, crc = struct.unpack(HEADER_FMT, data)
+    if magic != MAGIC:
+        raise FrameError(f"bad magic {magic:#x}")
+    if crc != crc32c_ref(data[:16]):
+        raise FrameError("header crc mismatch")
+    if msg_len > MAX_FRAME or payload_len > MAX_FRAME:
+        raise FrameError(f"oversized frame {msg_len}/{payload_len}")
+    return msg_len, payload_len, flags
+
+
+@serde_struct
+@dataclass
+class WireStatus:
+    code: int = int(StatusCode.OK)
+    message: str = ""
+
+    @classmethod
+    def from_status(cls, s: Status) -> "WireStatus":
+        return cls(int(s.code), s.message)
+
+    def to_status(self) -> Status:
+        return Status(StatusCode(self.code), self.message)
+
+
+@serde_struct
+@dataclass
+class MessagePacket:
+    """RPC envelope: req (method set) or rsp (status set), + serde body."""
+    uuid: int = 0
+    method: str = ""              # "Service.method" on requests
+    is_req: bool = True
+    status: WireStatus = field(default_factory=WireStatus)
+    version: int = 1
+    ts_client_called: float = 0.0
+    ts_server_received: float = 0.0
+    ts_server_replied: float = 0.0
+    body: object = None           # registered serde struct (or None)
+
+    def stamp_called(self) -> "MessagePacket":
+        self.ts_client_called = time.time()
+        return self

@@ -1,0 +1,3 @@
+"""Foundations: status/result error model, TOML config with hot update,
+metric recorders, serde, fault injection (reference: src/common/utils/,
+src/common/serde/, src/common/monitor/ — SURVEY.md §2.1)."""

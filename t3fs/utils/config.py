@@ -1,0 +1,132 @@
+"""Declarative config with TOML load, validation, and hot update.
+
+Mirrors the reference's ConfigBase reflection macros (CONFIG_ITEM /
+CONFIG_HOT_UPDATED_ITEM / CONFIG_OBJ, common/utils/ConfigBase.h:44-116):
+configs are dataclasses whose fields carry `hot` and `validator` metadata;
+`update()` applies a dict of dotted-key overrides, enforcing hot-update
+rules, and returns what changed so services can react (onConfigUpdated).
+"""
+
+from __future__ import annotations
+
+import tomllib
+from dataclasses import dataclass, field, fields, is_dataclass
+from typing import Any, Callable
+
+
+def citem(default: Any = None, *, hot: bool = True,
+          validator: Callable[[Any], bool] | None = None,
+          factory: Callable[[], Any] | None = None):
+    """Declare a config item (CONFIG_ITEM / CONFIG_HOT_UPDATED_ITEM analog)."""
+    meta = {"hot": hot, "validator": validator}
+    if factory is not None:
+        return field(default_factory=factory, metadata=meta)
+    return field(default=default, metadata=meta)
+
+
+def cobj(cls: type, **overrides):
+    """Declare a nested config object (CONFIG_OBJ analog)."""
+    if overrides:
+        return field(default_factory=lambda: cls(**overrides), metadata={"hot": True})
+    return field(default_factory=cls, metadata={"hot": True})
+
+
+class ConfigError(ValueError):
+    pass
+
+
+@dataclass
+class ConfigBase:
+    """Base for all config dataclasses."""
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ConfigBase":
+        kwargs = {}
+        known = {f.name: f for f in fields(cls)}
+        for key, val in d.items():
+            if key not in known:
+                raise ConfigError(f"{cls.__name__}: unknown config key {key!r}")
+            ftype = known[key].type
+            sub = _resolve_nested(cls, key)
+            if sub is not None and isinstance(val, dict):
+                kwargs[key] = sub.from_dict(val)
+            else:
+                kwargs[key] = val
+        cfg = cls(**kwargs)
+        cfg.validate()
+        return cfg
+
+    @classmethod
+    def from_toml(cls, text_or_path: str) -> "ConfigBase":
+        if "\n" not in text_or_path and text_or_path.endswith(".toml"):
+            with open(text_or_path, "rb") as f:
+                d = tomllib.load(f)
+        else:
+            d = tomllib.loads(text_or_path)
+        return cls.from_dict(d)
+
+    def to_dict(self) -> dict:
+        out = {}
+        for f in fields(self):
+            v = getattr(self, f.name)
+            out[f.name] = v.to_dict() if isinstance(v, ConfigBase) else v
+        return out
+
+    def validate(self) -> None:
+        for f in fields(self):
+            v = getattr(self, f.name)
+            if isinstance(v, ConfigBase):
+                v.validate()
+                continue
+            validator = f.metadata.get("validator") if f.metadata else None
+            if validator is not None and not validator(v):
+                raise ConfigError(f"{type(self).__name__}.{f.name}: invalid value {v!r}")
+
+    def update(self, overrides: dict, *, hot_only: bool = True, _prefix: str = "") -> list[str]:
+        """Apply {dotted.key: value} or nested-dict overrides.  With hot_only,
+        refuses to change items declared hot=False (reference semantics:
+        non-hot items need a restart).  Returns dotted names that changed."""
+        changed: list[str] = []
+        # normalize dotted keys into nested dicts
+        nested: dict = {}
+        for k, v in overrides.items():
+            parts = k.split(".")
+            cur = nested
+            for p in parts[:-1]:
+                cur = cur.setdefault(p, {})
+            if isinstance(v, dict) and isinstance(cur.get(parts[-1]), dict):
+                cur[parts[-1]].update(v)
+            else:
+                cur[parts[-1]] = v
+        known = {f.name: f for f in fields(self)}
+        for key, val in nested.items():
+            if key not in known:
+                raise ConfigError(f"{type(self).__name__}: unknown config key {key!r}")
+            f = known[key]
+            cur = getattr(self, key)
+            dotted = f"{_prefix}{key}"
+            if isinstance(cur, ConfigBase):
+                if not isinstance(val, dict):
+                    raise ConfigError(f"{dotted}: expected table, got {val!r}")
+                changed += cur.update(val, hot_only=hot_only, _prefix=dotted + ".")
+                continue
+            if cur == val:
+                continue
+            if hot_only and not (f.metadata or {}).get("hot", True):
+                raise ConfigError(f"{dotted}: not hot-updatable (requires restart)")
+            validator = (f.metadata or {}).get("validator")
+            if validator is not None and not validator(val):
+                raise ConfigError(f"{dotted}: invalid value {val!r}")
+            setattr(self, key, val)
+            changed.append(dotted)
+        return changed
+
+
+def _resolve_nested(cls: type, key: str) -> type | None:
+    """Return the nested ConfigBase subclass type for field `key`, if any."""
+    import typing
+    hints = typing.get_type_hints(cls)
+    t = hints.get(key)
+    if isinstance(t, type) and is_dataclass(t) and issubclass(t, ConfigBase):
+        return t
+    return None

@@ -1,0 +1,203 @@
+"""Reflection serde: compact self-describing binary for registered dataclasses.
+
+Mirrors the reference's serde layer (common/serde/Serde.h SERDE_STRUCT_FIELD):
+message structs are plain dataclasses registered with @serde_struct; encoding
+is a compact tagged binary (varints, length-prefixed bytes/str, lists, maps,
+typed structs by registered name).  Decode reconstructs the registered class
+and coerces enum/nested fields from type hints.
+
+Bulk data (chunk payloads) does NOT travel through serde — it rides the
+transport's out-of-band buffer path (net/transport.py), like the reference's
+RDMA bufs vs serde messages split.
+"""
+
+from __future__ import annotations
+
+import enum
+import io
+import struct
+import typing
+from dataclasses import fields, is_dataclass
+
+_registry: dict[str, type] = {}
+_hints_cache: dict[type, dict[str, object]] = {}
+
+
+def serde_struct(cls):
+    """Register a dataclass for typed wire encoding."""
+    assert is_dataclass(cls), f"{cls} must be a dataclass"
+    _registry[cls.__name__] = cls
+    return cls
+
+
+# --- tags ---
+T_NONE, T_FALSE, T_TRUE, T_INT, T_NEGINT, T_FLOAT = 0, 1, 2, 3, 4, 5
+T_BYTES, T_STR, T_LIST, T_MAP, T_STRUCT = 6, 7, 8, 9, 10
+
+
+def _write_varint(w: io.BytesIO, v: int) -> None:
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            w.write(bytes([b | 0x80]))
+        else:
+            w.write(bytes([b]))
+            return
+
+
+def _read_varint(r: io.BytesIO) -> int:
+    shift = 0
+    out = 0
+    while True:
+        byte = r.read(1)
+        if not byte:
+            raise ValueError("serde: truncated varint")
+        b = byte[0]
+        out |= (b & 0x7F) << shift
+        if not (b & 0x80):
+            return out
+        shift += 7
+
+
+def _encode(w: io.BytesIO, obj) -> None:
+    if obj is None:
+        w.write(bytes([T_NONE]))
+    elif obj is False:
+        w.write(bytes([T_FALSE]))
+    elif obj is True:
+        w.write(bytes([T_TRUE]))
+    elif isinstance(obj, enum.Enum):
+        _encode(w, obj.value)
+    elif isinstance(obj, int):
+        if obj >= 0:
+            w.write(bytes([T_INT]))
+            _write_varint(w, obj)
+        else:
+            w.write(bytes([T_NEGINT]))
+            _write_varint(w, -obj - 1)
+    elif isinstance(obj, float):
+        w.write(bytes([T_FLOAT]))
+        w.write(struct.pack("<d", obj))
+    elif isinstance(obj, (bytes, bytearray, memoryview)):
+        b = bytes(obj)
+        w.write(bytes([T_BYTES]))
+        _write_varint(w, len(b))
+        w.write(b)
+    elif isinstance(obj, str):
+        b = obj.encode("utf-8")
+        w.write(bytes([T_STR]))
+        _write_varint(w, len(b))
+        w.write(b)
+    elif isinstance(obj, (list, tuple)):
+        w.write(bytes([T_LIST]))
+        _write_varint(w, len(obj))
+        for x in obj:
+            _encode(w, x)
+    elif isinstance(obj, dict):
+        w.write(bytes([T_MAP]))
+        _write_varint(w, len(obj))
+        for k, v in obj.items():
+            _encode(w, k)
+            _encode(w, v)
+    elif is_dataclass(obj):
+        name = type(obj).__name__
+        if name not in _registry:
+            raise TypeError(f"serde: {name} not registered (@serde_struct)")
+        w.write(bytes([T_STRUCT]))
+        nb = name.encode()
+        _write_varint(w, len(nb))
+        w.write(nb)
+        fs = fields(obj)
+        _write_varint(w, len(fs))
+        for f in fs:
+            _encode(w, getattr(obj, f.name))
+    else:
+        raise TypeError(f"serde: cannot encode {type(obj)}")
+
+
+def _coerce(value, hint):
+    """Best-effort coercion of decoded primitives into hinted types."""
+    if hint is None or value is None:
+        return value
+    origin = typing.get_origin(hint)
+    if origin is typing.Union or str(origin) == "types.UnionType":
+        args = [a for a in typing.get_args(hint) if a is not type(None)]
+        return _coerce(value, args[0]) if len(args) == 1 else value
+    if isinstance(hint, type) and issubclass(hint, enum.Enum) and not isinstance(value, hint):
+        return hint(value)
+    if origin in (list, tuple) and isinstance(value, list):
+        args = typing.get_args(hint)
+        elem = args[0] if args else None
+        coerced = [_coerce(x, elem) for x in value]
+        return tuple(coerced) if origin is tuple else coerced
+    if origin is dict and isinstance(value, dict):
+        kt, vt = (typing.get_args(hint) + (None, None))[:2]
+        return {_coerce(k, kt): _coerce(v, vt) for k, v in value.items()}
+    return value
+
+
+def _type_hints(cls: type) -> dict[str, object]:
+    h = _hints_cache.get(cls)
+    if h is None:
+        h = _hints_cache[cls] = typing.get_type_hints(cls)
+    return h
+
+
+def _decode(r: io.BytesIO):
+    tag_b = r.read(1)
+    if not tag_b:
+        raise ValueError("serde: truncated input")
+    tag = tag_b[0]
+    if tag == T_NONE:
+        return None
+    if tag == T_FALSE:
+        return False
+    if tag == T_TRUE:
+        return True
+    if tag == T_INT:
+        return _read_varint(r)
+    if tag == T_NEGINT:
+        return -_read_varint(r) - 1
+    if tag == T_FLOAT:
+        return struct.unpack("<d", r.read(8))[0]
+    if tag == T_BYTES:
+        n = _read_varint(r)
+        return r.read(n)
+    if tag == T_STR:
+        n = _read_varint(r)
+        return r.read(n).decode("utf-8")
+    if tag == T_LIST:
+        n = _read_varint(r)
+        return [_decode(r) for _ in range(n)]
+    if tag == T_MAP:
+        n = _read_varint(r)
+        return {_decode(r): _decode(r) for _ in range(n)}
+    if tag == T_STRUCT:
+        nlen = _read_varint(r)
+        name = r.read(nlen).decode()
+        cls = _registry.get(name)
+        if cls is None:
+            raise ValueError(f"serde: unknown struct {name!r}")
+        nfields = _read_varint(r)
+        fs = fields(cls)
+        hints = _type_hints(cls)
+        # forward/backward compat: extra fields dropped, missing use defaults
+        kwargs = {}
+        for i in range(nfields):
+            v = _decode(r)
+            if i < len(fs):
+                f = fs[i]
+                kwargs[f.name] = _coerce(v, hints.get(f.name))
+        return cls(**kwargs)
+    raise ValueError(f"serde: bad tag {tag}")
+
+
+def dumps(obj) -> bytes:
+    w = io.BytesIO()
+    _encode(w, obj)
+    return w.getvalue()
+
+
+def loads(data: bytes | memoryview):
+    return _decode(io.BytesIO(bytes(data)))

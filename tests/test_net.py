@@ -1,0 +1,147 @@
+"""Net layer: echo service, typed bodies, errors, timeouts, duplex RemoteBuf
+emulation (reference analogs: tests/common/net/TestEcho.cc, TestProcessor.cc,
+tests/common/net/ib/TestRDMA.cc)."""
+
+import asyncio
+from dataclasses import dataclass
+
+import pytest
+
+from t3fs.net import Server, Client, rpc_method, service
+from t3fs.net.rdma import BufferRegistry, RemoteBuf, remote_read, remote_write
+from t3fs.utils.serde import serde_struct
+from t3fs.utils.status import StatusCode, StatusError, make_error
+
+
+@serde_struct
+@dataclass
+class EchoReq:
+    text: str = ""
+    n: int = 0
+
+
+@serde_struct
+@dataclass
+class EchoRsp:
+    text: str = ""
+    n: int = 0
+
+
+@service("Echo")
+class EchoService:
+    @rpc_method
+    async def echo(self, body: EchoReq, payload: bytes, conn):
+        return EchoRsp(text=body.text, n=body.n + 1), payload
+
+    @rpc_method
+    async def fail(self, body, payload, conn):
+        raise make_error(StatusCode.CHUNK_NOT_FOUND, "nope")
+
+    @rpc_method
+    async def slow(self, body, payload, conn):
+        await asyncio.sleep(5)
+        return None, b""
+
+    @rpc_method
+    async def pull(self, body: RemoteBuf, payload: bytes, conn):
+        """Server-side one-sided READ of the client's registered buffer."""
+        data = await remote_read(conn, body)
+        return EchoRsp(n=len(data)), data.upper()
+
+
+@pytest.fixture
+def loop_run():
+    def run(coro):
+        return asyncio.run(coro)
+    return run
+
+
+async def _with_cluster(fn):
+    server = Server()
+    server.add_service(EchoService())
+    await server.start()
+    client = Client()
+    try:
+        await fn(server, client)
+    finally:
+        await client.close()
+        await server.stop()
+
+
+def test_echo_roundtrip(loop_run):
+    async def body(server, client):
+        rsp, payload = await client.call(server.address, "Echo.echo",
+                                         EchoReq(text="hi", n=41), payload=b"bulk")
+        assert rsp.text == "hi" and rsp.n == 42 and payload == b"bulk"
+        # concurrent calls multiplex one connection
+        rsps = await asyncio.gather(*[
+            client.call(server.address, "Echo.echo", EchoReq(n=i)) for i in range(20)])
+        assert sorted(r[0].n for r in rsps) == list(range(1, 21))
+    loop_run(_with_cluster(body))
+
+
+def test_error_propagation(loop_run):
+    async def body(server, client):
+        with pytest.raises(StatusError) as ei:
+            await client.call(server.address, "Echo.fail")
+        assert ei.value.code == StatusCode.CHUNK_NOT_FOUND
+        with pytest.raises(StatusError) as ei:
+            await client.call(server.address, "Echo.nosuch")
+        assert ei.value.code == StatusCode.RPC_METHOD_NOT_FOUND
+    loop_run(_with_cluster(body))
+
+
+def test_timeout(loop_run):
+    async def body(server, client):
+        with pytest.raises(StatusError) as ei:
+            await client.call(server.address, "Echo.slow", timeout=0.1)
+        assert ei.value.code == StatusCode.RPC_TIMEOUT
+    loop_run(_with_cluster(body))
+
+
+def test_connect_failure(loop_run):
+    async def body():
+        client = Client(connect_timeout=0.5)
+        with pytest.raises(StatusError) as ei:
+            await client.call("127.0.0.1:1", "Echo.echo")
+        assert ei.value.code == StatusCode.RPC_CONNECT_FAILED
+    loop_run(body())
+
+
+def test_remote_buf_duplex(loop_run):
+    """Client registers a buffer; server pulls it (RDMA READ emulation) and
+    the response returns transformed payload; then server-side write-back."""
+    async def body(server, client):
+        bufs = BufferRegistry()
+        client.add_service(bufs)
+        handle = bufs.register(b"hello one-sided world")
+        rsp, payload = await client.call(server.address, "Echo.pull", handle)
+        assert rsp.n == len("hello one-sided world")
+        assert payload == b"HELLO ONE-SIDED WORLD"
+    loop_run(_with_cluster(body))
+
+
+def test_remote_buf_write_back(loop_run):
+    """Server pushes into a client-registered buffer (RDMA WRITE emulation)."""
+    @service("Pusher")
+    class Pusher:
+        @rpc_method
+        async def push(self, body: RemoteBuf, payload: bytes, conn):
+            await remote_write(conn, body, b"X" * body.length)
+            return None, b""
+
+    async def body():
+        server = Server()
+        server.add_service(Pusher())
+        await server.start()
+        client = Client()
+        bufs = BufferRegistry()
+        client.add_service(bufs)
+        try:
+            handle = bufs.register(8)
+            await client.call(server.address, "Pusher.push", handle)
+            assert bytes(bufs.local_view(handle)) == b"X" * 8
+        finally:
+            await client.close()
+            await server.stop()
+    loop_run(body())

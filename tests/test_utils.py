@@ -1,0 +1,145 @@
+"""Foundations: status, config (TOML + hot update), serde round-trip, metrics,
+fault injection (reference test analogs: tests/common/utils/, tests/common/serde/)."""
+
+import enum
+from dataclasses import dataclass, field
+
+import pytest
+
+from t3fs.utils.status import Status, StatusCode, StatusError, make_error, OK
+from t3fs.utils.config import ConfigBase, ConfigError, citem, cobj
+from t3fs.utils import serde
+from t3fs.utils.serde import serde_struct
+from t3fs.utils.metrics import (
+    CountRecorder, LatencyRecorder, ValueRecorder, Collector, reset_registry,
+)
+from t3fs.utils.fault_injection import enable_injection, fault_point, DebugFlags
+
+
+# --- status ---
+
+def test_status_basics():
+    assert OK.ok
+    s = Status(StatusCode.CHUNK_NOT_FOUND, "gone")
+    assert not s.ok and not s.retryable
+    assert Status(StatusCode.TIMEOUT).retryable
+    with pytest.raises(StatusError) as ei:
+        s.raise_if_error()
+    assert ei.value.code == StatusCode.CHUNK_NOT_FOUND
+
+
+# --- config ---
+
+@dataclass
+class NetCfg(ConfigBase):
+    port: int = citem(8000, hot=False)
+    timeout_s: float = citem(5.0, validator=lambda v: v > 0)
+
+
+@dataclass
+class AppCfg(ConfigBase):
+    name: str = citem("node")
+    net: NetCfg = cobj(NetCfg)
+
+
+def test_config_from_toml_and_update():
+    cfg = AppCfg.from_toml("""
+name = "storage1"
+[net]
+port = 9000
+timeout_s = 2.5
+""")
+    assert cfg.net.port == 9000 and cfg.net.timeout_s == 2.5
+    changed = cfg.update({"net.timeout_s": 4.0, "name": "x"})
+    assert sorted(changed) == ["name", "net.timeout_s"]
+    with pytest.raises(ConfigError):
+        cfg.update({"net.port": 1})  # not hot
+    cfg.update({"net.port": 1}, hot_only=False)
+    assert cfg.net.port == 1
+    with pytest.raises(ConfigError):
+        cfg.update({"net.timeout_s": -1})  # validator
+    with pytest.raises(ConfigError):
+        AppCfg.from_toml("unknown_key = 1")
+
+
+# --- serde ---
+
+class Color(enum.IntEnum):
+    RED = 1
+    BLUE = 2
+
+
+@serde_struct
+@dataclass
+class Inner:
+    x: int = 0
+    tag: Color = Color.RED
+
+
+@serde_struct
+@dataclass
+class Outer:
+    name: str = ""
+    blob: bytes = b""
+    items: list[int] = field(default_factory=list)
+    inner: Inner = field(default_factory=Inner)
+    maybe: int | None = None
+    status: Status | None = None
+
+
+def test_serde_roundtrip():
+    # Status isn't a serde struct; keep wire payloads to registered types
+    o = Outer(name="hello", blob=b"\x00\xff", items=[1, -5, 1 << 40],
+              inner=Inner(x=-7, tag=Color.BLUE), maybe=3)
+    b = serde.dumps(o)
+    o2 = serde.loads(b)
+    assert o2.name == "hello" and o2.blob == b"\x00\xff"
+    assert o2.items == [1, -5, 1 << 40]
+    assert o2.inner.tag is Color.BLUE and isinstance(o2.inner.tag, Color)
+    assert o2.maybe == 3
+
+
+def test_serde_primitives():
+    for v in (None, True, False, 0, -1, 12345678901234567890, 3.5, "é", b"raw",
+              [1, [2, "x"]], {"a": 1, 2: b"b"}):
+        assert serde.loads(serde.dumps(v)) == v
+
+
+def test_serde_unregistered_raises():
+    @dataclass
+    class Nope:
+        x: int = 0
+    with pytest.raises(TypeError):
+        serde.dumps(Nope())
+
+
+# --- metrics ---
+
+def test_metrics_recorders():
+    reset_registry()
+    c = CountRecorder("reqs", {"svc": "storage"})
+    c.add(3)
+    lat = LatencyRecorder("op_latency")
+    with lat.time():
+        pass
+    g = ValueRecorder("queue_depth")
+    g.set(7)
+    rows = Collector(reporters=[]).collect_once()
+    byname = {r["name"]: r for r in rows}
+    assert byname["reqs"]["value"] == 3 and byname["reqs"]["svc"] == "storage"
+    assert byname["op_latency"]["count"] == 1
+    assert byname["queue_depth"]["value"] == 7
+    # counts reset after collect
+    assert Collector(reporters=[]).collect_once()[0]["value"] == 0
+
+
+# --- fault injection ---
+
+def test_fault_injection():
+    assert not fault_point("never")  # disabled by default
+    with enable_injection(1.0, max_count=2):
+        assert fault_point("a") and fault_point("b") and not fault_point("c")
+    with enable_injection(0.0):
+        assert not fault_point("a")
+    d = DebugFlags(inject_server_error_prob=1.0)
+    assert serde.loads(serde.dumps(d)).inject_server_error_prob == 1.0
