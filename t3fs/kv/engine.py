@@ -135,15 +135,16 @@ class MemKVEngine(KVEngine):
             return None
 
     def _range_at(self, begin: bytes, end: bytes, version: int) -> list[tuple[bytes, bytes]]:
-        with self._lock:
+        out = []
+        with self._lock:  # one pass under one acquisition
             lo = bisect.bisect_left(self._sorted_keys, begin)
             hi = bisect.bisect_left(self._sorted_keys, end)
-            keys = self._sorted_keys[lo:hi]
-        out = []
-        for k in keys:
-            v = self._get_at(k, version)
-            if v is not None:
-                out.append((k, v))
+            for k in self._sorted_keys[lo:hi]:
+                for ver, val in reversed(self._data.get(k, ())):
+                    if ver <= version:
+                        if val is not None:
+                            out.append((k, val))
+                        break
         return out
 
     def _latest_write_version(self, key: bytes) -> int:

@@ -1,0 +1,2 @@
+"""Cluster manager: routing info, chains, heartbeat/lease, chain state
+machine (reference: src/mgmtd/ — SURVEY.md §2.4)."""

@@ -88,11 +88,15 @@ class Connection:
         async with self._send_lock:
             if self._closed:
                 raise make_error(StatusCode.RPC_SEND_FAILED, "connection closed")
-            self.writer.write(pack_header(len(msg), len(payload), flags))
-            self.writer.write(msg)
-            if payload:
-                self.writer.write(payload)
-            await self.writer.drain()
+            try:
+                self.writer.write(pack_header(len(msg), len(payload), flags))
+                self.writer.write(msg)
+                if payload:
+                    self.writer.write(payload)
+                await self.writer.drain()
+            except (OSError, asyncio.IncompleteReadError) as e:
+                raise make_error(StatusCode.RPC_SEND_FAILED,
+                                 f"send on {self.name}: {e}") from None
 
     async def call(self, method: str, body: object = None, payload: bytes = b"",
                    timeout: float = 30.0) -> tuple[object, bytes]:

@@ -1,0 +1,2 @@
+"""Storage node: chunk engine + CRAQ storage service (reference:
+src/storage/ + src/storage/chunk_engine/ — SURVEY.md §2.3)."""

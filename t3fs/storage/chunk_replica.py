@@ -1,0 +1,156 @@
+"""ChunkReplica: the CRAQ chunk state machine over the chunk engine.
+
+Reference analog: storage/store/ChunkReplica.cc — update version gating
+(:132-241: committed/stale/missing/advance cases), client-checksum verify
+(:193-206), updateChecksum combine-or-recompute (:319-360), commit (:30 in
+ChunkReplica.h), read rules (aioPrepareRead :38-130; committed-only serving,
+docs/design_notes.md:169-173).
+
+Version semantics:
+  commit_ver — highest committed update
+  update_ver — highest applied update (== commit_ver when COMMIT, commit_ver+1
+               when DIRTY: exactly one update may be pending per chunk because
+               the head serializes per-chunk under a lock)
+"""
+
+from __future__ import annotations
+
+from t3fs.ops.crc32c import crc32c_ref, crc32c_combine_ref
+from t3fs.storage.chunk_engine import ChunkEngine
+from t3fs.storage.types import (
+    ChunkId, ChunkMeta, ChunkState, IOResult, ReadIO, UpdateIO, UpdateType,
+)
+from t3fs.net.wire import WireStatus
+from t3fs.utils.status import Status, StatusCode, StatusError, make_error
+
+# pluggable CRC impl (the codec seam; default scalar reference — the storage
+# service swaps in the batched TPU codec via t3fs.ops.codec)
+CrcFn = type(crc32c_ref)
+
+
+class ChunkReplica:
+    def __init__(self, engine: ChunkEngine, crc=crc32c_ref, crc_combine=crc32c_combine_ref):
+        self.engine = engine
+        self.crc = crc
+        self.crc_combine = crc_combine
+
+    # --- update path ---
+
+    def apply_update(self, io: UpdateIO, payload: bytes) -> IOResult:
+        """Apply one update as DIRTY; raises StatusError on gating violations.
+        Idempotent for the retry of the currently-pending update."""
+        meta = self.engine.get_meta(io.chunk_id)
+
+        if io.update_type == UpdateType.REMOVE:
+            self.engine.remove(io.chunk_id)
+            return IOResult(WireStatus(), 0, io.update_ver, io.update_ver, io.chain_ver, 0)
+
+        if io.update_type == UpdateType.REPLACE or io.is_sync:
+            # full-chunk-replace (resync / write-during-recovery,
+            # design_notes.md:240-246): no version gating, adopt shipped vers
+            checksum = self.crc(payload)
+            if io.checksum and checksum != io.checksum:
+                raise make_error(StatusCode.CHECKSUM_MISMATCH,
+                                 f"{io.chunk_id}: replace payload checksum")
+            new = ChunkMeta(io.chunk_id, len(payload), io.update_ver,
+                            io.commit_ver or io.update_ver, io.chain_ver,
+                            checksum, ChunkState.COMMIT
+                            if (io.commit_ver or io.update_ver) >= io.update_ver
+                            else ChunkState.DIRTY)
+            self.engine.put(io.chunk_id, payload, new, io.chunk_size or len(payload))
+            return IOResult(WireStatus(), new.length, new.update_ver,
+                            new.commit_ver, new.chain_ver, new.checksum)
+
+        cur_update = meta.update_ver if meta else 0
+        cur_commit = meta.commit_ver if meta else 0
+        cur_state = meta.state if meta else ChunkState.COMMIT
+
+        if io.update_ver <= cur_commit:
+            # already applied and committed (late duplicate)
+            raise make_error(StatusCode.CHUNK_STALE_UPDATE,
+                             f"{io.chunk_id}: v{io.update_ver} <= committed v{cur_commit}")
+        if io.update_ver == cur_update and cur_state == ChunkState.DIRTY:
+            # retry of the pending update: idempotent success
+            return IOResult(WireStatus(), meta.length, meta.update_ver,
+                            meta.commit_ver, meta.chain_ver, meta.checksum)
+        if io.update_ver > cur_update + 1:
+            raise make_error(StatusCode.CHUNK_MISSING_UPDATE,
+                             f"{io.chunk_id}: v{io.update_ver} after v{cur_update}")
+        if cur_state == ChunkState.DIRTY:
+            # a different pending update exists; caller must retry after commit
+            raise make_error(StatusCode.CHUNK_BUSY,
+                             f"{io.chunk_id}: pending v{cur_update}")
+
+        # verify client checksum of the payload (ChunkReplica.cc:193-206)
+        payload_crc = self.crc(payload)
+        if io.checksum and payload_crc != io.checksum:
+            raise make_error(StatusCode.CHECKSUM_MISMATCH,
+                             f"{io.chunk_id}: payload crc {payload_crc:#x} != {io.checksum:#x}")
+
+        old = self.engine.read(io.chunk_id) if meta else b""
+
+        if io.update_type == UpdateType.TRUNCATE:
+            if io.length <= len(old):
+                content = old[: io.length]
+            else:
+                content = old + b"\x00" * (io.length - len(old))
+            checksum = self.crc(content)
+        else:
+            end = io.offset + len(payload)
+            if io.offset == len(old):
+                # pure append: combine instead of recompute (Common.h:191 trick)
+                content = old + payload
+                old_crc = meta.checksum if meta else 0
+                checksum = (self.crc_combine(old_crc, payload_crc, len(payload))
+                            if old else payload_crc)
+            else:
+                content = bytearray(old.ljust(max(len(old), end), b"\x00"))
+                content[io.offset:end] = payload
+                content = bytes(content)
+                checksum = self.crc(content)
+
+        new = ChunkMeta(io.chunk_id, len(content), io.update_ver, cur_commit,
+                        io.chain_ver, checksum, ChunkState.DIRTY)
+        self.engine.put(io.chunk_id, content, new, io.chunk_size or len(content))
+        return IOResult(WireStatus(), new.length, new.update_ver, new.commit_ver,
+                        new.chain_ver, new.checksum)
+
+    def commit(self, chunk_id: ChunkId, update_ver: int, chain_ver: int) -> IOResult:
+        """Flip DIRTY->COMMIT for update_ver (idempotent)."""
+        meta = self.engine.get_meta(chunk_id)
+        if meta is None:
+            # chunk was removed by a later update in the channel; treat as done
+            return IOResult(WireStatus(), 0, update_ver, update_ver, chain_ver, 0)
+        if meta.commit_ver >= update_ver:
+            return IOResult(WireStatus(), meta.length, meta.update_ver,
+                            meta.commit_ver, meta.chain_ver, meta.checksum)
+        if meta.update_ver != update_ver:
+            raise make_error(StatusCode.CHUNK_MISSING_UPDATE,
+                             f"{chunk_id}: commit v{update_ver} but applied v{meta.update_ver}")
+        meta.commit_ver = update_ver
+        meta.chain_ver = max(meta.chain_ver, chain_ver)
+        meta.state = ChunkState.COMMIT
+        self.engine.set_meta(chunk_id, meta)
+        return IOResult(WireStatus(), meta.length, meta.update_ver,
+                        meta.commit_ver, meta.chain_ver, meta.checksum)
+
+    # --- read path ---
+
+    def read(self, io: ReadIO) -> tuple[IOResult, bytes]:
+        meta = self.engine.get_meta(io.chunk_id)
+        if meta is None:
+            raise make_error(StatusCode.CHUNK_NOT_FOUND, str(io.chunk_id))
+        if meta.state == ChunkState.DIRTY and not io.allow_uncommitted:
+            # only committed versions are served (design_notes.md:169-173);
+            # client retries — commit latency is one chain round trip
+            raise make_error(StatusCode.CHUNK_BUSY,
+                             f"{io.chunk_id}: uncommitted v{meta.update_ver}")
+        data = self.engine.read(io.chunk_id, io.offset,
+                                io.length if io.length else -1)
+        if io.verify_checksum and io.offset == 0 and len(data) == meta.length:
+            actual = self.crc(data)
+            if actual != meta.checksum:
+                raise make_error(StatusCode.CHECKSUM_MISMATCH,
+                                 f"{io.chunk_id}: stored {meta.checksum:#x} != read {actual:#x}")
+        return IOResult(WireStatus(), len(data), meta.update_ver, meta.commit_ver,
+                        meta.chain_ver, meta.checksum), data

@@ -1,0 +1,106 @@
+"""Exactly-once update channels + reliable chain forwarding.
+
+Reference analogs: storage/service/ReliableUpdate.h:19-54 (per-(client,
+channel) seqnum dedupe so retries don't re-apply), ReliableForwarding.cc:
+33-138 (forward to successor with retry-until-routing-change).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+from t3fs.storage.types import IOResult, UpdateIO
+from t3fs.net.wire import WireStatus
+from t3fs.utils.status import StatusCode, StatusError, make_error
+
+log = logging.getLogger("t3fs.storage")
+
+
+class ReliableUpdate:
+    """Dedupe map: (client_id, chain_id, channel) -> (last seq, cached result).
+
+    A client serializes updates per channel; a retry re-sends the same seq.
+    Seq regressions are rejected (late duplicates of older requests)."""
+
+    def __init__(self):
+        self._sessions: dict[tuple, tuple[int, IOResult | None]] = {}
+        self._locks: dict[tuple, asyncio.Lock] = {}
+
+    def lock_for(self, io: UpdateIO) -> asyncio.Lock:
+        key = (io.client_id, io.chain_id, io.channel)
+        return self._locks.setdefault(key, asyncio.Lock())
+
+    def check(self, io: UpdateIO) -> IOResult | None:
+        """Returns cached result for a retry, None for a fresh update."""
+        if io.channel == 0:
+            return None  # unchanneled (e.g. internal) updates skip dedupe
+        key = (io.client_id, io.chain_id, io.channel)
+        entry = self._sessions.get(key)
+        if entry is None:
+            return None
+        last_seq, result = entry
+        if io.channel_seq == last_seq:
+            return result or IOResult(WireStatus(int(StatusCode.BUSY), "in flight"))
+        if io.channel_seq < last_seq:
+            raise make_error(StatusCode.CHUNK_STALE_UPDATE,
+                             f"channel {io.channel} seq {io.channel_seq} < {last_seq}")
+        return None
+
+    def begin(self, io: UpdateIO) -> None:
+        if io.channel:
+            key = (io.client_id, io.chain_id, io.channel)
+            self._sessions[key] = (io.channel_seq, None)
+
+    def record(self, io: UpdateIO, result: IOResult) -> None:
+        if io.channel:
+            key = (io.client_id, io.chain_id, io.channel)
+            self._sessions[key] = (io.channel_seq, result)
+
+
+class ReliableForwarding:
+    """Forward an applied update to the chain successor, retrying until it
+    succeeds or the routing epoch moves past the successor."""
+
+    def __init__(self, node, max_attempts: int = 30, retry_delay_s: float = 0.05):
+        self.node = node  # StorageNode (provides client + routing)
+        self.max_attempts = max_attempts
+        self.retry_delay_s = retry_delay_s
+
+    async def forward(self, target_id: int, io: UpdateIO,
+                      payload: bytes) -> IOResult | None:
+        """Returns successor's IOResult, or None when there is no successor
+        (this target is the tail)."""
+        attempt = 0
+        while True:
+            routing = self.node.routing()
+            chain = routing.chain(io.chain_id)
+            if chain is None:
+                raise make_error(StatusCode.TARGET_NOT_FOUND,
+                                 f"chain {io.chain_id} gone from routing")
+            succ = chain.successor_of(target_id)
+            if succ is None:
+                return None
+            address = routing.node_address(succ.node_id)
+            fwd = UpdateIO(**{**io.__dict__})
+            fwd.from_head = True
+            fwd.inline = True
+            fwd.buf = None
+            fwd.chain_ver = chain.chain_ver
+            try:
+                rsp, _ = await self.node.client.call(
+                    address, "Storage.update", fwd, payload=payload,
+                    timeout=self.node.forward_timeout_s)
+                return rsp.result
+            except StatusError as e:
+                attempt += 1
+                # retry until mgmtd reshapes the chain past the dead successor
+                # (infinite-retry semantics, ReliableForwarding.cc:33); bounded
+                # here so tests terminate — the bound maps to the heartbeat
+                # window within which mgmtd must act
+                if attempt >= self.max_attempts:
+                    raise make_error(
+                        StatusCode.TARGET_OFFLINE,
+                        f"forward to t{succ.target_id}@{address} failed after "
+                        f"{attempt} attempts: {e}") from None
+                await asyncio.sleep(self.retry_delay_s)

@@ -1,0 +1,298 @@
+"""Storage service: the CRAQ data-plane brain.
+
+Reference analog: storage/service/StorageOperator.{h,cc} — write (:233) ->
+handleUpdate (:333) -> doUpdate (:516) -> forward -> checksum cross-check
+(:464-485) -> doCommit (:611); batchRead (:82-231).  One StorageNode hosts
+many StorageTargets (one per disk/chain), wired to a routing provider
+(mgmtd client or a static fake) and an RPC client for chain forwarding.
+
+Commit ordering is CRAQ: apply locally (DIRTY), forward down the chain,
+commit after the successor acks — so the TAIL commits first and the head
+replies to the client only after the whole chain committed
+(docs/design_notes.md:153-176).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Callable
+
+from t3fs.mgmtd.types import ChainInfo, PublicTargetState, RoutingInfo
+from t3fs.net.conn import Connection
+from t3fs.net.rdma import remote_read, remote_write
+from t3fs.net.server import rpc_method, service
+from t3fs.net.wire import WireStatus
+from t3fs.storage.chunk_engine import ChunkEngine
+from t3fs.storage.chunk_replica import ChunkReplica
+from t3fs.storage.reliable import ReliableForwarding, ReliableUpdate
+from t3fs.storage.types import (
+    BatchReadReq, BatchReadRsp, ChunkId, IOResult, QueryLastChunkReq,
+    QueryLastChunkRsp, ReadIO, RemoveChunksReq, SpaceInfoRsp, TruncateChunkReq,
+    UpdateIO, UpdateType, WriteReq, WriteRsp,
+)
+from t3fs.utils.fault_injection import fault_raise
+from t3fs.utils.metrics import CountRecorder, LatencyRecorder
+from t3fs.utils.status import Status, StatusCode, StatusError, make_error
+
+log = logging.getLogger("t3fs.storage")
+
+
+class StorageTarget:
+    """One target (disk) = chunk engine + CRAQ replica + per-chunk locks."""
+
+    def __init__(self, target_id: int, root: str):
+        self.target_id = target_id
+        self.engine = ChunkEngine(root)
+        self.replica = ChunkReplica(self.engine)
+        self._chunk_locks: dict[ChunkId, asyncio.Lock] = {}
+
+    def chunk_lock(self, chunk_id: ChunkId) -> asyncio.Lock:
+        lock = self._chunk_locks.get(chunk_id)
+        if lock is None:
+            lock = self._chunk_locks[chunk_id] = asyncio.Lock()
+        return lock
+
+
+class StorageNode:
+    """Hosts targets + the Storage RPC service on one node."""
+
+    def __init__(self, node_id: int, routing_provider: Callable[[], RoutingInfo],
+                 client, forward_timeout_s: float = 10.0):
+        self.node_id = node_id
+        self._routing_provider = routing_provider
+        self.client = client
+        self.forward_timeout_s = forward_timeout_s
+        self.targets: dict[int, StorageTarget] = {}
+        self.reliable_update = ReliableUpdate()
+        self.forwarding = ReliableForwarding(self)
+        self.write_latency = LatencyRecorder(f"storage.write.n{node_id}")
+        self.read_count = CountRecorder(f"storage.read_ios.n{node_id}")
+
+    def routing(self) -> RoutingInfo:
+        return self._routing_provider()
+
+    def add_target(self, target_id: int, root: str) -> StorageTarget:
+        t = StorageTarget(target_id, root)
+        self.targets[target_id] = t
+        return t
+
+    # --- chain helpers ---
+
+    def _target_for_chain(self, chain: ChainInfo) -> StorageTarget | None:
+        for ct in chain.targets:
+            if ct.node_id == self.node_id and ct.target_id in self.targets:
+                return self.targets[ct.target_id]
+        return None
+
+    def _check_chain(self, chain_id: int, chain_ver: int,
+                     require_head: bool = False) -> tuple[ChainInfo, StorageTarget]:
+        chain = self.routing().chain(chain_id)
+        if chain is None:
+            raise make_error(StatusCode.TARGET_NOT_FOUND, f"chain {chain_id}")
+        if chain_ver and chain_ver != chain.chain_ver:
+            raise make_error(StatusCode.CHAIN_VERSION_MISMATCH,
+                             f"chain {chain_id}: req v{chain_ver} != v{chain.chain_ver}")
+        target = self._target_for_chain(chain)
+        if target is None:
+            raise make_error(StatusCode.TARGET_NOT_FOUND,
+                             f"chain {chain_id} has no target on node {self.node_id}")
+        if require_head:
+            head = chain.head()
+            if head is None or head.target_id != target.target_id:
+                raise make_error(StatusCode.NOT_HEAD,
+                                 f"target {target.target_id} is not head of chain {chain_id}")
+        return chain, target
+
+
+@service("Storage")
+class StorageService:
+    """RPC surface (fbs/storage/Service.h:8-24 analog)."""
+
+    def __init__(self, node: StorageNode):
+        self.node = node
+
+    # ---- write path ----
+
+    async def _update_to_result(self, io: UpdateIO, payload: bytes,
+                                conn: Connection, require_head: bool) -> IOResult:
+        """All gating/transport failures become per-IO result statuses
+        (reference: IOResult carries status, not RPC-level errors)."""
+        try:
+            return await self._handle_update(io, payload, conn, require_head)
+        except StatusError as e:
+            return IOResult(WireStatus(int(e.code), str(e)))
+
+    @rpc_method
+    async def write(self, req: WriteReq, payload: bytes, conn: Connection):
+        """Client entry point; must land on the chain head."""
+        with self.node.write_latency.time():
+            result = await self._update_to_result(req.io, payload, conn,
+                                                  require_head=True)
+        return WriteRsp(result=result), b""
+
+    @rpc_method
+    async def update(self, req: UpdateIO, payload: bytes, conn: Connection):
+        """Chain-internal hop from the predecessor."""
+        if not req.from_head:
+            raise make_error(StatusCode.INVALID_ARG, "update must come from chain")
+        result = await self._update_to_result(req, payload, conn,
+                                              require_head=False)
+        return WriteRsp(result=result), b""
+
+    async def _handle_update(self, io: UpdateIO, payload: bytes,
+                             conn: Connection, require_head: bool) -> IOResult:
+        node = self.node
+        fault_raise("storage.update.entry")
+        if io.debug.server_should_fail():
+            raise make_error(StatusCode.INTERNAL, "injected server error")
+        chain, target = node._check_chain(io.chain_id, io.chain_ver,
+                                          require_head=require_head)
+
+        # exactly-once channel dedupe (head only — forwarded hops are
+        # version-gated by the replica)
+        if require_head:
+            cached = node.reliable_update.check(io)
+            if cached is not None:
+                return cached
+
+        async with target.chunk_lock(io.chunk_id):
+            if require_head:
+                node.reliable_update.begin(io)
+            # fetch payload: one-sided pull from requester, or inline frame
+            if io.buf is not None and not io.inline:
+                payload = await remote_read(conn, io.buf)
+            if io.update_ver == 0:
+                meta = target.engine.get_meta(io.chunk_id)
+                io.update_ver = (meta.update_ver if meta else 0) + 1
+            io.chain_ver = chain.chain_ver
+
+            try:
+                result = target.replica.apply_update(io, payload)
+            except StatusError as e:
+                result = IOResult(WireStatus(int(e.code), str(e)))
+                if require_head:
+                    node.reliable_update.record(io, result)
+                return result
+
+            # forward down the chain (tail commits first)
+            try:
+                succ_result = await self._forward(chain, target, io, payload)
+            except StatusError as e:
+                result = IOResult(WireStatus(int(e.code), f"forward: {e}"))
+                if require_head:
+                    node.reliable_update.record(io, result)
+                return result
+
+            if succ_result is not None and succ_result.status.code == int(StatusCode.OK):
+                # checksum cross-check vs successor (StorageOperator.cc:464-485)
+                if (io.update_type == UpdateType.WRITE
+                        and succ_result.checksum != result.checksum):
+                    raise make_error(
+                        StatusCode.CHECKSUM_MISMATCH,
+                        f"{io.chunk_id}: successor {succ_result.checksum:#x} "
+                        f"!= local {result.checksum:#x}")
+            elif succ_result is not None:
+                result = succ_result  # propagate successor failure up the chain
+                if require_head:
+                    node.reliable_update.record(io, result)
+                return result
+
+            if io.update_type not in (UpdateType.REMOVE,):
+                result = target.replica.commit(io.chunk_id, io.update_ver,
+                                               chain.chain_ver)
+            if require_head:
+                node.reliable_update.record(io, result)
+            return result
+
+    async def _forward(self, chain: ChainInfo, target: StorageTarget,
+                       io: UpdateIO, payload: bytes) -> IOResult | None:
+        succ = chain.successor_of(target.target_id)
+        if succ is None:
+            return None
+        if succ.public_state == PublicTargetState.SYNCING and \
+                io.update_type in (UpdateType.WRITE, UpdateType.TRUNCATE):
+            # write-during-recovery: ship the FULL updated chunk so the
+            # syncing successor converges (design_notes.md:240-246)
+            meta = target.engine.get_meta(io.chunk_id)
+            full = target.engine.read(io.chunk_id)
+            rep = UpdateIO(**{**io.__dict__})
+            rep.update_type = UpdateType.REPLACE
+            rep.offset = 0
+            rep.length = len(full)
+            rep.checksum = meta.checksum
+            rep.commit_ver = 0  # commit decided by chain flow
+            return await self.node.forwarding.forward(target.target_id, rep, full)
+        return await self.node.forwarding.forward(target.target_id, io, payload)
+
+    # ---- read path ----
+
+    @rpc_method
+    async def batch_read(self, req: BatchReadReq, payload: bytes, conn: Connection):
+        """Reads go to ANY serving target (CRAQ read-any)."""
+        node = self.node
+        if req.debug.server_should_fail():
+            raise make_error(StatusCode.INTERNAL, "injected server error")
+        results: list[IOResult] = []
+        inline_parts: list[bytes] = []
+        for io in req.ios:
+            node.read_count.add()
+            try:
+                chain, target = node._check_chain(io.chain_id, 0)
+                result, data = target.replica.read(io)
+                if io.buf is not None:
+                    await remote_write(conn, io.buf.slice(0, len(data)), data)
+                else:
+                    inline_parts.append(data)
+                results.append(result)
+            except StatusError as e:
+                results.append(IOResult(WireStatus(int(e.code), str(e))))
+                if io.buf is None:
+                    inline_parts.append(b"")
+        return BatchReadRsp(results=results), b"".join(inline_parts)
+
+    # ---- metadata-ish ops ----
+
+    @rpc_method
+    async def query_last_chunk(self, req: QueryLastChunkReq, payload, conn):
+        _, target = self.node._check_chain(req.chain_id, 0)
+        metas = target.engine.query_range(req.inode)
+        rsp = QueryLastChunkRsp()
+        if metas:
+            last = metas[-1]
+            rsp.last_index = last.chunk_id.index
+            rsp.last_length = last.length
+            rsp.total_chunks = len(metas)
+            rsp.total_length = sum(m.length for m in metas)
+        return rsp, b""
+
+    @rpc_method
+    async def remove_chunks(self, req: RemoveChunksReq, payload, conn):
+        """Range remove via the chain (head entry), chunk by chunk."""
+        chain, target = self.node._check_chain(req.chain_id, 0, require_head=True)
+        removed = 0
+        for meta in target.engine.query_range(req.inode, req.begin_index,
+                                              req.end_index):
+            io = UpdateIO(chunk_id=meta.chunk_id, chain_id=req.chain_id,
+                          chain_ver=chain.chain_ver,
+                          update_type=UpdateType.REMOVE,
+                          update_ver=meta.update_ver + 1, from_head=True)
+            result = await self._update_to_result(io, b"", conn, require_head=False)
+            if result.status.code == int(StatusCode.OK):
+                removed += 1
+        return WriteRsp(result=IOResult(WireStatus(), removed)), b""
+
+    @rpc_method
+    async def truncate_chunk(self, req: TruncateChunkReq, payload, conn):
+        chain, _ = self.node._check_chain(req.chain_id, 0, require_head=True)
+        io = UpdateIO(chunk_id=req.chunk_id, chain_id=req.chain_id,
+                      chain_ver=chain.chain_ver, update_type=UpdateType.TRUNCATE,
+                      length=req.new_length, chunk_size=req.chunk_size)
+        result = await self._update_to_result(io, b"", conn, require_head=True)
+        return WriteRsp(result=result), b""
+
+    @rpc_method
+    async def space_info(self, req, payload, conn):
+        used = sum(t.engine.stats().used_bytes for t in self.node.targets.values())
+        alloc = sum(t.engine.stats().allocated_bytes for t in self.node.targets.values())
+        return SpaceInfoRsp(capacity=alloc, used=used, free=max(0, alloc - used)), b""

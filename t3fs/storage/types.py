@@ -1,0 +1,181 @@
+"""Storage wire/engine types.
+
+Reference analogs: fbs/storage/Common.h — ChunkId (128-bit inode||index,
+:82-110), ChunkState (:60), IOResult (:221), ReadIO/UpdateIO/CommitIO
+(:309-355), VersionedChainId (:252-268), UpdateChannel/MessageTag (:271-288).
+"""
+
+from __future__ import annotations
+
+import enum
+import struct
+from dataclasses import dataclass, field
+
+from t3fs.utils.serde import serde_struct
+from t3fs.net.wire import WireStatus
+from t3fs.net.rdma import RemoteBuf
+from t3fs.utils.fault_injection import DebugFlags
+
+
+@serde_struct
+@dataclass(frozen=True, order=True)
+class ChunkId:
+    """128-bit chunk address: (inode/object id, chunk index) — clients compute
+    chunk->chain placement from this with zero metadata involvement."""
+    inode: int = 0
+    index: int = 0
+
+    def encode(self) -> bytes:
+        return struct.pack(">QQ", self.inode, self.index)
+
+    @classmethod
+    def decode(cls, b: bytes) -> "ChunkId":
+        hi, lo = struct.unpack(">QQ", b)
+        return cls(hi, lo)
+
+    def __str__(self) -> str:
+        return f"{self.inode:x}.{self.index}"
+
+
+class ChunkState(enum.IntEnum):
+    COMMIT = 0     # committed, serveable
+    DIRTY = 1      # update applied, commit pending (CRAQ "pending version")
+
+
+@serde_struct
+@dataclass
+class ChunkMeta:
+    chunk_id: ChunkId = field(default_factory=ChunkId)
+    length: int = 0
+    update_ver: int = 0
+    commit_ver: int = 0
+    chain_ver: int = 0
+    checksum: int = 0          # CRC32C of current content
+    state: ChunkState = ChunkState.COMMIT
+
+
+class UpdateType(enum.IntEnum):
+    WRITE = 0
+    TRUNCATE = 1
+    REMOVE = 2
+    REPLACE = 3    # full-chunk-replace (resync path)
+
+
+@serde_struct
+@dataclass
+class UpdateIO:
+    """One CRAQ update as shipped client->head->successors."""
+    chunk_id: ChunkId = field(default_factory=ChunkId)
+    chain_id: int = 0
+    chain_ver: int = 0
+    update_type: UpdateType = UpdateType.WRITE
+    offset: int = 0
+    length: int = 0
+    chunk_size: int = 0        # size class to create the chunk in
+    update_ver: int = 0        # 0 on client entry; head assigns
+    commit_ver: int = 0
+    checksum: int = 0          # CRC32C of the payload
+    channel: int = 0           # exactly-once: (client channel, seqnum)
+    channel_seq: int = 0
+    client_id: str = ""
+    buf: RemoteBuf | None = None       # pull payload from requester (RDMA READ)
+    inline: bool = False               # payload rides the frame instead
+    is_sync: bool = False              # full-chunk-replace during resync
+    from_head: bool = False            # set on forwarded hops
+    commit_only: bool = False
+    debug: DebugFlags = field(default_factory=DebugFlags)
+
+
+@serde_struct
+@dataclass
+class ReadIO:
+    chunk_id: ChunkId = field(default_factory=ChunkId)
+    chain_id: int = 0
+    offset: int = 0
+    length: int = 0
+    buf: RemoteBuf | None = None       # push result into requester (RDMA WRITE)
+    verify_checksum: bool = False
+    allow_uncommitted: bool = False
+
+
+@serde_struct
+@dataclass
+class IOResult:
+    """Per-IO outcome (fbs/storage/Common.h:221)."""
+    status: WireStatus = field(default_factory=WireStatus)
+    length: int = 0
+    update_ver: int = 0
+    commit_ver: int = 0
+    commit_chain_ver: int = 0
+    checksum: int = 0
+
+
+@serde_struct
+@dataclass
+class BatchReadReq:
+    ios: list[ReadIO] = field(default_factory=list)
+    inline: bool = False
+    debug: DebugFlags = field(default_factory=DebugFlags)
+
+
+@serde_struct
+@dataclass
+class BatchReadRsp:
+    results: list[IOResult] = field(default_factory=list)
+    # inline payloads are concatenated in the frame payload, per-IO lengths
+    # in results[i].length
+
+
+@serde_struct
+@dataclass
+class WriteReq:
+    io: UpdateIO = field(default_factory=UpdateIO)
+
+
+@serde_struct
+@dataclass
+class WriteRsp:
+    result: IOResult = field(default_factory=IOResult)
+
+
+@serde_struct
+@dataclass
+class QueryLastChunkReq:
+    chain_id: int = 0
+    inode: int = 0
+
+
+@serde_struct
+@dataclass
+class QueryLastChunkRsp:
+    status: WireStatus = field(default_factory=WireStatus)
+    last_index: int = -1           # -1: no chunks
+    last_length: int = 0
+    total_chunks: int = 0
+    total_length: int = 0
+
+
+@serde_struct
+@dataclass
+class RemoveChunksReq:
+    chain_id: int = 0
+    inode: int = 0
+    begin_index: int = 0
+    end_index: int = 1 << 62
+
+
+@serde_struct
+@dataclass
+class TruncateChunkReq:
+    chain_id: int = 0
+    chunk_id: ChunkId = field(default_factory=ChunkId)
+    new_length: int = 0
+    chunk_size: int = 0
+
+
+@serde_struct
+@dataclass
+class SpaceInfoRsp:
+    capacity: int = 0
+    used: int = 0
+    free: int = 0

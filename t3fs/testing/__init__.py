@@ -1,0 +1,2 @@
+"""Test fabrics and fakes (reference: tests/lib/UnitTestFabric.h,
+tests/FakeMgmtdClient.h)."""

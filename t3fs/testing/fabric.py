@@ -1,0 +1,90 @@
+"""In-process multi-node storage cluster for tests.
+
+Reference analog: tests/lib/UnitTestFabric.h — N real StorageServers in one
+process wired to a hand-built RoutingInfo and a fake mgmtd; tests parameterize
+replica count / node count (SystemSetupConfig, :86-163).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import tempfile
+
+from t3fs.mgmtd.types import (
+    ChainInfo, ChainTargetInfo, ChainTable, NodeInfo, PublicTargetState,
+    RoutingInfo,
+)
+from t3fs.net.client import Client
+from t3fs.net.rdma import BufferRegistry
+from t3fs.net.server import Server
+from t3fs.storage.service import StorageNode, StorageService
+
+
+class StorageFabric:
+    """N storage nodes, one chain of `replicas` targets (extendable)."""
+
+    def __init__(self, num_nodes: int = 3, replicas: int = 3, chain_id: int = 1):
+        assert replicas <= num_nodes
+        self.num_nodes = num_nodes
+        self.replicas = replicas
+        self.chain_id = chain_id
+        self.routing = RoutingInfo(version=1)
+        self.servers: list[Server] = []
+        self.nodes: list[StorageNode] = []
+        self.client = Client()
+        self.bufs = BufferRegistry()
+        self.client.add_service(self.bufs)
+        self._tmp = tempfile.TemporaryDirectory(prefix="t3fs-fabric-")
+
+    def target_id(self, node_idx: int) -> int:
+        return (node_idx + 1) * 100 + 1
+
+    async def start(self) -> None:
+        for i in range(self.num_nodes):
+            node_id = i + 1
+            node = StorageNode(node_id, lambda: self.routing, Client())
+            node.client.add_service(BufferRegistry())  # forwarding conns
+            node.add_target(self.target_id(i), f"{self._tmp.name}/n{node_id}")
+            server = Server()
+            server.add_service(StorageService(node))
+            await server.start()
+            self.routing.nodes[node_id] = NodeInfo(node_id, server.address)
+            self.servers.append(server)
+            self.nodes.append(node)
+        self.routing.chains[self.chain_id] = ChainInfo(
+            chain_id=self.chain_id, chain_ver=1,
+            targets=[ChainTargetInfo(self.target_id(i), i + 1,
+                                     PublicTargetState.SERVING)
+                     for i in range(self.replicas)])
+        self.routing.chain_tables[1] = ChainTable(1, [self.chain_id])
+
+    def chain(self) -> ChainInfo:
+        return self.routing.chains[self.chain_id]
+
+    def head_address(self) -> str:
+        head = self.chain().head()
+        return self.routing.node_address(head.node_id)
+
+    def address_of_target(self, target_id: int) -> str:
+        for t in self.chain().targets:
+            if t.target_id == target_id:
+                return self.routing.node_address(t.node_id)
+        raise KeyError(target_id)
+
+    def bump_chain(self, new_targets: list[ChainTargetInfo]) -> None:
+        """Simulate an mgmtd chain update (version bump)."""
+        c = self.chain()
+        self.routing.chains[self.chain_id] = ChainInfo(
+            c.chain_id, c.chain_ver + 1, new_targets)
+        self.routing.version += 1
+
+    async def stop(self) -> None:
+        await self.client.close()
+        for node in self.nodes:
+            await node.client.close()
+        for server in self.servers:
+            await server.stop()
+        for node in self.nodes:
+            for t in node.targets.values():
+                t.engine.close()
+        self._tmp.cleanup()

@@ -82,11 +82,12 @@ class ConfigBase:
             if validator is not None and not validator(v):
                 raise ConfigError(f"{type(self).__name__}.{f.name}: invalid value {v!r}")
 
-    def update(self, overrides: dict, *, hot_only: bool = True, _prefix: str = "") -> list[str]:
-        """Apply {dotted.key: value} or nested-dict overrides.  With hot_only,
-        refuses to change items declared hot=False (reference semantics:
-        non-hot items need a restart).  Returns dotted names that changed."""
-        changed: list[str] = []
+    def update(self, overrides: dict, *, hot_only: bool = True) -> list[str]:
+        """Apply {dotted.key: value} or nested-dict overrides atomically:
+        every override is validated first, then all are applied — a rejected
+        key leaves the config untouched.  With hot_only, refuses items
+        declared hot=False (reference semantics: non-hot items need a
+        restart).  Returns dotted names that changed."""
         # normalize dotted keys into nested dicts
         nested: dict = {}
         for k, v in overrides.items():
@@ -98,17 +99,25 @@ class ConfigBase:
                 cur[parts[-1]].update(v)
             else:
                 cur[parts[-1]] = v
+        plan: list[tuple[ConfigBase, str, object, str]] = []
+        self._plan_update(nested, hot_only, "", plan)   # validates everything
+        for obj, key, val, _ in plan:
+            setattr(obj, key, val)
+        return [dotted for _, _, _, dotted in plan]
+
+    def _plan_update(self, nested: dict, hot_only: bool, prefix: str,
+                     plan: list) -> None:
         known = {f.name: f for f in fields(self)}
         for key, val in nested.items():
             if key not in known:
                 raise ConfigError(f"{type(self).__name__}: unknown config key {key!r}")
             f = known[key]
             cur = getattr(self, key)
-            dotted = f"{_prefix}{key}"
+            dotted = f"{prefix}{key}"
             if isinstance(cur, ConfigBase):
                 if not isinstance(val, dict):
                     raise ConfigError(f"{dotted}: expected table, got {val!r}")
-                changed += cur.update(val, hot_only=hot_only, _prefix=dotted + ".")
+                cur._plan_update(val, hot_only, dotted + ".", plan)
                 continue
             if cur == val:
                 continue
@@ -117,9 +126,7 @@ class ConfigBase:
             validator = (f.metadata or {}).get("validator")
             if validator is not None and not validator(val):
                 raise ConfigError(f"{dotted}: invalid value {val!r}")
-            setattr(self, key, val)
-            changed.append(dotted)
-        return changed
+            plan.append((self, key, val, dotted))
 
 
 def _resolve_nested(cls: type, key: str) -> type | None:

@@ -11,6 +11,7 @@ from __future__ import annotations
 import json
 import logging
 import math
+import random
 import threading
 import time
 from collections import defaultdict
@@ -106,7 +107,6 @@ class DistributionRecorder(Recorder):
             if len(self._samples) < self.RESERVOIR:
                 self._samples.append(v)
             else:  # reservoir sampling
-                import random
                 i = random.randrange(self._count)
                 if i < self.RESERVOIR:
                     self._samples[i] = v

@@ -46,6 +46,13 @@ def _write_varint(w: io.BytesIO, v: int) -> None:
             return
 
 
+def _read_exact(r: io.BytesIO, n: int) -> bytes:
+    b = r.read(n)
+    if len(b) != n:
+        raise ValueError(f"serde: truncated input (wanted {n}, got {len(b)})")
+    return b
+
+
 def _read_varint(r: io.BytesIO) -> int:
     shift = 0
     out = 0
@@ -160,13 +167,13 @@ def _decode(r: io.BytesIO):
     if tag == T_NEGINT:
         return -_read_varint(r) - 1
     if tag == T_FLOAT:
-        return struct.unpack("<d", r.read(8))[0]
+        return struct.unpack("<d", _read_exact(r, 8))[0]
     if tag == T_BYTES:
         n = _read_varint(r)
-        return r.read(n)
+        return _read_exact(r, n)
     if tag == T_STR:
         n = _read_varint(r)
-        return r.read(n).decode("utf-8")
+        return _read_exact(r, n).decode("utf-8")
     if tag == T_LIST:
         n = _read_varint(r)
         return [_decode(r) for _ in range(n)]
@@ -175,7 +182,7 @@ def _decode(r: io.BytesIO):
         return {_decode(r): _decode(r) for _ in range(n)}
     if tag == T_STRUCT:
         nlen = _read_varint(r)
-        name = r.read(nlen).decode()
+        name = _read_exact(r, nlen).decode()
         cls = _registry.get(name)
         if cls is None:
             raise ValueError(f"serde: unknown struct {name!r}")

@@ -1,0 +1,215 @@
+"""CRAQ storage service over the in-process fabric.
+
+Reference analogs: tests/storage/service/TestSingleProcessCluster.cc,
+TestStorageOperator, tests/storage/service/TestStorageServiceFailStop.cc.
+"""
+
+import asyncio
+
+import pytest
+
+from t3fs.mgmtd.types import ChainTargetInfo, PublicTargetState
+from t3fs.ops.crc32c import crc32c_ref
+from t3fs.storage.types import (
+    BatchReadReq, ChunkId, QueryLastChunkReq, ReadIO, RemoveChunksReq, UpdateIO,
+    UpdateType, WriteReq,
+)
+from t3fs.testing.fabric import StorageFabric
+from t3fs.utils.status import StatusCode
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def make_write(fabric, cid, data, *, offset=0, seq=1, channel=7,
+               update_ver=0, chunk_size=4096):
+    return WriteReq(io=UpdateIO(
+        chunk_id=cid, chain_id=fabric.chain_id,
+        chain_ver=fabric.chain().chain_ver,
+        update_type=UpdateType.WRITE, offset=offset, length=len(data),
+        chunk_size=chunk_size, update_ver=update_ver,
+        checksum=crc32c_ref(data), channel=channel, channel_seq=seq,
+        client_id="test-client", inline=True))
+
+
+async def write(fabric, cid, data, **kw):
+    rsp, _ = await fabric.client.call(
+        fabric.head_address(), "Storage.write",
+        make_write(fabric, cid, data, **kw), payload=data)
+    return rsp.result
+
+
+async def read(fabric, cid, address=None, offset=0, length=0):
+    req = BatchReadReq(ios=[ReadIO(chunk_id=cid, chain_id=fabric.chain_id,
+                                   offset=offset, length=length)])
+    rsp, payload = await fabric.client.call(
+        address or fabric.head_address(), "Storage.batch_read", req)
+    return rsp.results[0], payload
+
+
+def test_single_replica_write_read():
+    async def body():
+        fabric = StorageFabric(num_nodes=1, replicas=1)
+        await fabric.start()
+        try:
+            cid = ChunkId(10, 0)
+            data = b"hello chunk" * 30
+            result = await write(fabric, cid, data)
+            assert result.status.code == int(StatusCode.OK), result.status
+            assert result.update_ver == 1 and result.commit_ver == 1
+            assert result.checksum == crc32c_ref(data)
+            r, payload = await read(fabric, cid)
+            assert payload == data and r.commit_ver == 1
+        finally:
+            await fabric.stop()
+    run(body())
+
+
+def test_three_replica_chain_propagation():
+    async def body():
+        fabric = StorageFabric(num_nodes=3, replicas=3)
+        await fabric.start()
+        try:
+            cid = ChunkId(11, 0)
+            data = b"x" * 1000
+            result = await write(fabric, cid, data)
+            assert result.status.code == int(StatusCode.OK), result.status
+            # every replica holds committed identical content
+            for i in range(3):
+                target = fabric.nodes[i].targets[fabric.target_id(i)]
+                meta = target.engine.get_meta(cid)
+                assert meta is not None, f"replica {i} missing chunk"
+                assert meta.commit_ver == 1 and meta.checksum == crc32c_ref(data)
+                assert target.engine.read(cid) == data
+            # CRAQ read-any: read from the tail node's address
+            tail = fabric.chain().tail()
+            r, payload = await read(fabric, cid,
+                                    fabric.address_of_target(tail.target_id))
+            assert payload == data
+        finally:
+            await fabric.stop()
+    run(body())
+
+
+def test_appends_and_partial_overwrite():
+    async def body():
+        fabric = StorageFabric(num_nodes=2, replicas=2)
+        await fabric.start()
+        try:
+            cid = ChunkId(12, 0)
+            a = b"A" * 100
+            b = b"B" * 50
+            r1 = await write(fabric, cid, a, seq=1)
+            r2 = await write(fabric, cid, b, offset=100, seq=2)  # append
+            assert r2.status.code == int(StatusCode.OK)
+            assert r2.length == 150
+            assert r2.checksum == crc32c_ref(a + b)   # combine path
+            r3 = await write(fabric, cid, b"C" * 10, offset=50, seq=3)  # overwrite
+            _, payload = await read(fabric, cid)
+            assert payload == a[:50] + b"C" * 10 + a[60:] + b
+        finally:
+            await fabric.stop()
+    run(body())
+
+
+def test_channel_dedupe_exactly_once():
+    async def body():
+        fabric = StorageFabric(num_nodes=2, replicas=2)
+        await fabric.start()
+        try:
+            cid = ChunkId(13, 0)
+            data = b"dedupe me"
+            r1 = await write(fabric, cid, data, seq=5)
+            # identical retry returns the cached result, does NOT re-apply
+            r2 = await write(fabric, cid, data, seq=5)
+            assert (r2.update_ver, r2.commit_ver) == (r1.update_ver, r1.commit_ver)
+            meta = fabric.nodes[0].targets[fabric.target_id(0)].engine.get_meta(cid)
+            assert meta.update_ver == 1
+            # older seq rejected
+            r3 = await write(fabric, cid, data, seq=4)
+            assert r3.status.code == int(StatusCode.CHUNK_STALE_UPDATE)
+        finally:
+            await fabric.stop()
+    run(body())
+
+
+def test_chain_version_mismatch_rejected():
+    async def body():
+        fabric = StorageFabric(num_nodes=2, replicas=2)
+        await fabric.start()
+        try:
+            cid = ChunkId(14, 0)
+            req = make_write(fabric, cid, b"zz")
+            req.io.chain_ver = 99
+            rsp, _ = await fabric.client.call(fabric.head_address(),
+                                              "Storage.write", req, payload=b"zz")
+            assert rsp.result.status.code == int(StatusCode.CHAIN_VERSION_MISMATCH)
+        finally:
+            await fabric.stop()
+    run(body())
+
+    # note: non-head write rejection is covered in test_write_to_non_head
+
+
+def test_write_to_non_head():
+    async def body():
+        fabric = StorageFabric(num_nodes=2, replicas=2)
+        await fabric.start()
+        try:
+            cid = ChunkId(15, 0)
+            req = make_write(fabric, cid, b"data")
+            tail = fabric.chain().tail()
+            rsp, _ = await fabric.client.call(
+                fabric.address_of_target(tail.target_id),
+                "Storage.write", req, payload=b"data")
+            assert rsp.result.status.code == int(StatusCode.NOT_HEAD)
+        finally:
+            await fabric.stop()
+    run(body())
+
+
+def test_query_last_chunk_and_remove():
+    async def body():
+        fabric = StorageFabric(num_nodes=1, replicas=1)
+        await fabric.start()
+        try:
+            for idx in range(3):
+                await write(fabric, ChunkId(16, idx), bytes([idx]) * (idx + 1),
+                            seq=idx + 1)
+            rsp, _ = await fabric.client.call(
+                fabric.head_address(), "Storage.query_last_chunk",
+                QueryLastChunkReq(chain_id=fabric.chain_id, inode=16))
+            assert rsp.last_index == 2 and rsp.last_length == 3
+            assert rsp.total_chunks == 3 and rsp.total_length == 6
+            rsp, _ = await fabric.client.call(
+                fabric.head_address(), "Storage.remove_chunks",
+                RemoveChunksReq(chain_id=fabric.chain_id, inode=16,
+                                begin_index=1))
+            assert rsp.result.length == 2  # removed two chunks
+            rsp, _ = await fabric.client.call(
+                fabric.head_address(), "Storage.query_last_chunk",
+                QueryLastChunkReq(chain_id=fabric.chain_id, inode=16))
+            assert rsp.last_index == 0 and rsp.total_chunks == 1
+        finally:
+            await fabric.stop()
+    run(body())
+
+
+def test_uncommitted_not_served_and_concurrent_chunks():
+    async def body():
+        fabric = StorageFabric(num_nodes=3, replicas=3)
+        await fabric.start()
+        try:
+            # concurrent writes to distinct chunks all succeed
+            datas = {i: bytes([i]) * 200 for i in range(8)}
+            results = await asyncio.gather(*[
+                write(fabric, ChunkId(17, i), datas[i], channel=i + 1, seq=1)
+                for i in range(8)])
+            assert all(r.status.code == int(StatusCode.OK) for r in results)
+            for i in range(8):
+                _, payload = await read(fabric, ChunkId(17, i))
+                assert payload == datas[i]
+        finally:
+            await fabric.stop()
+    run(body())
