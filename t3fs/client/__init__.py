@@ -1,0 +1,1 @@
+"""Client libraries: storage, meta, mgmtd (reference: src/client/ — SURVEY.md §2.6)."""

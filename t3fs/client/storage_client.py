@@ -1,0 +1,327 @@
+"""StorageClient: chunk slicing, per-node batching, exactly-once channels,
+retry/failover, target selection.
+
+Reference analogs: client/storage/StorageClient.h:338-556 (batchRead/
+batchWrite/read/write/queryLastChunk/removeChunks/truncateChunks),
+StorageClientImpl.cc (chunk slicing, groupOpsByNodeId :1030, retry loop w/
+backoff :492-566,1151-1266, UpdateChannelAllocator), TargetSelection.h:31-49
+(LoadBalance/RoundRobin/TailTarget/HeadTarget — reads to any serving target,
+writes to head).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import enum
+import itertools
+import logging
+import random
+from dataclasses import dataclass, field
+from typing import Callable
+
+from t3fs.client.layout import FileLayout
+from t3fs.mgmtd.types import ChainInfo, PublicTargetState, RoutingInfo
+from t3fs.net.client import Client
+from t3fs.net.wire import WireStatus
+from t3fs.ops.crc32c import crc32c_ref
+from t3fs.storage.types import (
+    BatchReadReq, BatchReadRsp, ChunkId, IOResult, QueryLastChunkReq,
+    QueryLastChunkRsp, ReadIO, RemoveChunksReq, TruncateChunkReq, UpdateIO,
+    UpdateType, WriteReq,
+)
+from t3fs.utils.status import Status, StatusCode, StatusError, make_error
+
+log = logging.getLogger("t3fs.client")
+
+
+class TargetSelection(enum.IntEnum):
+    LOAD_BALANCE = 0
+    ROUND_ROBIN = 1
+    HEAD_TARGET = 2
+    TAIL_TARGET = 3
+
+
+@dataclass
+class StorageClientConfig:
+    max_retries: int = 8
+    retry_backoff_s: float = 0.02
+    request_timeout_s: float = 30.0
+    generate_checksums: bool = True
+    verify_checksums: bool = False
+    read_selection: TargetSelection = TargetSelection.LOAD_BALANCE
+    num_channels: int = 64
+
+
+class UpdateChannelAllocator:
+    """Pool of (channel, seq) pairs: one in-flight write per channel keeps
+    updates exactly-once + in-order (client/storage/UpdateChannelAllocator.h)."""
+
+    def __init__(self, num_channels: int):
+        self._free = list(range(1, num_channels + 1))
+        self._seqs = {c: 0 for c in self._free}
+        self._cond = asyncio.Condition()
+
+    async def acquire(self) -> tuple[int, int]:
+        async with self._cond:
+            while not self._free:
+                await self._cond.wait()
+            ch = self._free.pop()
+            self._seqs[ch] += 1
+            return ch, self._seqs[ch]
+
+    async def release(self, channel: int) -> None:
+        async with self._cond:
+            self._free.append(channel)
+            self._cond.notify()
+
+
+class StorageClient:
+    def __init__(self, routing_provider: Callable[[], RoutingInfo],
+                 client: Client | None = None,
+                 config: StorageClientConfig | None = None,
+                 client_id: str | None = None,
+                 refresh_routing: Callable[[], "asyncio.Future | None"] | None = None):
+        self.cfg = config or StorageClientConfig()
+        self._routing = routing_provider
+        self._refresh_routing = refresh_routing
+        self.client = client or Client()
+        self.client_id = client_id or f"sc-{random.getrandbits(48):012x}"
+        self.channels = UpdateChannelAllocator(self.cfg.num_channels)
+        self._rr = itertools.count()
+
+    def routing(self) -> RoutingInfo:
+        return self._routing()
+
+    async def _maybe_refresh(self) -> None:
+        if self._refresh_routing is not None:
+            res = self._refresh_routing()
+            if asyncio.iscoroutine(res) or isinstance(res, asyncio.Future):
+                await res
+
+    # --- target selection ---
+
+    def _pick_read_target(self, chain: ChainInfo, attempt: int):
+        serving = chain.serving()
+        if not serving:
+            raise make_error(StatusCode.TARGET_OFFLINE,
+                             f"chain {chain.chain_id}: no serving targets")
+        sel = self.cfg.read_selection
+        if sel == TargetSelection.HEAD_TARGET:
+            pick = serving[0]
+        elif sel == TargetSelection.TAIL_TARGET:
+            pick = serving[-1]
+        elif sel == TargetSelection.ROUND_ROBIN:
+            pick = serving[next(self._rr) % len(serving)]
+        else:
+            pick = serving[random.randrange(len(serving))]
+        # failover: later attempts walk the chain
+        if attempt:
+            pick = serving[(serving.index(pick) + attempt) % len(serving)]
+        return pick
+
+    # --- single-chunk ops ---
+
+    async def write_chunk(self, chain_id: int, chunk_id: ChunkId, offset: int,
+                          data: bytes, chunk_size: int,
+                          update_type: UpdateType = UpdateType.WRITE,
+                          truncate_len: int = 0) -> IOResult:
+        """One chunk-granular CRAQ write (retries are seq-stable)."""
+        channel, seq = await self.channels.acquire()
+        try:
+            io = UpdateIO(
+                chunk_id=chunk_id, chain_id=chain_id,
+                update_type=update_type, offset=offset,
+                length=len(data) if update_type == UpdateType.WRITE else truncate_len,
+                chunk_size=chunk_size,
+                checksum=crc32c_ref(data) if (self.cfg.generate_checksums and data) else 0,
+                channel=channel, channel_seq=seq,
+                client_id=self.client_id, inline=True)
+            return await self._write_with_retry(io, data)
+        finally:
+            await self.channels.release(channel)
+
+    async def _write_with_retry(self, io: UpdateIO, data: bytes) -> IOResult:
+        last: IOResult | None = None
+        for attempt in range(self.cfg.max_retries):
+            routing = self.routing()
+            chain = routing.chain(io.chain_id)
+            if chain is None:
+                raise make_error(StatusCode.TARGET_NOT_FOUND, f"chain {io.chain_id}")
+            head = chain.head()
+            if head is None:
+                await self._backoff(attempt)
+                await self._maybe_refresh()
+                continue
+            io.chain_ver = chain.chain_ver
+            address = routing.node_address(head.node_id)
+            try:
+                rsp, _ = await self.client.call(
+                    address, "Storage.write", WriteReq(io=io), payload=data,
+                    timeout=self.cfg.request_timeout_s)
+                last = rsp.result
+                status = Status(StatusCode(last.status.code), last.status.message)
+                if status.ok:
+                    return last
+                if not status.retryable:
+                    return last
+            except StatusError as e:
+                if not e.status.retryable:
+                    raise
+                last = IOResult(WireStatus(int(e.code), str(e)))
+            await self._backoff(attempt)
+            await self._maybe_refresh()
+        return last if last is not None else IOResult(
+            WireStatus(int(StatusCode.TIMEOUT), "write retries exhausted"))
+
+    async def read_chunk(self, chain_id: int, chunk_id: ChunkId,
+                         offset: int = 0, length: int = 0) -> tuple[IOResult, bytes]:
+        results, payloads = await self.batch_read(
+            [ReadIO(chunk_id=chunk_id, chain_id=chain_id, offset=offset,
+                    length=length, verify_checksum=self.cfg.verify_checksums)])
+        return results[0], payloads[0]
+
+    # --- batched ops ---
+
+    async def batch_read(self, ios: list[ReadIO]) -> tuple[list[IOResult], list[bytes]]:
+        """Group by serving node, dispatch per-node batches in parallel,
+        retry failed IOs with target failover."""
+        results: list[IOResult | None] = [None] * len(ios)
+        payloads: list[bytes] = [b""] * len(ios)
+        pending = list(range(len(ios)))
+        for attempt in range(self.cfg.max_retries):
+            routing = self.routing()
+            groups: dict[str, list[int]] = {}
+            for i in pending:
+                chain = routing.chain(ios[i].chain_id)
+                if chain is None:
+                    results[i] = IOResult(WireStatus(int(StatusCode.TARGET_NOT_FOUND),
+                                                     f"chain {ios[i].chain_id}"))
+                    continue
+                try:
+                    target = self._pick_read_target(chain, attempt)
+                except StatusError as e:
+                    results[i] = IOResult(WireStatus(int(e.code), str(e)))
+                    continue
+                groups.setdefault(routing.node_address(target.node_id), []).append(i)
+
+            async def read_group(address: str, idxs: list[int]):
+                req = BatchReadReq(ios=[ios[i] for i in idxs])
+                try:
+                    rsp, payload = await self.client.call(
+                        address, "Storage.batch_read", req,
+                        timeout=self.cfg.request_timeout_s)
+                except StatusError as e:
+                    for i in idxs:
+                        results[i] = IOResult(WireStatus(int(e.code), str(e)))
+                    return
+                pos = 0
+                for i, r in zip(idxs, rsp.results):
+                    results[i] = r
+                    # inline payloads are concatenated in request order
+                    n = r.length if r.status.code == int(StatusCode.OK) else 0
+                    payloads[i] = payload[pos: pos + n]
+                    pos += n
+
+            await asyncio.gather(*[read_group(a, idxs) for a, idxs in groups.items()])
+            pending = [i for i in pending
+                       if results[i] is not None
+                       and results[i].status.code != int(StatusCode.OK)
+                       and Status(StatusCode(results[i].status.code)).retryable]
+            if not pending:
+                break
+            await self._backoff(attempt)
+            await self._maybe_refresh()
+        return [r or IOResult(WireStatus(int(StatusCode.INTERNAL), "unset"))
+                for r in results], payloads
+
+    # --- file-level ops over a layout ---
+
+    async def write_file_range(self, layout: FileLayout, inode: int,
+                               offset: int, data: bytes) -> list[IOResult]:
+        """Slice [offset, +len) into chunk writes and run them concurrently."""
+        pieces = layout.chunk_span(offset, len(data))
+        tasks = []
+        pos = 0
+        for idx, coff, span in pieces:
+            chunk_data = data[pos: pos + span]
+            pos += span
+            tasks.append(self.write_chunk(
+                layout.chain_of(idx), ChunkId(inode, idx), coff, chunk_data,
+                chunk_size=layout.chunk_size))
+        return list(await asyncio.gather(*tasks))
+
+    async def read_file_range(self, layout: FileLayout, inode: int,
+                              offset: int, length: int) -> tuple[bytes, list[IOResult]]:
+        pieces = layout.chunk_span(offset, length)
+        ios = [ReadIO(chunk_id=ChunkId(inode, idx), chain_id=layout.chain_of(idx),
+                      offset=coff, length=span,
+                      verify_checksum=self.cfg.verify_checksums)
+               for idx, coff, span in pieces]
+        results, payloads = await self.batch_read(ios)
+        data = bytearray()
+        for (idx, coff, span), r, p in zip(pieces, results, payloads):
+            if r.status.code == int(StatusCode.CHUNK_NOT_FOUND):
+                data += b"\x00" * span  # hole
+            else:
+                data += p
+                if len(p) < span:
+                    data += b"\x00" * (span - len(p))  # short chunk tail
+        return bytes(data), results
+
+    async def query_last_chunk(self, layout: FileLayout, inode: int) -> int:
+        """File length via per-chain last-chunk queries (FileOperation analog)."""
+        routing = self.routing()
+        best = 0
+        for chain_id in set(layout.chains):
+            chain = routing.chain(chain_id)
+            if chain is None:
+                continue
+            head = chain.head()
+            if head is None:
+                continue
+            rsp, _ = await self.client.call(
+                routing.node_address(head.node_id), "Storage.query_last_chunk",
+                QueryLastChunkReq(chain_id=chain_id, inode=inode))
+            if rsp.last_index >= 0:
+                best = max(best, rsp.last_index * layout.chunk_size + rsp.last_length)
+        return best
+
+    async def remove_file_chunks(self, layout: FileLayout, inode: int) -> None:
+        routing = self.routing()
+        for chain_id in set(layout.chains):
+            chain = routing.chain(chain_id)
+            if chain is None or chain.head() is None:
+                continue
+            await self.client.call(
+                routing.node_address(chain.head().node_id),
+                "Storage.remove_chunks",
+                RemoveChunksReq(chain_id=chain_id, inode=inode))
+
+    async def truncate_file(self, layout: FileLayout, inode: int,
+                            new_length: int) -> None:
+        """Remove whole chunks past the cut, truncate the boundary chunk."""
+        routing = self.routing()
+        boundary = new_length // layout.chunk_size
+        boundary_off = new_length - boundary * layout.chunk_size
+        for chain_id in set(layout.chains):
+            chain = routing.chain(chain_id)
+            if chain is None or chain.head() is None:
+                continue
+            begin = boundary + (1 if boundary_off else 0)
+            await self.client.call(
+                routing.node_address(chain.head().node_id),
+                "Storage.remove_chunks",
+                RemoveChunksReq(chain_id=chain_id, inode=inode,
+                                begin_index=begin))
+        if boundary_off:
+            await self.write_chunk(
+                layout.chain_of(boundary), ChunkId(inode, boundary), 0, b"",
+                chunk_size=layout.chunk_size, update_type=UpdateType.TRUNCATE,
+                truncate_len=boundary_off)
+
+    async def _backoff(self, attempt: int) -> None:
+        await asyncio.sleep(self.cfg.retry_backoff_s * (2 ** min(attempt, 6))
+                            * (0.5 + random.random()))
+
+    async def close(self) -> None:
+        await self.client.close()

@@ -70,12 +70,17 @@ class Server:
         conn.start()
 
     async def stop(self) -> None:
+        # close live connections BEFORE wait_closed(): since 3.12,
+        # Server.wait_closed() blocks until every connection transport is
+        # closed, so the old order deadlocks while clients stay connected
         if self._server:
             self._server.close()
+        # drain until empty: a connection accepted during shutdown may be
+        # registered after a one-shot snapshot would have been taken
+        while self._conns:
+            await next(iter(self._conns)).close()
+        if self._server:
             await self._server.wait_closed()
-        for conn in list(self._conns):
-            await conn.close()
-        self._conns.clear()
 
     @property
     def address(self) -> str:
