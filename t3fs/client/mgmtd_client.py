@@ -1,0 +1,120 @@
+"""MgmtdClient: routing-info cache + heartbeat loop.
+
+Reference analogs: client/mgmtd/MgmtdClient.h — background-refreshed
+RoutingInfo cache with role-split interfaces (ForClient refreshes routing;
+ForServer additionally registers and heartbeats with local target states).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import Callable
+
+from t3fs.mgmtd.service import (
+    GetRoutingInfoReq, HeartbeatReq,
+)
+from t3fs.mgmtd.types import LocalTargetState, NodeInfo, RoutingInfo
+from t3fs.net.client import Client
+from t3fs.utils.status import StatusError
+
+log = logging.getLogger("t3fs.client.mgmtd")
+
+
+class MgmtdClient:
+    """ForClient role: keeps a fresh RoutingInfo cache."""
+
+    def __init__(self, mgmtd_address: str, client: Client | None = None,
+                 refresh_period_s: float = 0.5):
+        self.mgmtd_address = mgmtd_address
+        self.client = client or Client()
+        self.refresh_period_s = refresh_period_s
+        self._routing = RoutingInfo(version=0)
+        self._task: asyncio.Task | None = None
+        self._stopped = asyncio.Event()
+
+    def routing(self) -> RoutingInfo:
+        return self._routing
+
+    async def refresh(self) -> RoutingInfo:
+        try:
+            rsp, _ = await self.client.call(
+                self.mgmtd_address, "Mgmtd.get_routing_info",
+                GetRoutingInfoReq(known_version=self._routing.version),
+                timeout=5.0)
+            if rsp.info is not None:
+                self._routing = rsp.info
+        except StatusError as e:
+            log.warning("routing refresh failed: %s", e)
+        return self._routing
+
+    async def start(self) -> None:
+        await self.refresh()
+        self._task = asyncio.create_task(self._loop(), name="mgmtd-refresh")
+
+    async def _loop(self) -> None:
+        while not self._stopped.is_set():
+            await asyncio.sleep(self.refresh_period_s)
+            await self.refresh()
+
+    async def stop(self) -> None:
+        self._stopped.set()
+        if self._task:
+            self._task.cancel()
+            try:
+                await self._task
+            except (asyncio.CancelledError, Exception):
+                pass
+        await self.client.close()
+
+
+class MgmtdClientForServer(MgmtdClient):
+    """ForServer role: + registration & heartbeat loop carrying local target
+    states (the failure-detection input, SURVEY.md §3.5)."""
+
+    def __init__(self, mgmtd_address: str, node: NodeInfo,
+                 target_states: Callable[[], dict[int, LocalTargetState]],
+                 client: Client | None = None,
+                 heartbeat_period_s: float = 0.3,
+                 refresh_period_s: float = 0.5):
+        super().__init__(mgmtd_address, client, refresh_period_s)
+        self.node = node
+        self.target_states = target_states
+        self.heartbeat_period_s = heartbeat_period_s
+        self._hb_task: asyncio.Task | None = None
+        self.last_heartbeat_ok: float = 0.0
+
+    async def heartbeat_once(self) -> bool:
+        try:
+            rsp, _ = await self.client.call(
+                self.mgmtd_address, "Mgmtd.heartbeat",
+                HeartbeatReq(node=self.node, target_states=self.target_states(),
+                             routing_version=self._routing.version),
+                timeout=5.0)
+            self.last_heartbeat_ok = time.time()
+            if rsp.routing_version > self._routing.version:
+                await self.refresh()
+            return True
+        except StatusError as e:
+            log.warning("heartbeat failed: %s", e)
+            return False
+
+    async def start(self) -> None:
+        await self.heartbeat_once()
+        await super().start()
+        self._hb_task = asyncio.create_task(self._hb_loop(), name="mgmtd-hb")
+
+    async def _hb_loop(self) -> None:
+        while not self._stopped.is_set():
+            await asyncio.sleep(self.heartbeat_period_s)
+            await self.heartbeat_once()
+
+    async def stop(self) -> None:
+        if self._hb_task:
+            self._hb_task.cancel()
+            try:
+                await self._hb_task
+            except (asyncio.CancelledError, Exception):
+                pass
+        await super().stop()

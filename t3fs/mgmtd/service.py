@@ -1,0 +1,349 @@
+"""Mgmtd: cluster manager service.
+
+Reference analogs (SURVEY.md §2.4): MgmtdState (lease, MgmtdState.h:28),
+MgmtdOperator ops (heartbeat, getRoutingInfo, setChainTable, updateChain...),
+background MgmtdHeartbeatChecker (dead after T), MgmtdChainsUpdater applying
+the LocalState x PublicState transition table (updateChain.h:38
+generateNewChain; docs/design_notes.md:201-231), MgmtdLeaseExtender.
+
+State lives in the transactional KV (same store as file metadata, like the
+reference persists its lease/chains in FoundationDB); heartbeat liveness is
+in-memory (a restarted mgmtd re-learns it within one heartbeat period).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from dataclasses import dataclass, field
+
+from t3fs.kv.engine import KVEngine, with_transaction
+from t3fs.kv.prefixes import KeyPrefix
+from t3fs.mgmtd.types import (
+    ChainInfo, ChainTable, ChainTargetInfo, LocalTargetState, NodeInfo,
+    PublicTargetState, RoutingInfo,
+)
+from t3fs.net.server import rpc_method, service
+from t3fs.utils import serde
+from t3fs.utils.serde import serde_struct
+from t3fs.utils.status import StatusCode, make_error
+
+log = logging.getLogger("t3fs.mgmtd")
+
+
+@serde_struct
+@dataclass
+class HeartbeatReq:
+    node: NodeInfo = field(default_factory=NodeInfo)
+    target_states: dict[int, LocalTargetState] = field(default_factory=dict)
+    routing_version: int = 0
+
+
+@serde_struct
+@dataclass
+class HeartbeatRsp:
+    routing_version: int = 0
+    primary: bool = True
+
+
+@serde_struct
+@dataclass
+class GetRoutingInfoReq:
+    known_version: int = 0
+
+
+@serde_struct
+@dataclass
+class GetRoutingInfoRsp:
+    info: RoutingInfo | None = None   # None when caller is up to date
+
+
+@serde_struct
+@dataclass
+class SetChainsReq:
+    chains: list[ChainInfo] = field(default_factory=list)
+    tables: list[ChainTable] = field(default_factory=list)
+
+
+@serde_struct
+@dataclass
+class OkRsp:
+    ok: bool = True
+
+
+@serde_struct
+@dataclass
+class LeaseInfo:
+    holder_node: int = 0
+    holder_address: str = ""
+    expires_at: float = 0.0
+
+
+@dataclass
+class MgmtdConfig:
+    heartbeat_timeout_s: float = 2.0     # node dead after this silence
+    chains_update_period_s: float = 0.25
+    lease_ttl_s: float = 10.0
+    lease_extend_period_s: float = 3.0
+
+
+class MgmtdState:
+    """Persistent cluster state over the KV + in-memory liveness."""
+
+    def __init__(self, kv: KVEngine, node_id: int, address: str,
+                 cfg: MgmtdConfig):
+        self.kv = kv
+        self.node_id = node_id
+        self.address = address
+        self.cfg = cfg
+        self.last_heartbeat: dict[int, float] = {}
+        self.local_states: dict[int, LocalTargetState] = {}   # target -> state
+        self._routing_cache: RoutingInfo | None = None
+        # startup grace: a restarted mgmtd has an empty liveness map — treat
+        # every node as alive until one full heartbeat window has passed, or
+        # the first updater tick would demote the whole healthy cluster
+        self.started_at: float = time.time()
+
+    # --- lease (primary election) ---
+
+    async def try_acquire_lease(self) -> bool:
+        now = time.time()
+
+        async def txn_fn(txn):
+            raw = txn.get(KeyPrefix.LEASE.key())
+            lease = serde.loads(raw) if raw else LeaseInfo()
+            if lease.holder_node not in (0, self.node_id) and lease.expires_at > now:
+                return False
+            txn.set(KeyPrefix.LEASE.key(), serde.dumps(LeaseInfo(
+                self.node_id, self.address, now + self.cfg.lease_ttl_s)))
+            return True
+
+        return await with_transaction(self.kv, txn_fn)
+
+    def is_primary(self) -> bool:
+        txn = self.kv.transaction()
+        raw = txn.get(KeyPrefix.LEASE.key(), snapshot=True)
+        if not raw:
+            return False
+        lease = serde.loads(raw)
+        return lease.holder_node == self.node_id and lease.expires_at > time.time()
+
+    # --- persistent records ---
+
+    async def load_routing(self) -> RoutingInfo:
+        txn = self.kv.transaction()
+        info = RoutingInfo()
+        raw = txn.get(KeyPrefix.ROUTING_VER.key(), snapshot=True)
+        info.version = int(raw) if raw else 1
+        for k, v in txn.get_range(KeyPrefix.NODE.value, KeyPrefix.NODE.value + b"\xff",
+                                  snapshot=True):
+            n: NodeInfo = serde.loads(v)
+            info.nodes[n.node_id] = n
+        for k, v in txn.get_range(KeyPrefix.CHAIN.value, KeyPrefix.CHAIN.value + b"\xff",
+                                  snapshot=True):
+            c: ChainInfo = serde.loads(v)
+            info.chains[c.chain_id] = c
+        for k, v in txn.get_range(KeyPrefix.CHAIN_TABLE.value,
+                                  KeyPrefix.CHAIN_TABLE.value + b"\xff", snapshot=True):
+            t: ChainTable = serde.loads(v)
+            info.chain_tables[t.table_id] = t
+        self._routing_cache = info
+        return info
+
+    def routing(self) -> RoutingInfo:
+        return self._routing_cache or RoutingInfo()
+
+    async def save_node(self, node: NodeInfo) -> None:
+        async def txn_fn(txn):
+            txn.set(KeyPrefix.NODE.key(str(node.node_id).encode()), serde.dumps(node))
+        await with_transaction(self.kv, txn_fn)
+
+    async def save_chains(self, chains: list[ChainInfo],
+                          tables: list[ChainTable] = ()) -> None:
+        async def txn_fn(txn):
+            for c in chains:
+                txn.set(KeyPrefix.CHAIN.key(str(c.chain_id).encode()), serde.dumps(c))
+            for t in tables or ():
+                txn.set(KeyPrefix.CHAIN_TABLE.key(str(t.table_id).encode()),
+                        serde.dumps(t))
+            raw = txn.get(KeyPrefix.ROUTING_VER.key())
+            txn.set(KeyPrefix.ROUTING_VER.key(), str(int(raw or 1) + 1).encode())
+        await with_transaction(self.kv, txn_fn)
+        await self.load_routing()
+
+    def node_alive(self, node_id: int) -> bool:
+        now = time.time()
+        hb = self.last_heartbeat.get(node_id)
+        if hb is None:
+            return now - self.started_at < self.cfg.heartbeat_timeout_s
+        return now - hb < self.cfg.heartbeat_timeout_s
+
+
+def next_chain_state(chain: ChainInfo,
+                     alive: dict[int, bool],
+                     local: dict[int, LocalTargetState]) -> ChainInfo | None:
+    """One step of the chain state machine (generateNewChain analog,
+    mgmtd/service/updateChain.h:38; table at docs/design_notes.md:201-231).
+    Returns a NEW ChainInfo with bumped version if anything changed."""
+    targets = [ChainTargetInfo(t.target_id, t.node_id, t.public_state)
+               for t in chain.targets]
+    changed = False
+    serving_count = sum(1 for t in targets
+                        if t.public_state == PublicTargetState.SERVING)
+    # a LASTSRV target holds the only authoritative copy: while one exists,
+    # a returning stale target must NOT be seated as serving (write loss)
+    has_lastsrv = any(t.public_state == PublicTargetState.LASTSRV
+                      for t in targets)
+    for t in targets:
+        a = alive.get(t.node_id, False)
+        ls = local.get(t.target_id, LocalTargetState.INVALID)
+        if t.public_state == PublicTargetState.SERVING and not a:
+            # last serving target holds the authoritative copy: LASTSRV
+            t.public_state = (PublicTargetState.LASTSRV if serving_count == 1
+                              else PublicTargetState.OFFLINE)
+            serving_count -= 1
+            changed = True
+        elif t.public_state == PublicTargetState.SYNCING and not a:
+            t.public_state = PublicTargetState.OFFLINE
+            changed = True
+        elif t.public_state == PublicTargetState.LASTSRV and a:
+            t.public_state = PublicTargetState.SERVING
+            serving_count += 1
+            has_lastsrv = False
+            changed = True
+        elif t.public_state in (PublicTargetState.OFFLINE, PublicTargetState.WAITING) \
+                and a and ls in (LocalTargetState.ONLINE, LocalTargetState.UPTODATE):
+            if serving_count > 0:
+                t.public_state = PublicTargetState.SYNCING   # rejoin at tail
+                changed = True
+            elif not has_lastsrv:
+                # true cold start (nobody ever served or everyone wiped):
+                # the returning target seeds the chain
+                t.public_state = PublicTargetState.SERVING
+                serving_count += 1
+                changed = True
+            # else: wait for the LASTSRV holder — it has the newest data
+        elif t.public_state == PublicTargetState.SYNCING and a \
+                and ls == LocalTargetState.UPTODATE:
+            t.public_state = PublicTargetState.SERVING       # promoted to tail
+            serving_count += 1
+            changed = True
+    if not changed:
+        return None
+    # canonical order: serving (original order), then syncing, then the rest —
+    # offline targets move to the chain tail (design_notes.md:226)
+    order = {PublicTargetState.SERVING: 0, PublicTargetState.SYNCING: 1,
+             PublicTargetState.LASTSRV: 2, PublicTargetState.WAITING: 3,
+             PublicTargetState.OFFLINE: 4}
+    targets.sort(key=lambda t: order[t.public_state])
+    return ChainInfo(chain.chain_id, chain.chain_ver + 1, targets)
+
+
+@service("Mgmtd")
+class MgmtdService:
+    """RPC surface (fbs/mgmtd/MgmtdServiceDef.h:3-26 subset)."""
+
+    def __init__(self, state: MgmtdState):
+        self.state = state
+
+    def _require_primary(self):
+        if not self.state.is_primary():
+            raise make_error(StatusCode.MGMTD_NOT_PRIMARY,
+                             f"mgmtd {self.state.node_id} lost the lease")
+
+    @rpc_method
+    async def heartbeat(self, req: HeartbeatReq, payload, conn):
+        self._require_primary()
+        st = self.state
+        known = st.routing().nodes.get(req.node.node_id)
+        st.last_heartbeat[req.node.node_id] = time.time()
+        for tid, ls in req.target_states.items():
+            st.local_states[int(tid)] = LocalTargetState(ls)
+        if known is None or known.address != req.node.address:
+            await st.save_node(req.node)
+            await st.load_routing()
+        return HeartbeatRsp(routing_version=st.routing().version), b""
+
+    @rpc_method
+    async def get_routing_info(self, req: GetRoutingInfoReq, payload, conn):
+        info = self.state.routing()
+        if req.known_version >= info.version:
+            return GetRoutingInfoRsp(info=None), b""
+        return GetRoutingInfoRsp(info=info), b""
+
+    @rpc_method
+    async def set_chains(self, req: SetChainsReq, payload, conn):
+        """Admin op: install chains/chain tables (UploadChainTable analog)."""
+        self._require_primary()
+        await self.state.save_chains(req.chains, req.tables)
+        return OkRsp(), b""
+
+
+class MgmtdServer:
+    """State + service + background loops (chains updater, lease extender)."""
+
+    def __init__(self, kv: KVEngine, node_id: int = 1, address: str = "",
+                 cfg: MgmtdConfig | None = None):
+        self.cfg = cfg or MgmtdConfig()
+        self.state = MgmtdState(kv, node_id, address, self.cfg)
+        self.service = MgmtdService(self.state)
+        self._tasks: list[asyncio.Task] = []
+        self._stopped = asyncio.Event()
+
+    async def start(self) -> None:
+        acquired = await self.state.try_acquire_lease()
+        if acquired:
+            log.info("mgmtd %d acquired primary lease", self.state.node_id)
+        await self.state.load_routing()
+        self._tasks = [
+            asyncio.create_task(self._chains_updater(), name="mgmtd-chains"),
+            asyncio.create_task(self._lease_extender(), name="mgmtd-lease"),
+        ]
+
+    async def stop(self) -> None:
+        self._stopped.set()
+        for t in self._tasks:
+            t.cancel()
+        for t in self._tasks:
+            try:
+                await t
+            except (asyncio.CancelledError, Exception):
+                pass
+
+    async def _lease_extender(self) -> None:
+        while not self._stopped.is_set():
+            await asyncio.sleep(self.cfg.lease_extend_period_s)
+            try:
+                await self.state.try_acquire_lease()
+            except Exception:
+                log.exception("lease extension failed")
+
+    async def _chains_updater(self) -> None:
+        """Primary-only periodic scan applying the chain state machine
+        (MgmtdChainsUpdater.cc:72 analog)."""
+        while not self._stopped.is_set():
+            await asyncio.sleep(self.cfg.chains_update_period_s)
+            try:
+                if not self.state.is_primary():
+                    continue
+                await self.update_chains_once()
+            except Exception:
+                log.exception("chains updater failed")
+
+    async def update_chains_once(self) -> int:
+        """One updater tick; returns number of chains changed (test hook)."""
+        st = self.state
+        routing = st.routing()
+        updated = []
+        for chain in routing.chains.values():
+            alive = {t.node_id: st.node_alive(t.node_id) for t in chain.targets}
+            nxt = next_chain_state(chain, alive, st.local_states)
+            if nxt is not None:
+                updated.append(nxt)
+                log.info("chain %d v%d -> v%d: %s", nxt.chain_id,
+                         chain.chain_ver, nxt.chain_ver,
+                         [(t.target_id, t.public_state.name) for t in nxt.targets])
+        if updated:
+            await st.save_chains(updated)
+        return len(updated)

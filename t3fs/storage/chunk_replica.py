@@ -47,7 +47,13 @@ class ChunkReplica:
 
         if io.update_type == UpdateType.REPLACE or io.is_sync:
             # full-chunk-replace (resync / write-during-recovery,
-            # design_notes.md:240-246): no version gating, adopt shipped vers
+            # design_notes.md:240-246).  Version-MONOTONIC: a replace may
+            # never regress a newer chunk — the resync worker snapshots
+            # without holding the predecessor's chunk lock, so a stale
+            # replace can arrive after a live-forwarded newer one.
+            if meta is not None and meta.update_ver > io.update_ver:
+                return IOResult(WireStatus(), meta.length, meta.update_ver,
+                                meta.commit_ver, meta.chain_ver, meta.checksum)
             checksum = self.crc(payload)
             if io.checksum and checksum != io.checksum:
                 raise make_error(StatusCode.CHECKSUM_MISMATCH,

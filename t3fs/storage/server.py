@@ -1,0 +1,63 @@
+"""StorageServer: one storage node process wired to mgmtd.
+
+Reference analog: storage/service/StorageServer + Components wiring +
+TwoPhaseApplication<StorageServer> bootstrap (storage.cpp): the node hosts
+the Storage RPC service, heartbeats to mgmtd with local target states, and
+runs the resync worker.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from t3fs.client.mgmtd_client import MgmtdClientForServer
+from t3fs.mgmtd.types import NodeInfo
+from t3fs.net.client import Client
+from t3fs.net.server import Server
+from t3fs.storage.resync import ResyncWorker
+from t3fs.storage.service import StorageNode, StorageService
+
+log = logging.getLogger("t3fs.storage")
+
+
+class StorageServer:
+    def __init__(self, node_id: int, mgmtd_address: str, *,
+                 host: str = "127.0.0.1", port: int = 0,
+                 heartbeat_period_s: float = 0.3,
+                 resync_period_s: float = 0.2):
+        self.node_id = node_id
+        self.server = Server(host, port)
+        self.node = StorageNode(node_id, self._routing, Client())
+        self.service = StorageService(self.node)
+        self.server.add_service(self.service)
+        self.mgmtd_address = mgmtd_address
+        self.heartbeat_period_s = heartbeat_period_s
+        self.resync = ResyncWorker(self.node, period_s=resync_period_s)
+        self.mgmtd: MgmtdClientForServer | None = None
+
+    def _routing(self):
+        return self.mgmtd.routing() if self.mgmtd else None
+
+    def add_target(self, target_id: int, root: str, **kw):
+        return self.node.add_target(target_id, root, **kw)
+
+    async def start(self) -> None:
+        await self.server.start()
+        self.mgmtd = MgmtdClientForServer(
+            self.mgmtd_address,
+            NodeInfo(self.node_id, self.server.address, "storage"),
+            lambda: dict(self.node.local_states),
+            heartbeat_period_s=self.heartbeat_period_s,
+            refresh_period_s=self.heartbeat_period_s)
+        await self.mgmtd.start()
+        await self.resync.start()
+        log.info("storage node %d up at %s", self.node_id, self.server.address)
+
+    async def stop(self) -> None:
+        await self.resync.stop()
+        if self.mgmtd:
+            await self.mgmtd.stop()
+        await self.node.client.close()
+        await self.server.stop()
+        for t in self.node.targets.values():
+            t.engine.close()

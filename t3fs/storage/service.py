@@ -18,7 +18,9 @@ import asyncio
 import logging
 from typing import Callable
 
-from t3fs.mgmtd.types import ChainInfo, PublicTargetState, RoutingInfo
+from t3fs.mgmtd.types import (
+    ChainInfo, LocalTargetState, PublicTargetState, RoutingInfo,
+)
 from t3fs.net.conn import Connection
 from t3fs.net.rdma import remote_read, remote_write
 from t3fs.net.server import rpc_method, service
@@ -27,9 +29,10 @@ from t3fs.storage.chunk_engine import ChunkEngine
 from t3fs.storage.chunk_replica import ChunkReplica
 from t3fs.storage.reliable import ReliableForwarding, ReliableUpdate
 from t3fs.storage.types import (
-    BatchReadReq, BatchReadRsp, ChunkId, IOResult, QueryLastChunkReq,
-    QueryLastChunkRsp, ReadIO, RemoveChunksReq, SpaceInfoRsp, TruncateChunkReq,
-    UpdateIO, UpdateType, WriteReq, WriteRsp,
+    BatchReadReq, BatchReadRsp, ChunkId, IOResult,
+    QueryLastChunkReq, QueryLastChunkRsp, ReadIO, RemoveChunksReq,
+    SpaceInfoRsp, SyncDoneReq, SyncDoneRsp, SyncStartReq, SyncStartRsp,
+    TruncateChunkReq, UpdateIO, UpdateType, WriteReq, WriteRsp,
 )
 from t3fs.utils.fault_injection import fault_raise
 from t3fs.utils.metrics import CountRecorder, LatencyRecorder
@@ -64,6 +67,10 @@ class StorageNode:
         self.client = client
         self.forward_timeout_s = forward_timeout_s
         self.targets: dict[int, StorageTarget] = {}
+        # local target states reported in heartbeats (failure-detection input,
+        # fbs/mgmtd/LocalTargetInfo.h analog): a fresh/restarted target is
+        # ONLINE (data possibly stale) until resync marks it UPTODATE
+        self.local_states: dict[int, LocalTargetState] = {}
         self.reliable_update = ReliableUpdate()
         self.forwarding = ReliableForwarding(self)
         self.write_latency = LatencyRecorder(f"storage.write.n{node_id}")
@@ -72,9 +79,11 @@ class StorageNode:
     def routing(self) -> RoutingInfo:
         return self._routing_provider()
 
-    def add_target(self, target_id: int, root: str) -> StorageTarget:
+    def add_target(self, target_id: int, root: str,
+                   state: LocalTargetState = LocalTargetState.ONLINE) -> StorageTarget:
         t = StorageTarget(target_id, root)
         self.targets[target_id] = t
+        self.local_states[target_id] = state
         return t
 
     # --- chain helpers ---
@@ -296,3 +305,20 @@ class StorageService:
         used = sum(t.engine.stats().used_bytes for t in self.node.targets.values())
         alloc = sum(t.engine.stats().allocated_bytes for t in self.node.targets.values())
         return SpaceInfoRsp(capacity=alloc, used=used, free=max(0, alloc - used)), b""
+
+    # ---- resync protocol (predecessor-driven, ResyncWorker.cc analog) ----
+
+    @rpc_method
+    async def sync_start(self, req: SyncStartReq, payload, conn):
+        """Return the full chunk-meta dump of this chain's local target so the
+        predecessor can diff (ResyncWorker.cc:101-180)."""
+        _, target = self.node._check_chain(req.chain_id, 0)
+        return SyncStartRsp(metas=target.engine.all_metas()), b""
+
+    @rpc_method
+    async def sync_done(self, req: SyncDoneReq, payload, conn):
+        """Predecessor finished streaming diffs: this target's data is now
+        up to date — report UPTODATE in heartbeats so mgmtd promotes it."""
+        _, target = self.node._check_chain(req.chain_id, 0)
+        self.node.local_states[target.target_id] = LocalTargetState.UPTODATE
+        return SyncDoneRsp(), b""

@@ -179,3 +179,29 @@ class SpaceInfoRsp:
     capacity: int = 0
     used: int = 0
     free: int = 0
+
+
+@serde_struct
+@dataclass
+class SyncStartReq:
+    """Predecessor asks the syncing target for its full chunk-meta dump
+    (reference: syncStart RPC, ResyncWorker.cc:101-180)."""
+    chain_id: int = 0
+
+
+@serde_struct
+@dataclass
+class SyncStartRsp:
+    metas: list[ChunkMeta] = field(default_factory=list)
+
+
+@serde_struct
+@dataclass
+class SyncDoneReq:
+    chain_id: int = 0
+
+
+@serde_struct
+@dataclass
+class SyncDoneRsp:
+    ok: bool = True

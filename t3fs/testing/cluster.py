@@ -1,0 +1,122 @@
+"""LocalCluster: real mgmtd + N storage nodes in one process.
+
+Reference analog: testing_configs/ single-host cluster launcher (mgmtd + 5
+storage nodes with a generated chain table, testing_configs/README.md) —
+here in-process for tests, with fast failure-detection knobs.
+"""
+
+from __future__ import annotations
+
+import tempfile
+
+from t3fs.client.mgmtd_client import MgmtdClient
+from t3fs.client.storage_client import StorageClient, StorageClientConfig
+from t3fs.kv.engine import MemKVEngine
+from t3fs.mgmtd.service import MgmtdConfig, MgmtdServer, SetChainsReq
+from t3fs.mgmtd.types import ChainInfo, ChainTable, ChainTargetInfo, PublicTargetState
+from t3fs.net.client import Client
+from t3fs.net.server import Server
+from t3fs.storage.server import StorageServer
+
+
+class LocalCluster:
+    """mgmtd + N storage nodes + storage client, fast knobs for tests."""
+
+    def __init__(self, num_nodes: int = 3, replicas: int = 3,
+                 num_chains: int = 1,
+                 heartbeat_timeout_s: float = 0.6):
+        self.num_nodes = num_nodes
+        self.replicas = replicas
+        self.num_chains = num_chains
+        self.kv = MemKVEngine()
+        self.mgmtd_cfg = MgmtdConfig(
+            heartbeat_timeout_s=heartbeat_timeout_s,
+            chains_update_period_s=0.1,
+            lease_ttl_s=5.0, lease_extend_period_s=1.0)
+        self.mgmtd_rpc = Server()
+        self.mgmtd: MgmtdServer | None = None
+        self.storage: dict[int, StorageServer] = {}
+        self._tmp = tempfile.TemporaryDirectory(prefix="t3fs-cluster-")
+        self.admin = Client()
+        self.mgmtd_client: MgmtdClient | None = None
+        self.sc: StorageClient | None = None
+
+    def target_id(self, node_id: int, chain_idx: int = 0) -> int:
+        return node_id * 100 + chain_idx + 1
+
+    def node_root(self, node_id: int) -> str:
+        return f"{self._tmp.name}/node{node_id}"
+
+    async def start(self) -> None:
+        self.mgmtd = MgmtdServer(self.kv, 1, "", self.mgmtd_cfg)
+        self.mgmtd_rpc.add_service(self.mgmtd.service)
+        await self.mgmtd_rpc.start()
+        await self.mgmtd.start()
+
+        for i in range(self.num_nodes):
+            await self.start_storage_node(i + 1)
+
+        # install chains: chain c uses nodes (c, c+1, ... c+replicas-1) mod N
+        chains = []
+        for c in range(self.num_chains):
+            targets = []
+            for r in range(self.replicas):
+                node_id = (c + r) % self.num_nodes + 1
+                targets.append(ChainTargetInfo(
+                    self.target_id(node_id, c), node_id,
+                    PublicTargetState.SERVING))
+            chains.append(ChainInfo(chain_id=c + 1, chain_ver=1, targets=targets))
+        await self.admin.call(
+            self.mgmtd_rpc.address, "Mgmtd.set_chains",
+            SetChainsReq(chains=chains,
+                         tables=[ChainTable(1, [c.chain_id for c in chains])]))
+
+        # wait until every storage node has pulled the installed chains so
+        # first writes don't race routing propagation
+        import asyncio
+        want = self.mgmtd.state.routing().version
+        for _ in range(100):
+            if all(ss.mgmtd.routing().version >= want
+                   for ss in self.storage.values()):
+                break
+            await asyncio.sleep(0.05)
+
+        self.mgmtd_client = MgmtdClient(self.mgmtd_rpc.address,
+                                        refresh_period_s=0.1)
+        await self.mgmtd_client.start()
+        self.sc = StorageClient(
+            self.mgmtd_client.routing,
+            config=StorageClientConfig(retry_backoff_s=0.05, max_retries=12),
+            refresh_routing=self.mgmtd_client.refresh)
+
+    async def start_storage_node(self, node_id: int) -> StorageServer:
+        ss = StorageServer(node_id, self.mgmtd_rpc.address,
+                           heartbeat_period_s=0.15, resync_period_s=0.1)
+        for c in range(self.num_chains):
+            # every node pre-creates targets for chains it may serve
+            ss.add_target(self.target_id(node_id, c),
+                          f"{self.node_root(node_id)}/t{c}")
+        await ss.start()
+        self.storage[node_id] = ss
+        return ss
+
+    async def kill_storage_node(self, node_id: int) -> None:
+        """Fail-stop: the node vanishes (no clean goodbye)."""
+        ss = self.storage.pop(node_id)
+        await ss.stop()
+
+    def chain(self, chain_id: int = 1) -> ChainInfo:
+        return self.mgmtd.state.routing().chains[chain_id]
+
+    async def stop(self) -> None:
+        if self.sc:
+            await self.sc.close()
+        if self.mgmtd_client:
+            await self.mgmtd_client.stop()
+        await self.admin.close()
+        for node_id in list(self.storage):
+            await self.kill_storage_node(node_id)
+        if self.mgmtd:
+            await self.mgmtd.stop()
+        await self.mgmtd_rpc.stop()
+        self._tmp.cleanup()

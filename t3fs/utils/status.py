@@ -80,6 +80,8 @@ RETRYABLE_CODES = frozenset({
     StatusCode.TXN_CONFLICT, StatusCode.TXN_TOO_OLD, StatusCode.TXN_RETRYABLE,
     StatusCode.CHUNK_BUSY, StatusCode.CHAIN_VERSION_MISMATCH,
     StatusCode.TARGET_OFFLINE, StatusCode.NOT_HEAD, StatusCode.TARGET_SYNCING,
+    # routing staleness: the chain/target may simply not have propagated yet
+    StatusCode.TARGET_NOT_FOUND,
     StatusCode.MGMTD_NOT_PRIMARY, StatusCode.MGMTD_STALE_ROUTING,
 })
 

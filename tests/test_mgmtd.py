@@ -1,0 +1,115 @@
+"""Mgmtd: chain state machine transitions, lease, routing versioning
+(reference analogs: tests/mgmtd/TestMgmtdStore.cc, chain update tests)."""
+
+import asyncio
+
+import pytest
+
+from t3fs.kv.engine import MemKVEngine
+from t3fs.mgmtd.service import MgmtdConfig, MgmtdServer, MgmtdState, next_chain_state
+from t3fs.mgmtd.types import (
+    ChainInfo, ChainTargetInfo, LocalTargetState, PublicTargetState,
+)
+
+
+def chain(*states):
+    return ChainInfo(1, 1, [
+        ChainTargetInfo(100 + i, i + 1, s) for i, s in enumerate(states)])
+
+
+S = PublicTargetState.SERVING
+SY = PublicTargetState.SYNCING
+OFF = PublicTargetState.OFFLINE
+LAST = PublicTargetState.LASTSRV
+
+
+def test_serving_node_dies_moves_to_tail():
+    c = chain(S, S, S)
+    nxt = next_chain_state(c, {1: True, 2: False, 3: True}, {})
+    assert nxt.chain_ver == 2
+    assert [(t.target_id, t.public_state) for t in nxt.targets] == [
+        (100, S), (102, S), (101, OFF)]
+
+
+def test_last_serving_becomes_lastsrv():
+    c = chain(S)
+    nxt = next_chain_state(c, {1: False}, {})
+    assert nxt.targets[0].public_state == LAST
+    # comes back: immediately serving again (authoritative copy)
+    nxt2 = next_chain_state(nxt, {1: True}, {})
+    assert nxt2.targets[0].public_state == S
+
+
+def test_offline_rejoin_becomes_syncing_then_serving():
+    c = chain(S, OFF)
+    nxt = next_chain_state(c, {1: True, 2: True},
+                           {101: LocalTargetState.ONLINE})
+    assert nxt.targets[1].public_state == SY
+    # after resync reports UPTODATE -> serving at tail
+    nxt2 = next_chain_state(nxt, {1: True, 2: True},
+                            {101: LocalTargetState.UPTODATE})
+    assert [t.public_state for t in nxt2.targets] == [S, S]
+
+
+def test_no_change_returns_none():
+    c = chain(S, S)
+    assert next_chain_state(c, {1: True, 2: True}, {}) is None
+
+
+def test_syncing_node_dies():
+    c = chain(S, SY)
+    nxt = next_chain_state(c, {1: True, 2: False}, {})
+    assert nxt.targets[1].public_state == OFF
+
+
+def test_lease_single_primary():
+    async def body():
+        kv = MemKVEngine()
+        cfg = MgmtdConfig(lease_ttl_s=5.0)
+        a = MgmtdState(kv, 1, "a:1", cfg)
+        b = MgmtdState(kv, 2, "b:1", cfg)
+        assert await a.try_acquire_lease()
+        assert a.is_primary()
+        assert not await b.try_acquire_lease()  # lease held
+        assert not b.is_primary()
+        assert await a.try_acquire_lease()      # holder extends freely
+    asyncio.run(body())
+
+
+def test_mgmtd_server_routing_updates():
+    async def body():
+        kv = MemKVEngine()
+        srv = MgmtdServer(kv, 1, "", MgmtdConfig(
+            heartbeat_timeout_s=0.3, chains_update_period_s=0.05))
+        await srv.start()
+        try:
+            await srv.state.save_chains([chain(S, S)])
+            v0 = srv.state.routing().version
+            # both nodes heartbeat -> no change
+            import time
+            srv.state.last_heartbeat = {1: time.time(), 2: time.time()}
+            assert await srv.update_chains_once() == 0
+            # node 2 goes silent -> chain reshapes, version bumps
+            srv.state.last_heartbeat[2] = time.time() - 10
+            assert await srv.update_chains_once() == 1
+            info = srv.state.routing()
+            assert info.version == v0 + 1
+            assert info.chains[1].chain_ver == 2
+            assert info.chains[1].serving()[0].target_id == 100
+        finally:
+            await srv.stop()
+    asyncio.run(body())
+
+
+def test_stale_rejoin_waits_for_lastsrv():
+    """B (stale) must not seat as serving while A holds LASTSRV authority."""
+    c = chain(LAST, OFF)
+    nxt = next_chain_state(c, {1: False, 2: True},
+                           {101: LocalTargetState.ONLINE})
+    # B stays out (no change besides nothing) — LASTSRV data would be lost
+    assert nxt is None or nxt.targets[1].public_state != S
+    # A returns: LASTSRV -> SERVING, then B can sync behind it
+    nxt2 = next_chain_state(c, {1: True, 2: True},
+                            {101: LocalTargetState.ONLINE})
+    states = {t.target_id: t.public_state for t in nxt2.targets}
+    assert states[100] == S and states[101] == SY

@@ -1,0 +1,126 @@
+"""End-to-end elastic recovery: fail-stop, chain reshape, writes continue,
+rejoin, resync, promotion back to serving.
+
+Reference analogs: tests/storage/service/TestStorageServiceFailStop.cc,
+tests/storage/sync/TestSyncStartAndDone.cc / TestSyncForward.cc.
+"""
+
+import asyncio
+
+import pytest
+
+from t3fs.client.layout import FileLayout
+from t3fs.mgmtd.types import PublicTargetState
+from t3fs.testing.cluster import LocalCluster
+from t3fs.utils.status import StatusCode
+
+
+async def wait_for(predicate, timeout=10.0, interval=0.05, desc="condition"):
+    loop = asyncio.get_running_loop()
+    deadline = loop.time() + timeout
+    while loop.time() < deadline:
+        if predicate():
+            return
+        await asyncio.sleep(interval)
+    raise AssertionError(f"timeout waiting for {desc}")
+
+
+def test_cluster_write_read():
+    async def body():
+        cluster = LocalCluster(num_nodes=3, replicas=3)
+        await cluster.start()
+        try:
+            lay = FileLayout(chunk_size=4096, chains=[1])
+            data = b"mgmtd-backed" * 500
+            results = await cluster.sc.write_file_range(lay, 1, 0, data)
+            assert all(r.status.code == int(StatusCode.OK) for r in results)
+            got, _ = await cluster.sc.read_file_range(lay, 1, 0, len(data))
+            assert got == data
+        finally:
+            await cluster.stop()
+    asyncio.run(body())
+
+
+def test_failstop_reshape_write_rejoin_resync():
+    async def body():
+        cluster = LocalCluster(num_nodes=3, replicas=3,
+                               heartbeat_timeout_s=0.6)
+        await cluster.start()
+        try:
+            lay = FileLayout(chunk_size=4096, chains=[1])
+            data1 = b"before-failure" * 300
+            await cluster.sc.write_file_range(lay, 1, 0, data1)
+
+            # fail-stop the middle chain member (node 2 / target 201)
+            victim_target = cluster.target_id(2, 0)
+            await cluster.kill_storage_node(2)
+
+            # mgmtd detects silence and reshapes: victim moves to tail OFFLINE
+            await wait_for(
+                lambda: cluster.chain().chain_ver >= 2 and
+                all(t.target_id != victim_target
+                    for t in cluster.chain().serving()),
+                desc="chain reshape after fail-stop")
+            assert len(cluster.chain().serving()) == 2
+
+            # writes continue on the shortened chain
+            data2 = b"during-failure" * 300
+            results = await cluster.sc.write_file_range(lay, 2, 0, data2)
+            assert all(r.status.code == int(StatusCode.OK) for r in results), \
+                [r.status for r in results]
+
+            # node 2 returns with its old (stale) disk
+            await cluster.start_storage_node(2)
+            # mgmtd: OFFLINE+alive -> SYNCING; resync runs; -> SERVING
+            await wait_for(
+                lambda: any(t.target_id == victim_target
+                            for t in cluster.chain().serving()),
+                timeout=15.0, desc="victim promoted back to serving")
+            assert len(cluster.chain().serving()) == 3
+
+            # the rejoined replica must hold BOTH files' data, byte-exact
+            returned = cluster.storage[2].node.targets[victim_target]
+            from t3fs.storage.types import ChunkId
+            for inode, data in ((1, data1), (2, data2)):
+                got = b""
+                for idx in range((len(data) + 4095) // 4096):
+                    got += returned.engine.read(ChunkId(inode, idx))
+                assert got == data, f"inode {inode} diverged on rejoined node"
+
+            # and reads served by the whole cluster still match
+            got, _ = await cluster.sc.read_file_range(lay, 2, 0, len(data2))
+            assert got == data2
+        finally:
+            await cluster.stop()
+    asyncio.run(body())
+
+
+def test_rejoining_node_drops_extra_chunks():
+    """Chunks deleted while a node was down are removed during resync."""
+    async def body():
+        cluster = LocalCluster(num_nodes=3, replicas=3,
+                               heartbeat_timeout_s=0.6)
+        await cluster.start()
+        try:
+            lay = FileLayout(chunk_size=4096, chains=[1])
+            data = b"doomed" * 100
+            await cluster.sc.write_file_range(lay, 5, 0, data)
+
+            victim_target = cluster.target_id(2, 0)
+            await cluster.kill_storage_node(2)
+            await wait_for(lambda: len(cluster.chain().serving()) == 2,
+                           desc="reshape")
+            # remove the file while node 2 is down
+            await cluster.sc.remove_file_chunks(lay, 5)
+
+            await cluster.start_storage_node(2)
+            await wait_for(
+                lambda: any(t.target_id == victim_target
+                            for t in cluster.chain().serving()),
+                timeout=15.0, desc="rejoin")
+            returned = cluster.storage[2].node.targets[victim_target]
+            assert returned.engine.query_range(5) == [], \
+                "stale chunks must be dropped by resync"
+        finally:
+            await cluster.stop()
+    asyncio.run(body())
