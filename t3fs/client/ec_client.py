@@ -1,0 +1,232 @@
+"""Erasure-coded storage client: RS(k+m) stripes over a chain group.
+
+This is the capability t3fs ADDS over the reference (BASELINE.json configs
+#3/#4): the reference has EC only as a *placement* option in its chain-table
+solver (deploy/data_placement/src/model/data_placement.py:484) with no
+encode/decode data path.  Here a stripe of k data chunks gets m parity
+chunks, each of the k+m shards on a different chain (replication factor 1 —
+parity replaces replication), encoded/decoded by the batched GF(2) bit-matmul
+codec (t3fs.ops.jax_codec) that runs on the co-located TPU.
+
+Addressing: data chunk j of stripe s  -> ChunkId(inode, s*k + j)
+            parity chunk p of stripe s -> ChunkId(inode | PARITY_NS, s*m + p)
+Chain placement walks the layout's chain list stripe-by-stripe so recovery
+load spreads (the data_placement balanced-design goal).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from t3fs.ops import jax_codec
+from t3fs.ops.rs import default_rs
+from t3fs.storage.types import ChunkId, IOResult, ReadIO, UpdateType
+from t3fs.utils.serde import serde_struct
+from t3fs.utils.status import StatusCode, make_error
+
+log = logging.getLogger("t3fs.client.ec")
+
+PARITY_NS = 1 << 62   # parity chunk-id namespace bit
+
+
+@serde_struct
+@dataclass
+class ECLayout:
+    k: int = 8
+    m: int = 2
+    chunk_size: int = 1 << 20
+    chains: list[int] = field(default_factory=list)   # >= k+m distinct chains
+
+    def __post_init__(self):
+        assert len(self.chains) >= self.k + self.m, \
+            f"EC({self.k}+{self.m}) needs >= {self.k + self.m} chains"
+
+    def shard_chain(self, stripe: int, shard: int) -> int:
+        """Chain of shard (0..k+m-1) of a stripe; rotates per stripe."""
+        n = len(self.chains)
+        return self.chains[(stripe * (self.k + self.m) + shard) % n]
+
+    def data_chunk(self, inode: int, stripe: int, j: int) -> ChunkId:
+        return ChunkId(inode, stripe * self.k + j)
+
+    def parity_chunk(self, inode: int, stripe: int, p: int) -> ChunkId:
+        return ChunkId(inode | PARITY_NS, stripe * self.m + p)
+
+
+class ECStorageClient:
+    """Stripe-granular EC write/read/repair over a StorageClient."""
+
+    def __init__(self, storage_client, use_device_codec: bool = True):
+        self.sc = storage_client
+        self.use_device = use_device_codec
+
+    # --- codec (TPU path by default; numpy oracle as fallback) ---
+
+    async def _encode(self, data_shards: np.ndarray, k: int, m: int) -> np.ndarray:
+        # off the event loop: XLA compile takes seconds and device compute
+        # releases the GIL — blocking here would stall heartbeats/leases
+        def run():
+            if self.use_device:
+                out = jax_codec.rs_encode_jit(k, m)(data_shards[None, :, :])
+                return np.asarray(out)[0]
+            return default_rs(k, m).encode_ref(data_shards)
+        return await asyncio.to_thread(run)
+
+    async def _reconstruct(self, present_rows: np.ndarray,
+                           present: tuple[int, ...], want: tuple[int, ...],
+                           k: int, m: int) -> np.ndarray:
+        def run():
+            if self.use_device:
+                out = jax_codec.rs_reconstruct_jit(present, want, k, m)(
+                    present_rows[None, :, :])
+                return np.asarray(out)[0]
+            shards = {idx: present_rows[i] for i, idx in enumerate(present)}
+            return default_rs(k, m).decode_ref(shards, list(want))
+        return await asyncio.to_thread(run)
+
+    # --- write ---
+
+    async def write_stripe(self, layout: ECLayout, inode: int, stripe: int,
+                           data: bytes) -> list[IOResult]:
+        """Write one full stripe (k*chunk_size bytes; shorter data is
+        zero-padded on the wire but chunk lengths preserve the true size)."""
+        k, m, cs = layout.k, layout.m, layout.chunk_size
+        assert len(data) <= k * cs
+        lens = [max(0, min(cs, len(data) - j * cs)) for j in range(k)]
+        arr = np.zeros((k, cs), dtype=np.uint8)
+        flat = np.frombuffer(data, dtype=np.uint8)
+        for j in range(k):
+            if lens[j]:
+                arr[j, :lens[j]] = flat[j * cs: j * cs + lens[j]]
+        parity = await self._encode(arr, k, m)
+
+        # whole-chunk REPLACE (not splice-write) so a shorter re-write of the
+        # stripe cannot leave stale tail bytes that disagree with the new
+        # parity; shards emptied by the re-write are REMOVEd for the same
+        # reason (absent == zeros is the decode contract)
+        tasks = []
+        for j in range(k):
+            cid = layout.data_chunk(inode, stripe, j)
+            chain = layout.shard_chain(stripe, j)
+            if lens[j] == 0:
+                tasks.append(self.sc.write_chunk(
+                    chain, cid, 0, b"", chunk_size=cs,
+                    update_type=UpdateType.REMOVE))
+            else:
+                tasks.append(self.sc.write_chunk(
+                    chain, cid, 0, bytes(arr[j, :lens[j]]), chunk_size=cs,
+                    update_type=UpdateType.REPLACE))
+        for p in range(m):
+            # parity covers the zero-padded full stripe: store full-size
+            tasks.append(self.sc.write_chunk(
+                layout.shard_chain(stripe, k + p),
+                layout.parity_chunk(inode, stripe, p),
+                0, bytes(parity[p]), chunk_size=cs,
+                update_type=UpdateType.REPLACE))
+        return list(await asyncio.gather(*tasks))
+
+    # --- read with reconstruct-on-unavailability ---
+
+    async def read_stripe(self, layout: ECLayout, inode: int, stripe: int,
+                          stripe_len: int) -> bytes:
+        """Read a stripe's data, reconstructing any unavailable data chunks
+        from surviving shards (the EC-decode recovery path, BASELINE #4)."""
+        k, m, cs = layout.k, layout.m, layout.chunk_size
+        lens = [max(0, min(cs, stripe_len - j * cs)) for j in range(k)]
+        ios = [ReadIO(chunk_id=layout.data_chunk(inode, stripe, j),
+                      chain_id=layout.shard_chain(stripe, j))
+               for j in range(k) if lens[j]]
+        results, payloads = await self.sc.batch_read(ios)
+        chunks: dict[int, bytes] = {}
+        missing: list[int] = []
+        pos = 0
+        for j in range(k):
+            if not lens[j]:
+                continue
+            r, p = results[pos], payloads[pos]
+            pos += 1
+            if r.status.code == int(StatusCode.OK):
+                chunks[j] = p
+            else:
+                missing.append(j)
+        if missing:
+            zero_shards = frozenset(j for j in range(k) if lens[j] == 0)
+            rec = await self._reconstruct_shards(layout, inode, stripe,
+                                                 tuple(missing), zero_shards,
+                                                 known=chunks)
+            for j, content in zip(missing, rec):
+                chunks[j] = content[: lens[j]]
+        return b"".join(chunks[j][: lens[j]].ljust(lens[j], b"\x00")
+                        for j in range(k) if lens[j])
+
+    async def _reconstruct_shards(self, layout: ECLayout, inode: int,
+                                  stripe: int, want: tuple[int, ...],
+                                  zero_shards: frozenset[int],
+                                  known: dict[int, bytes] | None = None
+                                  ) -> list[bytes]:
+        """Fetch enough surviving shards (data we already have + parity +
+        other data) and decode the wanted shard indices (0..k+m-1 space).
+
+        `zero_shards` lists data shards the CALLER knows were never written
+        (short stripe) — only those may be substituted with zeros on
+        CHUNK_NOT_FOUND.  Any other missing shard counts as lost; silently
+        zero-filling it would decode garbage and, on the repair path, write
+        that garbage back as if it were real (double-loss corruption)."""
+        k, m, cs = layout.k, layout.m, layout.chunk_size
+        known = dict(known or {})
+        have: dict[int, np.ndarray] = {}
+        for j, content in known.items():
+            buf = np.zeros(cs, dtype=np.uint8)
+            buf[: len(content)] = np.frombuffer(content, dtype=np.uint8)
+            have[j] = buf
+
+        need_more = [s for s in range(k + m)
+                     if s not in have and s not in want]
+        ios, ids = [], []
+        for s in need_more:
+            if s in zero_shards:
+                have[s] = np.zeros(cs, dtype=np.uint8)
+                continue
+            cid = (layout.data_chunk(inode, stripe, s) if s < k
+                   else layout.parity_chunk(inode, stripe, s - k))
+            ios.append(ReadIO(chunk_id=cid,
+                              chain_id=layout.shard_chain(stripe, s)))
+            ids.append(s)
+        if ios:
+            results, payloads = await self.sc.batch_read(ios)
+            for s, r, p in zip(ids, results, payloads):
+                if r.status.code == int(StatusCode.OK):
+                    buf = np.zeros(cs, dtype=np.uint8)
+                    buf[: len(p)] = np.frombuffer(p, dtype=np.uint8)
+                    have[s] = buf
+        if len(have) < k:
+            raise make_error(
+                StatusCode.TARGET_OFFLINE,
+                f"EC stripe {stripe}: only {len(have)} of {k + m} shards "
+                f"available, need {k}")
+        present = tuple(sorted(have.keys())[:k])
+        rows = np.stack([have[s] for s in present])
+        out = await self._reconstruct(rows, present, tuple(want), k, m)
+        return [bytes(out[i]) for i in range(len(want))]
+
+    async def repair_chunk(self, layout: ECLayout, inode: int, stripe: int,
+                           shard: int, stripe_len: int) -> IOResult:
+        """Decode-reconstruct one lost shard and write it back to its chain
+        (target-resync EC recovery, BASELINE config #4).  stripe_len is the
+        stripe's true data length — it determines which shards are legitimate
+        zero holes vs genuinely lost."""
+        k, cs = layout.k, layout.chunk_size
+        lens = [max(0, min(cs, stripe_len - j * cs)) for j in range(k)]
+        zero_shards = frozenset(j for j in range(k) if lens[j] == 0)
+        rec = await self._reconstruct_shards(layout, inode, stripe, (shard,),
+                                             zero_shards)
+        cid = (layout.data_chunk(inode, stripe, shard) if shard < k
+               else layout.parity_chunk(inode, stripe, shard - k))
+        content = rec[0][: lens[shard]] if shard < k else rec[0]
+        return await self.sc.write_chunk(
+            layout.shard_chain(stripe, shard), cid, 0, bytes(content),
+            chunk_size=cs, update_type=UpdateType.REPLACE)

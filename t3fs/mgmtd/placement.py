@@ -1,0 +1,57 @@
+"""Data placement: choose chain groups under failure-domain constraints.
+
+Reference analog: deploy/data_placement/ (Pyomo+HiGHS integer program
+balancing recovery traffic, -type {CR,EC}).  t3fs v1 ships the load-bearing
+property as a greedy solver: an EC(k+m) stripe survives a node failure only
+if no node hosts more than m of its shards — the TPU decode probe on a
+3-node/10-chain topology demonstrated exactly this failure mode.
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+
+from t3fs.mgmtd.types import RoutingInfo
+
+
+def chain_nodes(routing: RoutingInfo, chain_id: int) -> list[int]:
+    chain = routing.chain(chain_id)
+    return [t.node_id for t in chain.targets] if chain else []
+
+
+def select_ec_chains(routing: RoutingInfo, k: int, m: int,
+                     candidates: list[int] | None = None) -> list[int]:
+    """Greedily pick k+m chains such that no node appears on more than m of
+    them (single-node loss then costs <= m shards = decodable).
+
+    Greedy, not exhaustive: prefers chains with fewer targets so wide
+    (multi-replica) chains don't block narrow ones; a ValueError means THIS
+    heuristic failed — a different candidate ordering or the full integer
+    program (reference deploy/data_placement) may still find a placement."""
+    want = k + m
+    cands = candidates if candidates is not None else sorted(routing.chains)
+    cands = sorted(cands, key=lambda c: len(chain_nodes(routing, c)))
+    chosen: list[int] = []
+    node_load: Counter = Counter()
+    for cid in cands:
+        nodes = chain_nodes(routing, cid)
+        if not nodes:
+            continue
+        if any(node_load[n] + 1 > m for n in nodes):
+            continue
+        chosen.append(cid)
+        node_load.update(nodes)
+        if len(chosen) == want:
+            return chosen
+    raise ValueError(
+        f"greedy EC({k}+{m}) placement failed: {len(chosen)} of {want} "
+        f"chains selected before node budgets ({m} shards each) were "
+        f"exhausted — add nodes/chains or try explicit candidates")
+
+
+def validate_ec_chains(routing: RoutingInfo, chains: list[int], m: int) -> bool:
+    """True iff no single node hosts more than m of these chains' targets."""
+    node_load: Counter = Counter()
+    for cid in chains:
+        node_load.update(chain_nodes(routing, cid))
+    return all(c <= m for c in node_load.values())

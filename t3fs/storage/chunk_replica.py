@@ -58,11 +58,18 @@ class ChunkReplica:
             if io.checksum and checksum != io.checksum:
                 raise make_error(StatusCode.CHECKSUM_MISMATCH,
                                  f"{io.chunk_id}: replace payload checksum")
+            if io.is_sync:
+                # resync ships committed state wholesale
+                commit_ver = io.commit_ver or io.update_ver
+                state = (ChunkState.COMMIT if commit_ver >= io.update_ver
+                         else ChunkState.DIRTY)
+            else:
+                # client-initiated whole-chunk replace still follows the
+                # CRAQ commit flow (DIRTY until the chain acks)
+                commit_ver = meta.commit_ver if meta else 0
+                state = ChunkState.DIRTY
             new = ChunkMeta(io.chunk_id, len(payload), io.update_ver,
-                            io.commit_ver or io.update_ver, io.chain_ver,
-                            checksum, ChunkState.COMMIT
-                            if (io.commit_ver or io.update_ver) >= io.update_ver
-                            else ChunkState.DIRTY)
+                            commit_ver, io.chain_ver, checksum, state)
             self.engine.put(io.chunk_id, payload, new, io.chunk_size or len(payload))
             return IOResult(WireStatus(), new.length, new.update_ver,
                             new.commit_ver, new.chain_ver, new.checksum)

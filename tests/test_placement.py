@@ -1,0 +1,39 @@
+"""EC placement solver: failure-domain budget (deploy/data_placement analog)."""
+
+import pytest
+
+from t3fs.mgmtd.placement import select_ec_chains, validate_ec_chains
+from t3fs.mgmtd.types import ChainInfo, ChainTargetInfo, PublicTargetState, RoutingInfo
+
+
+def make_routing(chain_node_pairs):
+    r = RoutingInfo()
+    for cid, node in chain_node_pairs:
+        r.chains[cid] = ChainInfo(cid, 1, [
+            ChainTargetInfo(cid * 100, node, PublicTargetState.SERVING)])
+    return r
+
+
+def test_select_respects_node_budget():
+    # 10 chains over 5 nodes (2 each): EC(8+2) fits with max 2 per node
+    routing = make_routing([(c, (c - 1) % 5 + 1) for c in range(1, 11)])
+    chains = select_ec_chains(routing, 8, 2)
+    assert len(chains) == 10
+    assert validate_ec_chains(routing, chains, 2)
+
+
+def test_select_fails_on_narrow_topology():
+    # 10 chains over 3 nodes: some node must host >= 4 shards > m=2
+    routing = make_routing([(c, (c - 1) % 3 + 1) for c in range(1, 11)])
+    with pytest.raises(ValueError):
+        select_ec_chains(routing, 8, 2)
+    assert not validate_ec_chains(routing, list(range(1, 11)), 2)
+
+
+def test_select_skips_overloaded_chains():
+    # 4 nodes; node 1 has many chains — solver must spread, not take first k+m
+    pairs = [(1, 1), (2, 1), (3, 1), (4, 2), (5, 2), (6, 3), (7, 3), (8, 4)]
+    routing = make_routing(pairs)
+    chains = select_ec_chains(routing, 4, 2, candidates=list(range(1, 9)))
+    assert validate_ec_chains(routing, chains, 2)
+    assert 3 not in chains  # third chain on node 1 must be skipped
