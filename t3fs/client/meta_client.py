@@ -1,0 +1,116 @@
+"""MetaClient: typed wrapper over the Meta service with server selection.
+
+Reference analogs: client/meta/MetaClient.{h,cc} (typed ops, retries),
+ServerSelectionStrategy.h (random/round-robin with failover across the
+stateless meta servers).
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+import random
+
+from t3fs.meta.schema import DirEntry, Inode
+from t3fs.meta.service import InodeReq, PathReq
+from t3fs.net.client import Client
+from t3fs.utils.status import StatusError
+
+log = logging.getLogger("t3fs.client.meta")
+
+
+class MetaClient:
+    def __init__(self, addresses: list[str], client: Client | None = None,
+                 client_id: str = "", strategy: str = "roundrobin",
+                 max_retries: int = 3):
+        assert addresses
+        self.addresses = list(addresses)
+        self.client = client or Client()
+        self.client_id = client_id or f"mc-{random.getrandbits(40):010x}"
+        self.strategy = strategy
+        self.max_retries = max_retries
+        self._rr = itertools.count()
+
+    def _pick(self, attempt: int) -> str:
+        if self.strategy == "random" and attempt == 0:
+            return random.choice(self.addresses)
+        return self.addresses[(next(self._rr) + attempt) % len(self.addresses)]
+
+    async def _call(self, method: str, req):
+        last: StatusError | None = None
+        for attempt in range(self.max_retries):
+            address = self._pick(attempt)
+            try:
+                rsp, _ = await self.client.call(address, f"Meta.{method}", req)
+                return rsp
+            except StatusError as e:
+                if not e.status.retryable:
+                    raise
+                last = e
+        raise last
+
+    # --- typed ops ---
+
+    async def stat(self, path: str, follow: bool = True) -> Inode:
+        return (await self._call("stat", PathReq(path=path, follow=follow))).inode
+
+    async def stat_inode(self, inode_id: int) -> Inode:
+        return (await self._call("stat_inode", InodeReq(inode_id=inode_id))).inode
+
+    async def create(self, path: str, perm: int = 0o644, chunk_size: int = 0,
+                     stripe: int = 0) -> tuple[Inode, str]:
+        rsp = await self._call("create", PathReq(
+            path=path, perm=perm, chunk_size=chunk_size, stripe=stripe,
+            client_id=self.client_id))
+        return rsp.inode, rsp.session_id
+
+    async def open(self, path: str, write: bool = False) -> tuple[Inode, str]:
+        rsp = await self._call("open", PathReq(path=path, write=write,
+                                               client_id=self.client_id))
+        return rsp.inode, rsp.session_id
+
+    async def close(self, inode_id: int, session_id: str = "",
+                    length: int = -1) -> Inode:
+        return (await self._call("close", InodeReq(
+            inode_id=inode_id, session_id=session_id, length=length))).inode
+
+    async def sync(self, inode_id: int) -> Inode:
+        return (await self._call("sync", InodeReq(inode_id=inode_id))).inode
+
+    async def report_write_position(self, inode_id: int, position: int) -> None:
+        await self._call("report_write_position",
+                         InodeReq(inode_id=inode_id, position=position))
+
+    async def mkdirs(self, path: str, perm: int = 0o755,
+                     recursive: bool = True) -> Inode:
+        return (await self._call("mkdirs", PathReq(
+            path=path, perm=perm, recursive=recursive))).inode
+
+    async def readdir(self, path: str) -> list[DirEntry]:
+        return (await self._call("readdir", PathReq(path=path))).entries
+
+    async def remove(self, path: str, recursive: bool = False) -> None:
+        await self._call("remove", PathReq(path=path, recursive=recursive))
+
+    async def rename(self, src: str, dst: str) -> None:
+        await self._call("rename", PathReq(path=src, target=dst))
+
+    async def symlink(self, path: str, target: str) -> Inode:
+        return (await self._call("symlink", PathReq(path=path, target=target))).inode
+
+    async def hardlink(self, existing: str, new_path: str) -> Inode:
+        return (await self._call("hardlink", PathReq(path=existing,
+                                                     target=new_path))).inode
+
+    async def set_attr(self, path: str, perm: int) -> Inode:
+        return (await self._call("set_attr", PathReq(path=path, perm=perm))).inode
+
+    async def truncate(self, inode_id: int, length: int) -> Inode:
+        return (await self._call("truncate", InodeReq(inode_id=inode_id,
+                                                      length=length))).inode
+
+    async def get_real_path(self, inode_id: int) -> str:
+        return (await self._call("get_real_path", InodeReq(inode_id=inode_id))).path
+
+    async def close_conn(self) -> None:
+        await self.client.close()

@@ -1,0 +1,2 @@
+"""Metadata service: inodes/dirents on the transactional KV
+(reference: src/meta/ — SURVEY.md §2.5)."""

@@ -1,0 +1,237 @@
+"""Meta RPC service + server wrapper (GC + session prune workers).
+
+Reference analogs: meta/service/MetaOperator.{h,cc} (21 ops, MetaOperator.h:
+47-96), components/GcManager (async chunk reclamation, GcManager.h:57-118),
+components/SessionManager (prune dead-client sessions, SessionManager.h:44-83),
+FileHelper (length via storage queryLastChunk).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from dataclasses import dataclass, field
+
+from t3fs.client.layout import FileLayout
+from t3fs.meta.schema import DirEntry, FileSession, Inode
+from t3fs.meta.store import ChainAllocator, MetaStore
+from t3fs.net.server import rpc_method, service
+from t3fs.utils.serde import serde_struct
+from t3fs.utils.status import StatusCode, StatusError, make_error
+
+log = logging.getLogger("t3fs.meta")
+
+
+# --- wire types (fbs/meta/Service.h analog, trimmed to the core 16 ops) ---
+
+@serde_struct
+@dataclass
+class PathReq:
+    path: str = ""
+    follow: bool = True
+    recursive: bool = False
+    perm: int = 0o644
+    chunk_size: int = 0
+    stripe: int = 0
+    client_id: str = ""
+    write: bool = False
+    target: str = ""          # symlink target / rename dst / hardlink new path
+
+
+@serde_struct
+@dataclass
+class InodeReq:
+    inode_id: int = 0
+    session_id: str = ""
+    length: int = -1          # -1: unknown (server settles via storage)
+    position: int = 0
+
+
+@serde_struct
+@dataclass
+class InodeRsp:
+    inode: Inode | None = None
+    session_id: str = ""
+
+
+@serde_struct
+@dataclass
+class ReaddirRsp:
+    entries: list[DirEntry] = field(default_factory=list)
+
+
+@serde_struct
+@dataclass
+class StatFsRsp:
+    capacity: int = 0
+    used: int = 0
+    free: int = 0
+
+
+@service("Meta")
+class MetaService:
+    def __init__(self, store: MetaStore, storage_client=None):
+        self.store = store
+        self.sc = storage_client   # FileHelper / GC path (may be None in tests)
+
+    # each handler returns (rsp, b"")
+
+    @rpc_method
+    async def stat(self, req: PathReq, payload, conn):
+        return InodeRsp(inode=await self.store.stat(req.path, req.follow)), b""
+
+    @rpc_method
+    async def stat_inode(self, req: InodeReq, payload, conn):
+        return InodeRsp(inode=await self.store.stat_inode(req.inode_id)), b""
+
+    @rpc_method
+    async def create(self, req: PathReq, payload, conn):
+        inode, session = await self.store.create(
+            req.path, req.perm, req.chunk_size, req.stripe, req.client_id)
+        return InodeRsp(inode=inode, session_id=session), b""
+
+    @rpc_method
+    async def open(self, req: PathReq, payload, conn):
+        inode, session = await self.store.open_file(
+            req.path, req.write, req.client_id)
+        return InodeRsp(inode=inode, session_id=session), b""
+
+    @rpc_method
+    async def close(self, req: InodeReq, payload, conn):
+        length = req.length if req.length >= 0 else None
+        if length is None and self.sc is not None:
+            inode = await self.store.stat_inode(req.inode_id)
+            if inode.layout is not None:
+                length = await self.sc.query_last_chunk(inode.layout,
+                                                        req.inode_id)
+        inode = await self.store.close_file(req.inode_id, req.session_id, length)
+        return InodeRsp(inode=inode), b""
+
+    @rpc_method
+    async def sync(self, req: InodeReq, payload, conn):
+        """fsync: settle precise length via storage (FileHelper analog)."""
+        inode = await self.store.stat_inode(req.inode_id)
+        if self.sc is not None and inode.layout is not None:
+            length = await self.sc.query_last_chunk(inode.layout, req.inode_id)
+            inode = await self.store.close_file(req.inode_id, "", length)
+        return InodeRsp(inode=inode), b""
+
+    @rpc_method
+    async def report_write_position(self, req: InodeReq, payload, conn):
+        await self.store.report_write_position(req.inode_id, req.position)
+        return InodeRsp(), b""
+
+    @rpc_method
+    async def mkdirs(self, req: PathReq, payload, conn):
+        return InodeRsp(inode=await self.store.mkdirs(
+            req.path, req.perm, req.recursive)), b""
+
+    @rpc_method
+    async def readdir(self, req: PathReq, payload, conn):
+        return ReaddirRsp(entries=await self.store.readdir(req.path)), b""
+
+    @rpc_method
+    async def remove(self, req: PathReq, payload, conn):
+        await self.store.remove(req.path, req.recursive)
+        return InodeRsp(), b""
+
+    @rpc_method
+    async def rename(self, req: PathReq, payload, conn):
+        await self.store.rename(req.path, req.target)
+        return InodeRsp(), b""
+
+    @rpc_method
+    async def symlink(self, req: PathReq, payload, conn):
+        return InodeRsp(inode=await self.store.symlink(req.path, req.target)), b""
+
+    @rpc_method
+    async def hardlink(self, req: PathReq, payload, conn):
+        return InodeRsp(inode=await self.store.hardlink(req.path, req.target)), b""
+
+    @rpc_method
+    async def set_attr(self, req: PathReq, payload, conn):
+        return InodeRsp(inode=await self.store.set_attr(
+            req.path, perm=req.perm)), b""
+
+    @rpc_method
+    async def truncate(self, req: InodeReq, payload, conn):
+        """Truncate file data (chunks) + settle meta length."""
+        inode = await self.store.stat_inode(req.inode_id)
+        if self.sc is not None and inode.layout is not None:
+            await self.sc.truncate_file(inode.layout, req.inode_id,
+                                        max(0, req.length))
+        inode = await self.store.set_length(req.inode_id, max(0, req.length))
+        return InodeRsp(inode=inode), b""
+
+    @rpc_method
+    async def get_real_path(self, req: InodeReq, payload, conn):
+        path = await self.store.get_real_path(req.inode_id)
+        return PathReq(path=path), b""
+
+    @rpc_method
+    async def statfs(self, req, payload, conn):
+        # aggregated from storage in a later round; placeholder totals
+        return StatFsRsp(), b""
+
+
+class MetaServer:
+    """MetaService + background GC of removed files' chunks."""
+
+    def __init__(self, store: MetaStore, storage_client,
+                 gc_period_s: float = 0.2, session_ttl_s: float = 3600.0):
+        self.store = store
+        self.sc = storage_client
+        self.service = MetaService(store, storage_client)
+        self.gc_period_s = gc_period_s
+        self.session_ttl_s = session_ttl_s
+        self._task: asyncio.Task | None = None
+        self._stopped = asyncio.Event()
+        self.gc_count = 0
+
+    async def start(self) -> None:
+        self._task = asyncio.create_task(self._gc_loop(), name="meta-gc")
+
+    async def stop(self) -> None:
+        self._stopped.set()
+        if self._task:
+            self._task.cancel()
+            try:
+                await self._task
+            except (asyncio.CancelledError, Exception):
+                pass
+
+    async def _gc_loop(self) -> None:
+        last_prune = 0.0
+        while not self._stopped.is_set():
+            await asyncio.sleep(self.gc_period_s)
+            try:
+                now = time.time()
+                if now - last_prune > max(1.0, self.session_ttl_s / 10):
+                    await self.store.prune_sessions(self.session_ttl_s)
+                    last_prune = now
+                await self.gc_once()
+            except Exception:
+                log.exception("meta gc failed")
+
+    async def gc_once(self) -> int:
+        """Reclaim chunks of removed files (GcManager.h:57-118 analog)."""
+        inodes = await self.store.gc_pop()
+        for inode in inodes:
+            if inode.layout is not None and self.sc is not None:
+                try:
+                    await self.sc.remove_file_chunks(inode.layout, inode.inode_id)
+                except StatusError as e:
+                    log.warning("gc of inode %d failed (requeue): %s",
+                                inode.inode_id, e)
+                    # push back for retry
+                    from t3fs.kv.engine import with_transaction
+                    from t3fs.meta.schema import gc_key
+                    from t3fs.utils import serde as _serde
+
+                    async def requeue(txn, inode=inode):
+                        txn.set(gc_key(inode.inode_id), _serde.dumps(inode))
+                    await with_transaction(self.store.kv, requeue)
+                    continue
+            self.gc_count += 1
+        return len(inodes)

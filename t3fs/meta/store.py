@@ -1,0 +1,480 @@
+"""MetaStore: filesystem operations as KV transactions.
+
+Reference analogs: meta/store/ops/* (one Operation object per op driven by
+the FDB retry loop, MetaStore.h:54-66), PathResolve.h:28-113 (iterative walk,
+symlink depth limits), components/InodeIdAllocator.h (batched ids),
+components/ChainAllocator.h:48-81 (chain selection for new files).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import random
+import struct
+import time
+import uuid as uuidlib
+
+from t3fs.client.layout import FileLayout
+from t3fs.kv.engine import KVEngine, Transaction, with_transaction
+from t3fs.kv.prefixes import KeyPrefix
+from t3fs.meta.schema import (
+    GC_PREFIX, DirEntry, FileSession, Inode, InodeType, ROOT_INODE_ID, gc_key,
+)
+from t3fs.utils import serde
+from t3fs.utils.status import StatusCode, StatusError, make_error
+
+MAX_SYMLINK_DEPTH = 10
+ID_BATCH = 1024
+
+
+class InodeIdAllocator:
+    """Batched monotonic inode ids from the KV (InodeIdAllocator.h:52)."""
+
+    def __init__(self, kv: KVEngine):
+        self.kv = kv
+        self._next = 0
+        self._limit = 0
+        self._lock = asyncio.Lock()
+
+    async def allocate(self) -> int:
+        async with self._lock:
+            if self._next >= self._limit:
+                async def bump(txn: Transaction):
+                    raw = txn.get(KeyPrefix.ALLOCATOR.key(b"inode"))
+                    cur = int(raw) if raw else ROOT_INODE_ID + 1
+                    txn.set(KeyPrefix.ALLOCATOR.key(b"inode"),
+                            str(cur + ID_BATCH).encode())
+                    return cur
+                self._next = await with_transaction(self.kv, bump)
+                self._limit = self._next + ID_BATCH
+            out = self._next
+            self._next += 1
+            return out
+
+
+class ChainAllocator:
+    """Round-robin + seeded-shuffle chain selection for new file layouts
+    (ChainAllocator.h:48-81): stripe_size chains from the chain table."""
+
+    def __init__(self, routing_provider, default_chunk_size: int = 512 * 1024,
+                 default_stripe: int = 1):
+        self.routing = routing_provider
+        self.default_chunk_size = default_chunk_size
+        self.default_stripe = default_stripe
+        self._rr = itertools.count()
+
+    def allocate_layout(self, chunk_size: int = 0, stripe: int = 0) -> FileLayout:
+        routing = self.routing()
+        table = routing.chain_tables.get(1)
+        chain_ids = table.chain_ids if table else sorted(routing.chains)
+        if not chain_ids:
+            raise make_error(StatusCode.INTERNAL, "no chains available for layout")
+        stripe = min(stripe or self.default_stripe, len(chain_ids))
+        start = next(self._rr) % len(chain_ids)
+        picked = [chain_ids[(start + i) % len(chain_ids)] for i in range(stripe)]
+        return FileLayout(chunk_size=chunk_size or self.default_chunk_size,
+                          stripe_size=stripe, chains=picked,
+                          seed=random.getrandbits(16) if stripe > 1 else 0)
+
+
+class MetaStore:
+    def __init__(self, kv: KVEngine, chain_allocator: ChainAllocator):
+        self.kv = kv
+        self.chains = chain_allocator
+        self.ids = InodeIdAllocator(kv)
+        self._ensure_root()
+
+    def _ensure_root(self) -> None:
+        txn = self.kv.transaction()
+        if txn.get(Inode.key(ROOT_INODE_ID), snapshot=True) is None:
+            root = Inode(inode_id=ROOT_INODE_ID, itype=InodeType.DIRECTORY,
+                         perm=0o755, nlink=2).touch()
+            txn.set(Inode.key(ROOT_INODE_ID), serde.dumps(root))
+            txn.commit()
+
+    # --- txn helpers ---
+
+    @staticmethod
+    def _get_inode(txn: Transaction, inode_id: int) -> Inode | None:
+        raw = txn.get(Inode.key(inode_id))
+        return serde.loads(raw) if raw else None
+
+    @staticmethod
+    def _require_inode(txn: Transaction, inode_id: int) -> Inode:
+        inode = MetaStore._get_inode(txn, inode_id)
+        if inode is None:
+            raise make_error(StatusCode.META_NOT_FOUND, f"inode {inode_id}")
+        return inode
+
+    @staticmethod
+    def _get_dent(txn: Transaction, parent: int, name: str) -> DirEntry | None:
+        raw = txn.get(DirEntry.key(parent, name))
+        return serde.loads(raw) if raw else None
+
+    def resolve(self, txn: Transaction, path: str,
+                follow_last: bool = True) -> tuple[int, str, DirEntry | None]:
+        """Path -> (parent_inode_id, last_name, existing dent-or-None).
+        Iterative with symlink expansion limits (PathResolve.h:28-113)."""
+        depth = 0
+        parts = [p for p in path.split("/") if p]
+        parent = ROOT_INODE_ID
+        i = 0
+        while i < len(parts):
+            name = parts[i]
+            last = i == len(parts) - 1
+            dent = self._get_dent(txn, parent, name)
+            if last and (dent is None or not follow_last
+                         or dent.itype != InodeType.SYMLINK):
+                return parent, name, dent
+            if dent is None:
+                raise make_error(StatusCode.META_NOT_FOUND,
+                                 "/".join(parts[: i + 1]))
+            if dent.itype == InodeType.SYMLINK:
+                depth += 1
+                if depth > MAX_SYMLINK_DEPTH:
+                    raise make_error(StatusCode.META_TOO_MANY_SYMLINKS, path)
+                inode = self._require_inode(txn, dent.inode_id)
+                target_parts = [p for p in inode.symlink_target.split("/") if p]
+                if inode.symlink_target.startswith("/"):
+                    parent = ROOT_INODE_ID
+                parts = target_parts + parts[i + 1:]
+                i = 0
+                continue
+            if not last and dent.itype != InodeType.DIRECTORY:
+                raise make_error(StatusCode.META_NOT_DIR,
+                                 "/".join(parts[: i + 1]))
+            parent = dent.inode_id
+            i += 1
+        return ROOT_INODE_ID, "", None   # path was "/" or empty
+
+    # --- ops (each returns a plain result; run via with_transaction) ---
+
+    async def stat(self, path: str, follow: bool = True) -> Inode:
+        async def fn(txn: Transaction):
+            if path.strip("/") == "":
+                return self._require_inode(txn, ROOT_INODE_ID)
+            parent, name, dent = self.resolve(txn, path, follow_last=follow)
+            if dent is None:
+                raise make_error(StatusCode.META_NOT_FOUND, path)
+            return self._require_inode(txn, dent.inode_id)
+        return await with_transaction(self.kv, fn)
+
+    async def stat_inode(self, inode_id: int) -> Inode:
+        async def fn(txn: Transaction):
+            return self._require_inode(txn, inode_id)
+        return await with_transaction(self.kv, fn)
+
+    async def mkdirs(self, path: str, perm: int = 0o755,
+                     recursive: bool = True) -> Inode:
+        async def fn(txn: Transaction):
+            parts = [p for p in path.split("/") if p]
+            if not parts:
+                raise make_error(StatusCode.META_EXISTS, "/")
+            parent = ROOT_INODE_ID
+            created: Inode | None = None
+            for i, name in enumerate(parts):
+                dent = self._get_dent(txn, parent, name)
+                last = i == len(parts) - 1
+                if dent is not None:
+                    if last:
+                        raise make_error(StatusCode.META_EXISTS, path)
+                    if dent.itype != InodeType.DIRECTORY:
+                        raise make_error(StatusCode.META_NOT_DIR, name)
+                    parent = dent.inode_id
+                    continue
+                if not last and not recursive:
+                    raise make_error(StatusCode.META_NOT_FOUND, name)
+                inode_id = await self.ids.allocate()
+                inode = Inode(inode_id=inode_id, itype=InodeType.DIRECTORY,
+                              perm=perm, nlink=2, parent=parent).touch()
+                txn.set(Inode.key(inode_id), serde.dumps(inode))
+                txn.set(DirEntry.key(parent, name), serde.dumps(
+                    DirEntry(parent, name, inode_id, InodeType.DIRECTORY)))
+                parent = inode_id
+                created = inode
+            return created
+        return await with_transaction(self.kv, fn)
+
+    async def create(self, path: str, perm: int = 0o644, chunk_size: int = 0,
+                     stripe: int = 0, session_client: str = "") -> tuple[Inode, str]:
+        """Create a file (+ optional write session). Returns (inode, session_id)."""
+        layout = self.chains.allocate_layout(chunk_size, stripe)
+
+        async def fn(txn: Transaction):
+            parent, name, dent = self.resolve(txn, path)
+            if dent is not None:
+                raise make_error(StatusCode.META_EXISTS, path)
+            if not name:
+                raise make_error(StatusCode.META_INVALID_PATH, path)
+            self._require_inode(txn, parent)
+            inode_id = await self.ids.allocate()
+            inode = Inode(inode_id=inode_id, itype=InodeType.FILE, perm=perm,
+                          layout=layout).touch()
+            txn.set(Inode.key(inode_id), serde.dumps(inode))
+            txn.set(DirEntry.key(parent, name), serde.dumps(
+                DirEntry(parent, name, inode_id, InodeType.FILE)))
+            session_id = ""
+            if session_client:
+                session_id = str(uuidlib.uuid4())
+                sess = FileSession(inode_id, session_id, session_client,
+                                   time.time())
+                txn.set(FileSession.key(inode_id, session_id), serde.dumps(sess))
+            return inode, session_id
+        return await with_transaction(self.kv, fn)
+
+    async def open_file(self, path: str, write: bool = False,
+                        session_client: str = "") -> tuple[Inode, str]:
+        async def fn(txn: Transaction):
+            parent, name, dent = self.resolve(txn, path)
+            if dent is None:
+                raise make_error(StatusCode.META_NOT_FOUND, path)
+            inode = self._require_inode(txn, dent.inode_id)
+            if inode.itype == InodeType.DIRECTORY and write:
+                raise make_error(StatusCode.META_IS_DIR, path)
+            session_id = ""
+            if write and session_client:
+                session_id = str(uuidlib.uuid4())
+                txn.set(FileSession.key(inode.inode_id, session_id),
+                        serde.dumps(FileSession(inode.inode_id, session_id,
+                                                session_client, time.time())))
+            return inode, session_id
+        return await with_transaction(self.kv, fn)
+
+    async def close_file(self, inode_id: int, session_id: str = "",
+                         length: int | None = None) -> Inode:
+        """Close/sync: settle length (caller computes via storage
+        query_last_chunk — FileOperation analog) and drop the session."""
+        async def fn(txn: Transaction):
+            inode = self._require_inode(txn, inode_id)
+            if length is not None and inode.itype == InodeType.FILE:
+                inode.length = length
+                inode.touch()
+                txn.set(Inode.key(inode_id), serde.dumps(inode))
+            if session_id:
+                txn.clear(FileSession.key(inode_id, session_id))
+            return inode
+        return await with_transaction(self.kv, fn)
+
+    async def report_write_position(self, inode_id: int, position: int) -> None:
+        """Max-write-position hint, reported every few seconds by writers
+        (docs/design_notes.md:91-95)."""
+        async def fn(txn: Transaction):
+            inode = self._require_inode(txn, inode_id)
+            if position > inode.length_hint:
+                inode.length_hint = position
+                if position > inode.length:
+                    inode.length = position
+                txn.set(Inode.key(inode_id), serde.dumps(inode))
+        await with_transaction(self.kv, fn)
+
+    async def readdir(self, path: str, limit: int = 0) -> list[DirEntry]:
+        async def fn(txn: Transaction):
+            if path.strip("/") == "":
+                dir_id = ROOT_INODE_ID
+            else:
+                parent, name, dent = self.resolve(txn, path)
+                if dent is None:
+                    raise make_error(StatusCode.META_NOT_FOUND, path)
+                if dent.itype != InodeType.DIRECTORY:
+                    raise make_error(StatusCode.META_NOT_DIR, path)
+                dir_id = dent.inode_id
+            pre = DirEntry.prefix(dir_id)
+            rows = txn.get_range(pre, pre + b"\xff", limit=limit)
+            return [serde.loads(v) for _, v in rows]
+        return await with_transaction(self.kv, fn)
+
+    async def symlink(self, path: str, target: str) -> Inode:
+        async def fn(txn: Transaction):
+            parent, name, dent = self.resolve(txn, path, follow_last=False)
+            if dent is not None:
+                raise make_error(StatusCode.META_EXISTS, path)
+            inode_id = await self.ids.allocate()
+            inode = Inode(inode_id=inode_id, itype=InodeType.SYMLINK,
+                          symlink_target=target).touch()
+            txn.set(Inode.key(inode_id), serde.dumps(inode))
+            txn.set(DirEntry.key(parent, name), serde.dumps(
+                DirEntry(parent, name, inode_id, InodeType.SYMLINK)))
+            return inode
+        return await with_transaction(self.kv, fn)
+
+    async def hardlink(self, existing: str, new_path: str) -> Inode:
+        async def fn(txn: Transaction):
+            _, _, src = self.resolve(txn, existing)
+            if src is None:
+                raise make_error(StatusCode.META_NOT_FOUND, existing)
+            if src.itype == InodeType.DIRECTORY:
+                raise make_error(StatusCode.META_IS_DIR, existing)
+            parent, name, dent = self.resolve(txn, new_path, follow_last=False)
+            if dent is not None:
+                raise make_error(StatusCode.META_EXISTS, new_path)
+            inode = self._require_inode(txn, src.inode_id)
+            inode.nlink += 1
+            inode.touch()
+            txn.set(Inode.key(inode.inode_id), serde.dumps(inode))
+            txn.set(DirEntry.key(parent, name), serde.dumps(
+                DirEntry(parent, name, inode.inode_id, src.itype)))
+            return inode
+        return await with_transaction(self.kv, fn)
+
+    async def rename(self, src: str, dst: str) -> None:
+        async def fn(txn: Transaction):
+            sparent, sname, sdent = self.resolve(txn, src, follow_last=False)
+            if sdent is None:
+                raise make_error(StatusCode.META_NOT_FOUND, src)
+            dparent, dname, ddent = self.resolve(txn, dst, follow_last=False)
+            if ddent is not None:
+                if ddent.itype == InodeType.DIRECTORY:
+                    pre = DirEntry.prefix(ddent.inode_id)
+                    if txn.get_range(pre, pre + b"\xff", limit=1):
+                        raise make_error(StatusCode.META_NOT_EMPTY, dst)
+                # overwrite: unlink destination
+                await self._unlink_entry(txn, ddent)
+            txn.clear(DirEntry.key(sparent, sname))
+            txn.set(DirEntry.key(dparent, dname), serde.dumps(
+                DirEntry(dparent, dname, sdent.inode_id, sdent.itype)))
+            if sdent.itype == InodeType.DIRECTORY:
+                inode = self._require_inode(txn, sdent.inode_id)
+                inode.parent = dparent
+                txn.set(Inode.key(inode.inode_id), serde.dumps(inode))
+        return await with_transaction(self.kv, fn)
+
+    async def _unlink_entry(self, txn: Transaction, dent: DirEntry) -> None:
+        inode = self._get_inode(txn, dent.inode_id)
+        if inode is None:
+            return
+        inode.nlink -= 1
+        if inode.itype == InodeType.DIRECTORY:
+            inode.nlink -= 1  # ".." style accounting
+        if inode.nlink <= 0 or inode.itype == InodeType.DIRECTORY:
+            txn.clear(Inode.key(inode.inode_id))
+            if inode.itype == InodeType.FILE and inode.layout is not None:
+                # enqueue chunk reclamation (GcManager analog)
+                txn.set(gc_key(inode.inode_id), serde.dumps(inode))
+        else:
+            inode.touch()
+            txn.set(Inode.key(inode.inode_id), serde.dumps(inode))
+
+    async def remove(self, path: str, recursive: bool = False) -> None:
+        async def fn(txn: Transaction):
+            parent, name, dent = self.resolve(txn, path, follow_last=False)
+            if dent is None:
+                raise make_error(StatusCode.META_NOT_FOUND, path)
+            if dent.itype == InodeType.DIRECTORY:
+                pre = DirEntry.prefix(dent.inode_id)
+                children = txn.get_range(pre, pre + b"\xff")
+                if children and not recursive:
+                    raise make_error(StatusCode.META_NOT_EMPTY, path)
+                for _, raw in children:
+                    child: DirEntry = serde.loads(raw)
+                    # recursive removal inside one txn (small trees); big
+                    # trees should go through trash + async GC
+                    await self._remove_tree(txn, child)
+                    txn.clear(DirEntry.key(child.parent, child.name))
+            await self._unlink_entry(txn, dent)
+            txn.clear(DirEntry.key(parent, name))
+        return await with_transaction(self.kv, fn)
+
+    async def _remove_tree(self, txn: Transaction, dent: DirEntry) -> None:
+        if dent.itype == InodeType.DIRECTORY:
+            pre = DirEntry.prefix(dent.inode_id)
+            for _, raw in txn.get_range(pre, pre + b"\xff"):
+                child: DirEntry = serde.loads(raw)
+                await self._remove_tree(txn, child)
+                txn.clear(DirEntry.key(child.parent, child.name))
+        await self._unlink_entry(txn, dent)
+
+    async def set_attr(self, path: str, *, perm: int | None = None,
+                       uid: int | None = None, gid: int | None = None) -> Inode:
+        async def fn(txn: Transaction):
+            parent, name, dent = self.resolve(txn, path)
+            if dent is None:
+                raise make_error(StatusCode.META_NOT_FOUND, path)
+            inode = self._require_inode(txn, dent.inode_id)
+            if perm is not None:
+                inode.perm = perm
+            if uid is not None:
+                inode.uid = uid
+            if gid is not None:
+                inode.gid = gid
+            inode.touch()
+            txn.set(Inode.key(inode.inode_id), serde.dumps(inode))
+            return inode
+        return await with_transaction(self.kv, fn)
+
+    async def set_length(self, inode_id: int, length: int) -> Inode:
+        async def fn(txn: Transaction):
+            inode = self._require_inode(txn, inode_id)
+            inode.length = length
+            inode.length_hint = min(inode.length_hint, length)
+            inode.touch()
+            txn.set(Inode.key(inode_id), serde.dumps(inode))
+            return inode
+        return await with_transaction(self.kv, fn)
+
+    async def get_real_path(self, inode_id: int) -> str:
+        """Walk parents to the root (GetRealPath analog). Only exact for
+        directories; files report their first dirent match."""
+        async def fn(txn: Transaction):
+            segments: list[str] = []
+            cur = inode_id
+            for _ in range(256):
+                if cur == ROOT_INODE_ID:
+                    return "/" + "/".join(reversed(segments))
+                inode = self._require_inode(txn, cur)
+                parent = inode.parent
+                pre = DirEntry.prefix(parent)
+                found = None
+                for _, raw in txn.get_range(pre, pre + b"\xff"):
+                    d: DirEntry = serde.loads(raw)
+                    if d.inode_id == cur:
+                        found = d
+                        break
+                if found is None:
+                    raise make_error(StatusCode.META_NOT_FOUND,
+                                     f"inode {cur} orphaned")
+                segments.append(found.name)
+                cur = parent
+            raise make_error(StatusCode.META_INVALID_PATH, "loop")
+        return await with_transaction(self.kv, fn)
+
+    # --- sessions & GC ---
+
+    async def sessions_of(self, inode_id: int) -> list[FileSession]:
+        txn = self.kv.transaction()
+        pre = FileSession.prefix(inode_id)
+        return [serde.loads(v) for _, v in
+                txn.get_range(pre, pre + b"\xff", snapshot=True)]
+
+    async def prune_sessions(self, ttl_s: float) -> int:
+        """Drop write sessions older than ttl (SessionManager.h:44-83 analog:
+        dead clients must not pin deferred deletions forever).  Live clients
+        are expected to refresh/close well within the ttl."""
+        cutoff = time.time() - ttl_s
+
+        async def fn(txn: Transaction):
+            pre = KeyPrefix.INODE_SESSION.value
+            dropped = 0
+            for k, v in txn.get_range(pre, pre + b"\xff", snapshot=True):
+                sess: FileSession = serde.loads(v)
+                if sess.created_at < cutoff:
+                    txn.clear(k)
+                    dropped += 1
+            return dropped
+        return await with_transaction(self.kv, fn)
+
+    async def gc_pop(self, limit: int = 16) -> list[Inode]:
+        """Dequeue inodes whose chunks need reclamation."""
+        async def fn(txn: Transaction):
+            rows = txn.get_range(GC_PREFIX, GC_PREFIX + b"\xff", limit=limit)
+            out = []
+            for k, v in rows:
+                inode: Inode = serde.loads(v)
+                # skip (keep queued) while write sessions remain
+                spre = FileSession.prefix(inode.inode_id)
+                if txn.get_range(spre, spre + b"\xff", limit=1):
+                    continue
+                txn.clear(k)
+                out.append(inode)
+            return out
+        return await with_transaction(self.kv, fn)

@@ -9,9 +9,12 @@ from __future__ import annotations
 
 import tempfile
 
+from t3fs.client.meta_client import MetaClient
 from t3fs.client.mgmtd_client import MgmtdClient
 from t3fs.client.storage_client import StorageClient, StorageClientConfig
 from t3fs.kv.engine import MemKVEngine
+from t3fs.meta.service import MetaServer
+from t3fs.meta.store import ChainAllocator, MetaStore
 from t3fs.mgmtd.service import MgmtdConfig, MgmtdServer, SetChainsReq
 from t3fs.mgmtd.types import ChainInfo, ChainTable, ChainTargetInfo, PublicTargetState
 from t3fs.net.client import Client
@@ -24,10 +27,15 @@ class LocalCluster:
 
     def __init__(self, num_nodes: int = 3, replicas: int = 3,
                  num_chains: int = 1,
-                 heartbeat_timeout_s: float = 0.6):
+                 heartbeat_timeout_s: float = 0.6,
+                 with_meta: bool = False):
         self.num_nodes = num_nodes
         self.replicas = replicas
         self.num_chains = num_chains
+        self.with_meta = with_meta
+        self.meta: MetaServer | None = None
+        self.meta_rpc: Server | None = None
+        self.mc: MetaClient | None = None
         self.kv = MemKVEngine()
         self.mgmtd_cfg = MgmtdConfig(
             heartbeat_timeout_s=heartbeat_timeout_s,
@@ -89,6 +97,18 @@ class LocalCluster:
             config=StorageClientConfig(retry_backoff_s=0.05, max_retries=12),
             refresh_routing=self.mgmtd_client.refresh)
 
+        if self.with_meta:
+            # stateless meta service on the same transactional KV as mgmtd
+            # (the reference shares one FoundationDB, docs/design_notes.md:7)
+            store = MetaStore(self.kv, ChainAllocator(
+                self.mgmtd_client.routing, default_chunk_size=4096))
+            self.meta = MetaServer(store, self.sc, gc_period_s=0.1)
+            self.meta_rpc = Server()
+            self.meta_rpc.add_service(self.meta.service)
+            await self.meta_rpc.start()
+            await self.meta.start()
+            self.mc = MetaClient([self.meta_rpc.address])
+
     async def start_storage_node(self, node_id: int) -> StorageServer:
         ss = StorageServer(node_id, self.mgmtd_rpc.address,
                            heartbeat_period_s=0.15, resync_period_s=0.1)
@@ -109,6 +129,12 @@ class LocalCluster:
         return self.mgmtd.state.routing().chains[chain_id]
 
     async def stop(self) -> None:
+        if self.mc:
+            await self.mc.close_conn()
+        if self.meta:
+            await self.meta.stop()
+        if self.meta_rpc:
+            await self.meta_rpc.stop()
         if self.sc:
             await self.sc.close()
         if self.mgmtd_client:

@@ -1,0 +1,201 @@
+"""Meta store/service: per-op tests over MemKV + StorageClientInMem
+(reference analogs: tests/meta/store/ops/Test{Create,Open,Rename,...}.cc)."""
+
+import asyncio
+
+import pytest
+
+from t3fs.client.layout import FileLayout
+from t3fs.client.meta_client import MetaClient
+from t3fs.client.storage_client_inmem import StorageClientInMem
+from t3fs.kv.engine import MemKVEngine
+from t3fs.meta.schema import InodeType, ROOT_INODE_ID
+from t3fs.meta.service import MetaServer, MetaService
+from t3fs.meta.store import ChainAllocator, MetaStore
+from t3fs.mgmtd.types import ChainInfo, ChainTable, ChainTargetInfo, PublicTargetState, RoutingInfo
+from t3fs.net.server import Server
+from t3fs.utils.status import StatusCode, StatusError
+
+
+def make_routing(num_chains=3):
+    r = RoutingInfo()
+    for c in range(1, num_chains + 1):
+        r.chains[c] = ChainInfo(c, 1, [ChainTargetInfo(c * 100, 1,
+                                                       PublicTargetState.SERVING)])
+    r.chain_tables[1] = ChainTable(1, list(r.chains))
+    return r
+
+
+@pytest.fixture
+def store():
+    kv = MemKVEngine()
+    routing = make_routing()
+    return MetaStore(kv, ChainAllocator(lambda: routing, default_chunk_size=4096))
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def test_mkdirs_stat_readdir(store):
+    async def body():
+        await store.mkdirs("/a/b/c")
+        inode = await store.stat("/a/b/c")
+        assert inode.itype == InodeType.DIRECTORY
+        with pytest.raises(StatusError) as ei:
+            await store.mkdirs("/a/b/c")
+        assert ei.value.code == StatusCode.META_EXISTS
+        with pytest.raises(StatusError):
+            await store.mkdirs("/x/y", recursive=False)
+        entries = await store.readdir("/a")
+        assert [e.name for e in entries] == ["b"]
+        root = await store.readdir("/")
+        assert [e.name for e in root] == ["a"]
+    run(body())
+
+
+def test_create_open_close(store):
+    async def body():
+        await store.mkdirs("/d")
+        inode, sess = await store.create("/d/f", chunk_size=4096,
+                                         session_client="c1")
+        assert inode.itype == InodeType.FILE and sess
+        assert inode.layout.chunk_size == 4096
+        with pytest.raises(StatusError) as ei:
+            await store.create("/d/f")
+        assert ei.value.code == StatusCode.META_EXISTS
+        got, sess2 = await store.open_file("/d/f", write=True,
+                                           session_client="c2")
+        assert got.inode_id == inode.inode_id
+        sessions = await store.sessions_of(inode.inode_id)
+        assert len(sessions) == 2
+        await store.close_file(inode.inode_id, sess, length=100)
+        await store.close_file(inode.inode_id, sess2)
+        assert await store.sessions_of(inode.inode_id) == []
+        assert (await store.stat("/d/f")).length == 100
+    run(body())
+
+
+def test_resolve_symlinks(store):
+    async def body():
+        await store.mkdirs("/real/dir")
+        await store.create("/real/dir/file")
+        await store.symlink("/link", "/real/dir")
+        inode = await store.stat("/link/file")
+        assert inode.itype == InodeType.FILE
+        # readlink-style stat without follow
+        raw = await store.stat("/link", follow=False)
+        assert raw.itype == InodeType.SYMLINK and raw.symlink_target == "/real/dir"
+        # loop detection
+        await store.symlink("/loop1", "/loop2")
+        await store.symlink("/loop2", "/loop1")
+        with pytest.raises(StatusError) as ei:
+            await store.stat("/loop1/x")
+        assert ei.value.code == StatusCode.META_TOO_MANY_SYMLINKS
+    run(body())
+
+
+def test_rename_and_overwrite(store):
+    async def body():
+        await store.mkdirs("/src")
+        await store.create("/src/a")
+        await store.mkdirs("/dst")
+        await store.rename("/src/a", "/dst/b")
+        assert (await store.stat("/dst/b")).itype == InodeType.FILE
+        with pytest.raises(StatusError):
+            await store.stat("/src/a")
+        # rename over existing file replaces it
+        await store.create("/src/c")
+        await store.rename("/src/c", "/dst/b")
+        # rename dir updates parent
+        await store.mkdirs("/src/sub")
+        await store.rename("/src/sub", "/dst/sub")
+        real = await store.get_real_path((await store.stat("/dst/sub")).inode_id)
+        assert real == "/dst/sub"
+    run(body())
+
+
+def test_hardlink_nlink_and_remove(store):
+    async def body():
+        await store.create("/f1")
+        inode = await store.hardlink("/f1", "/f2")
+        assert inode.nlink == 2
+        await store.remove("/f1")
+        assert (await store.stat("/f2")).nlink == 1
+        # removing the last link queues GC
+        await store.remove("/f2")
+        gc = await store.gc_pop()
+        assert [i.inode_id for i in gc] == [inode.inode_id]
+    run(body())
+
+
+def test_remove_recursive(store):
+    async def body():
+        await store.mkdirs("/t/a/b")
+        await store.create("/t/a/b/f1")
+        await store.create("/t/f2")
+        with pytest.raises(StatusError) as ei:
+            await store.remove("/t")
+        assert ei.value.code == StatusCode.META_NOT_EMPTY
+        await store.remove("/t", recursive=True)
+        with pytest.raises(StatusError):
+            await store.stat("/t")
+        gc = await store.gc_pop()
+        assert len(gc) == 2  # both files queued for chunk reclamation
+    run(body())
+
+
+def test_meta_service_rpc_and_gc():
+    """Full slice: RPC meta service + InMem storage client + GC worker."""
+    async def body():
+        kv = MemKVEngine()
+        routing = make_routing()
+        store = MetaStore(kv, ChainAllocator(lambda: routing,
+                                             default_chunk_size=1024))
+        sc = StorageClientInMem()
+        server = Server()
+        meta_server = MetaServer(store, sc, gc_period_s=0.05)
+        server.add_service(meta_server.service)
+        await server.start()
+        await meta_server.start()
+        mc = MetaClient([server.address])
+        try:
+            await mc.mkdirs("/data")
+            inode, sess = await mc.create("/data/file", chunk_size=1024)
+            # write through the storage client against the file's layout
+            data = b"meta+storage" * 200
+            await sc.write_file_range(inode.layout, inode.inode_id, 0, data)
+            # close with unknown length -> server settles via query_last_chunk
+            closed = await mc.close(inode.inode_id, sess)
+            assert closed.length == len(data)
+            got = await mc.stat("/data/file")
+            assert got.length == len(data)
+            # remove -> GC worker reclaims chunks from storage
+            await mc.remove("/data/file")
+            for _ in range(100):
+                if await sc.query_last_chunk(inode.layout, inode.inode_id) == 0:
+                    break
+                await asyncio.sleep(0.05)
+            assert await sc.query_last_chunk(inode.layout, inode.inode_id) == 0
+            # rename + readdir through RPC
+            await mc.mkdirs("/data/sub")
+            await mc.rename("/data/sub", "/data/sub2")
+            names = [e.name for e in await mc.readdir("/data")]
+            assert names == ["sub2"]
+        finally:
+            await mc.close_conn()
+            await meta_server.stop()
+            await server.stop()
+    run(body())
+
+
+def test_session_prune_unblocks_gc(store):
+    """A dead client's session must not pin deferred deletion forever."""
+    async def body():
+        inode, sess = await store.create("/pinned", session_client="dead-client")
+        await store.remove("/pinned")
+        assert await store.gc_pop() == []          # session pins it
+        assert await store.prune_sessions(ttl_s=0.0) == 1
+        gc = await store.gc_pop()
+        assert [i.inode_id for i in gc] == [inode.inode_id]
+    run(body())
