@@ -23,7 +23,7 @@ from t3fs.client.layout import FileLayout
 from t3fs.mgmtd.types import ChainInfo, PublicTargetState, RoutingInfo
 from t3fs.net.client import Client
 from t3fs.net.wire import WireStatus
-from t3fs.ops.crc32c import crc32c_ref
+from t3fs.ops.codec import crc32c as crc32c_ref
 from t3fs.storage.types import (
     BatchReadReq, BatchReadRsp, ChunkId, IOResult, QueryLastChunkReq,
     QueryLastChunkRsp, ReadIO, RemoveChunksReq, TruncateChunkReq, UpdateIO,

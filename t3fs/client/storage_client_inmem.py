@@ -8,7 +8,7 @@ from dataclasses import dataclass, field
 
 from t3fs.client.layout import FileLayout
 from t3fs.net.wire import WireStatus
-from t3fs.ops.crc32c import crc32c_ref
+from t3fs.ops.codec import crc32c as crc32c_ref
 from t3fs.storage.types import ChunkId, IOResult, ReadIO, UpdateType
 from t3fs.utils.status import StatusCode
 

@@ -1,0 +1,8 @@
+"""Native (C++) runtime components, built on demand with g++.
+
+The compiled shared library is cached next to the sources and rebuilt when
+any source is newer (dev loop) — operators ship a prebuilt .so instead by
+running `python -m t3fs.native.build`.
+"""
+
+from t3fs.native.build import load_library  # noqa: F401

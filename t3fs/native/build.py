@@ -1,0 +1,51 @@
+"""Build + load the t3fs native library (g++ -> .so, cached by mtime)."""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import sysconfig
+import threading
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SOURCES = ["chunk_engine.cpp", "usrbio.cpp"]
+_LIB = os.path.join(_DIR, "libt3fs_native.so")
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+
+_CXXFLAGS = ["-std=c++20", "-O2", "-g", "-fPIC", "-shared", "-Wall",
+             "-pthread", "-msse4.2"]
+
+
+def _sources() -> list[str]:
+    return [os.path.join(_DIR, s) for s in _SOURCES
+            if os.path.exists(os.path.join(_DIR, s))]
+
+
+def build(force: bool = False) -> str:
+    srcs = _sources()
+    if not force and os.path.exists(_LIB):
+        lib_mtime = os.path.getmtime(_LIB)
+        if all(os.path.getmtime(s) <= lib_mtime for s in srcs):
+            return _LIB
+    tmp = _LIB + f".tmp.{os.getpid()}"
+    cmd = ["g++", *_CXXFLAGS, "-o", tmp, *srcs]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+    except subprocess.CalledProcessError as e:
+        raise RuntimeError(f"native build failed:\n{e.stderr}") from e
+    os.replace(tmp, _LIB)
+    return _LIB
+
+
+def load_library() -> ctypes.CDLL:
+    global _lib
+    with _lock:
+        if _lib is None:
+            _lib = ctypes.CDLL(build())
+        return _lib
+
+
+if __name__ == "__main__":
+    print(build(force=True))

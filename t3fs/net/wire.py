@@ -10,7 +10,7 @@ import struct
 import time
 from dataclasses import dataclass, field
 
-from t3fs.ops.crc32c import crc32c_ref
+from t3fs.ops.codec import crc32c as crc32c_ref
 from t3fs.utils.serde import serde_struct
 from t3fs.utils.status import Status, StatusCode
 

@@ -1,0 +1,49 @@
+"""CPU-side codec dispatch: fastest available CRC32C for the host data path.
+
+Three tiers, mirroring the reference's CPU checksum (folly::crc32c,
+fbs/storage/Common.h:158):
+  native — SSE4.2 hardware CRC from t3fs/native (preferred; built on demand)
+  ref    — pure-Python table loop (always available; the correctness oracle)
+
+The TPU batched path (t3fs.ops.jax_codec / pallas_codec) is a separate seam
+used by the stripe-encode offload, not by per-RPC host checksums.
+"""
+
+from __future__ import annotations
+
+from t3fs.ops.crc32c import crc32c_combine_ref, crc32c_ref
+
+_native = None
+_tried = False
+
+
+def _load_native():
+    global _native, _tried
+    if not _tried:
+        _tried = True
+        try:
+            from t3fs.storage.native_engine import (
+                crc32c_combine_native, crc32c_native)
+
+            # force the lazy g++ build NOW and self-check, so a host without
+            # a toolchain (or non-x86) falls back instead of raising later
+            if crc32c_native(b"123456789") != 0xE3069283:
+                raise RuntimeError("native crc32c self-check failed")
+            _native = (crc32c_native, crc32c_combine_native)
+        except Exception:
+            _native = None
+    return _native
+
+
+def crc32c(data: bytes, crc: int = 0) -> int:
+    n = _load_native()
+    if n is not None:
+        return n[0](data, crc)
+    return crc32c_ref(data, crc)
+
+
+def crc32c_combine(a: int, b: int, len_b: int) -> int:
+    n = _load_native()
+    if n is not None:
+        return n[1](a, b, len_b)
+    return crc32c_combine_ref(a, b, len_b)

@@ -15,7 +15,8 @@ Version semantics:
 
 from __future__ import annotations
 
-from t3fs.ops.crc32c import crc32c_ref, crc32c_combine_ref
+from t3fs.ops.codec import crc32c, crc32c_combine
+from t3fs.ops.crc32c import crc32c_ref  # noqa: F401 (oracle re-export)
 from t3fs.storage.chunk_engine import ChunkEngine
 from t3fs.storage.types import (
     ChunkId, ChunkMeta, ChunkState, IOResult, ReadIO, UpdateIO, UpdateType,
@@ -23,13 +24,13 @@ from t3fs.storage.types import (
 from t3fs.net.wire import WireStatus
 from t3fs.utils.status import Status, StatusCode, StatusError, make_error
 
-# pluggable CRC impl (the codec seam; default scalar reference — the storage
-# service swaps in the batched TPU codec via t3fs.ops.codec)
+# pluggable CRC impl (the codec seam; default = fastest host path, which is
+# the native SSE4.2 library when built, else the Python reference)
 CrcFn = type(crc32c_ref)
 
 
 class ChunkReplica:
-    def __init__(self, engine: ChunkEngine, crc=crc32c_ref, crc_combine=crc32c_combine_ref):
+    def __init__(self, engine: ChunkEngine, crc=crc32c, crc_combine=crc32c_combine):
         self.engine = engine
         self.crc = crc
         self.crc_combine = crc_combine
