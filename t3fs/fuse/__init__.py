@@ -1,0 +1,5 @@
+"""User-surface file layer: VFS ops bridging MetaClient + StorageClient
+(reference: src/fuse/ — FuseOps.cc lowlevel ops, PioV batch gathering,
+IoRing/IovTable shm rings served by daemon workers)."""
+
+from t3fs.fuse.vfs import FileHandle, FileSystem  # noqa: F401

@@ -1,0 +1,2 @@
+"""App-side user libraries (reference: src/lib/ — the USRBIO C API and
+generic helpers)."""

@@ -14,8 +14,12 @@ _LIB = os.path.join(_DIR, "libt3fs_native.so")
 _lock = threading.Lock()
 _lib: ctypes.CDLL | None = None
 
+import platform
+
 _CXXFLAGS = ["-std=c++20", "-O2", "-g", "-fPIC", "-shared", "-Wall",
-             "-pthread", "-msse4.2"]
+             "-pthread"]
+if platform.machine() in ("x86_64", "AMD64"):
+    _CXXFLAGS.append("-msse4.2")  # hw CRC32C; other arches use the sw path
 
 
 def _sources() -> list[str]:

@@ -196,11 +196,20 @@ class Engine {
     if (::mkdir(root.c_str(), 0755) != 0 && errno != EEXIST)
       return fail("mkdir " + root);
     if (!load_snapshot()) return false;
-    if (!replay_wal()) return false;
+    uint64_t valid_wal = 0;
+    if (!replay_wal(&valid_wal)) return false;
     rebuild_allocator();
     wal_fd_ = ::open((root + "/meta.wal").c_str(),
                      O_RDWR | O_CREAT | O_APPEND, 0644);
     if (wal_fd_ < 0) return fail("open wal");
+    // Drop any torn tail NOW: with O_APPEND, new records would otherwise
+    // land behind the garbage and be lost by the next replay.
+    struct stat st;
+    if (fstat(wal_fd_, &st) == 0 &&
+        static_cast<uint64_t>(st.st_size) > valid_wal) {
+      if (::ftruncate(wal_fd_, valid_wal) != 0)
+        return fail("truncate torn wal tail");
+    }
     return true;
   }
 
@@ -483,7 +492,8 @@ class Engine {
     return true;
   }
 
-  bool replay_wal() {
+  bool replay_wal(uint64_t* valid_prefix) {
+    *valid_prefix = 0;
     int fd = ::open((root + "/meta.wal").c_str(), O_RDONLY);
     if (fd < 0) return true;
     struct stat st;
@@ -501,8 +511,7 @@ class Engine {
       memcpy(&magic, buf.data() + off, 4);
       memcpy(&crc, buf.data() + off + 4, 4);
       memcpy(&len, buf.data() + off + 8, 4);
-      if (magic != kWalMagic || off + 12 + len > buf.size() + 1 ||
-          len < 17 || off + 12 + len > buf.size())
+      if (magic != kWalMagic || len < 17 || off + 12 + len > buf.size())
         break;  // torn tail — stop replay here
       if (crc != crc32c(buf.data() + off + 8, 4 + len)) break;
       const uint8_t* p = buf.data() + off + 12;
@@ -525,6 +534,7 @@ class Engine {
       }
       wal_records_++;
       off += 12 + len;
+      *valid_prefix = off;
     }
     return true;
   }
@@ -554,6 +564,14 @@ class Engine {
     ::close(fd);
     if (::rename(tmp.c_str(), (root + "/meta.snap").c_str()) != 0)
       return fail("rename snapshot");
+    // Make the rename durable BEFORE truncating the WAL: otherwise a crash
+    // could persist the empty WAL while the directory still points at the
+    // old snapshot — rolling the store back to the previous compaction.
+    int dfd = ::open(root.c_str(), O_RDONLY | O_DIRECTORY);
+    if (dfd >= 0) {
+      ::fsync(dfd);
+      ::close(dfd);
+    }
     if (wal_fd_ >= 0) {
       ::ftruncate(wal_fd_, 0);
       ::lseek(wal_fd_, 0, SEEK_SET);

@@ -193,14 +193,32 @@ class NativeChunkEngine:
 
 
 def make_engine(root: str, *, backend: str = "native", sync_writes: bool = False):
-    """Engine factory: native C++ if available, else pure-Python."""
-    if backend == "native":
-        try:
-            return NativeChunkEngine(root, sync_writes=sync_writes)
-        except Exception:
-            # no toolchain / unsupported arch / open failure: fall back,
-            # mirroring the reference's engine-selection config seam
-            backend = "py"
+    """Engine factory: native C++ if available, else pure-Python.
+
+    Fallback applies ONLY when the native library cannot be built/loaded
+    (no toolchain, unsupported arch) — an open failure on an existing native
+    store is surfaced, never masked as an empty target.  On-disk format is
+    sticky: a root written by one engine reopens with that engine regardless
+    of the requested backend (meta.db = SQLite engine; meta.wal/meta.snap =
+    native engine)."""
+    import os
+
     from t3fs.storage.chunk_engine import ChunkEngine
 
+    has_py = os.path.exists(os.path.join(root, "meta.db"))
+    has_native = (os.path.exists(os.path.join(root, "meta.wal"))
+                  or os.path.exists(os.path.join(root, "meta.snap")))
+    if has_py and not has_native:
+        backend = "py"
+    elif has_native and not has_py:
+        backend = "native_required"
+
+    if backend.startswith("native"):
+        try:
+            native_lib()
+        except Exception:
+            if backend == "native_required":
+                raise
+            return ChunkEngine(root, sync_writes=sync_writes)
+        return NativeChunkEngine(root, sync_writes=sync_writes)
     return ChunkEngine(root, sync_writes=sync_writes)

@@ -45,13 +45,10 @@ class StorageTarget:
     """One target (disk) = chunk engine + CRAQ replica + per-chunk locks."""
 
     def __init__(self, target_id: int, root: str, engine_backend: str = "native"):
-        self.target_id = target_id
-        if engine_backend == "native":
-            from t3fs.storage.native_engine import make_engine
+        from t3fs.storage.native_engine import make_engine
 
-            self.engine = make_engine(root, backend="native")
-        else:
-            self.engine = ChunkEngine(root)
+        self.target_id = target_id
+        self.engine = make_engine(root, backend=engine_backend)
         self.replica = ChunkReplica(self.engine)
         self._chunk_locks: dict[ChunkId, asyncio.Lock] = {}
 

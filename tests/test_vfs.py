@@ -1,0 +1,108 @@
+"""VFS file layer over the full cluster (FuseOps/PioV analogs).
+
+Reference test analogs: tests/fuse/* and the meta-op tests driving
+MetaClient+StorageClient together."""
+
+import asyncio
+import os
+
+import pytest
+
+from t3fs.fuse.vfs import FileSystem, PioV
+from t3fs.testing.cluster import LocalCluster
+from t3fs.utils.status import StatusError
+
+
+def run(coro):
+    asyncio.run(coro)
+
+
+def test_vfs_file_lifecycle():
+    async def body():
+        cluster = LocalCluster(num_nodes=3, replicas=3, num_chains=3,
+                               with_meta=True)
+        await cluster.start()
+        try:
+            fs = FileSystem(cluster.mc, cluster.sc)
+            await fs.mkdirs("/data/raw")
+            fh = await fs.create("/data/raw/a.bin", chunk_size=4096)
+            payload = os.urandom(20000)
+            assert await fs.write(fh, 0, payload) == len(payload)
+            ino = await fs.close(fh)
+            assert ino.length == len(payload)
+
+            # read via fresh handle
+            fh2 = await fs.open("/data/raw/a.bin")
+            assert await fs.read(fh2, 0, 1 << 20) == payload
+            assert await fs.read(fh2, 5000, 100) == payload[5000:5100]
+            await fs.close(fh2)
+
+            # append mode
+            fh3 = await fs.open("/data/raw/a.bin", "a")
+            tail = b"tail-bytes"
+            await fs.write(fh3, 0, tail)
+            await fs.close(fh3)
+            assert await fs.read_file("/data/raw/a.bin") == payload + tail
+
+            # namespace ops
+            names = {e.name for e in await fs.readdir("/data/raw")}
+            assert names == {"a.bin"}
+            await fs.rename("/data/raw/a.bin", "/data/raw/b.bin")
+            st = await fs.stat("/data/raw/b.bin")
+            assert st.length == len(payload) + len(tail)
+            await fs.unlink("/data/raw/b.bin")
+            with pytest.raises(StatusError):
+                await fs.stat("/data/raw/b.bin")
+        finally:
+            await cluster.stop()
+    run(body())
+
+
+def test_vfs_write_read_convenience_and_overwrite():
+    async def body():
+        cluster = LocalCluster(num_nodes=3, replicas=2, with_meta=True)
+        await cluster.start()
+        try:
+            fs = FileSystem(cluster.mc, cluster.sc)
+            await fs.mkdirs("/m")
+            await fs.write_file("/m/x", b"first", chunk_size=4096)
+            assert await fs.read_file("/m/x") == b"first"
+            await fs.write_file("/m/x", b"second!")
+            assert await fs.read_file("/m/x") == b"second!"
+        finally:
+            await cluster.stop()
+    run(body())
+
+
+def test_piov_batch_mixed_ops():
+    async def body():
+        cluster = LocalCluster(num_nodes=3, replicas=3, with_meta=True)
+        await cluster.start()
+        try:
+            fs = FileSystem(cluster.mc, cluster.sc)
+            await fs.mkdirs("/p")
+            handles = []
+            blobs = []
+            for i in range(4):
+                fh = await fs.create(f"/p/f{i}", chunk_size=4096)
+                blob = os.urandom(6000 + i * 100)
+                await fs.write(fh, 0, blob)
+                handles.append(fh)
+                blobs.append(blob)
+
+            piov = PioV(fs)
+            for i, fh in enumerate(handles):
+                piov.add_read(fh, 100, 500, tag=i)
+            piov.add_write(handles[0], 0, b"Z" * 64, tag=100)
+            out = await piov.execute()
+            for i in range(4):
+                code, data = out[i]
+                assert code == 0
+                assert data == blobs[i][100:600]
+            assert out[100] == (0, 64)
+            assert (await fs.read(handles[0], 0, 64)) == b"Z" * 64
+            for fh in handles:
+                await fs.close(fh)
+        finally:
+            await cluster.stop()
+    run(body())
