@@ -27,9 +27,10 @@ class RingWorker:
     moves bytes between the shared iov and storage."""
 
     def __init__(self, ring_name: str, meta: MetaClient,
-                 storage: StorageClient, iov_size: int = 64 << 20):
+                 storage: StorageClient):
         self.ring = IoRing(ring_name, create=False)
-        self.iov = IoVec(self.ring.iov_name, iov_size, create=False)
+        # IoVec open maps the app segment's real (fstat'd) size
+        self.iov = IoVec(self.ring.iov_name, create=False)
         self.meta = meta
         self.storage = storage
         self._layouts: dict[int, object] = {}        # ident -> FileLayout

@@ -82,6 +82,10 @@ class FileSystem:
         ino, session = await self.meta.open(path, write=write)
         if ino.itype != InodeType.FILE:
             raise make_error(StatusCode.INVALID_ARG, f"not a file: {path}")
+        if mode == "w" and ino.layout is not None:
+            # POSIX O_TRUNC: drop existing bytes so a shorter rewrite does
+            # not leave the old tail (meta truncate removes stale chunks)
+            ino = await self.meta.truncate(ino.inode_id, 0)
         fh = self._register(ino, session, writable=write, append=(mode == "a"))
         if mode == "a":
             fh.max_written = await self.file_length(ino)

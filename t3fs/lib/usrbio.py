@@ -41,6 +41,9 @@ def _bind():
     lib.t3fs_iov_open.restype = C.c_void_p
     lib.t3fs_iov_open.argtypes = [C.c_char_p, C.c_uint64]
     lib.t3fs_iov_destroy.argtypes = [C.c_char_p, C.c_void_p, C.c_uint64]
+    lib.t3fs_iov_stat.restype = C.c_uint64
+    lib.t3fs_iov_stat.argtypes = [C.c_char_p]
+    lib.t3fs_iov_unmap.argtypes = [C.c_void_p, C.c_uint64]
     lib.t3fs_ior_create.restype = C.c_void_p
     lib.t3fs_ior_create.argtypes = [C.c_char_p, C.c_uint32, C.c_char_p]
     lib.t3fs_ior_open.restype = C.c_void_p
@@ -78,10 +81,20 @@ def _lib():
 class IoVec:
     """Shared data buffer (hf3fs_iov analog)."""
 
-    def __init__(self, name: str, size: int, create: bool = True):
+    def __init__(self, name: str, size: int = 0, create: bool = True):
         self.name = name
-        self.size = size
         self._create = create
+        if not create:
+            # always map the segment's REAL size (reference iovopen fstats
+            # the shm): guessing small breaks valid iov_off, guessing large
+            # SIGBUSes past the end
+            actual = _lib().t3fs_iov_stat(name.encode())
+            if actual == 0:
+                raise OSError(f"iov open failed: {name} (no such segment)")
+            size = actual
+        elif size <= 0:
+            raise ValueError("iov create needs a positive size")
+        self.size = size
         fn = _lib().t3fs_iov_create if create else _lib().t3fs_iov_open
         self._base = fn(name.encode(), size)
         if not self._base:
@@ -100,6 +113,8 @@ class IoVec:
             if unlink if unlink is not None else self._create:
                 _lib().t3fs_iov_destroy(self.name.encode(), self._base,
                                         self.size)
+            else:
+                _lib().t3fs_iov_unmap(self._base, self.size)
             self._base = None
 
 

@@ -17,6 +17,7 @@ from t3fs.client.layout import FileLayout
 from t3fs.meta.schema import DirEntry, FileSession, Inode
 from t3fs.meta.store import ChainAllocator, MetaStore
 from t3fs.net.server import rpc_method, service
+from t3fs.utils.config import ConfigBase as _ConfigBase, citem as _citem
 from t3fs.utils.serde import serde_struct
 from t3fs.utils.status import StatusCode, StatusError, make_error
 
@@ -175,19 +176,41 @@ class MetaService:
         return StatFsRsp(), b""
 
 
+@dataclass
+class MetaConfig(_ConfigBase):
+    """Hot meta-service knobs (GC loop reads them live each iteration)."""
+    gc_period_s: float = _citem(0.2, validator=lambda v: v > 0)
+    session_ttl_s: float = _citem(3600.0, validator=lambda v: v > 0)
+
+
 class MetaServer:
     """MetaService + background GC of removed files' chunks."""
 
     def __init__(self, store: MetaStore, storage_client,
-                 gc_period_s: float = 0.2, session_ttl_s: float = 3600.0):
+                 gc_period_s: float = 0.2, session_ttl_s: float = 3600.0,
+                 node_id: int = 0):
         self.store = store
         self.sc = storage_client
         self.service = MetaService(store, storage_client)
-        self.gc_period_s = gc_period_s
-        self.session_ttl_s = session_ttl_s
+        self.cfg = MetaConfig(gc_period_s=gc_period_s, session_ttl_s=session_ttl_s)
+        from t3fs.core.service import AppInfo, CoreService
+        self.core = CoreService(AppInfo(node_id, "meta"),
+                                config=self.cfg, kv=store.kv)
         self._task: asyncio.Task | None = None
         self._stopped = asyncio.Event()
         self.gc_count = 0
+
+    @property
+    def gc_period_s(self) -> float:
+        return self.cfg.gc_period_s
+
+    @property
+    def session_ttl_s(self) -> float:
+        return self.cfg.session_ttl_s
+
+    @property
+    def services(self):
+        return [self.service, self.core]
 
     async def start(self) -> None:
         self._task = asyncio.create_task(self._gc_loop(), name="meta-gc")

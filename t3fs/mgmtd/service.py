@@ -26,6 +26,7 @@ from t3fs.mgmtd.types import (
 )
 from t3fs.net.server import rpc_method, service
 from t3fs.utils import serde
+from t3fs.utils.config import ConfigBase, citem
 from t3fs.utils.serde import serde_struct
 from t3fs.utils.status import StatusCode, make_error
 
@@ -81,11 +82,13 @@ class LeaseInfo:
 
 
 @dataclass
-class MgmtdConfig:
-    heartbeat_timeout_s: float = 2.0     # node dead after this silence
-    chains_update_period_s: float = 0.25
-    lease_ttl_s: float = 10.0
-    lease_extend_period_s: float = 3.0
+class MgmtdConfig(ConfigBase):
+    """Hot-updatable service knobs (ConfigBase.h CONFIG_HOT_UPDATED_ITEM
+    analog) — the background loops read these live each iteration."""
+    heartbeat_timeout_s: float = citem(2.0, validator=lambda v: v > 0)
+    chains_update_period_s: float = citem(0.25, validator=lambda v: v > 0)
+    lease_ttl_s: float = citem(10.0, validator=lambda v: v > 0)
+    lease_extend_period_s: float = citem(3.0, validator=lambda v: v > 0)
 
 
 class MgmtdState:
@@ -284,12 +287,21 @@ class MgmtdServer:
     """State + service + background loops (chains updater, lease extender)."""
 
     def __init__(self, kv: KVEngine, node_id: int = 1, address: str = "",
-                 cfg: MgmtdConfig | None = None):
+                 cfg: MgmtdConfig | None = None, admin_token: str = ""):
         self.cfg = cfg or MgmtdConfig()
         self.state = MgmtdState(kv, node_id, address, self.cfg)
         self.service = MgmtdService(self.state)
+        from t3fs.core.service import AppInfo, CoreService
+        self.core = CoreService(AppInfo(node_id, "mgmtd", address),
+                                config=self.cfg, kv=kv, admin_token=admin_token)
         self._tasks: list[asyncio.Task] = []
         self._stopped = asyncio.Event()
+
+    @property
+    def services(self):
+        """Everything to register on the net server (reference registers
+        MgmtdService + CoreService, MgmtdServer.cc:33-34)."""
+        return [self.service, self.core]
 
     async def start(self) -> None:
         acquired = await self.state.try_acquire_lease()

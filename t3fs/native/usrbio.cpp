@@ -25,6 +25,7 @@
 #include <semaphore.h>
 #include <sys/mman.h>
 #include <sys/stat.h>
+#include <sys/stat.h>
 #include <unistd.h>
 
 namespace {
@@ -128,6 +129,24 @@ void t3fs_iov_destroy(const char* name, void* base, uint64_t size) {
   char shm[128];
   snprintf(shm, sizeof shm, "/t3fs-iov-%s", name);
   shm_unlink(shm);
+}
+
+// Real size of an existing iov segment (fstat), 0 if absent.  The daemon
+// must map the app's actual size: guessing smaller breaks valid iov_off
+// values, guessing larger SIGBUSes past the segment end.
+uint64_t t3fs_iov_stat(const char* name) {
+  char shm[128];
+  snprintf(shm, sizeof shm, "/t3fs-iov-%s", name);
+  int fd = shm_open(shm, O_RDONLY, 0600);
+  if (fd < 0) return 0;
+  struct stat st;
+  uint64_t size = (fstat(fd, &st) == 0) ? (uint64_t)st.st_size : 0;
+  close(fd);
+  return size;
+}
+
+void t3fs_iov_unmap(void* base, uint64_t size) {
+  if (base) munmap(base, size);
 }
 
 // ---- ior (submission/completion ring; reference hf3fs_iorcreate4) ----

@@ -10,29 +10,49 @@ from __future__ import annotations
 
 import logging
 
+from dataclasses import dataclass
+
 from t3fs.client.mgmtd_client import MgmtdClientForServer
 from t3fs.mgmtd.types import NodeInfo
 from t3fs.net.client import Client
 from t3fs.net.server import Server
 from t3fs.storage.resync import ResyncWorker
 from t3fs.storage.service import StorageNode, StorageService
+from t3fs.utils.config import ConfigBase, citem
 
 log = logging.getLogger("t3fs.storage")
+
+
+@dataclass
+class StorageConfig(ConfigBase):
+    """Storage node knobs.  Periods are hot (loops read them live);
+    listen address is not (requires restart)."""
+    host: str = citem("127.0.0.1", hot=False)
+    port: int = citem(0, hot=False)
+    heartbeat_period_s: float = citem(0.3, validator=lambda v: v > 0)
+    resync_period_s: float = citem(0.2, validator=lambda v: v > 0)
 
 
 class StorageServer:
     def __init__(self, node_id: int, mgmtd_address: str, *,
                  host: str = "127.0.0.1", port: int = 0,
                  heartbeat_period_s: float = 0.3,
-                 resync_period_s: float = 0.2):
+                 resync_period_s: float = 0.2,
+                 cfg: StorageConfig | None = None):
+        self.cfg = cfg or StorageConfig(
+            host=host, port=port, heartbeat_period_s=heartbeat_period_s,
+            resync_period_s=resync_period_s)
         self.node_id = node_id
-        self.server = Server(host, port)
+        self.server = Server(self.cfg.host, self.cfg.port)
         self.node = StorageNode(node_id, self._routing, Client())
         self.service = StorageService(self.node)
         self.server.add_service(self.service)
+        from t3fs.core.service import AppInfo, CoreService
+        self.core = CoreService(AppInfo(node_id, "storage"), config=self.cfg)
+        self.server.add_service(self.core)
         self.mgmtd_address = mgmtd_address
-        self.heartbeat_period_s = heartbeat_period_s
-        self.resync = ResyncWorker(self.node, period_s=resync_period_s)
+        self.heartbeat_period_s = self.cfg.heartbeat_period_s
+        self.resync = ResyncWorker(self.node, period_s=self.cfg.resync_period_s)
         self.mgmtd: MgmtdClientForServer | None = None
 
     def _routing(self):
@@ -41,8 +61,18 @@ class StorageServer:
     def add_target(self, target_id: int, root: str, **kw):
         return self.node.add_target(target_id, root, **kw)
 
+    def _on_config_updated(self, keys: list[str]) -> None:
+        """Push hot values into running components (onConfigUpdated analog)."""
+        self.heartbeat_period_s = self.cfg.heartbeat_period_s
+        if self.mgmtd is not None:
+            self.mgmtd.heartbeat_period_s = self.cfg.heartbeat_period_s
+            self.mgmtd.refresh_period_s = self.cfg.heartbeat_period_s
+        self.resync.period_s = self.cfg.resync_period_s
+
     async def start(self) -> None:
         await self.server.start()
+        self.core.app_info.address = self.server.address
+        self.core.on_config_updated = self._on_config_updated
         self.mgmtd = MgmtdClientForServer(
             self.mgmtd_address,
             NodeInfo(self.node_id, self.server.address, "storage"),

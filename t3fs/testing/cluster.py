@@ -56,8 +56,10 @@ class LocalCluster:
         return f"{self._tmp.name}/node{node_id}"
 
     async def start(self) -> None:
-        self.mgmtd = MgmtdServer(self.kv, 1, "", self.mgmtd_cfg)
-        self.mgmtd_rpc.add_service(self.mgmtd.service)
+        self.mgmtd = MgmtdServer(self.kv, 1, "", self.mgmtd_cfg,
+                                 admin_token="local-admin")
+        for svc in self.mgmtd.services:
+            self.mgmtd_rpc.add_service(svc)
         await self.mgmtd_rpc.start()
         await self.mgmtd.start()
 
@@ -104,7 +106,8 @@ class LocalCluster:
                 self.mgmtd_client.routing, default_chunk_size=4096))
             self.meta = MetaServer(store, self.sc, gc_period_s=0.1)
             self.meta_rpc = Server()
-            self.meta_rpc.add_service(self.meta.service)
+            for svc in self.meta.services:
+                self.meta_rpc.add_service(svc)
             await self.meta_rpc.start()
             await self.meta.start()
             self.mc = MetaClient([self.meta_rpc.address])

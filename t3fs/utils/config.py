@@ -124,8 +124,13 @@ class ConfigBase:
             if hot_only and not (f.metadata or {}).get("hot", True):
                 raise ConfigError(f"{dotted}: not hot-updatable (requires restart)")
             validator = (f.metadata or {}).get("validator")
-            if validator is not None and not validator(val):
-                raise ConfigError(f"{dotted}: invalid value {val!r}")
+            if validator is not None:
+                try:
+                    ok = bool(validator(val))
+                except Exception as e:  # e.g. TypeError from 'str' > 0
+                    raise ConfigError(f"{dotted}: invalid value {val!r} ({e})") from None
+                if not ok:
+                    raise ConfigError(f"{dotted}: invalid value {val!r}")
             plan.append((self, key, val, dotted))
 
 
@@ -137,3 +142,37 @@ def _resolve_nested(cls: type, key: str) -> type | None:
     if isinstance(t, type) and is_dataclass(t) and issubclass(t, ConfigBase):
         return t
     return None
+
+
+def _toml_value(v: Any) -> str:
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if isinstance(v, (int, float)):
+        return repr(v)
+    if isinstance(v, str):
+        return '"' + v.replace("\\", "\\\\").replace('"', '\\"') + '"'
+    if isinstance(v, (list, tuple)):
+        return "[" + ", ".join(_toml_value(x) for x in v) + "]"
+    raise ConfigError(f"cannot render {type(v).__name__} as TOML value")
+
+
+def to_toml(d: dict, _prefix: str = "") -> str:
+    """Render a (possibly nested) dict as TOML text — the config-introspection
+    wire format (reference: RenderConfig templating, common/utils/RenderConfig.h).
+    Round-trips through tomllib for everything ConfigBase.to_dict produces."""
+    scalars, tables = [], []
+    for k, v in d.items():
+        if isinstance(v, dict):
+            tables.append((k, v))
+        elif v is None:
+            continue  # TOML has no null; absent key means default
+        else:
+            scalars.append(f"{k} = {_toml_value(v)}")
+    out = []
+    if scalars:
+        out.append("\n".join(scalars))
+    for k, v in tables:
+        name = f"{_prefix}{k}"
+        body = to_toml(v, name + ".")
+        out.append(f"[{name}]" + ("\n" + body if body else ""))
+    return "\n\n".join(out).strip() + ("\n" if out else "")

@@ -24,8 +24,18 @@ _hints_cache: dict[type, dict[str, object]] = {}
 
 
 def serde_struct(cls):
-    """Register a dataclass for typed wire encoding."""
+    """Register a dataclass for typed wire encoding.
+
+    Names are globally unique on the wire: a second registration of the same
+    name from a DIFFERENT module is a hard error — otherwise decode would
+    silently build the wrong class for every peer (the reference avoids this
+    by fully-typed per-method reflection, Serde.h:25-59)."""
     assert is_dataclass(cls), f"{cls} must be a dataclass"
+    prev = _registry.get(cls.__name__)
+    if prev is not None and prev.__module__ != cls.__module__:
+        raise TypeError(
+            f"serde name collision: {cls.__name__} already registered by "
+            f"{prev.__module__}, redefined in {cls.__module__}")
     _registry[cls.__name__] = cls
     return cls
 

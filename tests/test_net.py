@@ -15,14 +15,14 @@ from t3fs.utils.status import StatusCode, StatusError, make_error
 
 @serde_struct
 @dataclass
-class EchoReq:
+class NetEchoReq:
     text: str = ""
     n: int = 0
 
 
 @serde_struct
 @dataclass
-class EchoRsp:
+class NetEchoRsp:
     text: str = ""
     n: int = 0
 
@@ -30,8 +30,8 @@ class EchoRsp:
 @service("Echo")
 class EchoService:
     @rpc_method
-    async def echo(self, body: EchoReq, payload: bytes, conn):
-        return EchoRsp(text=body.text, n=body.n + 1), payload
+    async def echo(self, body: NetEchoReq, payload: bytes, conn):
+        return NetEchoRsp(text=body.text, n=body.n + 1), payload
 
     @rpc_method
     async def fail(self, body, payload, conn):
@@ -46,7 +46,7 @@ class EchoService:
     async def pull(self, body: RemoteBuf, payload: bytes, conn):
         """Server-side one-sided READ of the client's registered buffer."""
         data = await remote_read(conn, body)
-        return EchoRsp(n=len(data)), data.upper()
+        return NetEchoRsp(n=len(data)), data.upper()
 
 
 @pytest.fixture
@@ -71,11 +71,11 @@ async def _with_cluster(fn):
 def test_echo_roundtrip(loop_run):
     async def body(server, client):
         rsp, payload = await client.call(server.address, "Echo.echo",
-                                         EchoReq(text="hi", n=41), payload=b"bulk")
+                                         NetEchoReq(text="hi", n=41), payload=b"bulk")
         assert rsp.text == "hi" and rsp.n == 42 and payload == b"bulk"
         # concurrent calls multiplex one connection
         rsps = await asyncio.gather(*[
-            client.call(server.address, "Echo.echo", EchoReq(n=i)) for i in range(20)])
+            client.call(server.address, "Echo.echo", NetEchoReq(n=i)) for i in range(20)])
         assert sorted(r[0].n for r in rsps) == list(range(1, 21))
     loop_run(_with_cluster(body))
 

@@ -96,8 +96,7 @@ def test_usrbio_end_to_end_through_cluster():
             fh = await fs.create("/u/data", chunk_size=4096)
             ident = usrbio.reg_fd(fh)
 
-            worker = RingWorker(ring_name, cluster.mc, cluster.sc,
-                                iov_size=1 << 20)
+            worker = RingWorker(ring_name, cluster.mc, cluster.sc)
             await worker.start()
 
             # write 3 blocks through the ring

@@ -69,6 +69,9 @@ def test_vfs_write_read_convenience_and_overwrite():
             assert await fs.read_file("/m/x") == b"first"
             await fs.write_file("/m/x", b"second!")
             assert await fs.read_file("/m/x") == b"second!"
+            # SHORTER rewrite must truncate (POSIX O_TRUNC): no stale tail
+            await fs.write_file("/m/x", b"hi")
+            assert await fs.read_file("/m/x") == b"hi"
         finally:
             await cluster.stop()
     run(body())
