@@ -144,7 +144,17 @@ class UserRsp:
 
 
 def _user_key(uid: int) -> bytes:
-    return KeyPrefix.USER.key(uid.to_bytes(8, "little"))
+    if not 0 <= uid < 2 ** 64:
+        raise make_error(StatusCode.INVALID_ARG, f"uid out of range: {uid}")
+    # big-endian so the uid keyspace sorts correctly under the range scan
+    return KeyPrefix.USER.key(uid.to_bytes(8, "big"))
+
+
+def _user_range() -> tuple[bytes, bytes]:
+    """[prefix, prefix+1): covers ALL uid encodings — prefix+b'\\xff' would
+    exclude any key whose first suffix byte is 0xff."""
+    lo = KeyPrefix.USER.value
+    return lo, lo[:-1] + bytes([lo[-1] + 1])
 
 
 @service("Core")
@@ -264,8 +274,8 @@ class CoreService:
         kv = self._need_kv()
 
         async def op(txn):
-            lo = KeyPrefix.USER.value
-            return txn.get_range(lo, lo + b"\xff")
+            lo, hi = _user_range()
+            return txn.get_range(lo, hi)
         rows = await with_transaction(kv, op)
         return UserRsp([serde.loads(v) for _, v in rows]), b""
 

@@ -188,14 +188,15 @@ class MetaServer:
 
     def __init__(self, store: MetaStore, storage_client,
                  gc_period_s: float = 0.2, session_ttl_s: float = 3600.0,
-                 node_id: int = 0):
+                 node_id: int = 0, admin_token: str = ""):
         self.store = store
         self.sc = storage_client
         self.service = MetaService(store, storage_client)
         self.cfg = MetaConfig(gc_period_s=gc_period_s, session_ttl_s=session_ttl_s)
         from t3fs.core.service import AppInfo, CoreService
         self.core = CoreService(AppInfo(node_id, "meta"),
-                                config=self.cfg, kv=store.kv)
+                                config=self.cfg, kv=store.kv,
+                                admin_token=admin_token)
         self._task: asyncio.Task | None = None
         self._stopped = asyncio.Event()
         self.gc_count = 0

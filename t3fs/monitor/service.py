@@ -1,0 +1,151 @@
+"""monitor_collector: cluster-wide metric aggregation service.
+
+Reference analog: src/monitor_collector/ — a service that fans metric
+samples pushed from every node into ClickHouse (deploy/sql/3fs-monitor.sql),
+fed by each node's MonitorCollectorClient reporter
+(common/monitor/MonitorCollectorClient).  Here the sink is sqlite (baked into
+Python, queryable like the ClickHouse tables) with a JSONL side option, and
+a query RPC used by the admin CLI.
+"""
+
+from __future__ import annotations
+
+import json
+import sqlite3
+import threading
+import time
+from dataclasses import dataclass, field
+
+from t3fs.net.server import rpc_method, service
+from t3fs.utils.serde import serde_struct
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS metrics (
+  ts REAL NOT NULL,
+  node_id INTEGER NOT NULL,
+  node_type TEXT NOT NULL,
+  name TEXT NOT NULL,
+  kind TEXT NOT NULL,
+  value REAL,
+  payload TEXT NOT NULL
+);
+CREATE INDEX IF NOT EXISTS metrics_name_ts ON metrics (name, ts);
+"""
+
+
+class MetricsDB:
+    """sqlite sink (the ClickHouse-table analog, deploy/sql/3fs-monitor.sql)."""
+
+    def __init__(self, path: str = ":memory:"):
+        self.path = path
+        self._conn = sqlite3.connect(path, check_same_thread=False)
+        self._lock = threading.Lock()
+        with self._lock:
+            self._conn.executescript(_SCHEMA)
+
+    def insert(self, node_id: int, node_type: str, ts: float,
+               samples: list[dict]) -> int:
+        rows = []
+        for s in samples:
+            value = s.get("value", s.get("mean"))
+            rows.append((ts, node_id, node_type, s.get("name", ""),
+                         s.get("type", ""),
+                         float(value) if value is not None else None,
+                         json.dumps(s, default=str)))
+        with self._lock:
+            self._conn.executemany(
+                "INSERT INTO metrics VALUES (?,?,?,?,?,?,?)", rows)
+            self._conn.commit()
+        return len(rows)
+
+    def query(self, name_prefix: str = "", since_ts: float = 0.0,
+              limit: int = 1000) -> list[dict]:
+        q = ("SELECT ts, node_id, node_type, payload FROM metrics "
+             "WHERE ts >= ? AND name LIKE ? ORDER BY ts DESC LIMIT ?")
+        with self._lock:
+            cur = self._conn.execute(q, (since_ts, name_prefix + "%", limit))
+            rows = cur.fetchall()
+        out = []
+        for ts, node_id, node_type, payload in rows:
+            d = json.loads(payload)
+            d.update(ts=ts, node_id=node_id, node_type=node_type)
+            out.append(d)
+        return out
+
+    def close(self) -> None:
+        with self._lock:
+            self._conn.close()
+
+
+@serde_struct
+@dataclass
+class ReportMetricsReq:
+    node_id: int = 0
+    node_type: str = ""
+    ts: float = 0.0
+    samples: list[dict] = field(default_factory=list)
+
+
+@serde_struct
+@dataclass
+class ReportMetricsRsp:
+    accepted: int = 0
+
+
+@serde_struct
+@dataclass
+class QueryMetricsReq:
+    name_prefix: str = ""
+    since_ts: float = 0.0
+    limit: int = 1000
+
+
+@serde_struct
+@dataclass
+class QueryMetricsRsp:
+    samples: list[dict] = field(default_factory=list)
+
+
+@service("Monitor")
+class MonitorCollectorService:
+    def __init__(self, db: MetricsDB | None = None):
+        self.db = db or MetricsDB()
+
+    @rpc_method
+    async def report(self, req: ReportMetricsReq, payload, conn):
+        n = self.db.insert(req.node_id, req.node_type,
+                           req.ts or time.time(), req.samples)
+        return ReportMetricsRsp(n), b""
+
+    @rpc_method
+    async def query(self, req: QueryMetricsReq, payload, conn):
+        return QueryMetricsRsp(
+            self.db.query(req.name_prefix, req.since_ts, req.limit)), b""
+
+
+class MonitorCollectorServer:
+    """monitor_collector_main analog: the aggregation service as a server."""
+
+    def __init__(self, db_path: str = ":memory:", host: str = "127.0.0.1",
+                 port: int = 0):
+        from t3fs.core.service import AppInfo, CoreService
+        from t3fs.net.server import Server
+
+        self.db = MetricsDB(db_path)
+        self.service = MonitorCollectorService(self.db)
+        self.server = Server(host, port)
+        self.server.add_service(self.service)
+        self.core = CoreService(AppInfo(0, "monitor"))
+        self.server.add_service(self.core)
+
+    async def start(self) -> None:
+        await self.server.start()
+        self.core.app_info.address = self.server.address
+
+    async def stop(self) -> None:
+        await self.server.stop()
+        self.db.close()
+
+    @property
+    def address(self) -> str:
+        return self.server.address

@@ -38,7 +38,8 @@ class StorageServer:
                  host: str = "127.0.0.1", port: int = 0,
                  heartbeat_period_s: float = 0.3,
                  resync_period_s: float = 0.2,
-                 cfg: StorageConfig | None = None):
+                 cfg: StorageConfig | None = None,
+                 admin_token: str = ""):
         self.cfg = cfg or StorageConfig(
             host=host, port=port, heartbeat_period_s=heartbeat_period_s,
             resync_period_s=resync_period_s)
@@ -48,7 +49,8 @@ class StorageServer:
         self.service = StorageService(self.node)
         self.server.add_service(self.service)
         from t3fs.core.service import AppInfo, CoreService
-        self.core = CoreService(AppInfo(node_id, "storage"), config=self.cfg)
+        self.core = CoreService(AppInfo(node_id, "storage"), config=self.cfg,
+                                admin_token=admin_token)
         self.server.add_service(self.core)
         self.mgmtd_address = mgmtd_address
         self.heartbeat_period_s = self.cfg.heartbeat_period_s

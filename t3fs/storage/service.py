@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import time as _time
 from typing import Callable
 
 from t3fs.mgmtd.types import (
@@ -34,9 +35,11 @@ from t3fs.storage.types import (
     SpaceInfoRsp, SyncDoneReq, SyncDoneRsp, SyncStartReq, SyncStartRsp,
     TruncateChunkReq, UpdateIO, UpdateType, WriteReq, WriteRsp,
 )
+from t3fs.analytics.trace_log import StorageEventTrace
 from t3fs.utils.fault_injection import fault_raise
 from t3fs.utils.metrics import CountRecorder, LatencyRecorder
 from t3fs.utils.status import Status, StatusCode, StatusError, make_error
+from t3fs.utils.tracing import add_event as trace_add
 
 log = logging.getLogger("t3fs.storage")
 
@@ -77,6 +80,8 @@ class StorageNode:
         self.forwarding = ReliableForwarding(self)
         self.write_latency = LatencyRecorder(f"storage.write.n{node_id}")
         self.read_count = CountRecorder(f"storage.read_ios.n{node_id}")
+        # optional StructuredTraceLog[StorageEventTrace] (analytics §5.1)
+        self.trace_log = None
 
     def routing(self) -> RoutingInfo:
         return self._routing_provider()
@@ -153,8 +158,35 @@ class StorageService:
 
     async def _handle_update(self, io: UpdateIO, payload: bytes,
                              conn: Connection, require_head: bool) -> IOResult:
+        """Trace-wrapped update: one StorageEventTrace row per update hop
+        (reference: StorageOperator writes a StorageEventTrace per update,
+        StorageOperator.cc:356-361,399,461-462,509)."""
+        if self.node.trace_log is None:
+            return await self._handle_update_inner(io, payload, conn, require_head)
+        t0 = _time.perf_counter()
+        result: IOResult | None = None
+        try:
+            result = await self._handle_update_inner(io, payload, conn,
+                                                     require_head)
+            return result
+        finally:
+            self.node.trace_log.append(StorageEventTrace(
+                ts=_time.time(), node_id=self.node.node_id,
+                chain_id=io.chain_id, chunk_id=str(io.chunk_id),
+                update_ver=io.update_ver,
+                commit_ver=result.commit_ver if result else 0,
+                update_type=io.update_type.name.lower()
+                if hasattr(io.update_type, "name") else str(io.update_type),
+                length=io.length,
+                checksum=result.checksum if result else 0,
+                commit_status=result.status.code if result else -1,
+                latency_s=_time.perf_counter() - t0))
+
+    async def _handle_update_inner(self, io: UpdateIO, payload: bytes,
+                                   conn: Connection, require_head: bool) -> IOResult:
         node = self.node
         fault_raise("storage.update.entry")
+        trace_add("storage.update.enter", f"chunk={io.chunk_id}")
         if io.debug.server_should_fail():
             raise make_error(StatusCode.INTERNAL, "injected server error")
         chain, target = node._check_chain(io.chain_id, io.chain_ver,
@@ -173,6 +205,7 @@ class StorageService:
             # fetch payload: one-sided pull from requester, or inline frame
             if io.buf is not None and not io.inline:
                 payload = await remote_read(conn, io.buf)
+                trace_add("storage.update.pulled", f"len={len(payload)}")
             if io.update_ver == 0:
                 meta = target.engine.get_meta(io.chunk_id)
                 io.update_ver = (meta.update_ver if meta else 0) + 1
@@ -180,6 +213,7 @@ class StorageService:
 
             try:
                 result = target.replica.apply_update(io, payload)
+                trace_add("storage.update.applied", f"ver={io.update_ver}")
             except StatusError as e:
                 result = IOResult(WireStatus(int(e.code), str(e)))
                 if require_head:
@@ -189,6 +223,7 @@ class StorageService:
             # forward down the chain (tail commits first)
             try:
                 succ_result = await self._forward(chain, target, io, payload)
+                trace_add("storage.update.forwarded")
             except StatusError as e:
                 result = IOResult(WireStatus(int(e.code), f"forward: {e}"))
                 if require_head:
@@ -212,6 +247,7 @@ class StorageService:
             if io.update_type not in (UpdateType.REMOVE,):
                 result = target.replica.commit(io.chunk_id, io.update_ver,
                                                chain.chain_ver)
+                trace_add("storage.update.committed")
             if require_head:
                 node.reliable_update.record(io, result)
             return result

@@ -1,0 +1,58 @@
+"""Request-scoped trace points.
+
+Reference analog: common/utils/Tracing.h:12-72 — TRACING_ADD_EVENT appends
+(timestamp, event) points to a folly::RequestContext-scoped `Points` buffer;
+the points ride with the request across executor hops.  Here a contextvar
+carries the point buffer across awaits in the same task tree.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import time
+from dataclasses import dataclass, field
+
+_points: contextvars.ContextVar["Points | None"] = contextvars.ContextVar(
+    "t3fs_trace_points", default=None)
+
+
+@dataclass
+class Points:
+    """One request's trace: (monotonic ts, event, detail) triples."""
+    events: list[tuple[float, str, str]] = field(default_factory=list)
+    t0: float = field(default_factory=time.perf_counter)
+
+    def add(self, event: str, detail: str = "") -> None:
+        self.events.append((time.perf_counter() - self.t0, event, detail))
+
+    def spans(self) -> list[tuple[str, float]]:
+        """(event, seconds-since-previous-event) decomposition."""
+        out, prev = [], 0.0
+        for ts, event, _ in self.events:
+            out.append((event, ts - prev))
+            prev = ts
+        return out
+
+
+def start_trace() -> Points:
+    """Begin a request scope; returns the live point buffer."""
+    p = Points()
+    _points.set(p)
+    return p
+
+
+def current_trace() -> Points | None:
+    return _points.get()
+
+
+def add_event(event: str, detail: str = "") -> None:
+    """TRACING_ADD_EVENT analog — no-op when no scope is active."""
+    p = _points.get()
+    if p is not None:
+        p.add(event, detail)
+
+
+def end_trace() -> Points | None:
+    p = _points.get()
+    _points.set(None)
+    return p

@@ -133,8 +133,14 @@ def test_user_ctrl(core_server):
                                 UserReq("tok", UserInfo(1)))
         assert rsp.users[0].token == token
         await cli.call(srv.address, "Core.userAdd", UserReq("tok", UserInfo(2, "bob")))
+        # uid=255: low byte 0xff must not fall off the range-scan end
+        await cli.call(srv.address, "Core.userAdd", UserReq("tok", UserInfo(255, "ff")))
         rsp, _ = await cli.call(srv.address, "Core.userList", UserReq("tok"))
-        assert {u.name for u in rsp.users} == {"alice", "bob"}
+        assert {u.name for u in rsp.users} == {"alice", "bob", "ff"}
+        with pytest.raises(StatusError):  # uid out of range -> INVALID_ARG
+            await cli.call(srv.address, "Core.userAdd",
+                           UserReq("tok", UserInfo(-1, "neg")))
+        await cli.call(srv.address, "Core.userRemove", UserReq("tok", UserInfo(255)))
         await cli.call(srv.address, "Core.userRemove", UserReq("tok", UserInfo(1)))
         with pytest.raises(StatusError):
             await cli.call(srv.address, "Core.userGet", UserReq(user=UserInfo(1)))

@@ -1,0 +1,82 @@
+"""monitor_collector: node metrics pushed over RPC into the queryable sink.
+
+Reference analog: src/monitor_collector/ + common/monitor/
+MonitorCollectorClient (SURVEY.md §2.1 monitor, §5.5).
+"""
+
+import asyncio
+import time
+
+from t3fs.monitor.reporter import MonitorReporter
+from t3fs.monitor.service import (
+    MetricsDB, MonitorCollectorServer, QueryMetricsReq, ReportMetricsReq,
+)
+from t3fs.net.client import Client
+from t3fs.utils import metrics as M
+
+
+def test_metrics_db_roundtrip():
+    db = MetricsDB()
+    n = db.insert(3, "storage", 123.0, [
+        {"name": "write.bytes", "type": "count", "value": 4096},
+        {"name": "write.lat", "type": "dist", "count": 7, "mean": 0.2,
+         "p99": 0.9},
+    ])
+    assert n == 2
+    rows = db.query("write.")
+    assert len(rows) == 2
+    lat = next(r for r in rows if r["name"] == "write.lat")
+    assert lat["p99"] == 0.9 and lat["node_id"] == 3
+    assert db.query("write.bytes")[0]["value"] == 4096
+    assert db.query("nope") == []
+    db.close()
+
+
+def test_report_and_query_rpc():
+    async def body():
+        srv = MonitorCollectorServer()
+        await srv.start()
+        cli = Client()
+        try:
+            rsp, _ = await cli.call(
+                srv.address, "Monitor.report",
+                ReportMetricsReq(1, "meta", time.time(),
+                                 [{"name": "ops", "type": "count", "value": 5}]))
+            assert rsp.accepted == 1
+            rsp, _ = await cli.call(srv.address, "Monitor.query",
+                                    QueryMetricsReq(name_prefix="ops"))
+            assert rsp.samples[0]["value"] == 5 and rsp.samples[0]["node_type"] == "meta"
+        finally:
+            await cli.close()
+            await srv.stop()
+    asyncio.run(body())
+
+
+def test_collector_to_monitor_pipeline():
+    """In-proc Collector -> MonitorReporter thread -> collector service."""
+    async def body():
+        srv = MonitorCollectorServer()
+        await srv.start()
+        M.reset_registry()
+        rep = MonitorReporter(srv.address, node_id=7, node_type="storage")
+        try:
+            c = M.CountRecorder("pipeline.ops")
+            c.add(41)
+            collector = M.Collector(period_s=3600, reporters=[rep])
+            collector.collect_once()
+            cli = Client()
+            rows = []
+            for _ in range(50):  # reporter thread is async; poll briefly
+                rsp, _ = await cli.call(srv.address, "Monitor.query",
+                                        QueryMetricsReq(name_prefix="pipeline."))
+                rows = rsp.samples
+                if rows:
+                    break
+                await asyncio.sleep(0.05)
+            await cli.close()
+            assert rows and rows[0]["value"] == 41 and rows[0]["node_id"] == 7
+        finally:
+            rep.close()
+            M.reset_registry()
+            await srv.stop()
+    asyncio.run(body())
