@@ -13,9 +13,12 @@ lock-cheap and never blocks on IO: a background thread flushes row groups.
 from __future__ import annotations
 
 import dataclasses
+import logging
 import threading
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Any, Iterator
+
+_log = logging.getLogger("t3fs.analytics")
 
 
 @dataclass
@@ -61,12 +64,20 @@ class StructuredTraceLog:
         self._thread = threading.Thread(
             target=self._run, daemon=True, name="t3fs-tracelog")
         self._flush_interval_s = flush_interval_s
-        self._thread.start()
         self.rows_written = 0
+        self.rows_dropped = 0
+        self._thread.start()
+
+    MAX_BUFFERED = 1 << 16
 
     def append(self, entry: Any) -> None:
         row = tuple(getattr(entry, f) for f in self._fields)
         with self._lock:
+            if len(self._buf) >= self.MAX_BUFFERED:
+                # sink is stuck (disk full, EIO): shed oldest rather than
+                # grow without bound on the hot path
+                del self._buf[: self.rows_per_group]
+                self.rows_dropped += self.rows_per_group
             self._buf.append(row)
             if len(self._buf) >= self.rows_per_group:
                 self._flush_ev.set()
@@ -75,10 +86,21 @@ class StructuredTraceLog:
         while not self._stop.is_set():
             self._flush_ev.wait(self._flush_interval_s)
             self._flush_ev.clear()
-            self._flush_once()
-        self._flush_once()
+            self._flush_safe()
+        self._flush_safe()
         if self._writer is not None:
-            self._writer.close()
+            try:
+                self._writer.close()   # parquet footer
+            except Exception:
+                _log.exception("trace log close failed: %s", self.path)
+
+    def _flush_safe(self) -> None:
+        """A failing sink must never kill the flusher thread — the log is
+        best-effort observability, not the data path."""
+        try:
+            self._flush_once()
+        except Exception:
+            _log.exception("trace log flush failed: %s", self.path)
 
     def _flush_once(self) -> None:
         with self._lock:

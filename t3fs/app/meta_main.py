@@ -1,0 +1,86 @@
+"""meta_main: metadata service binary (reference: src/meta/meta.cpp,
+TwoPhaseApplication<MetaServer>).
+
+Stateless against its transactional KV (the reference's FoundationDB role is
+played by the WAL engine spec in [kv]); talks to mgmtd for routing and to
+storage for GC / length queries.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass
+
+from t3fs.app.base import ApplicationBase, LogConfig
+from t3fs.client.mgmtd_client import MgmtdClient
+from t3fs.client.storage_client import StorageClient, StorageClientConfig
+from t3fs.kv.wal_engine import open_kv_engine
+from t3fs.meta.service import MetaServer
+from t3fs.meta.store import ChainAllocator, MetaStore
+from t3fs.net.server import Server
+from t3fs.utils.config import ConfigBase, citem, cobj
+
+
+@dataclass
+class MetaMainConfig(ConfigBase):
+    node_id: int = citem(0, hot=False)
+    listen_host: str = citem("127.0.0.1", hot=False)
+    listen_port: int = citem(0, hot=False)
+    mgmtd_address: str = citem("127.0.0.1:9000", hot=False)
+    kv: str = citem("mem", hot=False)
+    default_chunk_size: int = citem(1 << 20, hot=False,
+                                    validator=lambda v: v > 0)
+    stripe_size: int = citem(1, hot=False, validator=lambda v: v >= 1)
+    gc_period_s: float = citem(0.5, validator=lambda v: v > 0)
+    session_ttl_s: float = citem(3600.0, validator=lambda v: v > 0)
+    admin_token: str = citem("", hot=False)
+    port_file: str = citem("", hot=False)
+    log: LogConfig = cobj(LogConfig)
+
+
+async def serve(cfg: MetaMainConfig, app: ApplicationBase) -> None:
+    kv = open_kv_engine(cfg.kv)
+    rpc = Server(cfg.listen_host, cfg.listen_port)
+    mgmtd = MgmtdClient(cfg.mgmtd_address)
+    state: dict = {}
+
+    async def start():
+        await mgmtd.start()
+        sc = StorageClient(mgmtd.routing, config=StorageClientConfig(),
+                           refresh_routing=mgmtd.refresh)
+        store = MetaStore(kv, ChainAllocator(
+            mgmtd.routing, default_chunk_size=cfg.default_chunk_size,
+            default_stripe=cfg.stripe_size))
+        meta = MetaServer(store, sc, gc_period_s=cfg.gc_period_s,
+                          session_ttl_s=cfg.session_ttl_s,
+                          node_id=cfg.node_id, admin_token=cfg.admin_token)
+        for svc in meta.services:
+            rpc.add_service(svc)
+        await rpc.start()
+        await meta.start()
+        state["meta"], state["sc"] = meta, sc
+        if cfg.port_file:
+            with open(cfg.port_file, "w") as f:
+                f.write(str(rpc.port))
+
+    async def stop():
+        if "meta" in state:
+            await state["meta"].stop()
+        await rpc.stop()
+        if "sc" in state:
+            await state["sc"].close()
+        await mgmtd.stop()
+        if hasattr(kv, "close"):
+            kv.close()
+
+    await app.run(start, stop)
+
+
+def main(argv: list[str] | None = None) -> None:
+    app = ApplicationBase("meta", MetaMainConfig)
+    cfg = app.boot(argv)
+    asyncio.run(serve(cfg, app))
+
+
+if __name__ == "__main__":
+    main()

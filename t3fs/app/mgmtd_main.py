@@ -1,0 +1,65 @@
+"""mgmtd_main: cluster manager binary (reference: src/mgmtd/mgmtd.cpp).
+
+    python -m t3fs.app.mgmtd_main --config configs/mgmtd.toml
+"""
+
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass
+
+from t3fs.app.base import ApplicationBase, LogConfig
+from t3fs.kv.wal_engine import open_kv_engine
+from t3fs.mgmtd.service import MgmtdConfig, MgmtdServer
+from t3fs.net.server import Server
+from t3fs.utils.config import ConfigBase, citem, cobj
+
+
+@dataclass
+class MgmtdMainConfig(ConfigBase):
+    node_id: int = citem(1, hot=False)
+    listen_host: str = citem("127.0.0.1", hot=False)
+    listen_port: int = citem(0, hot=False)
+    kv: str = citem("mem", hot=False)       # open_kv_engine spec
+    admin_token: str = citem("", hot=False)
+    port_file: str = citem("", hot=False)   # write bound port here (dev clusters)
+    service: MgmtdConfig = cobj(MgmtdConfig)
+    log: LogConfig = cobj(LogConfig)
+
+
+async def serve(cfg: MgmtdMainConfig, app: ApplicationBase) -> None:
+    kv = open_kv_engine(cfg.kv)
+    rpc = Server(cfg.listen_host, cfg.listen_port)
+
+    mgmtd: list[MgmtdServer] = []
+
+    async def start():
+        await rpc.start()
+        srv = MgmtdServer(kv, cfg.node_id, rpc.address, cfg.service,
+                          admin_token=cfg.admin_token)
+        for svc in srv.services:
+            rpc.add_service(svc)
+        await srv.start()
+        mgmtd.append(srv)
+        if cfg.port_file:
+            with open(cfg.port_file, "w") as f:
+                f.write(str(rpc.port))
+
+    async def stop():
+        if mgmtd:
+            await mgmtd[0].stop()
+        await rpc.stop()
+        if hasattr(kv, "close"):
+            kv.close()
+
+    await app.run(start, stop)
+
+
+def main(argv: list[str] | None = None) -> None:
+    app = ApplicationBase("mgmtd", MgmtdMainConfig)
+    cfg = app.boot(argv)
+    asyncio.run(serve(cfg, app))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,43 @@
+"""monitor_main: metric aggregation binary (reference:
+src/monitor_collector/ monitor_collector_main).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass
+
+from t3fs.app.base import ApplicationBase, LogConfig
+from t3fs.monitor.service import MonitorCollectorServer
+from t3fs.utils.config import ConfigBase, citem, cobj
+
+
+@dataclass
+class MonitorMainConfig(ConfigBase):
+    listen_host: str = citem("127.0.0.1", hot=False)
+    listen_port: int = citem(0, hot=False)
+    db_path: str = citem(":memory:", hot=False)
+    port_file: str = citem("", hot=False)
+    log: LogConfig = cobj(LogConfig)
+
+
+async def serve(cfg: MonitorMainConfig, app: ApplicationBase) -> None:
+    srv = MonitorCollectorServer(cfg.db_path, cfg.listen_host, cfg.listen_port)
+
+    async def start():
+        await srv.start()
+        if cfg.port_file:
+            with open(cfg.port_file, "w") as f:
+                f.write(str(srv.server.port))
+
+    await app.run(start, srv.stop)
+
+
+def main(argv: list[str] | None = None) -> None:
+    app = ApplicationBase("monitor", MonitorMainConfig)
+    cfg = app.boot(argv)
+    asyncio.run(serve(cfg, app))
+
+
+if __name__ == "__main__":
+    main()

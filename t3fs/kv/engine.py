@@ -153,32 +153,38 @@ class MemKVEngine(KVEngine):
 
     def _commit(self, txn: Transaction) -> None:
         with self._lock:
-            # conflict check: any tracked read invalidated after snapshot?
-            for key in txn._read_keys:
-                if self._latest_write_version(key) > txn.read_version:
-                    raise make_error(StatusCode.TXN_CONFLICT, f"key {key!r}")
-            for begin, end in txn._read_ranges:
-                lo = bisect.bisect_left(self._sorted_keys, begin)
-                hi = bisect.bisect_left(self._sorted_keys, end)
-                for k in self._sorted_keys[lo:hi]:
-                    if self._latest_write_version(k) > txn.read_version:
-                        raise make_error(StatusCode.TXN_CONFLICT, f"range key {k!r}")
-            if not txn._writes and not txn._range_clears:
-                return
-            self._version += 1
-            ver = self._version
-            # expand range clears against current live keys
-            for begin, end in txn._range_clears:
-                lo = bisect.bisect_left(self._sorted_keys, begin)
-                hi = bisect.bisect_left(self._sorted_keys, end)
-                for k in self._sorted_keys[lo:hi]:
-                    if k not in txn._writes:
-                        self._data.setdefault(k, []).append((ver, None))
-            for key, val in txn._writes.items():
-                if key not in self._data:
-                    bisect.insort(self._sorted_keys, key)
-                    self._data[key] = []
-                self._data[key].append((ver, val))
+            self._check_conflicts_locked(txn)
+            self._apply_locked(txn)
+
+    def _check_conflicts_locked(self, txn: Transaction) -> None:
+        """Abort if any tracked read was invalidated after the snapshot."""
+        for key in txn._read_keys:
+            if self._latest_write_version(key) > txn.read_version:
+                raise make_error(StatusCode.TXN_CONFLICT, f"key {key!r}")
+        for begin, end in txn._read_ranges:
+            lo = bisect.bisect_left(self._sorted_keys, begin)
+            hi = bisect.bisect_left(self._sorted_keys, end)
+            for k in self._sorted_keys[lo:hi]:
+                if self._latest_write_version(k) > txn.read_version:
+                    raise make_error(StatusCode.TXN_CONFLICT, f"range key {k!r}")
+
+    def _apply_locked(self, txn: Transaction) -> None:
+        if not txn._writes and not txn._range_clears:
+            return
+        self._version += 1
+        ver = self._version
+        # expand range clears against current live keys
+        for begin, end in txn._range_clears:
+            lo = bisect.bisect_left(self._sorted_keys, begin)
+            hi = bisect.bisect_left(self._sorted_keys, end)
+            for k in self._sorted_keys[lo:hi]:
+                if k not in txn._writes:
+                    self._data.setdefault(k, []).append((ver, None))
+        for key, val in txn._writes.items():
+            if key not in self._data:
+                bisect.insort(self._sorted_keys, key)
+                self._data[key] = []
+            self._data[key].append((ver, val))
 
 
 async def with_transaction(engine: KVEngine,

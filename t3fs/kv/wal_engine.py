@@ -1,0 +1,240 @@
+"""Durable transactional KV: WAL + snapshot over the in-memory SSI engine.
+
+Reference analogs: the transactional-KV seam of src/fdb/ — HybridKvEngine
+picks an engine {fdb | memkv | custom} behind IKVEngine
+(HybridKvEngine.h:13-31); here the durable engine is a write-ahead log +
+snapshot pair (the role FoundationDB plays for meta/mgmtd state), reusing
+MemKVEngine's MVCC/SSI commit logic so transaction semantics are identical
+across engines — exactly how the reference's tests swap memkv for fdb.
+
+Files (under one directory):
+  kv.snap     point-in-time latest-value dump  [tmp+rename, crc-framed]
+  kv.wal      committed write batches since the snapshot  [crc-framed]
+
+Crash atomicity: a commit is durable once its WAL frame is written (+fsync
+in "always" mode).  A torn/corrupt tail frame is discarded on open —
+commits are applied prefix-wise, like RocksDB WriteBatch recovery
+(chunk_engine/README.md "Maintaining the Allocator's in-memory state").
+"""
+
+from __future__ import annotations
+
+import bisect
+import os
+import struct
+import threading
+import zlib
+
+from t3fs.kv.engine import KVEngine, MemKVEngine, Transaction
+
+_FRAME_HDR = struct.Struct("<II")     # payload_len, crc32(payload)
+_SNAP_MAGIC = b"T3KVSNP1"
+_WAL_MAGIC = b"T3KVWAL1"
+
+
+def _pack_batch(writes: list[tuple[bytes, bytes | None]],
+                range_clears: list[tuple[bytes, bytes]]) -> bytes:
+    out = [struct.pack("<II", len(writes), len(range_clears))]
+    for k, v in writes:
+        if v is None:
+            out.append(struct.pack("<Iq", len(k), -1))
+            out.append(k)
+        else:
+            out.append(struct.pack("<Iq", len(k), len(v)))
+            out.append(k)
+            out.append(v)
+    for b, e in range_clears:
+        out.append(struct.pack("<II", len(b), len(e)))
+        out.append(b)
+        out.append(e)
+    return b"".join(out)
+
+
+def _unpack_batch(buf: bytes):
+    nw, nc = struct.unpack_from("<II", buf, 0)
+    off = 8
+    writes: list[tuple[bytes, bytes | None]] = []
+    for _ in range(nw):
+        klen, vlen = struct.unpack_from("<Iq", buf, off)
+        off += 12
+        k = buf[off:off + klen]
+        off += klen
+        if vlen < 0:
+            writes.append((k, None))
+        else:
+            writes.append((k, buf[off:off + vlen]))
+            off += vlen
+    clears: list[tuple[bytes, bytes]] = []
+    for _ in range(nc):
+        blen, elen = struct.unpack_from("<II", buf, off)
+        off += 8
+        clears.append((buf[off:off + blen], buf[off + blen:off + blen + elen]))
+        off += blen + elen
+    return writes, clears
+
+
+class WalKVEngine(MemKVEngine):
+    """MemKVEngine whose committed batches are logged to disk and replayed
+    on open.  sync: "always" fsyncs each commit (durable vs power loss),
+    "os" leaves flushing to the page cache (durable vs process crash)."""
+
+    def __init__(self, root: str, *, sync: str = "always",
+                 compact_threshold_bytes: int = 8 << 20):
+        super().__init__()
+        assert sync in ("always", "os")
+        self.root = root
+        self.sync = sync
+        self.compact_threshold_bytes = compact_threshold_bytes
+        os.makedirs(root, exist_ok=True)
+        self.snap_path = os.path.join(root, "kv.snap")
+        self.wal_path = os.path.join(root, "kv.wal")
+        self._io_lock = threading.Lock()
+        self._wal_valid_end = 0
+        self._load()
+        if (os.path.exists(self.wal_path)
+                and os.path.getsize(self.wal_path) > self._wal_valid_end):
+            # discard the torn tail BEFORE appending — otherwise new frames
+            # land after the tear and every future replay stops short of them
+            with open(self.wal_path, "r+b") as f:
+                f.truncate(self._wal_valid_end)
+        self._wal = open(self.wal_path, "ab")
+        if self._wal.tell() == 0:
+            self._wal.write(_WAL_MAGIC)
+            self._wal.flush()
+
+    # --- recovery ---
+
+    def _load(self) -> None:
+        if os.path.exists(self.snap_path):
+            with open(self.snap_path, "rb") as f:
+                data = f.read()
+            if data[:8] == _SNAP_MAGIC and len(data) >= 8 + _FRAME_HDR.size:
+                payload = data[8 + _FRAME_HDR.size:]
+                plen, crc = _FRAME_HDR.unpack_from(data, 8)
+                if len(payload) == plen and zlib.crc32(payload) == crc:
+                    writes, _ = _unpack_batch(payload)
+                    self._version = 1
+                    for k, v in writes:
+                        self._apply_loaded(k, v, 1)
+                # else: corrupt snapshot — start empty, WAL replays on top
+        if os.path.exists(self.wal_path):
+            with open(self.wal_path, "rb") as f:
+                data = f.read()
+            off = len(_WAL_MAGIC) if data[:8] == _WAL_MAGIC else 0
+            self._wal_valid_end = off
+            while off + _FRAME_HDR.size <= len(data):
+                plen, crc = _FRAME_HDR.unpack_from(data, off)
+                start = off + _FRAME_HDR.size
+                payload = data[start:start + plen]
+                if len(payload) != plen or zlib.crc32(payload) != crc:
+                    break  # torn tail: stop replay here
+                writes, clears = _unpack_batch(payload)
+                self._version += 1
+                ver = self._version
+                for b, e in clears:
+                    lo = bisect.bisect_left(self._sorted_keys, b)
+                    hi = bisect.bisect_left(self._sorted_keys, e)
+                    for k in self._sorted_keys[lo:hi]:
+                        self._data.setdefault(k, []).append((ver, None))
+                for k, v in writes:
+                    self._apply_loaded(k, v, ver)
+                off = start + plen
+                self._wal_valid_end = off
+
+    def _apply_loaded(self, k: bytes, v: bytes | None, ver: int) -> None:
+        if k not in self._data:
+            bisect.insort(self._sorted_keys, k)
+            self._data[k] = []
+        self._data[k].append((ver, v))
+
+    # --- durable commit ---
+
+    def _commit(self, txn: Transaction) -> None:
+        with self._io_lock:
+            with self._lock:
+                # standard WAL ordering: conflict-check, LOG, then apply —
+                # a failed append must leave memory untouched, or restart
+                # silently diverges (lost batch, persisted dependents)
+                self._check_conflicts_locked(txn)
+                writes = list(txn._writes.items())
+                clears = list(txn._range_clears)
+                if writes or clears:
+                    payload = _pack_batch(writes, clears)
+                    pos = self._wal.tell()
+                    try:
+                        self._wal.write(_FRAME_HDR.pack(len(payload),
+                                                        zlib.crc32(payload)))
+                        self._wal.write(payload)
+                        self._wal.flush()
+                        if self.sync == "always":
+                            os.fsync(self._wal.fileno())
+                    except OSError:
+                        # drop the torn frame so later commits don't land
+                        # beyond a tear that replay will stop at
+                        try:
+                            self._wal.truncate(pos)
+                            self._wal.seek(pos)
+                        except OSError:
+                            pass
+                        raise
+                self._apply_locked(txn)
+            if self._wal.tell() >= self.compact_threshold_bytes:
+                self._compact_locked()
+
+    # --- compaction ---
+
+    def compact(self) -> None:
+        with self._io_lock:
+            self._compact_locked()
+
+    def _compact_locked(self) -> None:
+        with self._lock:
+            latest = []
+            for k in self._sorted_keys:
+                versions = self._data.get(k)
+                if versions and versions[-1][1] is not None:
+                    latest.append((k, versions[-1][1]))
+        payload = _pack_batch(latest, [])
+        tmp = self.snap_path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(_SNAP_MAGIC)
+            f.write(_FRAME_HDR.pack(len(payload), zlib.crc32(payload)))
+            f.write(payload)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.snap_path)
+        # snapshot durable -> WAL can restart
+        self._wal.close()
+        self._wal = open(self.wal_path, "wb")
+        self._wal.write(_WAL_MAGIC)
+        self._wal.flush()
+        if self.sync == "always":
+            os.fsync(self._wal.fileno())
+
+    def close(self) -> None:
+        with self._io_lock:
+            if not self._wal.closed:
+                self._wal.flush()
+                if self.sync == "always":
+                    os.fsync(self._wal.fileno())
+                self._wal.close()
+
+
+def open_kv_engine(spec: str) -> KVEngine:
+    """HybridKvEngine-style selector (HybridKvEngine.h:13-31):
+      "mem"                  in-memory SSI engine (tests, single node)
+      "wal:/path[?sync=os]"  durable WAL+snapshot engine at /path
+    """
+    if spec == "mem":
+        return MemKVEngine()
+    if spec.startswith("wal:"):
+        rest = spec[4:]
+        sync = "always"
+        if "?" in rest:
+            rest, q = rest.split("?", 1)
+            for part in q.split("&"):
+                k, _, v = part.partition("=")
+                if k == "sync":
+                    sync = v
+        return WalKVEngine(rest, sync=sync)
+    raise ValueError(f"unknown kv engine spec: {spec!r}")

@@ -81,6 +81,40 @@ class LeaseInfo:
     expires_at: float = 0.0
 
 
+@serde_struct
+@dataclass
+class NodeStatus:
+    node: NodeInfo = field(default_factory=NodeInfo)
+    last_heartbeat_age_s: float = -1.0
+    alive: bool = False
+
+
+@serde_struct
+@dataclass
+class ListNodesRsp:
+    nodes: list[NodeStatus] = field(default_factory=list)
+
+
+@serde_struct
+@dataclass
+class SetConfigTemplateReq:
+    node_type: str = ""
+    toml: str = ""
+
+
+@serde_struct
+@dataclass
+class GetConfigTemplateReq:
+    node_type: str = ""
+
+
+@serde_struct
+@dataclass
+class GetConfigTemplateRsp:
+    toml: str = ""
+    found: bool = False
+
+
 @dataclass
 class MgmtdConfig(ConfigBase):
     """Hot-updatable service knobs (ConfigBase.h CONFIG_HOT_UPDATED_ITEM
@@ -131,6 +165,11 @@ class MgmtdState:
             return False
         lease = serde.loads(raw)
         return lease.holder_node == self.node_id and lease.expires_at > time.time()
+
+    async def lease_info(self) -> LeaseInfo:
+        txn = self.kv.transaction()
+        raw = txn.get(KeyPrefix.LEASE.key(), snapshot=True)
+        return serde.loads(raw) if raw else LeaseInfo()
 
     # --- persistent records ---
 
@@ -281,6 +320,47 @@ class MgmtdService:
         self._require_primary()
         await self.state.save_chains(req.chains, req.tables)
         return OkRsp(), b""
+
+    @rpc_method
+    async def list_nodes(self, req, payload, conn):
+        """Admin op (ListNodes analog): registered nodes + liveness."""
+        st = self.state
+        now = time.time()
+        rows = []
+        for node in st.routing().nodes.values():
+            hb = st.last_heartbeat.get(node.node_id, 0.0)
+            rows.append(NodeStatus(
+                node=node, last_heartbeat_age_s=(now - hb) if hb else -1.0,
+                alive=st.node_alive(node.node_id)))
+        return ListNodesRsp(rows), b""
+
+    @rpc_method
+    async def get_lease(self, req, payload, conn):
+        """Who is primary (MgmtdLeaseInfo analog)."""
+        lease = await self.state.lease_info()
+        return lease, b""
+
+    @rpc_method
+    async def set_config_template(self, req: SetConfigTemplateReq, payload, conn):
+        """Store a per-node-type config template in the KV — the config-
+        distribution half of the two-phase bootstrap (reference:
+        TwoPhaseApplication.h:42-46, core/app/MgmtdClientFetcher.h)."""
+        self._require_primary()
+
+        async def op(txn):
+            txn.set(KeyPrefix.CONFIG.key(req.node_type.encode()),
+                    req.toml.encode())
+        await with_transaction(self.state.kv, op)
+        return OkRsp(), b""
+
+    @rpc_method
+    async def get_config_template(self, req: GetConfigTemplateReq, payload, conn):
+        async def op(txn):
+            return txn.get(KeyPrefix.CONFIG.key(req.node_type.encode()))
+        raw = await with_transaction(self.state.kv, op)
+        return GetConfigTemplateRsp(
+            toml=raw.decode() if raw is not None else "",
+            found=raw is not None), b""
 
 
 class MgmtdServer:

@@ -60,10 +60,14 @@ class MetricsDB:
 
     def query(self, name_prefix: str = "", since_ts: float = 0.0,
               limit: int = 1000) -> list[dict]:
+        # range comparison, not LIKE: metric names routinely contain '_',
+        # which LIKE would treat as a wildcard
         q = ("SELECT ts, node_id, node_type, payload FROM metrics "
-             "WHERE ts >= ? AND name LIKE ? ORDER BY ts DESC LIMIT ?")
+             "WHERE ts >= ? AND name >= ? AND name < ? "
+             "ORDER BY ts DESC LIMIT ?")
+        hi = name_prefix + chr(0x10FFFF)
         with self._lock:
-            cur = self._conn.execute(q, (since_ts, name_prefix + "%", limit))
+            cur = self._conn.execute(q, (since_ts, name_prefix, hi, limit))
             rows = cur.fetchall()
         out = []
         for ts, node_id, node_type, payload in rows:

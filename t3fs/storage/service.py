@@ -87,8 +87,9 @@ class StorageNode:
         return self._routing_provider()
 
     def add_target(self, target_id: int, root: str,
-                   state: LocalTargetState = LocalTargetState.ONLINE) -> StorageTarget:
-        t = StorageTarget(target_id, root)
+                   state: LocalTargetState = LocalTargetState.ONLINE,
+                   engine_backend: str = "native") -> StorageTarget:
+        t = StorageTarget(target_id, root, engine_backend)
         self.targets[target_id] = t
         self.local_states[target_id] = state
         return t
@@ -165,13 +166,15 @@ class StorageService:
             return await self._handle_update_inner(io, payload, conn, require_head)
         t0 = _time.perf_counter()
         result: IOResult | None = None
+        trace: dict = {}
         try:
             result = await self._handle_update_inner(io, payload, conn,
-                                                     require_head)
+                                                     require_head, trace)
             return result
         finally:
             self.node.trace_log.append(StorageEventTrace(
                 ts=_time.time(), node_id=self.node.node_id,
+                target_id=trace.get("target_id", 0),
                 chain_id=io.chain_id, chunk_id=str(io.chunk_id),
                 update_ver=io.update_ver,
                 commit_ver=result.commit_ver if result else 0,
@@ -179,18 +182,23 @@ class StorageService:
                 if hasattr(io.update_type, "name") else str(io.update_type),
                 length=io.length,
                 checksum=result.checksum if result else 0,
+                forward_status=trace.get("forward_status", 0),
                 commit_status=result.status.code if result else -1,
                 latency_s=_time.perf_counter() - t0))
 
     async def _handle_update_inner(self, io: UpdateIO, payload: bytes,
-                                   conn: Connection, require_head: bool) -> IOResult:
+                                   conn: Connection, require_head: bool,
+                                   trace: dict | None = None) -> IOResult:
         node = self.node
+        if trace is None:
+            trace = {}
         fault_raise("storage.update.entry")
         trace_add("storage.update.enter", f"chunk={io.chunk_id}")
         if io.debug.server_should_fail():
             raise make_error(StatusCode.INTERNAL, "injected server error")
         chain, target = node._check_chain(io.chain_id, io.chain_ver,
                                           require_head=require_head)
+        trace["target_id"] = target.target_id
 
         # exactly-once channel dedupe (head only — forwarded hops are
         # version-gated by the replica)
@@ -224,6 +232,8 @@ class StorageService:
             try:
                 succ_result = await self._forward(chain, target, io, payload)
                 trace_add("storage.update.forwarded")
+                if succ_result is not None:
+                    trace["forward_status"] = succ_result.status.code
             except StatusError as e:
                 result = IOResult(WireStatus(int(e.code), f"forward: {e}"))
                 if require_head:

@@ -90,5 +90,5 @@ def test_storage_update_writes_event_trace():
             assert len(rows) == 3, rows
             assert all(r.update_type == "write" and r.commit_status == 0
                        for _, r in rows)
-            assert all(r.latency_s > 0 for _, r in rows)
+            assert all(r.latency_s > 0 and r.target_id > 0 for _, r in rows)
     asyncio.run(body())
