@@ -60,7 +60,8 @@ class DevCluster:
     # --- layout helpers (same scheme as testing LocalCluster) ---
 
     def target_id(self, node_id: int, chain_idx: int = 0) -> int:
-        return node_id * 100 + chain_idx + 1
+        from t3fs.mgmtd.placement import target_id
+        return target_id(node_id, chain_idx)
 
     def _kv_spec(self, name: str) -> str:
         if not self.durable:
@@ -82,8 +83,10 @@ class DevCluster:
         proc = subprocess.Popen(
             [sys.executable, "-m", module, "--config", cfg_path],
             stdout=logf, stderr=subprocess.STDOUT,
-            env={**os.environ, "PYTHONPATH": os.path.dirname(
-                os.path.dirname(os.path.dirname(os.path.abspath(__file__))))},
+            env={**os.environ, "PYTHONPATH": os.pathsep.join(filter(None, [
+                os.path.dirname(os.path.dirname(os.path.dirname(
+                    os.path.abspath(__file__)))),
+                os.environ.get("PYTHONPATH", "")]))},
             cwd=self.run_dir)
         self.procs[name] = proc
         return proc

@@ -1,0 +1,510 @@
+"""t3fs admin CLI: one-shot commands + interactive shell.
+
+Reference analog: src/client/cli/ + src/client/bin/admin_cli.cc — the
+interactive admin shell with command families for cluster management
+(ListNodes, UploadChainTable, DumpChainTable), config
+(GetConfig/HotUpdateConfig/VerifyConfig), users, file ops, chunk-meta dumps,
+checksums and a quick bench (registerAdminCommands.cc).
+
+Usage:
+    python -m t3fs.cli.admin --mgmtd 127.0.0.1:9000 list-nodes
+    python -m t3fs.cli.admin --mgmtd ... --meta ... ls /
+    python -m t3fs.cli.admin --mgmtd ...            # interactive shell
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import shlex
+import sys
+import time
+
+from t3fs.client.meta_client import MetaClient
+from t3fs.client.mgmtd_client import MgmtdClient
+from t3fs.client.storage_client import StorageClient, StorageClientConfig
+from t3fs.core.service import (
+    EchoReq, GetConfigReq, HotUpdateConfigReq, RenderConfigReq, UserInfo,
+    UserReq,
+)
+from t3fs.fuse.vfs import FileSystem
+from t3fs.mgmtd.service import (
+    GetConfigTemplateReq, SetChainsReq, SetConfigTemplateReq,
+)
+from t3fs.mgmtd.types import (
+    ChainInfo, ChainTable, ChainTargetInfo, PublicTargetState,
+)
+from t3fs.monitor.service import QueryMetricsReq
+from t3fs.net.client import Client
+from t3fs.ops.codec import crc32c
+from t3fs.storage.types import SyncStartReq
+from t3fs.utils.status import StatusError
+
+COMMANDS: dict[str, tuple] = {}    # name -> (configure_fn, handler, help)
+
+
+def command(name: str, help_: str):
+    def deco(fn):
+        COMMANDS[name] = (getattr(fn, "_configure", lambda p: None), fn, help_)
+        return fn
+    return deco
+
+
+def args_(*specs):
+    """Attach positional/option specs: ("name", {kwargs})."""
+    def deco(fn):
+        def configure(p: argparse.ArgumentParser):
+            for spec in specs:
+                flag, kw = spec
+                p.add_argument(flag, **kw)
+        fn._configure = configure
+        return fn
+    return deco
+
+
+class AdminContext:
+    def __init__(self, mgmtd: str, meta: str = "", monitor: str = "",
+                 token: str = ""):
+        self.mgmtd_address = mgmtd
+        self.meta_address = meta
+        self.monitor_address = monitor
+        self.token = token
+        self.cli = Client()
+        self._mgmtd_client: MgmtdClient | None = None
+        self._fs: FileSystem | None = None
+        self._sc: StorageClient | None = None
+
+    async def mgmtd_client(self) -> MgmtdClient:
+        if self._mgmtd_client is None:
+            self._mgmtd_client = MgmtdClient(self.mgmtd_address,
+                                             refresh_period_s=0.5)
+            await self._mgmtd_client.start()
+        return self._mgmtd_client
+
+    async def fs(self) -> FileSystem:
+        if self._fs is None:
+            if not self.meta_address:
+                raise SystemExit("file commands need --meta ADDR")
+            mg = await self.mgmtd_client()
+            self._sc = StorageClient(mg.routing, config=StorageClientConfig(),
+                                     refresh_routing=mg.refresh)
+            self._fs = FileSystem(MetaClient([self.meta_address]), self._sc)
+        return self._fs
+
+    async def close(self) -> None:
+        if self._fs is not None:
+            await self._fs.meta.close_conn()
+        if self._sc is not None:
+            await self._sc.close()
+        if self._mgmtd_client is not None:
+            await self._mgmtd_client.stop()
+        await self.cli.close()
+
+
+def _fmt_table(rows: list[list], headers: list[str]) -> str:
+    cols = [headers] + [[str(c) for c in r] for r in rows]
+    widths = [max(len(r[i]) for r in cols) for i in range(len(headers))]
+    out = ["  ".join(h.ljust(w) for h, w in zip(headers, widths))]
+    for r in cols[1:]:
+        out.append("  ".join(c.ljust(w) for c, w in zip(r, widths)))
+    return "\n".join(out)
+
+
+# ---------------- cluster ----------------
+
+@command("list-nodes", "registered nodes + liveness (ListNodes)")
+async def list_nodes(ctx: AdminContext, args) -> None:
+    rsp, _ = await ctx.cli.call(ctx.mgmtd_address, "Mgmtd.list_nodes", None)
+    rows = [[s.node.node_id, s.node.node_type, s.node.address,
+             "up" if s.alive else "DOWN",
+             f"{s.last_heartbeat_age_s:.1f}s" if s.last_heartbeat_age_s >= 0
+             else "never"]
+            for s in rsp.nodes]
+    print(_fmt_table(rows, ["id", "type", "address", "state", "hb-age"]))
+
+
+@command("lease", "current mgmtd primary lease")
+async def lease(ctx: AdminContext, args) -> None:
+    rsp, _ = await ctx.cli.call(ctx.mgmtd_address, "Mgmtd.get_lease", None)
+    ttl = rsp.expires_at - time.time()
+    print(f"primary=node{rsp.holder_node} addr={rsp.holder_address} "
+          f"ttl={ttl:.1f}s")
+
+
+@command("routing", "dump RoutingInfo (DumpChainTable analog)")
+async def routing(ctx: AdminContext, args) -> None:
+    mg = await ctx.mgmtd_client()
+    info = await mg.refresh()
+    print(f"version={info.version} bootstrapping={info.bootstrapping}")
+    for table_id, table in sorted(info.chain_tables.items()):
+        print(f"chain-table {table_id}: chains={table.chain_ids}")
+    rows = []
+    for chain in sorted(info.chains.values(), key=lambda c: c.chain_id):
+        for t in chain.targets:
+            rows.append([chain.chain_id, chain.chain_ver, t.target_id,
+                         t.node_id, t.public_state.name])
+    print(_fmt_table(rows, ["chain", "ver", "target", "node", "state"]))
+
+
+@command("gen-chains", "generate + optionally install a chain table")
+@args_(("--nodes", {"required": True,
+                    "help": "comma-separated storage node ids"}),
+       ("--replicas", {"type": int, "default": 3}),
+       ("--chains", {"type": int, "default": 1}),
+       ("--apply", {"action": "store_true",
+                    "help": "install via Mgmtd.set_chains"}))
+async def gen_chains(ctx: AdminContext, args) -> None:
+    from t3fs.mgmtd.placement import target_id
+    node_ids = [int(x) for x in args.nodes.split(",")]
+    chains = []
+    for c in range(args.chains):
+        targets = []
+        for r in range(args.replicas):
+            node_id = node_ids[(c + r) % len(node_ids)]
+            targets.append(ChainTargetInfo(target_id(node_id, c), node_id,
+                                           PublicTargetState.SERVING))
+        chains.append(ChainInfo(chain_id=c + 1, chain_ver=1, targets=targets))
+    for chain in chains:
+        print(f"chain {chain.chain_id}: " + " -> ".join(
+            f"t{t.target_id}@n{t.node_id}" for t in chain.targets))
+    if args.apply:
+        await ctx.cli.call(
+            ctx.mgmtd_address, "Mgmtd.set_chains",
+            SetChainsReq(chains=chains,
+                         tables=[ChainTable(1, [c.chain_id for c in chains])]))
+        print("installed")
+
+
+@command("set-config-template", "store a node-type config template in mgmtd")
+@args_(("node_type", {}), ("file", {"help": "TOML file"}))
+async def set_config_template(ctx: AdminContext, args) -> None:
+    with open(args.file) as f:
+        toml_text = f.read()
+    await ctx.cli.call(ctx.mgmtd_address, "Mgmtd.set_config_template",
+                       SetConfigTemplateReq(args.node_type, toml_text))
+    print(f"template[{args.node_type}] = {len(toml_text)} bytes")
+
+
+@command("get-config-template", "fetch a node-type config template")
+@args_(("node_type", {}))
+async def get_config_template(ctx: AdminContext, args) -> None:
+    rsp, _ = await ctx.cli.call(ctx.mgmtd_address, "Mgmtd.get_config_template",
+                                GetConfigTemplateReq(args.node_type))
+    print(rsp.toml if rsp.found else f"(no template for {args.node_type})")
+
+
+# ---------------- per-server config/app ----------------
+
+@command("app-info", "identity/uptime of any server")
+@args_(("addr", {}))
+async def app_info(ctx: AdminContext, args) -> None:
+    rsp, _ = await ctx.cli.call(args.addr, "Core.getAppInfo", None)
+    i = rsp.info
+    print(f"{i.node_type} node={i.node_id} addr={i.address} pid={i.pid} "
+          f"version={i.version} uptime={rsp.uptime_s:.1f}s")
+
+
+@command("echo", "round-trip check against any server")
+@args_(("addr", {}), ("message", {"nargs": "?", "default": "ping"}))
+async def echo(ctx: AdminContext, args) -> None:
+    t0 = time.perf_counter()
+    rsp, _ = await ctx.cli.call(args.addr, "Core.echo", EchoReq(args.message))
+    print(f"{rsp.message}  ({(time.perf_counter() - t0) * 1e3:.2f} ms)")
+
+
+@command("get-config", "render a server's live config (GetConfig)")
+@args_(("addr", {}))
+async def get_config(ctx: AdminContext, args) -> None:
+    rsp, _ = await ctx.cli.call(args.addr, "Core.getConfig", GetConfigReq())
+    print(rsp.toml, end="")
+
+
+def _parse_kv(pairs: list[str]) -> dict:
+    # one K=V parser for the whole system (binaries' --set and this CLI)
+    from t3fs.app.base import parse_overrides
+    return parse_overrides(pairs)
+
+
+@command("verify-config", "dry-run config overrides (VerifyConfig/RenderConfig)")
+@args_(("addr", {}), ("overrides", {"nargs": "+", "metavar": "K=V"}))
+async def verify_config(ctx: AdminContext, args) -> None:
+    rsp, _ = await ctx.cli.call(
+        args.addr, "Core.renderConfig",
+        RenderConfigReq(_parse_kv(args.overrides), admin_token=ctx.token))
+    print(f"would update: {rsp.updated_keys}")
+
+
+@command("hot-update-config", "apply hot config overrides (HotUpdateConfig)")
+@args_(("addr", {}), ("overrides", {"nargs": "+", "metavar": "K=V"}))
+async def hot_update_config(ctx: AdminContext, args) -> None:
+    rsp, _ = await ctx.cli.call(
+        args.addr, "Core.hotUpdateConfig",
+        HotUpdateConfigReq(_parse_kv(args.overrides), ctx.token))
+    print(f"updated: {rsp.updated_keys}")
+
+
+# ---------------- users ----------------
+
+@command("user-add", "create a user (token auto-generated)")
+@args_(("uid", {"type": int}), ("name", {}),
+       ("--admin", {"action": "store_true"}))
+async def user_add(ctx: AdminContext, args) -> None:
+    rsp, _ = await ctx.cli.call(
+        ctx.mgmtd_address, "Core.userAdd",
+        UserReq(ctx.token, UserInfo(args.uid, args.name,
+                                    is_admin=args.admin)))
+    u = rsp.users[0]
+    print(f"uid={u.uid} name={u.name} admin={u.is_admin} token={u.token}")
+
+
+@command("user-list", "list users")
+async def user_list(ctx: AdminContext, args) -> None:
+    rsp, _ = await ctx.cli.call(ctx.mgmtd_address, "Core.userList",
+                                UserReq(ctx.token))
+    rows = [[u.uid, u.name, u.is_admin] for u in rsp.users]
+    print(_fmt_table(rows, ["uid", "name", "admin"]))
+
+
+@command("user-remove", "delete a user")
+@args_(("uid", {"type": int}))
+async def user_remove(ctx: AdminContext, args) -> None:
+    await ctx.cli.call(ctx.mgmtd_address, "Core.userRemove",
+                       UserReq(ctx.token, UserInfo(args.uid)))
+    print("removed")
+
+
+# ---------------- file system ----------------
+
+@command("mkdir", "create directories recursively")
+@args_(("path", {}))
+async def mkdir(ctx: AdminContext, args) -> None:
+    fs = await ctx.fs()
+    await fs.mkdirs(args.path)
+    print(f"created {args.path}")
+
+
+@command("ls", "list a directory")
+@args_(("path", {"nargs": "?", "default": "/"}))
+async def ls(ctx: AdminContext, args) -> None:
+    fs = await ctx.fs()
+    rows = []
+    for e in await fs.readdir(args.path):
+        rows.append([e.name, e.itype.name.lower(), e.inode_id])
+    print(_fmt_table(rows, ["name", "type", "inode"]))
+
+
+@command("stat", "stat a path")
+@args_(("path", {}))
+async def stat(ctx: AdminContext, args) -> None:
+    fs = await ctx.fs()
+    ino = await fs.stat(args.path)
+    length = await fs.file_length(ino) if ino.layout is not None else 0
+    print(f"inode={ino.inode_id} type={ino.itype.name.lower()} "
+          f"perm={oct(ino.perm)} length={length}")
+    if ino.layout is not None:
+        print(f"layout: chunk_size={ino.layout.chunk_size} "
+              f"chains={ino.layout.chains}")
+
+
+@command("rm", "remove a path")
+@args_(("path", {}), ("-r", {"action": "store_true", "dest": "recursive"}))
+async def rm(ctx: AdminContext, args) -> None:
+    fs = await ctx.fs()
+    await fs.unlink(args.path, recursive=args.recursive)
+    print(f"removed {args.path}")
+
+
+@command("mv", "rename a path")
+@args_(("src", {}), ("dst", {}))
+async def mv(ctx: AdminContext, args) -> None:
+    fs = await ctx.fs()
+    await fs.rename(args.src, args.dst)
+    print(f"{args.src} -> {args.dst}")
+
+
+@command("put", "upload a local file")
+@args_(("local", {}), ("remote", {}),
+       ("--chunk-size", {"type": int, "default": 0}))
+async def put(ctx: AdminContext, args) -> None:
+    fs = await ctx.fs()
+    with open(args.local, "rb") as f:
+        data = f.read()
+    await fs.write_file(args.remote, data, chunk_size=args.chunk_size)
+    print(f"wrote {len(data)} bytes to {args.remote}")
+
+
+@command("get", "download a file")
+@args_(("remote", {}), ("local", {}))
+async def get(ctx: AdminContext, args) -> None:
+    fs = await ctx.fs()
+    data = await fs.read_file(args.remote)
+    with open(args.local, "wb") as f:
+        f.write(data)
+    print(f"read {len(data)} bytes from {args.remote}")
+
+
+@command("cat", "print file contents")
+@args_(("path", {}))
+async def cat(ctx: AdminContext, args) -> None:
+    fs = await ctx.fs()
+    sys.stdout.buffer.write(await fs.read_file(args.path))
+
+
+@command("checksum", "CRC32C of a file's contents (Checksum command)")
+@args_(("path", {}))
+async def checksum(ctx: AdminContext, args) -> None:
+    fs = await ctx.fs()
+    data = await fs.read_file(args.path)
+    print(f"crc32c={crc32c(data):#010x} length={len(data)}")
+
+
+@command("truncate", "truncate a file")
+@args_(("path", {}), ("length", {"type": int}))
+async def truncate(ctx: AdminContext, args) -> None:
+    fs = await ctx.fs()
+    await fs.truncate(args.path, args.length)
+    print(f"truncated {args.path} to {args.length}")
+
+
+# ---------------- storage ----------------
+
+@command("space-info", "capacity/used/free of a storage node")
+@args_(("addr", {}))
+async def space_info(ctx: AdminContext, args) -> None:
+    rsp, _ = await ctx.cli.call(args.addr, "Storage.space_info", None)
+    print(f"capacity={rsp.capacity} used={rsp.used} free={rsp.free}")
+
+
+@command("dump-chunkmeta", "chunk metadata of a chain on a storage node")
+@args_(("addr", {}), ("chain_id", {"type": int}))
+async def dump_chunkmeta(ctx: AdminContext, args) -> None:
+    rsp, _ = await ctx.cli.call(args.addr, "Storage.sync_start",
+                                SyncStartReq(chain_id=args.chain_id))
+    rows = [[m.chunk_id, m.commit_ver, m.chain_ver, m.length,
+             f"{m.checksum:#010x}"] for m in rsp.metas]
+    print(_fmt_table(rows, ["chunk", "commit_ver", "chain_ver", "len",
+                            "crc32c"]))
+
+
+# ---------------- metrics / bench ----------------
+
+@command("metrics", "query the monitor collector")
+@args_(("prefix", {"nargs": "?", "default": ""}),
+       ("--since", {"type": float, "default": 0.0}),
+       ("--limit", {"type": int, "default": 50}))
+async def metrics(ctx: AdminContext, args) -> None:
+    if not ctx.monitor_address:
+        raise SystemExit("metrics needs --monitor ADDR")
+    rsp, _ = await ctx.cli.call(ctx.monitor_address, "Monitor.query",
+                                QueryMetricsReq(args.prefix, args.since,
+                                                args.limit))
+    for s in rsp.samples:
+        print(json.dumps(s, default=str))
+
+
+@command("bench", "quick write+read bench through meta+storage")
+@args_(("--dir", {"default": "/_bench", "dest": "bench_dir"}),
+       ("--files", {"type": int, "default": 4}),
+       ("--size", {"type": int, "default": 1 << 20}),
+       ("--chunk-size", {"type": int, "default": 0}),
+       ("--keep", {"action": "store_true"}))
+async def bench(ctx: AdminContext, args) -> None:
+    import os
+    fs = await ctx.fs()
+    await fs.mkdirs(args.bench_dir)
+    payloads = [os.urandom(args.size) for _ in range(args.files)]
+    t0 = time.perf_counter()
+    await asyncio.gather(*[
+        fs.write_file(f"{args.bench_dir}/f{i}", p,
+                      chunk_size=args.chunk_size)
+        for i, p in enumerate(payloads)])
+    tw = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    reads = await asyncio.gather(*[
+        fs.read_file(f"{args.bench_dir}/f{i}") for i in range(args.files)])
+    tr = time.perf_counter() - t0
+    assert all(r == p for r, p in zip(reads, payloads)), "readback mismatch"
+    total = args.files * args.size
+    print(f"write: {total / tw / 1e6:.1f} MB/s  read: {total / tr / 1e6:.1f} "
+          f"MB/s  ({args.files} x {args.size} B)")
+    if not args.keep:
+        await fs.unlink(args.bench_dir, recursive=True)
+
+
+# ---------------- driver ----------------
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(prog="t3fs-admin")
+    ap.add_argument("--mgmtd", default="127.0.0.1:9000")
+    ap.add_argument("--meta", default="")
+    ap.add_argument("--monitor", default="")
+    ap.add_argument("--token", default="")
+    sub = ap.add_subparsers(dest="command")
+    for name, (configure, _fn, help_) in sorted(COMMANDS.items()):
+        p = sub.add_parser(name, help=help_)
+        configure(p)
+    return ap
+
+
+async def dispatch(ctx: AdminContext, args, *, in_repl: bool = False) -> int:
+    _, fn, _ = COMMANDS[args.command]
+    try:
+        await fn(ctx, args)
+        return 0
+    except StatusError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    except SystemExit as e:
+        # bad arguments (e.g. malformed K=V): fatal one-shot, recoverable
+        # inside the shell
+        if not in_repl:
+            raise
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+
+
+async def repl(ctx: AdminContext, parser: argparse.ArgumentParser) -> None:
+    print("t3fs admin shell — 'help' lists commands, 'quit' exits")
+    loop = asyncio.get_running_loop()
+    while True:
+        try:
+            line = await loop.run_in_executor(None, input, "t3fs> ")
+        except (EOFError, KeyboardInterrupt):
+            break
+        line = line.strip()
+        if not line:
+            continue
+        if line in ("quit", "exit"):
+            break
+        if line == "help":
+            for name, (_c, _f, help_) in sorted(COMMANDS.items()):
+                print(f"  {name:22s} {help_}")
+            continue
+        try:
+            args = parser.parse_args(shlex.split(line))
+        except SystemExit:
+            continue  # argparse already printed the error
+        if args.command:
+            await dispatch(ctx, args, in_repl=True)
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    ctx = AdminContext(args.mgmtd, args.meta, args.monitor, args.token)
+
+    async def run():
+        try:
+            if args.command:
+                return await dispatch(ctx, args)
+            await repl(ctx, parser)
+            return 0
+        finally:
+            await ctx.close()
+
+    return asyncio.run(run())
+
+
+if __name__ == "__main__":
+    sys.exit(main())

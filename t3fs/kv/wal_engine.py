@@ -20,12 +20,16 @@ commits are applied prefix-wise, like RocksDB WriteBatch recovery
 from __future__ import annotations
 
 import bisect
+import logging
 import os
 import struct
 import threading
 import zlib
 
 from t3fs.kv.engine import KVEngine, MemKVEngine, Transaction
+from t3fs.utils.status import StatusCode, make_error
+
+log = logging.getLogger("t3fs.kv")
 
 _FRAME_HDR = struct.Struct("<II")     # payload_len, crc32(payload)
 _SNAP_MAGIC = b"T3KVSNP1"
@@ -97,10 +101,12 @@ class WalKVEngine(MemKVEngine):
             # land after the tear and every future replay stops short of them
             with open(self.wal_path, "r+b") as f:
                 f.truncate(self._wal_valid_end)
-        self._wal = open(self.wal_path, "ab")
+        # unbuffered: write() reaches the OS or raises — a Python-level
+        # buffer could replay an aborted frame on a later flush
+        self._wal = open(self.wal_path, "ab", buffering=0)
+        self._broken = False
         if self._wal.tell() == 0:
             self._wal.write(_WAL_MAGIC)
-            self._wal.flush()
 
     # --- recovery ---
 
@@ -116,7 +122,17 @@ class WalKVEngine(MemKVEngine):
                     self._version = 1
                     for k, v in writes:
                         self._apply_loaded(k, v, 1)
-                # else: corrupt snapshot — start empty, WAL replays on top
+                else:
+                    # a post-compaction WAL is near-empty: booting without
+                    # the snapshot is near-total data loss — say so loudly
+                    log.critical(
+                        "snapshot %s is CORRUPT (crc/length mismatch); "
+                        "starting from WAL alone — state may be missing "
+                        "everything before the last compaction",
+                        self.snap_path)
+            else:
+                log.critical("snapshot %s is CORRUPT (bad magic/truncated); "
+                             "starting from WAL alone", self.snap_path)
         if os.path.exists(self.wal_path):
             with open(self.wal_path, "rb") as f:
                 data = f.read()
@@ -159,23 +175,33 @@ class WalKVEngine(MemKVEngine):
                 writes = list(txn._writes.items())
                 clears = list(txn._range_clears)
                 if writes or clears:
+                    if self._broken:
+                        raise make_error(
+                            StatusCode.INTERNAL,
+                            "WAL is failed (earlier append error); "
+                            "reopen the engine")
                     payload = _pack_batch(writes, clears)
                     pos = self._wal.tell()
                     try:
                         self._wal.write(_FRAME_HDR.pack(len(payload),
-                                                        zlib.crc32(payload)))
-                        self._wal.write(payload)
-                        self._wal.flush()
+                                                        zlib.crc32(payload))
+                                        + payload)
                         if self.sync == "always":
                             os.fsync(self._wal.fileno())
                     except OSError:
                         # drop the torn frame so later commits don't land
-                        # beyond a tear that replay will stop at
+                        # beyond a tear that replay will stop at; if even
+                        # that fails, refuse all further commits — anything
+                        # appended past a tear would be silently lost
                         try:
-                            self._wal.truncate(pos)
+                            os.ftruncate(self._wal.fileno(), pos)
                             self._wal.seek(pos)
                         except OSError:
-                            pass
+                            self._broken = True
+                            log.critical(
+                                "WAL %s: failed append AND failed truncate; "
+                                "engine is read-only until reopen",
+                                self.wal_path)
                         raise
                 self._apply_locked(txn)
             if self._wal.tell() >= self.compact_threshold_bytes:
@@ -205,9 +231,8 @@ class WalKVEngine(MemKVEngine):
         os.replace(tmp, self.snap_path)
         # snapshot durable -> WAL can restart
         self._wal.close()
-        self._wal = open(self.wal_path, "wb")
+        self._wal = open(self.wal_path, "wb", buffering=0)
         self._wal.write(_WAL_MAGIC)
-        self._wal.flush()
         if self.sync == "always":
             os.fsync(self._wal.fileno())
 

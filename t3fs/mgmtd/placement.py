@@ -14,6 +14,12 @@ from collections import Counter
 from t3fs.mgmtd.types import RoutingInfo
 
 
+def target_id(node_id: int, chain_idx: int) -> int:
+    """Canonical dev/test target-id scheme shared by the cluster launchers
+    and admin gen-chains: one target per (node, chain slot)."""
+    return node_id * 100 + chain_idx + 1
+
+
 def chain_nodes(routing: RoutingInfo, chain_id: int) -> list[int]:
     chain = routing.chain(chain_id)
     return [t.node_id for t in chain.targets] if chain else []

@@ -1,0 +1,108 @@
+"""Admin CLI driven as a real subprocess against a multi-process cluster.
+
+Reference analog: src/client/cli admin_cli commands (ListNodes,
+DumpChainTable, GetConfig/HotUpdateConfig, file ops, Checksum, Bench).
+"""
+
+import asyncio
+import os
+import subprocess
+import sys
+import tempfile
+
+import pytest
+
+from t3fs.app.dev_cluster import DevCluster
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_cli(cluster: DevCluster, *argv: str) -> str:
+    cmd = [sys.executable, "-m", "t3fs.cli.admin",
+           "--mgmtd", cluster.mgmtd_address]
+    if cluster.meta_address:
+        cmd += ["--meta", cluster.meta_address]
+    cmd += list(argv)
+    out = subprocess.run(
+        cmd, capture_output=True, text=True, timeout=60,
+        env={**os.environ, "PYTHONPATH": os.pathsep.join(
+            filter(None, [REPO, os.environ.get("PYTHONPATH", "")]))})
+    assert out.returncode == 0, f"{argv}: {out.stdout}\n{out.stderr}"
+    return out.stdout
+
+
+@pytest.mark.slow
+def test_admin_cli_families():
+    async def body(run_dir):
+        cluster = DevCluster(run_dir, num_storage=2, replicas=2,
+                             num_chains=1, with_meta=True, durable=False,
+                             chunk_size=64 * 1024)
+        await cluster.start()
+        return cluster
+
+    async def teardown(cluster):
+        await cluster.stop()
+
+    with tempfile.TemporaryDirectory(prefix="t3fs-cli-") as d:
+        cluster = asyncio.run(body(d))
+        try:
+            out = run_cli(cluster, "list-nodes")
+            assert "storage" in out and "up" in out
+
+            out = run_cli(cluster, "lease")
+            assert "primary=node1" in out
+
+            out = run_cli(cluster, "routing")
+            assert "chain-table 1" in out and "SERVING" in out
+
+            storage_addr = open(os.path.join(d, "storage1.port")).read()
+            storage_addr = f"127.0.0.1:{storage_addr.strip()}"
+            out = run_cli(cluster, "app-info", storage_addr)
+            assert "storage" in out and "uptime" in out
+
+            out = run_cli(cluster, "get-config", storage_addr)
+            assert "heartbeat_period_s" in out
+
+            out = run_cli(cluster, "hot-update-config", storage_addr,
+                          "resync_period_s=0.123")
+            assert "resync_period_s" in out
+            out = run_cli(cluster, "get-config", storage_addr)
+            assert "0.123" in out
+
+            out = run_cli(cluster, "verify-config", storage_addr,
+                          "resync_period_s=0.5")
+            assert "would update" in out
+
+            # file family
+            run_cli(cluster, "mkdir", "/cli")
+            local = os.path.join(d, "local.bin")
+            with open(local, "wb") as f:
+                f.write(os.urandom(200_000))
+            run_cli(cluster, "put", local, "/cli/blob")
+            out = run_cli(cluster, "ls", "/cli")
+            assert "blob" in out
+            out = run_cli(cluster, "stat", "/cli/blob")
+            assert "length=200000" in out
+            fetched = os.path.join(d, "fetched.bin")
+            run_cli(cluster, "get", "/cli/blob", fetched)
+            assert open(fetched, "rb").read() == open(local, "rb").read()
+            out = run_cli(cluster, "checksum", "/cli/blob")
+            assert "crc32c=0x" in out
+            run_cli(cluster, "mv", "/cli/blob", "/cli/blob2")
+            out = run_cli(cluster, "ls", "/cli")
+            names = {line.split()[0] for line in out.splitlines()[1:] if line}
+            assert "blob2" in names and "blob" not in names
+            run_cli(cluster, "rm", "/cli/blob2")
+
+            # storage family
+            out = run_cli(cluster, "space-info", storage_addr)
+            assert "capacity=" in out
+            out = run_cli(cluster, "dump-chunkmeta", storage_addr, "1")
+            assert "commit_ver" in out
+
+            # bench family
+            out = run_cli(cluster, "bench", "--files", "2",
+                          "--size", "131072")
+            assert "write:" in out and "read:" in out
+        finally:
+            asyncio.run(teardown(cluster))
