@@ -136,6 +136,9 @@ class MgmtdState:
         self.cfg = cfg
         self.last_heartbeat: dict[int, float] = {}
         self.local_states: dict[int, LocalTargetState] = {}   # target -> state
+        # targets whose node silently restarted: demote from SERVING so they
+        # resync (cleared by the chains updater AFTER a successful save)
+        self.restarted_targets: set[int] = set()
         self._routing_cache: RoutingInfo | None = None
         # startup grace: a restarted mgmtd has an empty liveness map — treat
         # every node as alive until one full heartbeat window has passed, or
@@ -224,7 +227,8 @@ class MgmtdState:
 
 def next_chain_state(chain: ChainInfo,
                      alive: dict[int, bool],
-                     local: dict[int, LocalTargetState]) -> ChainInfo | None:
+                     local: dict[int, LocalTargetState],
+                     restarted: set[int] = frozenset()) -> ChainInfo | None:
     """One step of the chain state machine (generateNewChain analog,
     mgmtd/service/updateChain.h:38; table at docs/design_notes.md:201-231).
     Returns a NEW ChainInfo with bumped version if anything changed."""
@@ -233,6 +237,13 @@ def next_chain_state(chain: ChainInfo,
     changed = False
     serving_count = sum(1 for t in targets
                         if t.public_state == PublicTargetState.SERVING)
+    # survivors a restarted member can be demoted onto: serving, alive, and
+    # not themselves freshly restarted — demoting onto a dead/restarted
+    # "survivor" would leave the chain with no authoritative copy
+    healthy_serving = sum(
+        1 for t in targets
+        if t.public_state == PublicTargetState.SERVING
+        and alive.get(t.node_id, False) and t.target_id not in restarted)
     # a LASTSRV target holds the only authoritative copy: while one exists,
     # a returning stale target must NOT be seated as serving (write loss)
     has_lastsrv = any(t.public_state == PublicTargetState.LASTSRV
@@ -240,7 +251,16 @@ def next_chain_state(chain: ChainInfo,
     for t in targets:
         a = alive.get(t.node_id, False)
         ls = local.get(t.target_id, LocalTargetState.INVALID)
-        if t.public_state == PublicTargetState.SERVING and not a:
+        if t.public_state == PublicTargetState.SERVING and a \
+                and t.target_id in restarted and healthy_serving >= 1:
+            # node restarted within the heartbeat window: its data may be
+            # stale/lost while it still looks alive — demote to SYNCING so
+            # resync re-validates it (sole survivor keeps serving: its copy,
+            # whatever remains of it, is the best the chain has)
+            t.public_state = PublicTargetState.SYNCING
+            serving_count -= 1
+            changed = True
+        elif t.public_state == PublicTargetState.SERVING and not a:
             # last serving target holds the authoritative copy: LASTSRV
             t.public_state = (PublicTargetState.LASTSRV if serving_count == 1
                               else PublicTargetState.OFFLINE)
@@ -300,9 +320,22 @@ class MgmtdService:
         st = self.state
         known = st.routing().nodes.get(req.node.node_id)
         st.last_heartbeat[req.node.node_id] = time.time()
+        # generation is PERSISTED with the node record, so restart
+        # detection survives an mgmtd restart/failover coinciding with
+        # the storage node's restart
+        prev_gen = known.generation if known is not None else None
+        if req.node.generation and prev_gen \
+                and prev_gen != req.node.generation:
+            # fast restart (within the heartbeat window): every target
+            # this node serves must fall back to SYNCING and resync
+            for chain in st.routing().chains.values():
+                for t in chain.targets:
+                    if t.node_id == req.node.node_id:
+                        st.restarted_targets.add(t.target_id)
         for tid, ls in req.target_states.items():
             st.local_states[int(tid)] = LocalTargetState(ls)
-        if known is None or known.address != req.node.address:
+        if known is None or known.address != req.node.address \
+                or known.generation != req.node.generation:
             await st.save_node(req.node)
             await st.load_routing()
         return HeartbeatRsp(routing_version=st.routing().version), b""
@@ -428,9 +461,13 @@ class MgmtdServer:
         st = self.state
         routing = st.routing()
         updated = []
+        handled: set[int] = set()
         for chain in routing.chains.values():
             alive = {t.node_id: st.node_alive(t.node_id) for t in chain.targets}
-            nxt = next_chain_state(chain, alive, st.local_states)
+            nxt = next_chain_state(chain, alive, st.local_states,
+                                   restarted=st.restarted_targets)
+            handled |= {t.target_id for t in chain.targets} \
+                & st.restarted_targets
             if nxt is not None:
                 updated.append(nxt)
                 log.info("chain %d v%d -> v%d: %s", nxt.chain_id,
@@ -438,4 +475,8 @@ class MgmtdServer:
                          [(t.target_id, t.public_state.name) for t in nxt.targets])
         if updated:
             await st.save_chains(updated)
+        # only forget restart flags once the demotions are durably saved —
+        # dropping them before a failed save would leave a stale node
+        # serving forever
+        st.restarted_targets -= handled
         return len(updated)

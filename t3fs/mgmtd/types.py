@@ -84,6 +84,10 @@ class NodeInfo:
     address: str = ""            # host:port of the storage/meta service
     node_type: str = "storage"   # storage | meta | mgmtd
     status: NodeStatus = NodeStatus.ACTIVE
+    # process generation (start timestamp): lets mgmtd detect a crash-restart
+    # that happened WITHIN the heartbeat window — the node looks continuously
+    # alive but its serving targets may have lost state and need resync
+    generation: float = 0.0
 
 
 @serde_struct

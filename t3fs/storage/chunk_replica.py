@@ -55,6 +55,19 @@ class ChunkReplica:
             if meta is not None and meta.update_ver > io.update_ver:
                 return IOResult(WireStatus(), meta.length, meta.update_ver,
                                 meta.commit_ver, meta.chain_ver, meta.checksum)
+            if meta is not None and meta.update_ver == io.update_ver \
+                    and meta.commit_ver >= io.update_ver \
+                    and io.checksum in (0, meta.checksum):
+                # same version ALREADY COMMITTED with matching content: a
+                # late replace (e.g. a write-forward racing a completed
+                # resync of the same version) must be idempotent —
+                # re-marking DIRTY would wedge the chunk, since the
+                # idempotent commit path would never flip it back.  A
+                # DIFFERENT checksum at the same version is divergence
+                # (e.g. post-data-loss) and must fall through so the
+                # replace actually repairs the bytes.
+                return IOResult(WireStatus(), meta.length, meta.update_ver,
+                                meta.commit_ver, meta.chain_ver, meta.checksum)
             checksum = self.crc(payload)
             if io.checksum and checksum != io.checksum:
                 raise make_error(StatusCode.CHECKSUM_MISMATCH,
@@ -136,6 +149,12 @@ class ChunkReplica:
             # chunk was removed by a later update in the channel; treat as done
             return IOResult(WireStatus(), 0, update_ver, update_ver, chain_ver, 0)
         if meta.commit_ver >= update_ver:
+            if meta.state == ChunkState.DIRTY \
+                    and meta.update_ver <= meta.commit_ver:
+                # defense in depth: a DIRTY marker at/below the committed
+                # version is a stale artifact — repair it so reads resume
+                meta.state = ChunkState.COMMIT
+                self.engine.set_meta(chunk_id, meta)
             return IOResult(WireStatus(), meta.length, meta.update_ver,
                             meta.commit_ver, meta.chain_ver, meta.checksum)
         if meta.update_ver != update_ver:

@@ -94,6 +94,12 @@ class ResyncWorker:
                     and rm.checksum == lm.checksum \
                     and rm.commit_ver >= lm.commit_ver:
                 continue
+            # re-fetch the meta at SEND time: a write may have landed since
+            # the diff snapshot, and sending the old checksum with the new
+            # content trips the successor's payload verification
+            lm = target.engine.get_meta(cid)
+            if lm is None or lm.state != ChunkState.COMMIT:
+                continue  # now gone or write-in-flight: live path covers it
             content = target.engine.read(cid)
             io = UpdateIO(
                 chunk_id=cid, chain_id=chain.chain_id, chain_ver=chain.chain_ver,

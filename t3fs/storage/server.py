@@ -9,6 +9,7 @@ runs the resync worker.
 from __future__ import annotations
 
 import logging
+import time as _time
 
 from dataclasses import dataclass
 
@@ -77,7 +78,8 @@ class StorageServer:
         self.core.on_config_updated = self._on_config_updated
         self.mgmtd = MgmtdClientForServer(
             self.mgmtd_address,
-            NodeInfo(self.node_id, self.server.address, "storage"),
+            NodeInfo(self.node_id, self.server.address, "storage",
+                     generation=_time.time()),
             lambda: dict(self.node.local_states),
             heartbeat_period_s=self.heartbeat_period_s,
             refresh_period_s=self.heartbeat_period_s)

@@ -271,16 +271,31 @@ class StorageService:
                 io.update_type in (UpdateType.WRITE, UpdateType.TRUNCATE):
             # write-during-recovery: ship the FULL updated chunk so the
             # syncing successor converges (design_notes.md:240-246)
-            meta = target.engine.get_meta(io.chunk_id)
-            full = target.engine.read(io.chunk_id)
-            rep = UpdateIO(**{**io.__dict__})
-            rep.update_type = UpdateType.REPLACE
-            rep.offset = 0
-            rep.length = len(full)
-            rep.checksum = meta.checksum
-            rep.commit_ver = 0  # commit decided by chain flow
-            return await self.node.forwarding.forward(target.target_id, rep, full)
-        return await self.node.forwarding.forward(target.target_id, io, payload)
+            return await self._forward_full_replace(target, io)
+        result = await self.node.forwarding.forward(target.target_id, io, payload)
+        if result is not None and result.status.code == int(
+                StatusCode.CHUNK_MISSING_UPDATE) \
+                and io.update_type in (UpdateType.WRITE, UpdateType.TRUNCATE):
+            # successor misses earlier updates of this chunk — e.g. it was
+            # promoted from SYNCING by a resync round that skipped the chunk
+            # because it was DIRTY here.  The reference's doForward falls
+            # back to full-chunk forwarding (ReliableForwarding.cc:33-138);
+            # replace with our applied content, version-gated so it can
+            # never regress a newer successor copy.
+            return await self._forward_full_replace(target, io)
+        return result
+
+    async def _forward_full_replace(self, target: StorageTarget,
+                                    io: UpdateIO) -> IOResult | None:
+        meta = target.engine.get_meta(io.chunk_id)
+        full = target.engine.read(io.chunk_id)
+        rep = UpdateIO(**{**io.__dict__})
+        rep.update_type = UpdateType.REPLACE
+        rep.offset = 0
+        rep.length = len(full)
+        rep.checksum = meta.checksum
+        rep.commit_ver = 0  # commit decided by chain flow
+        return await self.node.forwarding.forward(target.target_id, rep, full)
 
     # ---- read path ----
 

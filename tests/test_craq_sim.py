@@ -1,0 +1,48 @@
+"""CRAQ protocol model checking via seeded deterministic schedules.
+
+Reference analog: specs/DataStorage P-spec test schedules (specs/README.md).
+The simulator drives the REAL ChunkReplica state machine and the REAL
+next_chain_state transition function; these seeds historically exposed:
+  - committed-chunk regression to DIRTY by a same-version late REPLACE
+  - missing full-chunk forward fallback after a mid-write resync promotion
+  - undetected fast restarts (generation change inside the heartbeat window)
+  - resync sending stale checksums after a concurrent write
+"""
+
+import pytest
+
+from t3fs.testing.craq_sim import CraqSim, run_schedules
+
+
+def test_no_crash_schedules_converge():
+    assert run_schedules(20, crashes=0) == {}
+
+
+def test_single_crash_schedules():
+    assert run_schedules(60, crashes=1) == {}
+
+
+def test_double_crash_schedules():
+    assert run_schedules(60, crashes=2) == {}
+
+
+def test_crash_with_disk_wipe_schedules():
+    """Worst case: the restarted node lost its disk entirely."""
+    assert run_schedules(40, crashes=1, wipe_on_crash=True) == {}
+    assert run_schedules(40, crashes=2, wipe_on_crash=True) == {}
+
+
+def test_two_replica_chain_schedules():
+    assert run_schedules(30, crashes=1, replicas=2) == {}
+
+
+def test_five_replica_chain_schedules():
+    assert run_schedules(20, crashes=2, replicas=5, writes=8) == {}
+
+
+@pytest.mark.slow
+def test_schedule_soak():
+    """Wider sweep (a few hundred schedules, still < 10 s)."""
+    assert run_schedules(150, seed0=1000, crashes=2) == {}
+    assert run_schedules(100, seed0=5000, crashes=2,
+                         wipe_on_crash=True, writes=10, chunks=3) == {}
