@@ -1,0 +1,162 @@
+"""storage_bench: direct StorageClient load generator.
+
+Reference analog: benchmarks/storage_bench/ (StorageBench.cc:8-27) — drives
+StorageClient against a cluster in write or read mode with checksum and
+fault-injection flags; this is the harness behind the BASELINE configs.
+
+Modes:
+  --cluster local      in-process fabric (UnitTestFabric analog), default
+  --mgmtd HOST:PORT    a live cluster (e.g. t3fs.app.dev_cluster)
+
+    python -m benchmarks.storage_bench --mode write --chunk-size 1048576 \
+        --num-chunks 64 --concurrency 16 --seconds 5 --json
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import time
+
+from t3fs.client.storage_client import StorageClient, StorageClientConfig
+from t3fs.storage.types import ChunkId
+from t3fs.utils.metrics import LatencyRecorder
+
+BENCH_INODE = 0xBE7C
+
+
+async def _mk_local(args):
+    from t3fs.testing.fabric import StorageFabric
+    from t3fs.utils.fault_injection import DebugFlags
+    fab = StorageFabric(num_nodes=args.nodes, replicas=args.replicas)
+    await fab.start()
+    sc = StorageClient(
+        lambda: fab.routing, client=fab.client,
+        config=StorageClientConfig(
+            verify_checksums=args.verify_checksums,
+            debug=DebugFlags(
+                inject_server_error_prob=args.inject_server_error),
+        ))
+    return fab, sc, fab.chain_id
+
+
+async def _mk_remote(args):
+    from t3fs.client.mgmtd_client import MgmtdClient
+    from t3fs.utils.fault_injection import DebugFlags
+    mg = MgmtdClient(args.mgmtd, refresh_period_s=0.5)
+    await mg.start()
+    sc = StorageClient(
+        mg.routing, refresh_routing=mg.refresh,
+        config=StorageClientConfig(
+            verify_checksums=args.verify_checksums,
+            debug=DebugFlags(
+                inject_server_error_prob=args.inject_server_error),
+        ))
+    routing = mg.routing()
+    chain_id = sorted(routing.chains)[0]
+    return mg, sc, chain_id
+
+
+async def run_bench(args) -> dict:
+    env, sc, chain_id = await (_mk_remote(args) if args.mgmtd
+                               else _mk_local(args))
+    lat = LatencyRecorder("bench.op")
+    stop_at = time.perf_counter() + args.seconds
+    counters = {"ops": 0, "bytes": 0, "errors": 0}
+    payloads = [os.urandom(args.chunk_size) for _ in range(8)]
+
+    async def writer(widx: int) -> None:
+        i = widx
+        while time.perf_counter() < stop_at:
+            cid = ChunkId(BENCH_INODE, i % args.num_chunks)
+            i += args.concurrency
+            try:
+                with lat.time():
+                    await sc.write_chunk(chain_id, cid, 0,
+                                         payloads[i % len(payloads)],
+                                         args.chunk_size)
+                counters["ops"] += 1
+                counters["bytes"] += args.chunk_size
+            except Exception:
+                counters["errors"] += 1
+
+    async def reader(widx: int) -> None:
+        i = widx
+        while time.perf_counter() < stop_at:
+            cid = ChunkId(BENCH_INODE, i % args.num_chunks)
+            i += args.concurrency
+            try:
+                with lat.time():
+                    _res, data = await sc.read_chunk(chain_id, cid)
+                counters["ops"] += 1
+                counters["bytes"] += len(data)
+            except Exception:
+                counters["errors"] += 1
+
+    # read mode needs a populated keyspace
+    if args.mode in ("read", "mixed"):
+        await asyncio.gather(*[
+            sc.write_chunk(chain_id, ChunkId(BENCH_INODE, i), 0,
+                           payloads[i % len(payloads)], args.chunk_size)
+            for i in range(args.num_chunks)])
+
+    t0 = time.perf_counter()
+    worker = {"write": writer, "read": reader}.get(args.mode)
+    if worker is not None:
+        await asyncio.gather(*[worker(w) for w in range(args.concurrency)])
+    else:  # mixed
+        half = max(1, args.concurrency // 2)
+        await asyncio.gather(*[writer(w) for w in range(half)],
+                             *[reader(w) for w in range(half)])
+    wall = time.perf_counter() - t0
+
+    snap = lat.collect()
+    result = {
+        "mode": args.mode, "chunk_size": args.chunk_size,
+        "concurrency": args.concurrency, "wall_s": round(wall, 3),
+        "ops": counters["ops"], "errors": counters["errors"],
+        "iops": round(counters["ops"] / wall, 1),
+        "MB_s": round(counters["bytes"] / wall / 1e6, 2),
+        "p50_ms": round(snap.get("p50", 0) * 1e3, 3),
+        "p99_ms": round(snap.get("p99", 0) * 1e3, 3),
+    }
+
+    await sc.close()
+    await env.stop()
+    return result
+
+
+def parse_args(argv=None):
+    ap = argparse.ArgumentParser(prog="storage_bench")
+    ap.add_argument("--mode", choices=["write", "read", "mixed"],
+                    default="write")
+    ap.add_argument("--mgmtd", default="",
+                    help="live cluster address; omit for in-process fabric")
+    ap.add_argument("--nodes", type=int, default=3)
+    ap.add_argument("--replicas", type=int, default=3)
+    ap.add_argument("--chunk-size", type=int, default=1 << 20)
+    ap.add_argument("--num-chunks", type=int, default=64)
+    ap.add_argument("--concurrency", type=int, default=16)
+    ap.add_argument("--seconds", type=float, default=5.0)
+    ap.add_argument("--verify-checksums", action="store_true")
+    ap.add_argument("--inject-server-error", type=float, default=0.0,
+                    help="probability of injected server errors (DebugFlags)")
+    ap.add_argument("--json", action="store_true")
+    return ap.parse_args(argv)
+
+
+def main(argv=None) -> None:
+    args = parse_args(argv)
+    result = asyncio.run(run_bench(args))
+    if args.json:
+        print(json.dumps(result))
+    else:
+        print(f"{result['mode']}: {result['MB_s']} MB/s, "
+              f"{result['iops']} IOPS, p50={result['p50_ms']} ms, "
+              f"p99={result['p99_ms']} ms, errors={result['errors']}")
+
+
+if __name__ == "__main__":
+    main()

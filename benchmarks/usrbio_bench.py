@@ -1,0 +1,126 @@
+"""usrbio_bench: small-IO random reads through the USRBIO shm ring.
+
+Reference analog: benchmarks/fio_usrbio/ — the fio external ioengine over
+the hf3fs USRBIO C API, used to benchmark the KVCache-style random-read
+path (README.md:45-48: peak ~40 GiB/s aggregate).  Here the app side preps
+4 KiB random reads into the shared ring with a bounded queue depth and
+measures completion IOPS while the daemon-side RingWorker drains through
+the StorageClient batch path.
+
+    python -m benchmarks.usrbio_bench --block-size 4096 --depth 64 \
+        --seconds 5 --json
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import random
+import time
+
+from t3fs.fuse.ring_worker import RingWorker
+from t3fs.fuse.vfs import FileSystem
+from t3fs.lib import usrbio
+from t3fs.testing.cluster import LocalCluster
+
+
+async def run_bench(args) -> dict:
+    cluster = LocalCluster(num_nodes=args.nodes, replicas=args.replicas,
+                           num_chains=args.chains, with_meta=True)
+    await cluster.start()
+    suffix = f"bench-{os.getpid()}-{random.getrandbits(24):06x}"
+    iov = ring = worker = None
+    try:
+        fs = FileSystem(cluster.mc, cluster.sc)
+        await fs.mkdirs("/bench")
+        fh = await fs.create("/bench/data", chunk_size=args.block_size)
+        file_blocks = args.file_size // args.block_size
+        # populate through the normal write path
+        blob = os.urandom(args.file_size)
+        await fs.write(fh, 0, blob)
+
+        iov = usrbio.IoVec(f"iov-{suffix}",
+                           args.depth * args.block_size)
+        ring = usrbio.IoRing(f"ring-{suffix}", entries=args.depth * 2,
+                             iov=iov)
+        ident = usrbio.reg_fd(fh)
+        worker = RingWorker(f"ring-{suffix}", cluster.mc, cluster.sc)
+        await worker.start()
+
+        rng = random.Random(0)
+        stop_at = time.perf_counter() + args.seconds
+        completed = 0
+        errors = 0
+        t0 = time.perf_counter()
+        loop = asyncio.get_running_loop()
+        inflight = 0
+        userdata = 0
+        while time.perf_counter() < stop_at or inflight:
+            # top up the queue depth
+            while inflight < args.depth and time.perf_counter() < stop_at:
+                block = rng.randrange(file_blocks)
+                slot = userdata % args.depth
+                ring.prep_io(True, ident, slot * args.block_size,
+                             args.block_size, block * args.block_size,
+                             userdata=userdata)
+                userdata += 1
+                inflight += 1
+            ring.submit_ios()
+            done = await loop.run_in_executor(
+                None, lambda: ring.wait_for_ios(
+                    max_n=args.depth, min_n=1, timeout_ms=5000))
+            if not done:
+                break
+            for c in done:
+                inflight -= 1
+                completed += 1
+                if c.status != 0:
+                    errors += 1
+        wall = time.perf_counter() - t0
+
+        await fs.close(fh)
+        return {
+            "block_size": args.block_size, "depth": args.depth,
+            "file_size": args.file_size, "wall_s": round(wall, 3),
+            "reads": completed, "errors": errors,
+            "iops": round(completed / wall, 1),
+            "MB_s": round(completed * args.block_size / wall / 1e6, 2),
+        }
+    finally:
+        if worker:
+            await worker.stop()
+        if ring:
+            ring.close()
+        if iov:
+            iov.close()
+        await cluster.stop()
+
+
+def parse_args(argv=None):
+    ap = argparse.ArgumentParser(prog="usrbio_bench")
+    ap.add_argument("--nodes", type=int, default=3)
+    ap.add_argument("--replicas", type=int, default=2)
+    ap.add_argument("--chains", type=int, default=2)
+    ap.add_argument("--block-size", type=int, default=4096)
+    ap.add_argument("--file-size", type=int, default=4 << 20)
+    ap.add_argument("--depth", type=int, default=64)
+    ap.add_argument("--seconds", type=float, default=5.0)
+    ap.add_argument("--json", action="store_true")
+    return ap.parse_args(argv)
+
+
+def main(argv=None) -> None:
+    args = parse_args(argv)
+    result = asyncio.run(run_bench(args))
+    if args.json:
+        print(json.dumps(result))
+    else:
+        print(f"randread {result['block_size']} B x depth {result['depth']}: "
+              f"{result['iops']} IOPS, {result['MB_s']} MB/s, "
+              f"errors={result['errors']}")
+
+
+if __name__ == "__main__":
+    main()

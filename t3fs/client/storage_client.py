@@ -29,6 +29,7 @@ from t3fs.storage.types import (
     QueryLastChunkRsp, ReadIO, RemoveChunksReq, TruncateChunkReq, UpdateIO,
     UpdateType, WriteReq,
 )
+from t3fs.utils.fault_injection import DebugFlags
 from t3fs.utils.status import Status, StatusCode, StatusError, make_error
 
 log = logging.getLogger("t3fs.client")
@@ -50,6 +51,9 @@ class StorageClientConfig:
     verify_checksums: bool = False
     read_selection: TargetSelection = TargetSelection.LOAD_BALANCE
     num_channels: int = 64
+    # fault-injection flags carried in every request (reference
+    # StorageClient.h:162-166 driving DebugFlags, Common.h:290-307)
+    debug: DebugFlags = field(default_factory=DebugFlags)
 
 
 class UpdateChannelAllocator:
@@ -135,7 +139,8 @@ class StorageClient:
                 chunk_size=chunk_size,
                 checksum=crc32c_ref(data) if (self.cfg.generate_checksums and data) else 0,
                 channel=channel, channel_seq=seq,
-                client_id=self.client_id, inline=True)
+                client_id=self.client_id, inline=True,
+                debug=self.cfg.debug)
             return await self._write_with_retry(io, data)
         finally:
             await self.channels.release(channel)
@@ -205,7 +210,8 @@ class StorageClient:
                 groups.setdefault(routing.node_address(target.node_id), []).append(i)
 
             async def read_group(address: str, idxs: list[int]):
-                req = BatchReadReq(ios=[ios[i] for i in idxs])
+                req = BatchReadReq(ios=[ios[i] for i in idxs],
+                                   debug=self.cfg.debug)
                 try:
                     rsp, payload = await self.client.call(
                         address, "Storage.batch_read", req,

@@ -1,0 +1,45 @@
+"""Bench harnesses run end to end with tiny budgets.
+
+Reference analogs: benchmarks/storage_bench (StorageBench.cc modes/flags)
+and benchmarks/fio_usrbio (small-IO randread path).
+"""
+
+import asyncio
+
+import pytest
+
+from benchmarks.storage_bench import parse_args as sb_args, run_bench as sb_run
+from benchmarks.usrbio_bench import parse_args as ub_args, run_bench as ub_run
+
+
+def test_storage_bench_write_mode():
+    res = asyncio.run(sb_run(sb_args(
+        ["--mode", "write", "--seconds", "1", "--chunk-size", "65536",
+         "--concurrency", "4", "--num-chunks", "8"])))
+    assert res["ops"] > 0 and res["errors"] == 0
+    assert res["MB_s"] > 0 and res["p99_ms"] > 0
+
+
+def test_storage_bench_read_mode_with_checksum_verify():
+    res = asyncio.run(sb_run(sb_args(
+        ["--mode", "read", "--seconds", "1", "--chunk-size", "65536",
+         "--concurrency", "4", "--num-chunks", "8", "--verify-checksums"])))
+    assert res["ops"] > 0 and res["errors"] == 0
+
+
+def test_storage_bench_survives_fault_injection():
+    """DebugFlags-driven injected server errors are absorbed by retries
+    (reference: storage_bench -injectRandomServerError)."""
+    res = asyncio.run(sb_run(sb_args(
+        ["--mode", "write", "--seconds", "1", "--chunk-size", "65536",
+         "--concurrency", "4", "--num-chunks", "8",
+         "--inject-server-error", "0.05"])))
+    assert res["ops"] > 0 and res["errors"] == 0
+
+
+@pytest.mark.slow
+def test_usrbio_bench_randread():
+    res = asyncio.run(ub_run(ub_args(
+        ["--seconds", "1", "--depth", "16", "--file-size", "1048576"])))
+    assert res["reads"] > 0 and res["errors"] == 0
+    assert res["iops"] > 0
