@@ -367,6 +367,34 @@ async def truncate(ctx: AdminContext, args) -> None:
     print(f"truncated {args.path} to {args.length}")
 
 
+@command("trash-put", "move a path into timestamped trash instead of rm")
+@args_(("path", {}), ("--ttl", {"default": "3d",
+                                "help": "1h|3h|8h|1d|3d|7d"}))
+async def trash_put(ctx: AdminContext, args) -> None:
+    from t3fs.utils.trash import Trash
+    fs = await ctx.fs()
+    dest = await Trash(fs).put(args.path, args.ttl)
+    print(f"{args.path} -> {dest}")
+
+
+@command("trash-ls", "list trash slots and their expiries")
+async def trash_ls(ctx: AdminContext, args) -> None:
+    from t3fs.utils.trash import Trash
+    fs = await ctx.fs()
+    rows = []
+    for slot, expiry, entries in await Trash(fs).list():
+        rows.append([slot, expiry.strftime("%Y-%m-%d %H:%M"), len(entries)])
+    print(_fmt_table(rows, ["slot", "expires", "entries"]))
+
+
+@command("trash-clean", "delete expired trash slots (trash_cleaner)")
+async def trash_clean(ctx: AdminContext, args) -> None:
+    from t3fs.utils.trash import TrashCleaner
+    fs = await ctx.fs()
+    removed = await TrashCleaner(fs).clean_once()
+    print(f"removed {len(removed)}: {removed}")
+
+
 # ---------------- storage ----------------
 
 @command("space-info", "capacity/used/free of a storage node")

@@ -139,6 +139,10 @@ class MgmtdState:
         # targets whose node silently restarted: demote from SERVING so they
         # resync (cleared by the chains updater AFTER a successful save)
         self.restarted_targets: set[int] = set()
+        # node records whose generation changed: persisted by the chains
+        # updater IN THE SAME transaction as the demotions, so an mgmtd
+        # failover can't see the new generation without the demotions
+        self.pending_node_saves: dict[int, NodeInfo] = {}
         self._routing_cache: RoutingInfo | None = None
         # startup grace: a restarted mgmtd has an empty liveness map — treat
         # every node as alive until one full heartbeat window has passed, or
@@ -205,13 +209,20 @@ class MgmtdState:
         await with_transaction(self.kv, txn_fn)
 
     async def save_chains(self, chains: list[ChainInfo],
-                          tables: list[ChainTable] = ()) -> None:
+                          tables: list[ChainTable] = (),
+                          nodes: list[NodeInfo] = ()) -> None:
+        """Persist chains (+tables, +node records) in ONE transaction — the
+        nodes ride along so e.g. a restart-demotion and the node's new
+        generation become durable together."""
         async def txn_fn(txn):
             for c in chains:
                 txn.set(KeyPrefix.CHAIN.key(str(c.chain_id).encode()), serde.dumps(c))
             for t in tables or ():
                 txn.set(KeyPrefix.CHAIN_TABLE.key(str(t.table_id).encode()),
                         serde.dumps(t))
+            for n in nodes or ():
+                txn.set(KeyPrefix.NODE.key(str(n.node_id).encode()),
+                        serde.dumps(n))
             raw = txn.get(KeyPrefix.ROUTING_VER.key())
             txn.set(KeyPrefix.ROUTING_VER.key(), str(int(raw or 1) + 1).encode())
         await with_transaction(self.kv, txn_fn)
@@ -244,6 +255,18 @@ def next_chain_state(chain: ChainInfo,
         1 for t in targets
         if t.public_state == PublicTargetState.SERVING
         and alive.get(t.node_id, False) and t.target_id not in restarted)
+    # if EVERY live serving member restarted (e.g. rack power blip), one of
+    # them must stay as the survivor the others resync from — exempting the
+    # head keeps the chain available; the rest still get demoted so replica
+    # divergence from the restarts is repaired
+    survivor_exempt: int | None = None
+    if healthy_serving == 0:
+        for t in targets:
+            if t.public_state == PublicTargetState.SERVING \
+                    and alive.get(t.node_id, False) \
+                    and t.target_id in restarted:
+                survivor_exempt = t.target_id
+                break
     # a LASTSRV target holds the only authoritative copy: while one exists,
     # a returning stale target must NOT be seated as serving (write loss)
     has_lastsrv = any(t.public_state == PublicTargetState.LASTSRV
@@ -252,7 +275,8 @@ def next_chain_state(chain: ChainInfo,
         a = alive.get(t.node_id, False)
         ls = local.get(t.target_id, LocalTargetState.INVALID)
         if t.public_state == PublicTargetState.SERVING and a \
-                and t.target_id in restarted and healthy_serving >= 1:
+                and t.target_id in restarted \
+                and (healthy_serving >= 1 or t.target_id != survivor_exempt):
             # node restarted within the heartbeat window: its data may be
             # stale/lost while it still looks alive — demote to SYNCING so
             # resync re-validates it (sole survivor keeps serving: its copy,
@@ -324,18 +348,24 @@ class MgmtdService:
         # detection survives an mgmtd restart/failover coinciding with
         # the storage node's restart
         prev_gen = known.generation if known is not None else None
-        if req.node.generation and prev_gen \
-                and prev_gen != req.node.generation:
+        restarted = (req.node.generation and prev_gen
+                     and prev_gen != req.node.generation)
+        if restarted:
             # fast restart (within the heartbeat window): every target
-            # this node serves must fall back to SYNCING and resync
+            # this node serves must fall back to SYNCING and resync.
+            # The new generation is NOT persisted here — the chains
+            # updater saves it atomically with the demotions, so a
+            # primary failover can't observe the generation without them.
             for chain in st.routing().chains.values():
                 for t in chain.targets:
                     if t.node_id == req.node.node_id:
                         st.restarted_targets.add(t.target_id)
+            st.pending_node_saves[req.node.node_id] = req.node
         for tid, ls in req.target_states.items():
             st.local_states[int(tid)] = LocalTargetState(ls)
-        if known is None or known.address != req.node.address \
-                or known.generation != req.node.generation:
+        if not restarted and (known is None
+                              or known.address != req.node.address
+                              or known.generation != req.node.generation):
             await st.save_node(req.node)
             await st.load_routing()
         return HeartbeatRsp(routing_version=st.routing().version), b""
@@ -473,10 +503,14 @@ class MgmtdServer:
                 log.info("chain %d v%d -> v%d: %s", nxt.chain_id,
                          chain.chain_ver, nxt.chain_ver,
                          [(t.target_id, t.public_state.name) for t in nxt.targets])
-        if updated:
-            await st.save_chains(updated)
+        pending_nodes = list(st.pending_node_saves.values())
+        if updated or pending_nodes:
+            # demotions and the new node generations land in ONE txn
+            await st.save_chains(updated, nodes=pending_nodes)
         # only forget restart flags once the demotions are durably saved —
         # dropping them before a failed save would leave a stale node
         # serving forever
         st.restarted_targets -= handled
+        for n in pending_nodes:
+            st.pending_node_saves.pop(n.node_id, None)
         return len(updated)

@@ -1,0 +1,42 @@
+"""Migration service (stub).
+
+Reference analog: src/migration/ — the reference ships a STUB migration
+service binary (migration_main, SURVEY.md §1 L6 "migration (stub)");
+mirrored here so the binary inventory matches: the service registers,
+reports its status, and rejects job submission as unimplemented.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from t3fs.net.server import rpc_method, service
+from t3fs.utils.serde import serde_struct
+from t3fs.utils.status import StatusCode, make_error
+
+
+@serde_struct
+@dataclass
+class MigrationStatusRsp:
+    implemented: bool = False
+    jobs: list[str] = field(default_factory=list)
+
+
+@serde_struct
+@dataclass
+class SubmitMigrationReq:
+    src_chain: int = 0
+    dst_chain: int = 0
+
+
+@service("Migration")
+class MigrationService:
+    @rpc_method
+    async def status(self, req, payload, conn):
+        return MigrationStatusRsp(), b""
+
+    @rpc_method
+    async def submit(self, req: SubmitMigrationReq, payload, conn):
+        raise make_error(StatusCode.NOT_IMPLEMENTED,
+                         "migration jobs are not implemented (stub, as in "
+                         "the reference)")

@@ -318,7 +318,8 @@ class CraqSim:
         tail = self.node_of_target(tail_t)
         succ = self.node_of_target(succ_t)
         remote = {m.chunk_id.encode(): m for m in succ.engine.all_metas()}
-        local = {m.chunk_id.encode(): m for m in tail.engine.all_metas()
+        local_all = {m.chunk_id.encode(): m for m in tail.engine.all_metas()}
+        local = {k: m for k, m in local_all.items()
                  if m.state == ChunkState.COMMIT}
         steps: list[tuple] = []
         for key, lm in local.items():
@@ -330,7 +331,7 @@ class CraqSim:
             steps.append(("replace", tail_t, lm.chunk_id, lm.update_ver,
                           lm.commit_ver, lm.checksum))
         for key, rm in remote.items():
-            if key not in {m.chunk_id.encode() for m in tail.engine.all_metas()}:
+            if key not in local_all:
                 steps.append(("remove", tail_t, rm.chunk_id,
                               rm.update_ver + 1, 0, 0))
         steps.append(("sync_done", tail_t, None, 0, 0, 0))

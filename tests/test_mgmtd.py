@@ -113,3 +113,44 @@ def test_stale_rejoin_waits_for_lastsrv():
                             {101: LocalTargetState.ONLINE})
     states = {t.target_id: t.public_state for t in nxt2.targets}
     assert states[100] == S and states[101] == SY
+
+
+def test_fast_restart_demotes_to_syncing():
+    """A restarted-but-alive SERVING member is demoted so it resyncs
+    (generation-change detection, heartbeat NodeInfo.generation)."""
+    c = chain(S, S, S)
+    nxt = next_chain_state(c, {1: True, 2: True, 3: True},
+                           {101: LocalTargetState.ONLINE},
+                           restarted={101})
+    states = {t.target_id: t.public_state for t in nxt.targets}
+    assert states[101] == SY and states[100] == S and states[102] == S
+    # demoted member moves behind the serving prefix
+    assert [t.target_id for t in nxt.targets] == [100, 102, 101]
+
+
+def test_fast_restart_sole_survivor_keeps_serving():
+    """No healthy survivor -> the restarted member stays serving."""
+    c = chain(S)
+    assert next_chain_state(c, {1: True}, {}, restarted={100}) is None
+
+
+def test_fast_restart_all_members_keeps_one_survivor():
+    """Rack blip: all serving members restarted — exactly one stays as the
+    survivor, the rest demote and resync from it."""
+    c = chain(S, S, S)
+    nxt = next_chain_state(c, {1: True, 2: True, 3: True}, {},
+                           restarted={100, 101, 102})
+    states = [t.public_state for t in nxt.targets]
+    assert states.count(S) == 1 and states.count(SY) == 2
+    assert nxt.targets[0].public_state == S  # head survives
+
+
+def test_fast_restart_not_demoted_onto_dead_survivor():
+    """The only other serving member is dead: the restarted one must keep
+    serving (demoting it would leave no serving copy)."""
+    c = chain(S, S)
+    nxt = next_chain_state(c, {1: True, 2: False}, {}, restarted={100})
+    states = {t.target_id: t.public_state for t in nxt.targets}
+    assert states[100] == S          # stays: sole usable copy
+    # 101 was not the last serving (100 still is), so it goes OFFLINE
+    assert states[101] == OFF

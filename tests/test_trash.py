@@ -1,0 +1,98 @@
+"""Trash UX + expiry cleaner + migration stub.
+
+Reference analogs: hf3fs_utils/trash.py naming convention,
+src/client/trash_cleaner expiry scan, src/migration stub service.
+"""
+
+import asyncio
+from datetime import datetime, timedelta, timezone
+
+import pytest
+
+from t3fs.fuse.vfs import FileSystem
+from t3fs.testing.cluster import LocalCluster
+from t3fs.utils.status import StatusError
+from t3fs.utils.trash import (
+    TRASH_CONFIGS, Trash, TrashCleaner, parse_trash_dir,
+)
+
+
+def test_trash_dir_naming_roundtrip():
+    cfg = TRASH_CONFIGS["1h"]
+    now = datetime(2026, 7, 29, 12, 34, tzinfo=timezone.utc)
+    name = cfg.current_dir(now)
+    parsed = parse_trash_dir(name)
+    assert parsed is not None
+    cfg_name, start, end = parsed
+    assert cfg_name == "1h"
+    assert start <= now
+    assert end - start == cfg.expire + cfg.time_slice
+    # same slice -> same dir (items batch into slices)
+    assert cfg.current_dir(now + timedelta(minutes=1)) == name
+    assert parse_trash_dir("not-a-trash-dir-at-all") is None
+    assert parse_trash_dir("junk") is None
+
+
+def test_trash_put_list_clean_cycle():
+    async def body():
+        cl = LocalCluster(num_nodes=3, replicas=2, with_meta=True)
+        await cl.start()
+        try:
+            fs = FileSystem(cl.mc, cl.sc)
+            trash = Trash(fs)
+            cleaner = TrashCleaner(fs)
+            await fs.mkdirs("/data")
+            await fs.write_file("/data/doc", b"keep me for a while")
+            await fs.write_file("/data/doc2", b"me too")
+
+            dest = await trash.put("/data/doc", "1h")
+            assert dest.startswith("/trash/1h-")
+            # name collision gets a suffix
+            await fs.write_file("/data/doc", b"second body")
+            dest2 = await trash.put("/data/doc", "1h")
+            assert dest2 == dest + ".1"
+
+            with pytest.raises(StatusError):
+                await fs.stat("/data/doc")
+            assert await fs.read_file(dest) == b"keep me for a while"
+
+            slots = await trash.list()
+            assert len(slots) == 1 and len(slots[0][2]) == 2
+
+            # not expired yet
+            assert await cleaner.clean_once() == []
+            # jump past expiry
+            future = datetime.now(timezone.utc) + timedelta(hours=2, minutes=11)
+            removed = await cleaner.clean_once(now=future)
+            assert len(removed) == 1
+            assert await trash.list() == []
+            with pytest.raises(StatusError):
+                await fs.stat(dest)
+
+            with pytest.raises(ValueError):
+                await trash.put("/data/doc2", "99years")
+        finally:
+            await cl.stop()
+    asyncio.run(body())
+
+
+def test_migration_stub_service():
+    from t3fs.migration.service import MigrationService, SubmitMigrationReq
+    from t3fs.net.client import Client
+    from t3fs.net.server import Server
+
+    async def body():
+        srv = Server()
+        srv.add_service(MigrationService())
+        await srv.start()
+        cli = Client()
+        try:
+            rsp, _ = await cli.call(srv.address, "Migration.status", None)
+            assert rsp.implemented is False
+            with pytest.raises(StatusError):
+                await cli.call(srv.address, "Migration.submit",
+                               SubmitMigrationReq(1, 2))
+        finally:
+            await cli.close()
+            await srv.stop()
+    asyncio.run(body())
