@@ -40,10 +40,22 @@ class ECLayout:
     m: int = 2
     chunk_size: int = 1 << 20
     chains: list[int] = field(default_factory=list)   # >= k+m distinct chains
+    # parity format id (RSCode.code_id): persisted with the layout so a
+    # future change of generator coefficients fails LOUDLY at decode time
+    # instead of silently reconstructing garbage from old parity
+    code_id: str = ""
 
     def __post_init__(self):
         assert len(self.chains) >= self.k + self.m, \
             f"EC({self.k}+{self.m}) needs >= {self.k + self.m} chains"
+        if not self.code_id:
+            from t3fs.ops.rs import default_rs
+            self.code_id = default_rs(self.k, self.m).code_id
+
+    def check_code(self, rs) -> None:
+        assert rs.code_id == self.code_id, \
+            f"stripe parity was written with code {self.code_id!r} but this " \
+            f"build decodes with {rs.code_id!r} — refusing to mix formats"
 
     def shard_chain(self, stripe: int, shard: int) -> int:
         """Chain of shard (0..k+m-1) of a stripe; rotates per stripe."""
@@ -102,6 +114,7 @@ class ECStorageClient:
         for j in range(k):
             if lens[j]:
                 arr[j, :lens[j]] = flat[j * cs: j * cs + lens[j]]
+        layout.check_code(default_rs(k, m))
         parity = await self._encode(arr, k, m)
 
         # whole-chunk REPLACE (not splice-write) so a shorter re-write of the
@@ -208,6 +221,7 @@ class ECStorageClient:
                 StatusCode.TARGET_OFFLINE,
                 f"EC stripe {stripe}: only {len(have)} of {k + m} shards "
                 f"available, need {k}")
+        layout.check_code(default_rs(k, m))
         present = tuple(sorted(have.keys())[:k])
         rows = np.stack([have[s] for s in present])
         out = await self._reconstruct(rows, present, tuple(want), k, m)

@@ -26,7 +26,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from t3fs.ops.crc32c import default_matrices
 from t3fs.ops.jax_codec import (
     DEFAULT_SEG_BYTES, unpack_bits, pack_bits_u32, _mod2,
-    make_crc32c_raw, make_rs_encode,
+    make_crc32c_raw, make_rs_encode_matmul,
 )
 from t3fs.ops.rs import default_rs
 
@@ -71,7 +71,11 @@ def make_sharded_encode_step(mesh: Mesh, chunk_len: int, k: int = 8, m: int = 2,
     ]))
     affine = np.uint32(mats.affine_const(chunk_len))
     raw_local = make_crc32c_raw(local_len, seg_bytes)
-    rs_encode = make_rs_encode(default_rs(k, m))
+    # pinned to the matmul encoder: in the FUSED RS+CRC step the matmul
+    # folds into the CRC's HBM passes nearly free, while the word-SWAR
+    # path mixed with the byte-wise CRC measured 3x slower end to end
+    # (same reasoning as jax_codec.make_stripe_encode_step)
+    rs_encode = make_rs_encode_matmul(default_rs(k, m))
 
     def local_step(stripes: jax.Array):
         # stripes: (n_local, k, local_len); byte-concat then unpack inside the
