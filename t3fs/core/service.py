@@ -255,7 +255,7 @@ class CoreService:
         kv = self._need_kv()
 
         async def op(txn):
-            return txn.get(_user_key(req.user.uid))
+            return await txn.get(_user_key(req.user.uid))
         raw = await with_transaction(kv, op)
         if raw is None:
             raise make_error(StatusCode.NOT_FOUND, f"no user {req.user.uid}")
@@ -275,7 +275,7 @@ class CoreService:
 
         async def op(txn):
             lo, hi = _user_range()
-            return txn.get_range(lo, hi)
+            return await txn.get_range(lo, hi)
         rows = await with_transaction(kv, op)
         return UserRsp([serde.loads(v) for _, v in rows]), b""
 

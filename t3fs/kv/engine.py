@@ -26,9 +26,10 @@ from t3fs.utils.status import StatusCode, StatusError, make_error
 class Transaction:
     """One transaction against a MemKVEngine."""
 
-    def __init__(self, engine: "MemKVEngine"):
+    def __init__(self, engine: "MemKVEngine", read_version: int | None = None):
         self.engine = engine
-        self.read_version = engine._version
+        self.read_version = (engine._version if read_version is None
+                             else read_version)
         self._writes: dict[bytes, bytes | None] = {}   # None = clear
         self._range_clears: list[tuple[bytes, bytes]] = []
         self._read_keys: set[bytes] = set()
@@ -37,7 +38,7 @@ class Transaction:
 
     # --- reads ---
 
-    def get(self, key: bytes, *, snapshot: bool = False) -> bytes | None:
+    async def get(self, key: bytes, *, snapshot: bool = False) -> bytes | None:
         if key in self._writes:
             return self._writes[key]
         if not snapshot:
@@ -46,11 +47,11 @@ class Transaction:
             return None  # read-your-writes across clear_range
         return self.engine._get_at(key, self.read_version)
 
-    def snapshot_get(self, key: bytes) -> bytes | None:
-        return self.get(key, snapshot=True)
+    async def snapshot_get(self, key: bytes) -> bytes | None:
+        return await self.get(key, snapshot=True)
 
-    def get_range(self, begin: bytes, end: bytes, *, limit: int = 0,
-                  snapshot: bool = False) -> list[tuple[bytes, bytes]]:
+    async def get_range(self, begin: bytes, end: bytes, *, limit: int = 0,
+                        snapshot: bool = False) -> list[tuple[bytes, bytes]]:
         """Keys in [begin, end), sorted; limit 0 = unlimited."""
         if not snapshot:
             self._read_ranges.append((begin, end))
@@ -89,7 +90,7 @@ class Transaction:
 
     # --- commit ---
 
-    def commit(self) -> None:
+    async def commit(self) -> None:
         assert not self._committed, "transaction reused after commit"
         self.engine._commit(self)
         self._committed = True
@@ -198,7 +199,7 @@ async def with_transaction(engine: KVEngine,
         txn = engine.transaction()
         try:
             result = await fn(txn)
-            txn.commit()
+            await txn.commit()
             return result
         except StatusError as e:
             if e.code not in (StatusCode.TXN_CONFLICT, StatusCode.TXN_RETRYABLE,

@@ -41,7 +41,7 @@ class InodeIdAllocator:
         async with self._lock:
             if self._next >= self._limit:
                 async def bump(txn: Transaction):
-                    raw = txn.get(KeyPrefix.ALLOCATOR.key(b"inode"))
+                    raw = await txn.get(KeyPrefix.ALLOCATOR.key(b"inode"))
                     cur = int(raw) if raw else ROOT_INODE_ID + 1
                     txn.set(KeyPrefix.ALLOCATOR.key(b"inode"),
                             str(cur + ID_BATCH).encode())
@@ -83,37 +83,40 @@ class MetaStore:
         self.kv = kv
         self.chains = chain_allocator
         self.ids = InodeIdAllocator(kv)
-        self._ensure_root()
+        self._root_ready = False
 
-    def _ensure_root(self) -> None:
+    async def _ensure_root(self) -> None:
+        if self._root_ready:
+            return
+        self._root_ready = True
         txn = self.kv.transaction()
-        if txn.get(Inode.key(ROOT_INODE_ID), snapshot=True) is None:
+        if await txn.get(Inode.key(ROOT_INODE_ID), snapshot=True) is None:
             root = Inode(inode_id=ROOT_INODE_ID, itype=InodeType.DIRECTORY,
                          perm=0o755, nlink=2).touch()
             txn.set(Inode.key(ROOT_INODE_ID), serde.dumps(root))
-            txn.commit()
+            await txn.commit()
 
     # --- txn helpers ---
 
     @staticmethod
-    def _get_inode(txn: Transaction, inode_id: int) -> Inode | None:
-        raw = txn.get(Inode.key(inode_id))
+    async def _get_inode(txn: Transaction, inode_id: int) -> Inode | None:
+        raw = await txn.get(Inode.key(inode_id))
         return serde.loads(raw) if raw else None
 
     @staticmethod
-    def _require_inode(txn: Transaction, inode_id: int) -> Inode:
-        inode = MetaStore._get_inode(txn, inode_id)
+    async def _require_inode(txn: Transaction, inode_id: int) -> Inode:
+        inode = await MetaStore._get_inode(txn, inode_id)
         if inode is None:
             raise make_error(StatusCode.META_NOT_FOUND, f"inode {inode_id}")
         return inode
 
     @staticmethod
-    def _get_dent(txn: Transaction, parent: int, name: str) -> DirEntry | None:
-        raw = txn.get(DirEntry.key(parent, name))
+    async def _get_dent(txn: Transaction, parent: int, name: str) -> DirEntry | None:
+        raw = await txn.get(DirEntry.key(parent, name))
         return serde.loads(raw) if raw else None
 
-    def resolve(self, txn: Transaction, path: str,
-                follow_last: bool = True) -> tuple[int, str, DirEntry | None]:
+    async def resolve(self, txn: Transaction, path: str,
+                      follow_last: bool = True) -> tuple[int, str, DirEntry | None]:
         """Path -> (parent_inode_id, last_name, existing dent-or-None).
         Iterative with symlink expansion limits (PathResolve.h:28-113)."""
         depth = 0
@@ -123,7 +126,7 @@ class MetaStore:
         while i < len(parts):
             name = parts[i]
             last = i == len(parts) - 1
-            dent = self._get_dent(txn, parent, name)
+            dent = await self._get_dent(txn, parent, name)
             if last and (dent is None or not follow_last
                          or dent.itype != InodeType.SYMLINK):
                 return parent, name, dent
@@ -134,7 +137,7 @@ class MetaStore:
                 depth += 1
                 if depth > MAX_SYMLINK_DEPTH:
                     raise make_error(StatusCode.META_TOO_MANY_SYMLINKS, path)
-                inode = self._require_inode(txn, dent.inode_id)
+                inode = await self._require_inode(txn, dent.inode_id)
                 target_parts = [p for p in inode.symlink_target.split("/") if p]
                 if inode.symlink_target.startswith("/"):
                     parent = ROOT_INODE_ID
@@ -153,16 +156,16 @@ class MetaStore:
     async def stat(self, path: str, follow: bool = True) -> Inode:
         async def fn(txn: Transaction):
             if path.strip("/") == "":
-                return self._require_inode(txn, ROOT_INODE_ID)
-            parent, name, dent = self.resolve(txn, path, follow_last=follow)
+                return await self._require_inode(txn, ROOT_INODE_ID)
+            parent, name, dent = await self.resolve(txn, path, follow_last=follow)
             if dent is None:
                 raise make_error(StatusCode.META_NOT_FOUND, path)
-            return self._require_inode(txn, dent.inode_id)
+            return await self._require_inode(txn, dent.inode_id)
         return await with_transaction(self.kv, fn)
 
     async def stat_inode(self, inode_id: int) -> Inode:
         async def fn(txn: Transaction):
-            return self._require_inode(txn, inode_id)
+            return await self._require_inode(txn, inode_id)
         return await with_transaction(self.kv, fn)
 
     async def mkdirs(self, path: str, perm: int = 0o755,
@@ -174,7 +177,7 @@ class MetaStore:
             parent = ROOT_INODE_ID
             created: Inode | None = None
             for i, name in enumerate(parts):
-                dent = self._get_dent(txn, parent, name)
+                dent = await self._get_dent(txn, parent, name)
                 last = i == len(parts) - 1
                 if dent is not None:
                     if last:
@@ -202,12 +205,12 @@ class MetaStore:
         layout = self.chains.allocate_layout(chunk_size, stripe)
 
         async def fn(txn: Transaction):
-            parent, name, dent = self.resolve(txn, path)
+            parent, name, dent = await self.resolve(txn, path)
             if dent is not None:
                 raise make_error(StatusCode.META_EXISTS, path)
             if not name:
                 raise make_error(StatusCode.META_INVALID_PATH, path)
-            self._require_inode(txn, parent)
+            await self._require_inode(txn, parent)
             inode_id = await self.ids.allocate()
             inode = Inode(inode_id=inode_id, itype=InodeType.FILE, perm=perm,
                           layout=layout).touch()
@@ -226,10 +229,10 @@ class MetaStore:
     async def open_file(self, path: str, write: bool = False,
                         session_client: str = "") -> tuple[Inode, str]:
         async def fn(txn: Transaction):
-            parent, name, dent = self.resolve(txn, path)
+            parent, name, dent = await self.resolve(txn, path)
             if dent is None:
                 raise make_error(StatusCode.META_NOT_FOUND, path)
-            inode = self._require_inode(txn, dent.inode_id)
+            inode = await self._require_inode(txn, dent.inode_id)
             if inode.itype == InodeType.DIRECTORY and write:
                 raise make_error(StatusCode.META_IS_DIR, path)
             session_id = ""
@@ -246,7 +249,7 @@ class MetaStore:
         """Close/sync: settle length (caller computes via storage
         query_last_chunk — FileOperation analog) and drop the session."""
         async def fn(txn: Transaction):
-            inode = self._require_inode(txn, inode_id)
+            inode = await self._require_inode(txn, inode_id)
             if length is not None and inode.itype == InodeType.FILE:
                 inode.length = length
                 inode.touch()
@@ -260,7 +263,7 @@ class MetaStore:
         """Max-write-position hint, reported every few seconds by writers
         (docs/design_notes.md:91-95)."""
         async def fn(txn: Transaction):
-            inode = self._require_inode(txn, inode_id)
+            inode = await self._require_inode(txn, inode_id)
             if position > inode.length_hint:
                 inode.length_hint = position
                 if position > inode.length:
@@ -273,20 +276,20 @@ class MetaStore:
             if path.strip("/") == "":
                 dir_id = ROOT_INODE_ID
             else:
-                parent, name, dent = self.resolve(txn, path)
+                parent, name, dent = await self.resolve(txn, path)
                 if dent is None:
                     raise make_error(StatusCode.META_NOT_FOUND, path)
                 if dent.itype != InodeType.DIRECTORY:
                     raise make_error(StatusCode.META_NOT_DIR, path)
                 dir_id = dent.inode_id
             pre = DirEntry.prefix(dir_id)
-            rows = txn.get_range(pre, pre + b"\xff", limit=limit)
+            rows = await txn.get_range(pre, pre + b"\xff", limit=limit)
             return [serde.loads(v) for _, v in rows]
         return await with_transaction(self.kv, fn)
 
     async def symlink(self, path: str, target: str) -> Inode:
         async def fn(txn: Transaction):
-            parent, name, dent = self.resolve(txn, path, follow_last=False)
+            parent, name, dent = await self.resolve(txn, path, follow_last=False)
             if dent is not None:
                 raise make_error(StatusCode.META_EXISTS, path)
             inode_id = await self.ids.allocate()
@@ -300,15 +303,15 @@ class MetaStore:
 
     async def hardlink(self, existing: str, new_path: str) -> Inode:
         async def fn(txn: Transaction):
-            _, _, src = self.resolve(txn, existing)
+            _, _, src = await self.resolve(txn, existing)
             if src is None:
                 raise make_error(StatusCode.META_NOT_FOUND, existing)
             if src.itype == InodeType.DIRECTORY:
                 raise make_error(StatusCode.META_IS_DIR, existing)
-            parent, name, dent = self.resolve(txn, new_path, follow_last=False)
+            parent, name, dent = await self.resolve(txn, new_path, follow_last=False)
             if dent is not None:
                 raise make_error(StatusCode.META_EXISTS, new_path)
-            inode = self._require_inode(txn, src.inode_id)
+            inode = await self._require_inode(txn, src.inode_id)
             inode.nlink += 1
             inode.touch()
             txn.set(Inode.key(inode.inode_id), serde.dumps(inode))
@@ -319,14 +322,14 @@ class MetaStore:
 
     async def rename(self, src: str, dst: str) -> None:
         async def fn(txn: Transaction):
-            sparent, sname, sdent = self.resolve(txn, src, follow_last=False)
+            sparent, sname, sdent = await self.resolve(txn, src, follow_last=False)
             if sdent is None:
                 raise make_error(StatusCode.META_NOT_FOUND, src)
-            dparent, dname, ddent = self.resolve(txn, dst, follow_last=False)
+            dparent, dname, ddent = await self.resolve(txn, dst, follow_last=False)
             if ddent is not None:
                 if ddent.itype == InodeType.DIRECTORY:
                     pre = DirEntry.prefix(ddent.inode_id)
-                    if txn.get_range(pre, pre + b"\xff", limit=1):
+                    if await txn.get_range(pre, pre + b"\xff", limit=1):
                         raise make_error(StatusCode.META_NOT_EMPTY, dst)
                 # overwrite: unlink destination
                 await self._unlink_entry(txn, ddent)
@@ -334,13 +337,13 @@ class MetaStore:
             txn.set(DirEntry.key(dparent, dname), serde.dumps(
                 DirEntry(dparent, dname, sdent.inode_id, sdent.itype)))
             if sdent.itype == InodeType.DIRECTORY:
-                inode = self._require_inode(txn, sdent.inode_id)
+                inode = await self._require_inode(txn, sdent.inode_id)
                 inode.parent = dparent
                 txn.set(Inode.key(inode.inode_id), serde.dumps(inode))
         return await with_transaction(self.kv, fn)
 
     async def _unlink_entry(self, txn: Transaction, dent: DirEntry) -> None:
-        inode = self._get_inode(txn, dent.inode_id)
+        inode = await self._get_inode(txn, dent.inode_id)
         if inode is None:
             return
         inode.nlink -= 1
@@ -357,12 +360,12 @@ class MetaStore:
 
     async def remove(self, path: str, recursive: bool = False) -> None:
         async def fn(txn: Transaction):
-            parent, name, dent = self.resolve(txn, path, follow_last=False)
+            parent, name, dent = await self.resolve(txn, path, follow_last=False)
             if dent is None:
                 raise make_error(StatusCode.META_NOT_FOUND, path)
             if dent.itype == InodeType.DIRECTORY:
                 pre = DirEntry.prefix(dent.inode_id)
-                children = txn.get_range(pre, pre + b"\xff")
+                children = await txn.get_range(pre, pre + b"\xff")
                 if children and not recursive:
                     raise make_error(StatusCode.META_NOT_EMPTY, path)
                 for _, raw in children:
@@ -378,7 +381,7 @@ class MetaStore:
     async def _remove_tree(self, txn: Transaction, dent: DirEntry) -> None:
         if dent.itype == InodeType.DIRECTORY:
             pre = DirEntry.prefix(dent.inode_id)
-            for _, raw in txn.get_range(pre, pre + b"\xff"):
+            for _, raw in await txn.get_range(pre, pre + b"\xff"):
                 child: DirEntry = serde.loads(raw)
                 await self._remove_tree(txn, child)
                 txn.clear(DirEntry.key(child.parent, child.name))
@@ -387,10 +390,10 @@ class MetaStore:
     async def set_attr(self, path: str, *, perm: int | None = None,
                        uid: int | None = None, gid: int | None = None) -> Inode:
         async def fn(txn: Transaction):
-            parent, name, dent = self.resolve(txn, path)
+            parent, name, dent = await self.resolve(txn, path)
             if dent is None:
                 raise make_error(StatusCode.META_NOT_FOUND, path)
-            inode = self._require_inode(txn, dent.inode_id)
+            inode = await self._require_inode(txn, dent.inode_id)
             if perm is not None:
                 inode.perm = perm
             if uid is not None:
@@ -404,7 +407,7 @@ class MetaStore:
 
     async def set_length(self, inode_id: int, length: int) -> Inode:
         async def fn(txn: Transaction):
-            inode = self._require_inode(txn, inode_id)
+            inode = await self._require_inode(txn, inode_id)
             inode.length = length
             inode.length_hint = min(inode.length_hint, length)
             inode.touch()
@@ -421,11 +424,11 @@ class MetaStore:
             for _ in range(256):
                 if cur == ROOT_INODE_ID:
                     return "/" + "/".join(reversed(segments))
-                inode = self._require_inode(txn, cur)
+                inode = await self._require_inode(txn, cur)
                 parent = inode.parent
                 pre = DirEntry.prefix(parent)
                 found = None
-                for _, raw in txn.get_range(pre, pre + b"\xff"):
+                for _, raw in await txn.get_range(pre, pre + b"\xff"):
                     d: DirEntry = serde.loads(raw)
                     if d.inode_id == cur:
                         found = d
@@ -444,7 +447,7 @@ class MetaStore:
         txn = self.kv.transaction()
         pre = FileSession.prefix(inode_id)
         return [serde.loads(v) for _, v in
-                txn.get_range(pre, pre + b"\xff", snapshot=True)]
+                await txn.get_range(pre, pre + b"\xff", snapshot=True)]
 
     async def prune_sessions(self, ttl_s: float) -> int:
         """Drop write sessions older than ttl (SessionManager.h:44-83 analog:
@@ -455,7 +458,7 @@ class MetaStore:
         async def fn(txn: Transaction):
             pre = KeyPrefix.INODE_SESSION.value
             dropped = 0
-            for k, v in txn.get_range(pre, pre + b"\xff", snapshot=True):
+            for k, v in await txn.get_range(pre, pre + b"\xff", snapshot=True):
                 sess: FileSession = serde.loads(v)
                 if sess.created_at < cutoff:
                     txn.clear(k)
@@ -466,13 +469,13 @@ class MetaStore:
     async def gc_pop(self, limit: int = 16) -> list[Inode]:
         """Dequeue inodes whose chunks need reclamation."""
         async def fn(txn: Transaction):
-            rows = txn.get_range(GC_PREFIX, GC_PREFIX + b"\xff", limit=limit)
+            rows = await txn.get_range(GC_PREFIX, GC_PREFIX + b"\xff", limit=limit)
             out = []
             for k, v in rows:
                 inode: Inode = serde.loads(v)
                 # skip (keep queued) while write sessions remain
                 spre = FileSession.prefix(inode.inode_id)
-                if txn.get_range(spre, spre + b"\xff", limit=1):
+                if await txn.get_range(spre, spre + b"\xff", limit=1):
                     continue
                 txn.clear(k)
                 out.append(inode)

@@ -155,7 +155,7 @@ class MgmtdState:
         now = time.time()
 
         async def txn_fn(txn):
-            raw = txn.get(KeyPrefix.LEASE.key())
+            raw = await txn.get(KeyPrefix.LEASE.key())
             lease = serde.loads(raw) if raw else LeaseInfo()
             if lease.holder_node not in (0, self.node_id) and lease.expires_at > now:
                 return False
@@ -165,9 +165,9 @@ class MgmtdState:
 
         return await with_transaction(self.kv, txn_fn)
 
-    def is_primary(self) -> bool:
+    async def is_primary(self) -> bool:
         txn = self.kv.transaction()
-        raw = txn.get(KeyPrefix.LEASE.key(), snapshot=True)
+        raw = await txn.get(KeyPrefix.LEASE.key(), snapshot=True)
         if not raw:
             return False
         lease = serde.loads(raw)
@@ -175,7 +175,7 @@ class MgmtdState:
 
     async def lease_info(self) -> LeaseInfo:
         txn = self.kv.transaction()
-        raw = txn.get(KeyPrefix.LEASE.key(), snapshot=True)
+        raw = await txn.get(KeyPrefix.LEASE.key(), snapshot=True)
         return serde.loads(raw) if raw else LeaseInfo()
 
     # --- persistent records ---
@@ -183,17 +183,17 @@ class MgmtdState:
     async def load_routing(self) -> RoutingInfo:
         txn = self.kv.transaction()
         info = RoutingInfo()
-        raw = txn.get(KeyPrefix.ROUTING_VER.key(), snapshot=True)
+        raw = await txn.get(KeyPrefix.ROUTING_VER.key(), snapshot=True)
         info.version = int(raw) if raw else 1
-        for k, v in txn.get_range(KeyPrefix.NODE.value, KeyPrefix.NODE.value + b"\xff",
+        for k, v in await txn.get_range(KeyPrefix.NODE.value, KeyPrefix.NODE.value + b"\xff",
                                   snapshot=True):
             n: NodeInfo = serde.loads(v)
             info.nodes[n.node_id] = n
-        for k, v in txn.get_range(KeyPrefix.CHAIN.value, KeyPrefix.CHAIN.value + b"\xff",
+        for k, v in await txn.get_range(KeyPrefix.CHAIN.value, KeyPrefix.CHAIN.value + b"\xff",
                                   snapshot=True):
             c: ChainInfo = serde.loads(v)
             info.chains[c.chain_id] = c
-        for k, v in txn.get_range(KeyPrefix.CHAIN_TABLE.value,
+        for k, v in await txn.get_range(KeyPrefix.CHAIN_TABLE.value,
                                   KeyPrefix.CHAIN_TABLE.value + b"\xff", snapshot=True):
             t: ChainTable = serde.loads(v)
             info.chain_tables[t.table_id] = t
@@ -223,7 +223,7 @@ class MgmtdState:
             for n in nodes or ():
                 txn.set(KeyPrefix.NODE.key(str(n.node_id).encode()),
                         serde.dumps(n))
-            raw = txn.get(KeyPrefix.ROUTING_VER.key())
+            raw = await txn.get(KeyPrefix.ROUTING_VER.key())
             txn.set(KeyPrefix.ROUTING_VER.key(), str(int(raw or 1) + 1).encode())
         await with_transaction(self.kv, txn_fn)
         await self.load_routing()
@@ -333,14 +333,14 @@ class MgmtdService:
     def __init__(self, state: MgmtdState):
         self.state = state
 
-    def _require_primary(self):
-        if not self.state.is_primary():
+    async def _require_primary(self):
+        if not await self.state.is_primary():
             raise make_error(StatusCode.MGMTD_NOT_PRIMARY,
                              f"mgmtd {self.state.node_id} lost the lease")
 
     @rpc_method
     async def heartbeat(self, req: HeartbeatReq, payload, conn):
-        self._require_primary()
+        await self._require_primary()
         st = self.state
         known = st.routing().nodes.get(req.node.node_id)
         st.last_heartbeat[req.node.node_id] = time.time()
@@ -380,7 +380,7 @@ class MgmtdService:
     @rpc_method
     async def set_chains(self, req: SetChainsReq, payload, conn):
         """Admin op: install chains/chain tables (UploadChainTable analog)."""
-        self._require_primary()
+        await self._require_primary()
         await self.state.save_chains(req.chains, req.tables)
         return OkRsp(), b""
 
@@ -408,7 +408,7 @@ class MgmtdService:
         """Store a per-node-type config template in the KV — the config-
         distribution half of the two-phase bootstrap (reference:
         TwoPhaseApplication.h:42-46, core/app/MgmtdClientFetcher.h)."""
-        self._require_primary()
+        await self._require_primary()
 
         async def op(txn):
             txn.set(KeyPrefix.CONFIG.key(req.node_type.encode()),
@@ -419,7 +419,7 @@ class MgmtdService:
     @rpc_method
     async def get_config_template(self, req: GetConfigTemplateReq, payload, conn):
         async def op(txn):
-            return txn.get(KeyPrefix.CONFIG.key(req.node_type.encode()))
+            return await txn.get(KeyPrefix.CONFIG.key(req.node_type.encode()))
         raw = await with_transaction(self.state.kv, op)
         return GetConfigTemplateRsp(
             toml=raw.decode() if raw is not None else "",
@@ -480,7 +480,7 @@ class MgmtdServer:
         while not self._stopped.is_set():
             await asyncio.sleep(self.cfg.chains_update_period_s)
             try:
-                if not self.state.is_primary():
+                if not await self.state.is_primary():
                     continue
                 await self.update_chains_once()
             except Exception:

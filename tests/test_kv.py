@@ -1,5 +1,9 @@
 """KV layer: snapshot isolation, conflict detection, ranges, retry driver
-(reference analogs: tests/common/kv/, tests/fdb/)."""
+(reference analogs: tests/common/kv/, tests/fdb/).
+
+The transaction API is coroutine-based (reference ITransaction is CoTryTask)
+so the same seam serves in-memory, WAL, and remote engines.
+"""
 
 import asyncio
 
@@ -9,112 +13,127 @@ from t3fs.kv import MemKVEngine, with_transaction
 from t3fs.utils.status import StatusCode, StatusError
 
 
+def run(coro):
+    return asyncio.run(coro)
+
+
 def test_basic_set_get():
-    kv = MemKVEngine()
-    t = kv.transaction()
-    assert t.get(b"a") is None
-    t.set(b"a", b"1")
-    assert t.get(b"a") == b"1"  # read-your-writes
-    t.commit()
-    t2 = kv.transaction()
-    assert t2.get(b"a") == b"1"
+    async def body():
+        kv = MemKVEngine()
+        t = kv.transaction()
+        assert await t.get(b"a") is None
+        t.set(b"a", b"1")
+        assert await t.get(b"a") == b"1"  # read-your-writes
+        await t.commit()
+        t2 = kv.transaction()
+        assert await t2.get(b"a") == b"1"
+    run(body())
 
 
 def test_snapshot_isolation():
-    kv = MemKVEngine()
-    t0 = kv.transaction()
-    t0.set(b"k", b"v0")
-    t0.commit()
+    async def body():
+        kv = MemKVEngine()
+        t0 = kv.transaction()
+        t0.set(b"k", b"v0")
+        await t0.commit()
 
-    t1 = kv.transaction()          # snapshot before t2's write
-    t2 = kv.transaction()
-    t2.set(b"k", b"v2")
-    t2.commit()
-    assert t1.get(b"k", snapshot=True) == b"v0"   # still sees snapshot
+        t1 = kv.transaction()          # snapshot before t2's write
+        t2 = kv.transaction()
+        t2.set(b"k", b"v2")
+        await t2.commit()
+        assert await t1.get(b"k", snapshot=True) == b"v0"
+    run(body())
 
 
 def test_write_conflict():
-    kv = MemKVEngine()
-    kv_t = kv.transaction()
-    kv_t.set(b"k", b"v0")
-    kv_t.commit()
+    async def body():
+        kv = MemKVEngine()
+        kv_t = kv.transaction()
+        kv_t.set(b"k", b"v0")
+        await kv_t.commit()
 
-    t1 = kv.transaction()
-    _ = t1.get(b"k")               # tracked read
-    t2 = kv.transaction()
-    t2.set(b"k", b"v2")
-    t2.commit()
-    t1.set(b"other", b"x")
-    with pytest.raises(StatusError) as ei:
-        t1.commit()
-    assert ei.value.code == StatusCode.TXN_CONFLICT
+        t1 = kv.transaction()
+        _ = await t1.get(b"k")         # tracked read
+        t2 = kv.transaction()
+        t2.set(b"k", b"v2")
+        await t2.commit()
+        t1.set(b"other", b"x")
+        with pytest.raises(StatusError) as ei:
+            await t1.commit()
+        assert ei.value.code == StatusCode.TXN_CONFLICT
+    run(body())
 
 
 def test_snapshot_read_no_conflict():
-    kv = MemKVEngine()
-    t1 = kv.transaction()
-    _ = t1.get(b"k", snapshot=True)
-    t2 = kv.transaction()
-    t2.set(b"k", b"v2")
-    t2.commit()
-    t1.set(b"other", b"x")
-    t1.commit()  # no conflict: snapshot read untracked
+    async def body():
+        kv = MemKVEngine()
+        t1 = kv.transaction()
+        _ = await t1.get(b"k", snapshot=True)
+        t2 = kv.transaction()
+        t2.set(b"k", b"v2")
+        await t2.commit()
+        t1.set(b"other", b"x")
+        await t1.commit()  # no conflict: snapshot read untracked
+    run(body())
 
 
 def test_range_scan_and_conflict():
-    kv = MemKVEngine()
-    t = kv.transaction()
-    for i in range(5):
-        t.set(f"p{i}".encode(), str(i).encode())
-    t.set(b"q0", b"other")
-    t.commit()
+    async def body():
+        kv = MemKVEngine()
+        t = kv.transaction()
+        for i in range(5):
+            t.set(f"p{i}".encode(), str(i).encode())
+        t.set(b"q0", b"other")
+        await t.commit()
 
-    t1 = kv.transaction()
-    rows = t1.get_range(b"p", b"q")
-    assert [k for k, _ in rows] == [f"p{i}".encode() for i in range(5)]
-    assert t1.get_range(b"p", b"q", limit=2) == rows[:2]
+        t1 = kv.transaction()
+        rows = await t1.get_range(b"p", b"q")
+        assert [k for k, _ in rows] == [f"p{i}".encode() for i in range(5)]
+        assert await t1.get_range(b"p", b"q", limit=2) == rows[:2]
 
-    # phantom: insert into the scanned range from another txn
-    t2 = kv.transaction()
-    t2.set(b"p9", b"new")
-    t2.commit()
-    t1.set(b"x", b"y")
-    with pytest.raises(StatusError):
-        t1.commit()
+        # phantom: insert into the scanned range from another txn
+        t2 = kv.transaction()
+        t2.set(b"p9", b"new")
+        await t2.commit()
+        t1.set(b"x", b"y")
+        with pytest.raises(StatusError):
+            await t1.commit()
+    run(body())
 
 
 def test_clear_and_clear_range():
-    kv = MemKVEngine()
-    t = kv.transaction()
-    for i in range(5):
-        t.set(f"p{i}".encode(), b"v")
-    t.commit()
-    t = kv.transaction()
-    t.clear(b"p0")
-    t.clear_range(b"p2", b"p4")
-    assert [k for k, _ in t.get_range(b"p", b"q")] == [b"p1", b"p4"]
-    t.commit()
-    t = kv.transaction()
-    assert [k for k, _ in t.get_range(b"p", b"q")] == [b"p1", b"p4"]
+    async def body():
+        kv = MemKVEngine()
+        t = kv.transaction()
+        for i in range(5):
+            t.set(f"p{i}".encode(), b"v")
+        await t.commit()
+        t = kv.transaction()
+        t.clear(b"p0")
+        t.clear_range(b"p2", b"p4")
+        assert [k for k, _ in await t.get_range(b"p", b"q")] == [b"p1", b"p4"]
+        await t.commit()
+        t = kv.transaction()
+        assert [k for k, _ in await t.get_range(b"p", b"q")] == [b"p1", b"p4"]
+    run(body())
 
 
 def test_retry_driver():
-    kv = MemKVEngine()
-    t = kv.transaction()
-    t.set(b"counter", b"0")
-    t.commit()
+    async def body():
+        kv = MemKVEngine()
+        t = kv.transaction()
+        t.set(b"counter", b"0")
+        await t.commit()
 
-    async def incr(txn):
-        v = int(txn.get(b"counter"))
-        await asyncio.sleep(0)
-        txn.set(b"counter", str(v + 1).encode())
-        return v + 1
+        async def incr(txn):
+            v = int(await txn.get(b"counter"))
+            await asyncio.sleep(0)
+            txn.set(b"counter", str(v + 1).encode())
+            return v + 1
 
-    async def run():
-        # 20 concurrent increments; conflicts must all retry to serializable result
+        # 20 concurrent increments; conflicts must all retry to serial result
         await asyncio.gather(*[with_transaction(kv, incr, max_retries=50)
                                for _ in range(20)])
         t = kv.transaction()
-        return int(t.get(b"counter"))
-
-    assert asyncio.run(run()) == 20
+        assert int(await t.get(b"counter")) == 20
+    run(body())

@@ -14,13 +14,15 @@ from t3fs.utils.status import StatusError
 
 
 def put(engine, k: bytes, v: bytes):
-    txn = engine.transaction()
-    txn.set(k, v)
-    txn.commit()
+    async def body():
+        txn = engine.transaction()
+        txn.set(k, v)
+        await txn.commit()
+    asyncio.run(body())
 
 
 def get(engine, k: bytes):
-    return engine.transaction().get(k)
+    return asyncio.run(engine.transaction().get(k))
 
 
 def test_basic_persistence_across_reopen():
@@ -30,7 +32,7 @@ def test_basic_persistence_across_reopen():
         put(kv, b"b", b"2")
         txn = kv.transaction()
         txn.clear(b"a")
-        txn.commit()
+        asyncio.run(txn.commit())
         kv.close()
 
         kv2 = WalKVEngine(d, sync="os")
@@ -46,10 +48,10 @@ def test_range_clear_persists():
             put(kv, b"k%02d" % i, b"v%d" % i)
         txn = kv.transaction()
         txn.clear_range(b"k03", b"k07")
-        txn.commit()
+        asyncio.run(txn.commit())
         kv.close()
         kv2 = WalKVEngine(d, sync="os")
-        rows = kv2.transaction().get_range(b"k00", b"k99")
+        rows = asyncio.run(kv2.transaction().get_range(b"k00", b"k99"))
         assert [k for k, _ in rows] == [b"k00", b"k01", b"k02",
                                         b"k07", b"k08", b"k09"]
         kv2.close()
@@ -84,7 +86,7 @@ def test_compaction_snapshot_and_wal_reset():
         put(kv, b"del", b"x")
         txn = kv.transaction()
         txn.clear(b"del")
-        txn.commit()
+        asyncio.run(txn.commit())
         kv.compact()
         wal_after = os.path.getsize(os.path.join(d, "kv.wal"))
         assert wal_after == 8  # magic only
@@ -106,7 +108,7 @@ def test_auto_compact_on_threshold():
         assert os.path.getsize(os.path.join(d, "kv.wal")) < 4096 + 4096
         kv.close()
         kv2 = WalKVEngine(d, sync="os")
-        assert sum(1 for _ in kv2.transaction().get_range(b"k", b"l")) == 100
+        assert len(asyncio.run(kv2.transaction().get_range(b"k", b"l"))) == 100
         kv2.close()
 
 
@@ -114,14 +116,16 @@ def test_ssi_conflict_not_logged():
     """An aborted transaction must leave no WAL trace."""
     with tempfile.TemporaryDirectory() as d:
         kv = WalKVEngine(d, sync="os")
-        t1 = kv.transaction()
-        t1.get(b"x")
-        t2 = kv.transaction()
-        t2.set(b"x", b"2")
-        t2.commit()
-        t1.set(b"x", b"1")
-        with pytest.raises(StatusError):
-            t1.commit()
+        async def body():
+            t1 = kv.transaction()
+            await t1.get(b"x")
+            t2 = kv.transaction()
+            t2.set(b"x", b"2")
+            await t2.commit()
+            t1.set(b"x", b"1")
+            with pytest.raises(StatusError):
+                await t1.commit()
+        asyncio.run(body())
         kv.close()
         kv2 = WalKVEngine(d, sync="os")
         assert get(kv2, b"x") == b"2"
