@@ -57,11 +57,17 @@ async def run_bench(args) -> dict:
         loop = asyncio.get_running_loop()
         inflight = 0
         userdata = 0
+        # explicit free-list of iov slots: deriving the slot from
+        # userdata % depth can hand a still-in-flight IO's slot to a new IO
+        # after out-of-order completions (torn reads)
+        free_slots = list(range(args.depth))
+        slot_of: dict[int, int] = {}
         while time.perf_counter() < stop_at or inflight:
             # top up the queue depth
-            while inflight < args.depth and time.perf_counter() < stop_at:
+            while free_slots and time.perf_counter() < stop_at:
                 block = rng.randrange(file_blocks)
-                slot = userdata % args.depth
+                slot = free_slots.pop()
+                slot_of[userdata] = slot
                 ring.prep_io(True, ident, slot * args.block_size,
                              args.block_size, block * args.block_size,
                              userdata=userdata)
@@ -76,6 +82,7 @@ async def run_bench(args) -> dict:
             for c in done:
                 inflight -= 1
                 completed += 1
+                free_slots.append(slot_of.pop(c.userdata))
                 if c.status != 0:
                     errors += 1
         wall = time.perf_counter() - t0

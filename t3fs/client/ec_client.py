@@ -33,6 +33,13 @@ log = logging.getLogger("t3fs.client.ec")
 PARITY_NS = 1 << 62   # parity chunk-id namespace bit
 
 
+# Format id assumed for layouts serialized before code_id existed: the
+# round-1 generator was row-reduced Vandermonde over the default polynomial.
+# Deserializing such a blob must NOT inherit the current default generator —
+# decoding rrvand parity with the raid6 matrix reconstructs garbage silently.
+LEGACY_CODE_ID = "rrvand-11d"
+
+
 @serde_struct
 @dataclass
 class ECLayout:
@@ -41,21 +48,32 @@ class ECLayout:
     chunk_size: int = 1 << 20
     chains: list[int] = field(default_factory=list)   # >= k+m distinct chains
     # parity format id (RSCode.code_id): persisted with the layout so a
-    # future change of generator coefficients fails LOUDLY at decode time
-    # instead of silently reconstructing garbage from old parity
-    code_id: str = ""
+    # change of generator coefficients fails LOUDLY at decode time instead
+    # of silently reconstructing garbage from old parity.  Dataclass default
+    # (= what a pre-versioning serialized layout deserializes to) is the
+    # LEGACY id; new layouts get the current id via create().
+    code_id: str = LEGACY_CODE_ID
 
     def __post_init__(self):
-        assert len(self.chains) >= self.k + self.m, \
-            f"EC({self.k}+{self.m}) needs >= {self.k + self.m} chains"
-        if not self.code_id:
-            from t3fs.ops.rs import default_rs
-            self.code_id = default_rs(self.k, self.m).code_id
+        if len(self.chains) < self.k + self.m:
+            raise make_error(
+                StatusCode.INVALID_ARG,
+                f"EC({self.k}+{self.m}) needs >= {self.k + self.m} chains")
+
+    @classmethod
+    def create(cls, k: int = 8, m: int = 2, chunk_size: int = 1 << 20,
+               chains: list[int] | None = None) -> "ECLayout":
+        """Layout-creation factory: stamps the CURRENT parity format id."""
+        return cls(k=k, m=m, chunk_size=chunk_size, chains=chains or [],
+                   code_id=default_rs(k, m).code_id)
 
     def check_code(self, rs) -> None:
-        assert rs.code_id == self.code_id, \
-            f"stripe parity was written with code {self.code_id!r} but this " \
-            f"build decodes with {rs.code_id!r} — refusing to mix formats"
+        if rs.code_id != self.code_id:
+            raise make_error(
+                StatusCode.EC_FORMAT_MISMATCH,
+                f"stripe parity was written with code {self.code_id!r} but "
+                f"this build decodes with {rs.code_id!r} — refusing to mix "
+                f"formats")
 
     def shard_chain(self, stripe: int, shard: int) -> int:
         """Chain of shard (0..k+m-1) of a stripe; rotates per stripe."""

@@ -92,7 +92,7 @@ class Transaction:
 
     async def commit(self) -> None:
         assert not self._committed, "transaction reused after commit"
-        self.engine._commit(self)
+        await self.engine.commit_async(self)
         self._committed = True
 
 
@@ -102,6 +102,11 @@ class KVEngine:
 
     def clear_all(self) -> None:
         raise NotImplementedError
+
+    async def commit_async(self, txn: Transaction) -> None:
+        """Engines whose commit blocks (fsync) override to offload the
+        commit off the event loop; the in-memory commit stays inline."""
+        self._commit(txn)
 
 
 class MemKVEngine(KVEngine):

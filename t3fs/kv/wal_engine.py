@@ -19,6 +19,7 @@ commits are applied prefix-wise, like RocksDB WriteBatch recovery
 
 from __future__ import annotations
 
+import asyncio
 import bisect
 import logging
 import os
@@ -164,6 +165,23 @@ class WalKVEngine(MemKVEngine):
         self._data[k].append((ver, v))
 
     # --- durable commit ---
+
+    async def commit_async(self, txn: Transaction) -> None:
+        # sync="always" fsyncs every commit: run it in a worker thread so a
+        # slow disk doesn't stall the node's whole event loop (all locks
+        # below are threading locks, so cross-thread commit is safe)
+        fut = asyncio.get_running_loop().run_in_executor(None, self._commit, txn)
+        try:
+            await asyncio.shield(fut)
+        except asyncio.CancelledError:
+            # The thread may still complete the append+fsync: the commit is
+            # maybe-committed from the caller's view (same contract as any
+            # distributed KV commit interrupted by cancellation).  Consume
+            # the outcome so a late error — e.g. ValueError when close()
+            # already closed the WAL before a queued commit started — isn't
+            # logged as a never-retrieved exception.
+            fut.add_done_callback(lambda f: f.cancelled() or f.exception())
+            raise
 
     def _commit(self, txn: Transaction) -> None:
         with self._io_lock:

@@ -84,17 +84,32 @@ class MetaStore:
         self.chains = chain_allocator
         self.ids = InodeIdAllocator(kv)
         self._root_ready = False
+        self._root_lock = asyncio.Lock()
 
     async def _ensure_root(self) -> None:
+        """Bootstrap the root inode on a fresh store.  _root_ready flips only
+        after a successful commit, so a transient commit failure leaves the
+        bootstrap to be retried by the next op."""
         if self._root_ready:
             return
-        self._root_ready = True
-        txn = self.kv.transaction()
-        if await txn.get(Inode.key(ROOT_INODE_ID), snapshot=True) is None:
-            root = Inode(inode_id=ROOT_INODE_ID, itype=InodeType.DIRECTORY,
-                         perm=0o755, nlink=2).touch()
-            txn.set(Inode.key(ROOT_INODE_ID), serde.dumps(root))
-            await txn.commit()
+        async with self._root_lock:
+            if self._root_ready:
+                return
+
+            async def fn(txn: Transaction) -> None:
+                if await txn.get(Inode.key(ROOT_INODE_ID), snapshot=True) is None:
+                    root = Inode(inode_id=ROOT_INODE_ID,
+                                 itype=InodeType.DIRECTORY,
+                                 perm=0o755, nlink=2).touch()
+                    txn.set(Inode.key(ROOT_INODE_ID), serde.dumps(root))
+
+            await with_transaction(self.kv, fn)
+            self._root_ready = True
+
+    async def _txn(self, fn):
+        """All meta ops enter here: root bootstrap, then the retry driver."""
+        await self._ensure_root()
+        return await with_transaction(self.kv, fn)
 
     # --- txn helpers ---
 
@@ -161,12 +176,12 @@ class MetaStore:
             if dent is None:
                 raise make_error(StatusCode.META_NOT_FOUND, path)
             return await self._require_inode(txn, dent.inode_id)
-        return await with_transaction(self.kv, fn)
+        return await self._txn(fn)
 
     async def stat_inode(self, inode_id: int) -> Inode:
         async def fn(txn: Transaction):
             return await self._require_inode(txn, inode_id)
-        return await with_transaction(self.kv, fn)
+        return await self._txn(fn)
 
     async def mkdirs(self, path: str, perm: int = 0o755,
                      recursive: bool = True) -> Inode:
@@ -197,7 +212,7 @@ class MetaStore:
                 parent = inode_id
                 created = inode
             return created
-        return await with_transaction(self.kv, fn)
+        return await self._txn(fn)
 
     async def create(self, path: str, perm: int = 0o644, chunk_size: int = 0,
                      stripe: int = 0, session_client: str = "") -> tuple[Inode, str]:
@@ -224,7 +239,7 @@ class MetaStore:
                                    time.time())
                 txn.set(FileSession.key(inode_id, session_id), serde.dumps(sess))
             return inode, session_id
-        return await with_transaction(self.kv, fn)
+        return await self._txn(fn)
 
     async def open_file(self, path: str, write: bool = False,
                         session_client: str = "") -> tuple[Inode, str]:
@@ -242,7 +257,7 @@ class MetaStore:
                         serde.dumps(FileSession(inode.inode_id, session_id,
                                                 session_client, time.time())))
             return inode, session_id
-        return await with_transaction(self.kv, fn)
+        return await self._txn(fn)
 
     async def close_file(self, inode_id: int, session_id: str = "",
                          length: int | None = None) -> Inode:
@@ -257,7 +272,7 @@ class MetaStore:
             if session_id:
                 txn.clear(FileSession.key(inode_id, session_id))
             return inode
-        return await with_transaction(self.kv, fn)
+        return await self._txn(fn)
 
     async def report_write_position(self, inode_id: int, position: int) -> None:
         """Max-write-position hint, reported every few seconds by writers
@@ -269,7 +284,7 @@ class MetaStore:
                 if position > inode.length:
                     inode.length = position
                 txn.set(Inode.key(inode_id), serde.dumps(inode))
-        await with_transaction(self.kv, fn)
+        await self._txn(fn)
 
     async def readdir(self, path: str, limit: int = 0) -> list[DirEntry]:
         async def fn(txn: Transaction):
@@ -285,7 +300,7 @@ class MetaStore:
             pre = DirEntry.prefix(dir_id)
             rows = await txn.get_range(pre, pre + b"\xff", limit=limit)
             return [serde.loads(v) for _, v in rows]
-        return await with_transaction(self.kv, fn)
+        return await self._txn(fn)
 
     async def symlink(self, path: str, target: str) -> Inode:
         async def fn(txn: Transaction):
@@ -299,7 +314,7 @@ class MetaStore:
             txn.set(DirEntry.key(parent, name), serde.dumps(
                 DirEntry(parent, name, inode_id, InodeType.SYMLINK)))
             return inode
-        return await with_transaction(self.kv, fn)
+        return await self._txn(fn)
 
     async def hardlink(self, existing: str, new_path: str) -> Inode:
         async def fn(txn: Transaction):
@@ -318,7 +333,7 @@ class MetaStore:
             txn.set(DirEntry.key(parent, name), serde.dumps(
                 DirEntry(parent, name, inode.inode_id, src.itype)))
             return inode
-        return await with_transaction(self.kv, fn)
+        return await self._txn(fn)
 
     async def rename(self, src: str, dst: str) -> None:
         async def fn(txn: Transaction):
@@ -340,7 +355,7 @@ class MetaStore:
                 inode = await self._require_inode(txn, sdent.inode_id)
                 inode.parent = dparent
                 txn.set(Inode.key(inode.inode_id), serde.dumps(inode))
-        return await with_transaction(self.kv, fn)
+        return await self._txn(fn)
 
     async def _unlink_entry(self, txn: Transaction, dent: DirEntry) -> None:
         inode = await self._get_inode(txn, dent.inode_id)
@@ -376,7 +391,7 @@ class MetaStore:
                     txn.clear(DirEntry.key(child.parent, child.name))
             await self._unlink_entry(txn, dent)
             txn.clear(DirEntry.key(parent, name))
-        return await with_transaction(self.kv, fn)
+        return await self._txn(fn)
 
     async def _remove_tree(self, txn: Transaction, dent: DirEntry) -> None:
         if dent.itype == InodeType.DIRECTORY:
@@ -403,7 +418,7 @@ class MetaStore:
             inode.touch()
             txn.set(Inode.key(inode.inode_id), serde.dumps(inode))
             return inode
-        return await with_transaction(self.kv, fn)
+        return await self._txn(fn)
 
     async def set_length(self, inode_id: int, length: int) -> Inode:
         async def fn(txn: Transaction):
@@ -413,7 +428,7 @@ class MetaStore:
             inode.touch()
             txn.set(Inode.key(inode_id), serde.dumps(inode))
             return inode
-        return await with_transaction(self.kv, fn)
+        return await self._txn(fn)
 
     async def get_real_path(self, inode_id: int) -> str:
         """Walk parents to the root (GetRealPath analog). Only exact for
@@ -439,7 +454,7 @@ class MetaStore:
                 segments.append(found.name)
                 cur = parent
             raise make_error(StatusCode.META_INVALID_PATH, "loop")
-        return await with_transaction(self.kv, fn)
+        return await self._txn(fn)
 
     # --- sessions & GC ---
 
@@ -464,7 +479,7 @@ class MetaStore:
                     txn.clear(k)
                     dropped += 1
             return dropped
-        return await with_transaction(self.kv, fn)
+        return await self._txn(fn)
 
     async def gc_pop(self, limit: int = 16) -> list[Inode]:
         """Dequeue inodes whose chunks need reclamation."""
@@ -480,4 +495,4 @@ class MetaStore:
                 txn.clear(k)
                 out.append(inode)
             return out
-        return await with_transaction(self.kv, fn)
+        return await self._txn(fn)

@@ -53,6 +53,7 @@ class StatusCode(enum.IntEnum):
     NO_SPACE = 5012
     TARGET_SYNCING = 5013            # full-chunk-replace required
     READ_ONLY = 5014
+    EC_FORMAT_MISMATCH = 5015        # stripe parity written with another generator
 
     # meta (reference: MetaCode)
     META_NOT_FOUND = 6001

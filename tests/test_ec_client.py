@@ -11,14 +11,32 @@ from t3fs.utils.status import StatusCode
 
 
 def test_ec_layout_addressing():
-    lay = ECLayout(k=4, m=2, chunk_size=100, chains=[1, 2, 3, 4, 5, 6])
+    lay = ECLayout.create(k=4, m=2, chunk_size=100, chains=[1, 2, 3, 4, 5, 6])
     # all shards of one stripe land on distinct chains
     chains = [lay.shard_chain(0, s) for s in range(6)]
     assert len(set(chains)) == 6
     # rotation: stripe 1 starts at a different chain
     assert lay.shard_chain(1, 0) == lay.shard_chain(0, 0)  # 6 % 6 == 0 rotation
-    lay7 = ECLayout(k=4, m=2, chunk_size=100, chains=[1, 2, 3, 4, 5, 6, 7])
+    lay7 = ECLayout.create(k=4, m=2, chunk_size=100, chains=[1, 2, 3, 4, 5, 6, 7])
     assert lay7.shard_chain(1, 0) != lay7.shard_chain(0, 0)
+
+
+def test_ec_legacy_layout_refuses_current_decoder():
+    """A layout serialized before code_id existed must NOT be decoded with
+    the current generator matrix (ADVICE r1: silent garbage reconstruction)."""
+    from t3fs.ops.rs import default_rs
+    from t3fs.utils import serde
+    from t3fs.utils.status import StatusError
+    lay = ECLayout.create(k=4, m=2, chunk_size=100, chains=[1, 2, 3, 4, 5, 6])
+    # current-format layout round-trips and passes
+    lay2 = serde.loads(serde.dumps(lay))
+    lay2.check_code(default_rs(4, 2))
+    # legacy blob: code_id field absent -> deserializes to the legacy id
+    legacy = ECLayout(k=4, m=2, chunk_size=100, chains=[1, 2, 3, 4, 5, 6])
+    assert legacy.code_id == "rrvand-11d"
+    with pytest.raises(StatusError) as ei:
+        legacy.check_code(default_rs(4, 2))
+    assert ei.value.status.code == int(StatusCode.EC_FORMAT_MISMATCH)
 
 
 def test_ec_write_read_roundtrip_and_reconstruct():
@@ -28,7 +46,7 @@ def test_ec_write_read_roundtrip_and_reconstruct():
                                heartbeat_timeout_s=0.6)
         await cluster.start()
         try:
-            lay = ECLayout(k=4, m=2, chunk_size=2048,
+            lay = ECLayout.create(k=4, m=2, chunk_size=2048,
                            chains=[1, 2, 3, 4, 5, 6])
             ec = ECStorageClient(cluster.sc)
             data = bytes(range(256)) * 32  # 8192 = exactly one 4-chunk stripe
@@ -61,7 +79,7 @@ def test_ec_short_stripe_and_repair():
         cluster = LocalCluster(num_nodes=3, replicas=1, num_chains=6)
         await cluster.start()
         try:
-            lay = ECLayout(k=4, m=2, chunk_size=1024, chains=[1, 2, 3, 4, 5, 6])
+            lay = ECLayout.create(k=4, m=2, chunk_size=1024, chains=[1, 2, 3, 4, 5, 6])
             ec = ECStorageClient(cluster.sc)
             data = b"short stripe!" * 100  # 1300B: chunk0 full, chunk1 partial
             await ec.write_stripe(lay, 10, 0, data)

@@ -69,9 +69,9 @@ def test_lease_single_primary():
         a = MgmtdState(kv, 1, "a:1", cfg)
         b = MgmtdState(kv, 2, "b:1", cfg)
         assert await a.try_acquire_lease()
-        assert a.is_primary()
+        assert await a.is_primary()
         assert not await b.try_acquire_lease()  # lease held
-        assert not b.is_primary()
+        assert not await b.is_primary()
         assert await a.try_acquire_lease()      # holder extends freely
     asyncio.run(body())
 
