@@ -167,6 +167,11 @@ class WalKVEngine(MemKVEngine):
     # --- durable commit ---
 
     async def commit_async(self, txn: Transaction) -> None:
+        if not txn._writes and not txn._range_clears:
+            # read-only: no WAL, no fsync — conflict-check inline rather than
+            # paying two thread hops on every stat/readdir/open
+            self._commit(txn)
+            return
         # sync="always" fsyncs every commit: run it in a worker thread so a
         # slow disk doesn't stall the node's whole event loop (all locks
         # below are threading locks, so cross-thread commit is safe)
@@ -185,42 +190,47 @@ class WalKVEngine(MemKVEngine):
 
     def _commit(self, txn: Transaction) -> None:
         with self._io_lock:
+            # standard WAL ordering: conflict-check, LOG, then apply — a
+            # failed append must leave memory untouched, or restart silently
+            # diverges (lost batch, persisted dependents).  _lock is held
+            # only around the memory phases: the fsync runs under _io_lock
+            # alone, so event-loop readers aren't stalled behind a slow disk
+            # (commits are fully serialized by _io_lock, so nothing can
+            # invalidate the conflict check between check and apply).
             with self._lock:
-                # standard WAL ordering: conflict-check, LOG, then apply —
-                # a failed append must leave memory untouched, or restart
-                # silently diverges (lost batch, persisted dependents)
                 self._check_conflicts_locked(txn)
-                writes = list(txn._writes.items())
-                clears = list(txn._range_clears)
-                if writes or clears:
-                    if self._broken:
-                        raise make_error(
-                            StatusCode.INTERNAL,
-                            "WAL is failed (earlier append error); "
-                            "reopen the engine")
-                    payload = _pack_batch(writes, clears)
-                    pos = self._wal.tell()
+            writes = list(txn._writes.items())
+            clears = list(txn._range_clears)
+            if writes or clears:
+                if self._broken:
+                    raise make_error(
+                        StatusCode.INTERNAL,
+                        "WAL is failed (earlier append error); "
+                        "reopen the engine")
+                payload = _pack_batch(writes, clears)
+                pos = self._wal.tell()
+                try:
+                    self._wal.write(_FRAME_HDR.pack(len(payload),
+                                                    zlib.crc32(payload))
+                                    + payload)
+                    if self.sync == "always":
+                        os.fsync(self._wal.fileno())
+                except OSError:
+                    # drop the torn frame so later commits don't land
+                    # beyond a tear that replay will stop at; if even
+                    # that fails, refuse all further commits — anything
+                    # appended past a tear would be silently lost
                     try:
-                        self._wal.write(_FRAME_HDR.pack(len(payload),
-                                                        zlib.crc32(payload))
-                                        + payload)
-                        if self.sync == "always":
-                            os.fsync(self._wal.fileno())
+                        os.ftruncate(self._wal.fileno(), pos)
+                        self._wal.seek(pos)
                     except OSError:
-                        # drop the torn frame so later commits don't land
-                        # beyond a tear that replay will stop at; if even
-                        # that fails, refuse all further commits — anything
-                        # appended past a tear would be silently lost
-                        try:
-                            os.ftruncate(self._wal.fileno(), pos)
-                            self._wal.seek(pos)
-                        except OSError:
-                            self._broken = True
-                            log.critical(
-                                "WAL %s: failed append AND failed truncate; "
-                                "engine is read-only until reopen",
-                                self.wal_path)
-                        raise
+                        self._broken = True
+                        log.critical(
+                            "WAL %s: failed append AND failed truncate; "
+                            "engine is read-only until reopen",
+                            self.wal_path)
+                    raise
+            with self._lock:
                 self._apply_locked(txn)
             if self._wal.tell() >= self.compact_threshold_bytes:
                 self._compact_locked()

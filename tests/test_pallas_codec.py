@@ -10,11 +10,18 @@ import pytest
 from t3fs.ops.crc32c import crc32c_ref, default_matrices
 from t3fs.ops.jax_codec import pack_bits_u32
 from t3fs.ops.pallas_codec import (
-    make_crc32c_raw_fast, make_rs_encode_pallas, make_rs_reconstruct_pallas,
-    make_stripe_encode_step_fast)
+    make_crc32c_raw_fast, make_crc32c_words, make_rs_encode_pallas,
+    make_rs_encode_words_pallas, make_rs_reconstruct_pallas,
+    make_stripe_encode_step_fast, make_stripe_encode_step_words)
 from t3fs.ops.rs import default_rs
 
 rng = np.random.default_rng(7)
+
+
+def _to_words(byts: np.ndarray) -> np.ndarray:
+    """uint8 (..., L) -> little-endian uint32 (..., L//4) word view."""
+    return byts.reshape(*byts.shape[:-1], byts.shape[-1] // 4, 4) \
+        .view(np.uint32).reshape(*byts.shape[:-1], byts.shape[-1] // 4)
 
 
 def test_rs_encode_pallas_matches_oracle():
@@ -49,6 +56,52 @@ def test_stripe_step_fast_matches_oracle():
     stripes = rng.integers(0, 256, (2, 8, L), dtype=np.uint8)
     parity, crcs = step(jnp.asarray(stripes))
     parity, crcs = np.asarray(parity), np.asarray(crcs)
+    for i in range(2):
+        ref_par = rs.encode_ref(stripes[i])
+        assert np.array_equal(parity[i], ref_par)
+        for s in range(8):
+            assert int(crcs[i, s]) == crc32c_ref(stripes[i, s].tobytes())
+        for j in range(2):
+            assert int(crcs[i, 8 + j]) == crc32c_ref(ref_par[j].tobytes())
+
+
+@pytest.mark.parametrize("block_w,L", [
+    (512, 2048),     # COLS = bw fallback branch
+    (4096, 16384),   # COLS = 2048 branch (the shipping bench configuration)
+])
+def test_rs_encode_words_matches_oracle(block_w, L):
+    import jax.numpy as jnp
+
+    rs = default_rs()
+    enc = make_rs_encode_words_pallas(rs, block_w=block_w, interpret=True)
+    data = rng.integers(0, 256, (2, 8, L), dtype=np.uint8)
+    got = np.asarray(enc(jnp.asarray(_to_words(data))))
+    got_bytes = got.view(np.uint8).reshape(2, 2, L)
+    for i in range(2):
+        assert np.array_equal(got_bytes[i], rs.encode_ref(data[i]))
+
+
+def test_crc32c_words_matches_oracle():
+    import jax.numpy as jnp
+
+    L = 2048  # 4 segments of 512 bytes
+    crc = make_crc32c_words(L // 4, block_r=8, interpret=True)
+    rows = rng.integers(0, 256, (3, L), dtype=np.uint8)
+    got = np.asarray(crc(jnp.asarray(_to_words(rows))))
+    for r in range(3):
+        assert int(got[r]) == crc32c_ref(rows[r].tobytes())
+
+
+def test_stripe_step_words_matches_oracle():
+    import jax.numpy as jnp
+
+    L = 2048
+    rs = default_rs()
+    step = make_stripe_encode_step_words(L // 4, interpret=True)
+    stripes = rng.integers(0, 256, (2, 8, L), dtype=np.uint8)
+    parity, crcs = step(jnp.asarray(_to_words(stripes)))
+    parity = np.asarray(parity).view(np.uint8).reshape(2, 2, L)
+    crcs = np.asarray(crcs)
     for i in range(2):
         ref_par = rs.encode_ref(stripes[i])
         assert np.array_equal(parity[i], ref_par)
