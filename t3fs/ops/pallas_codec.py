@@ -337,9 +337,12 @@ def make_crc_seg_words_pallas(block_r: int = 512, interpret: bool = False):
     return seg_crc
 
 
-def make_crc32c_words(chunk_words: int, block_r: int = 512,
-                      interpret: bool = False):
-    """(n, chunk_words) uint32 word rows -> (n,) uint32 CRC32C (full chunks).
+def make_crc32c_words_raw(chunk_words: int, block_r: int = 512,
+                          interpret: bool = False):
+    """(n, chunk_words) uint32 word rows -> (n,) uint32 RAW CRC (no init/final
+    affine).  Raw CRC is zero-preserving, so callers may FRONT-pad shorter
+    buffers with zero bytes and apply affine_const(true_len) themselves —
+    this is how the storage codec backend batches variable-length payloads.
 
     chunk_words must be a multiple of 128 (512-byte segments)."""
     from t3fs.ops.jax_codec import pack_bits_u32
@@ -352,10 +355,9 @@ def make_crc32c_words(chunk_words: int, block_r: int = 512,
     C = jnp.asarray(
         P.transpose(0, 2, 1).reshape(nseg * 32, 32).astype(np.float32),
         dtype=jnp.bfloat16)
-    affine = np.uint32(mats.affine_const(chunk_words * 4))
     seg = make_crc_seg_words_pallas(block_r, interpret)
 
-    def crc(words: jax.Array) -> jax.Array:
+    def raw_crc(words: jax.Array) -> jax.Array:
         n = words.shape[0]
         rows = words.reshape(n * nseg, _SEG_W)
         R = rows.shape[0]
@@ -366,7 +368,21 @@ def make_crc32c_words(chunk_words: int, block_r: int = 512,
         raw = jax.lax.dot_general(
             seg_bits.reshape(n, nseg * 32), C, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32).astype(jnp.int32) & 1
-        return pack_bits_u32(raw) ^ affine
+        return pack_bits_u32(raw)
+
+    return raw_crc
+
+
+def make_crc32c_words(chunk_words: int, block_r: int = 512,
+                      interpret: bool = False):
+    """(n, chunk_words) uint32 word rows -> (n,) uint32 CRC32C (full chunks).
+
+    chunk_words must be a multiple of 128 (512-byte segments)."""
+    affine = np.uint32(default_matrices().affine_const(chunk_words * 4))
+    raw = make_crc32c_words_raw(chunk_words, block_r, interpret)
+
+    def crc(words: jax.Array) -> jax.Array:
+        return raw(words) ^ affine
 
     return crc
 

@@ -140,7 +140,11 @@ class ChunkEngine:
             if length == 0:
                 return b""
             fd = self._fd(sc)
-        return os.pread(fd, length, block * sc + offset)
+            # pread stays under the lock: a concurrent COW put may free this
+            # block and a later allocation reuse it mid-read (the native
+            # engine preads under its shared lock for the same reason; the
+            # reference uses Arc'd chunk handles — engine.rs read safety)
+            return os.pread(fd, length, block * sc + offset)
 
     def put(self, chunk_id: ChunkId, content: bytes, meta: ChunkMeta,
             chunk_size: int) -> None:

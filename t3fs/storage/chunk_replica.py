@@ -37,9 +37,14 @@ class ChunkReplica:
 
     # --- update path ---
 
-    def apply_update(self, io: UpdateIO, payload: bytes) -> IOResult:
+    def apply_update(self, io: UpdateIO, payload: bytes,
+                     payload_crc: int | None = None) -> IOResult:
         """Apply one update as DIRTY; raises StatusError on gating violations.
-        Idempotent for the retry of the currently-pending update."""
+        Idempotent for the retry of the currently-pending update.
+
+        payload_crc: CRC32C of payload precomputed by the node's
+        ChecksumBackend (the codec seam — batched device offload); when None
+        the replica computes it on the host."""
         meta = self.engine.get_meta(io.chunk_id)
 
         if io.update_type == UpdateType.REMOVE:
@@ -68,7 +73,8 @@ class ChunkReplica:
                 # replace actually repairs the bytes.
                 return IOResult(WireStatus(), meta.length, meta.update_ver,
                                 meta.commit_ver, meta.chain_ver, meta.checksum)
-            checksum = self.crc(payload)
+            checksum = payload_crc if payload_crc is not None \
+                else self.crc(payload)
             if io.checksum and checksum != io.checksum:
                 raise make_error(StatusCode.CHECKSUM_MISMATCH,
                                  f"{io.chunk_id}: replace payload checksum")
@@ -109,7 +115,8 @@ class ChunkReplica:
                              f"{io.chunk_id}: pending v{cur_update}")
 
         # verify client checksum of the payload (ChunkReplica.cc:193-206)
-        payload_crc = self.crc(payload)
+        if payload_crc is None:
+            payload_crc = self.crc(payload)
         if io.checksum and payload_crc != io.checksum:
             raise make_error(StatusCode.CHECKSUM_MISMATCH,
                              f"{io.chunk_id}: payload crc {payload_crc:#x} != {io.checksum:#x}")
@@ -170,16 +177,34 @@ class ChunkReplica:
     # --- read path ---
 
     def read(self, io: ReadIO) -> tuple[IOResult, bytes]:
-        meta = self.engine.get_meta(io.chunk_id)
-        if meta is None:
-            raise make_error(StatusCode.CHUNK_NOT_FOUND, str(io.chunk_id))
-        if meta.state == ChunkState.DIRTY and not io.allow_uncommitted:
-            # only committed versions are served (design_notes.md:169-173);
-            # client retries — commit latency is one chain round trip
+        # Optimistic meta validation: reads run concurrently with the update
+        # worker (no chunk lock), and engine.get_meta + engine.read are two
+        # separately-locked calls — re-check the meta after the data read and
+        # retry if an update slipped between them, so the returned bytes
+        # always pair with the returned versions/checksum (each engine call
+        # is internally atomic; any concurrent put bumps update_ver or
+        # changes the checksum).
+        for _ in range(8):
+            meta = self.engine.get_meta(io.chunk_id)
+            if meta is None:
+                raise make_error(StatusCode.CHUNK_NOT_FOUND, str(io.chunk_id))
+            if meta.state == ChunkState.DIRTY and not io.allow_uncommitted:
+                # only committed versions are served (design_notes.md:169-173);
+                # client retries — commit latency is one chain round trip
+                raise make_error(StatusCode.CHUNK_BUSY,
+                                 f"{io.chunk_id}: uncommitted v{meta.update_ver}")
+            data = self.engine.read(io.chunk_id, io.offset,
+                                    io.length if io.length else -1)
+            meta2 = self.engine.get_meta(io.chunk_id)
+            if meta2 is not None \
+                    and meta2.update_ver == meta.update_ver \
+                    and meta2.checksum == meta.checksum \
+                    and meta2.length == meta.length:
+                meta = meta2  # commit_ver/state may have advanced; report newest
+                break
+        else:
             raise make_error(StatusCode.CHUNK_BUSY,
-                             f"{io.chunk_id}: uncommitted v{meta.update_ver}")
-        data = self.engine.read(io.chunk_id, io.offset,
-                                io.length if io.length else -1)
+                             f"{io.chunk_id}: update storm during read")
         if io.verify_checksum and io.offset == 0 and len(data) == meta.length:
             actual = self.crc(data)
             if actual != meta.checksum:

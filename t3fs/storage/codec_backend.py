@@ -1,0 +1,262 @@
+"""ChecksumBackend: the pluggable codec seam behind the storage write path.
+
+This is the BASELINE.json north star — `chunk_engine.backend=tpu` — realized
+as the seam the reference keeps for engine pluggability
+(src/storage/store/StorageTarget.h:85-162, engine v1/v2 switch): storage
+checksums flow through a backend chosen by config:
+
+  cpu    — host CRC32C (native SSE4.2 when built, else the table oracle);
+           large buffers hop to a thread so the event loop never blocks.
+  device — micro-batched device offload ("tpu" in prod): concurrent update
+           RPCs enqueue payloads, a worker drains the queue, buckets them by
+           padded segment count, and runs ONE batched word-kernel call per
+           bucket (t3fs.ops.pallas_codec.make_crc32c_words_raw); raw CRC is
+           zero-preserving so buffers are front-padded and the true-length
+           affine constant is applied per buffer on the host.  On non-TPU
+           platforms the same kernels run in interpret mode so the full
+           batching path is testable on the CPU mesh.
+  null   — returns 0 and disables verification (reference
+           FeatureFlags::BYPASS_* testability analog, fbs/storage/Common.h:72).
+
+Reference CPU analog being replaced: folly::crc32c
+(src/fbs/storage/Common.h:158).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from collections import defaultdict
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass
+
+import numpy as np
+
+from t3fs.ops.codec import crc32c as cpu_crc32c
+from t3fs.ops.crc32c import default_matrices
+
+log = logging.getLogger("t3fs.storage.codec")
+
+# below this, the host CRC is cheaper than a device round trip
+DEFAULT_MIN_DEVICE_BYTES = 64 << 10
+SEG_BYTES = 512
+SEG_WORDS = SEG_BYTES // 4
+# payloads hop off the event loop above this even on the cpu backend
+CPU_OFFLOAD_BYTES = 256 << 10
+
+
+class ChecksumBackend:
+    """Interface: async batched CRC32C for the storage node hot path."""
+
+    name = "base"
+
+    async def payload_crc(self, data: bytes) -> int:
+        raise NotImplementedError
+
+    @property
+    def verify_enabled(self) -> bool:
+        return True
+
+    async def close(self) -> None:
+        pass
+
+
+class CpuChecksumBackend(ChecksumBackend):
+    name = "cpu"
+
+    async def payload_crc(self, data: bytes) -> int:
+        if len(data) >= CPU_OFFLOAD_BYTES:
+            return await asyncio.to_thread(cpu_crc32c, data)
+        return cpu_crc32c(data)
+
+
+class NullChecksumBackend(ChecksumBackend):
+    name = "null"
+
+    async def payload_crc(self, data: bytes) -> int:
+        return 0
+
+    @property
+    def verify_enabled(self) -> bool:
+        return False
+
+
+@dataclass
+class _Pending:
+    data: bytes
+    future: asyncio.Future
+    loop: asyncio.AbstractEventLoop
+
+
+class DeviceChecksumBackend(ChecksumBackend):
+    """Micro-batching CRC32C offload to the JAX device.
+
+    Batching across concurrent updates is what makes the device path win:
+    one 512-byte-segment kernel call covers every payload that arrived
+    within the batching window (reference batches writes at UpdateWorker;
+    here the batch crosses chunks and chains)."""
+
+    name = "device"
+
+    def __init__(self, max_batch: int = 64, max_wait_us: int = 300,
+                 min_device_bytes: int = DEFAULT_MIN_DEVICE_BYTES):
+        self.max_batch = max_batch
+        self.max_wait_s = max_wait_us / 1e6
+        self.min_device_bytes = min_device_bytes
+        self._q: asyncio.Queue[_Pending] = asyncio.Queue()
+        self._worker: asyncio.Task | None = None
+        self._pool = ThreadPoolExecutor(1, thread_name_prefix="t3fs-codec")
+        self._fns: dict[tuple[int, int], object] = {}
+        self._interpret: bool | None = None
+        self.batches = 0
+        self.batched_items = 0
+
+    # --- public API ---
+
+    async def payload_crc(self, data: bytes) -> int:
+        if len(data) < self.min_device_bytes:
+            return cpu_crc32c(data)
+        if self._worker is None or self._worker.done():
+            self._worker = asyncio.get_running_loop().create_task(
+                self._worker_loop())
+        fut = asyncio.get_running_loop().create_future()
+        await self._q.put(_Pending(data, fut, asyncio.get_running_loop()))
+        return await fut
+
+    async def close(self) -> None:
+        if self._worker is not None:
+            self._worker.cancel()
+            try:
+                await self._worker
+            except (asyncio.CancelledError, Exception):
+                pass
+            self._worker = None
+        # fail anything still queued so in-flight payload_crc() awaits don't
+        # hang a node shutdown under write load
+        err = make_closed_error()
+        while not self._q.empty():
+            item = self._q.get_nowait()
+            if not item.future.done():
+                item.future.set_exception(err)
+        self._pool.shutdown(wait=True)
+
+    # --- batching worker ---
+
+    async def _worker_loop(self) -> None:
+        loop = asyncio.get_running_loop()
+        batch: list[_Pending] = []
+        try:
+            while True:
+                batch = [await self._q.get()]
+                deadline = loop.time() + self.max_wait_s
+                while len(batch) < self.max_batch:
+                    timeout = deadline - loop.time()
+                    if timeout <= 0:
+                        break
+                    try:
+                        batch.append(
+                            await asyncio.wait_for(self._q.get(), timeout))
+                    except asyncio.TimeoutError:
+                        break
+                groups: dict[int, list[_Pending]] = defaultdict(list)
+                for item in batch:
+                    groups[self._bucket_words(len(item.data))].append(item)
+                self.batches += len(groups)
+                self.batched_items += len(batch)
+                try:
+                    await loop.run_in_executor(self._pool, self._flush, groups)
+                except Exception as e:  # pragma: no cover - device failure
+                    log.exception("device CRC flush failed; failing batch")
+                    for item in batch:
+                        item.loop.call_soon_threadsafe(
+                            _set_exception_safe, item.future, e)
+                batch = []
+        except asyncio.CancelledError:
+            # fail whatever was collected but not yet flushed
+            err = make_closed_error()
+            for item in batch:
+                if not item.future.done():
+                    item.future.set_exception(err)
+            raise
+
+    @staticmethod
+    def _bucket_words(nbytes: int) -> int:
+        """Pad to a power-of-two number of 512-byte segments (bounded set of
+        compiled shapes, mirroring the engine's size-class ladder)."""
+        segs = max(1, -(-nbytes // SEG_BYTES))
+        p = 1
+        while p < segs:
+            p <<= 1
+        return p * SEG_WORDS
+
+    def _fn(self, chunk_words: int):
+        # keyed by chunk_words only: jax.jit retraces per batch shape anyway,
+        # and the host-side matrix build is the expensive part
+        fn = self._fns.get(chunk_words)
+        if fn is None:
+            import jax
+
+            from t3fs.ops.pallas_codec import make_crc32c_words_raw
+
+            if self._interpret is None:
+                self._interpret = jax.devices()[0].platform != "tpu"
+            fn = jax.jit(make_crc32c_words_raw(
+                chunk_words, interpret=self._interpret))
+            self._fns[chunk_words] = fn
+        return fn
+
+    def _flush(self, groups: dict[int, list[_Pending]]) -> None:
+        """Runs in the codec thread: one device call per bucket."""
+        mats = default_matrices()
+        for chunk_words, items in groups.items():
+            n = 1
+            while n < len(items):
+                n <<= 1
+            arr = np.zeros((n, chunk_words * 4), dtype=np.uint8)
+            for i, item in enumerate(items):
+                # FRONT-pad: raw CRC is zero-preserving
+                arr[i, arr.shape[1] - len(item.data):] = np.frombuffer(
+                    item.data, dtype=np.uint8)
+            words = arr.view(np.uint32)
+            raw = np.asarray(self._fn(chunk_words)(words))
+            for i, item in enumerate(items):
+                crc = int(raw[i]) ^ mats.affine_const(len(item.data))
+                item.loop.call_soon_threadsafe(
+                    _set_result_safe, item.future, crc)
+
+
+def make_closed_error() -> Exception:
+    from t3fs.utils.status import StatusCode, make_error
+
+    return make_error(StatusCode.INTERNAL, "checksum backend closed")
+
+
+def _set_result_safe(fut: asyncio.Future, value: int) -> None:
+    if not fut.done():
+        fut.set_result(value)
+
+
+def _set_exception_safe(fut: asyncio.Future, exc: Exception) -> None:
+    if not fut.done():
+        fut.set_exception(exc)
+
+
+def make_checksum_backend(name: str | ChecksumBackend, **kw) -> ChecksumBackend:
+    """Factory for the config seam: checksum_backend = cpu | tpu | null.
+
+    "tpu" and "device" both map to the batching device backend (it runs on
+    whatever device JAX has — the real chip in prod, CPU interpret in tests).
+    An already-constructed backend passes through (tests tune batching)."""
+    if isinstance(name, ChecksumBackend):
+        return name
+    if callable(name):
+        # factory: a fresh backend per node (needed when each test runs its
+        # own event loop — a backend's queue binds to the loop that uses it)
+        return make_checksum_backend(name())
+    if name in ("cpu", "", None):
+        return CpuChecksumBackend()
+    if name in ("tpu", "device"):
+        return DeviceChecksumBackend(**kw)
+    if name == "null":
+        return NullChecksumBackend()
+    raise ValueError(f"unknown checksum backend {name!r}")

@@ -32,6 +32,9 @@ class StorageConfig(ConfigBase):
     port: int = citem(0, hot=False)
     heartbeat_period_s: float = citem(0.3, validator=lambda v: v > 0)
     resync_period_s: float = citem(0.2, validator=lambda v: v > 0)
+    # the codec seam (BASELINE north star): cpu | tpu | null
+    checksum_backend: str = citem(
+        "cpu", hot=False, validator=lambda v: v in ("cpu", "tpu", "device", "null"))
 
 
 class StorageServer:
@@ -39,14 +42,16 @@ class StorageServer:
                  host: str = "127.0.0.1", port: int = 0,
                  heartbeat_period_s: float = 0.3,
                  resync_period_s: float = 0.2,
+                 checksum_backend: str = "cpu",
                  cfg: StorageConfig | None = None,
                  admin_token: str = ""):
         self.cfg = cfg or StorageConfig(
             host=host, port=port, heartbeat_period_s=heartbeat_period_s,
-            resync_period_s=resync_period_s)
+            resync_period_s=resync_period_s, checksum_backend=checksum_backend)
         self.node_id = node_id
         self.server = Server(self.cfg.host, self.cfg.port)
-        self.node = StorageNode(node_id, self._routing, Client())
+        self.node = StorageNode(node_id, self._routing, Client(),
+                                checksum_backend=self.cfg.checksum_backend)
         self.service = StorageService(self.node)
         self.server.add_service(self.service)
         from t3fs.core.service import AppInfo, CoreService
@@ -92,6 +97,7 @@ class StorageServer:
         if self.mgmtd:
             await self.mgmtd.stop()
         await self.node.client.close()
+        await self.node.codec.close()
         await self.server.stop()
         for t in self.node.targets.values():
-            t.engine.close()
+            t.close()

@@ -45,15 +45,24 @@ log = logging.getLogger("t3fs.storage")
 
 
 class StorageTarget:
-    """One target (disk) = chunk engine + CRAQ replica + per-chunk locks."""
+    """One target (disk) = chunk engine + CRAQ replica + per-chunk locks.
+
+    Disk mutations run on a dedicated single worker thread per target (the
+    reference's UpdateWorker, storage/update/UpdateWorker.{h,cc}): the RPC
+    event loop never blocks on pwrite/fsync, and per-disk write ordering
+    stays deterministic."""
 
     def __init__(self, target_id: int, root: str, engine_backend: str = "native"):
+        from concurrent.futures import ThreadPoolExecutor
+
         from t3fs.storage.native_engine import make_engine
 
         self.target_id = target_id
         self.engine = make_engine(root, backend=engine_backend)
         self.replica = ChunkReplica(self.engine)
         self._chunk_locks: dict[ChunkId, asyncio.Lock] = {}
+        self.update_executor = ThreadPoolExecutor(
+            1, thread_name_prefix=f"t3fs-upd-{target_id}")
 
     def chunk_lock(self, chunk_id: ChunkId) -> asyncio.Lock:
         lock = self._chunk_locks.get(chunk_id)
@@ -61,16 +70,32 @@ class StorageTarget:
             lock = self._chunk_locks[chunk_id] = asyncio.Lock()
         return lock
 
+    async def run_update(self, fn, *args):
+        """Run a replica/engine mutation on this target's update worker."""
+        return await asyncio.get_running_loop().run_in_executor(
+            self.update_executor, fn, *args)
+
+    def close(self) -> None:
+        self.update_executor.shutdown(wait=True)
+        self.engine.close()
+
 
 class StorageNode:
     """Hosts targets + the Storage RPC service on one node."""
 
     def __init__(self, node_id: int, routing_provider: Callable[[], RoutingInfo],
-                 client, forward_timeout_s: float = 10.0):
+                 client, forward_timeout_s: float = 10.0,
+                 checksum_backend: str = "cpu", read_concurrency: int = 16):
+        from t3fs.storage.codec_backend import make_checksum_backend
+
         self.node_id = node_id
         self._routing_provider = routing_provider
         self.client = client
         self.forward_timeout_s = forward_timeout_s
+        # the codec seam (north star): cpu | tpu | null
+        self.codec = make_checksum_backend(checksum_backend)
+        self.read_concurrency = read_concurrency
+        self._read_sem: asyncio.Semaphore | None = None
         self.targets: dict[int, StorageTarget] = {}
         # local target states reported in heartbeats (failure-detection input,
         # fbs/mgmtd/LocalTargetInfo.h analog): a fresh/restarted target is
@@ -90,6 +115,12 @@ class StorageNode:
                    state: LocalTargetState = LocalTargetState.ONLINE,
                    engine_backend: str = "native") -> StorageTarget:
         t = StorageTarget(target_id, root, engine_backend)
+        if not self.codec.verify_enabled:
+            # null backend: EVERY path (append combine, overwrite recompute,
+            # read verify) must agree on checksum 0, or stored checksums
+            # diverge across update types and spuriously fail verification
+            t.replica.crc = lambda data, crc=0: 0
+            t.replica.crc_combine = lambda a, b, len_b: 0
         self.targets[target_id] = t
         self.local_states[target_id] = state
         return t
@@ -219,8 +250,21 @@ class StorageService:
                 io.update_ver = (meta.update_ver if meta else 0) + 1
             io.chain_ver = chain.chain_ver
 
+            # checksum via the codec seam: the device backend micro-batches
+            # CRCs across every update concurrently in flight on this node
+            # (BASELINE north star; replaces folly::crc32c, Common.h:158)
+            payload_crc: int | None = None
+            if payload and io.update_type in (UpdateType.WRITE,
+                                              UpdateType.REPLACE):
+                if not node.codec.verify_enabled:
+                    io.checksum = 0
+                    payload_crc = 0
+                else:
+                    payload_crc = await node.codec.payload_crc(payload)
+
             try:
-                result = target.replica.apply_update(io, payload)
+                result = await target.run_update(
+                    target.replica.apply_update, io, payload, payload_crc)
                 trace_add("storage.update.applied", f"ver={io.update_ver}")
             except StatusError as e:
                 result = IOResult(WireStatus(int(e.code), str(e)))
@@ -255,8 +299,9 @@ class StorageService:
                 return result
 
             if io.update_type not in (UpdateType.REMOVE,):
-                result = target.replica.commit(io.chunk_id, io.update_ver,
-                                               chain.chain_ver)
+                result = await target.run_update(
+                    target.replica.commit, io.chunk_id, io.update_ver,
+                    chain.chain_ver)
                 trace_add("storage.update.committed")
             if require_head:
                 node.reliable_update.record(io, result)
@@ -301,26 +346,37 @@ class StorageService:
 
     @rpc_method
     async def batch_read(self, req: BatchReadReq, payload: bytes, conn: Connection):
-        """Reads go to ANY serving target (CRAQ read-any)."""
+        """Reads go to ANY serving target (CRAQ read-any).
+
+        IOs run CONCURRENTLY: engine reads hop to worker threads (both
+        engines take shared/brief locks, so reads parallelize) bounded by a
+        node-wide semaphore — the reference's AioReadWorker + job-split
+        architecture (storage/aio/AioReadWorker.h:21-44, job split at
+        StorageOperator.cc:162-169).  Response order is preserved."""
         node = self.node
         if req.debug.server_should_fail():
             raise make_error(StatusCode.INTERNAL, "injected server error")
-        results: list[IOResult] = []
-        inline_parts: list[bytes] = []
-        for io in req.ios:
+        if node._read_sem is None:
+            node._read_sem = asyncio.Semaphore(node.read_concurrency)
+
+        async def one(io: ReadIO) -> tuple[IOResult, bytes | None]:
             node.read_count.add()
             try:
                 chain, target = node._check_chain(io.chain_id, 0)
-                result, data = target.replica.read(io)
+                async with node._read_sem:
+                    result, data = await asyncio.to_thread(
+                        target.replica.read, io)
                 if io.buf is not None:
                     await remote_write(conn, io.buf.slice(0, len(data)), data)
-                else:
-                    inline_parts.append(data)
-                results.append(result)
+                    return result, None
+                return result, data
             except StatusError as e:
-                results.append(IOResult(WireStatus(int(e.code), str(e))))
-                if io.buf is None:
-                    inline_parts.append(b"")
+                return (IOResult(WireStatus(int(e.code), str(e))),
+                        None if io.buf is not None else b"")
+
+        pairs = await asyncio.gather(*(one(io) for io in req.ios))
+        results = [r for r, _ in pairs]
+        inline_parts = [d for _, d in pairs if d is not None]
         return BatchReadRsp(results=results), b"".join(inline_parts)
 
     # ---- metadata-ish ops ----

@@ -23,11 +23,20 @@ from t3fs.storage.service import StorageNode, StorageService
 class StorageFabric:
     """N storage nodes, one chain of `replicas` targets (extendable)."""
 
-    def __init__(self, num_nodes: int = 3, replicas: int = 3, chain_id: int = 1):
+    # class-level defaults so suites can parameterize every test at once
+    # (UnitTestFabric SystemSetupConfig analog, tests/lib/UnitTestFabric.h:86)
+    default_checksum_backend: str = "cpu"
+    default_engine_backend: str = "native"
+
+    def __init__(self, num_nodes: int = 3, replicas: int = 3, chain_id: int = 1,
+                 checksum_backend=None, engine_backend: str | None = None):
         assert replicas <= num_nodes
         self.num_nodes = num_nodes
         self.replicas = replicas
         self.chain_id = chain_id
+        self.checksum_backend = (checksum_backend if checksum_backend is not None
+                                 else self.default_checksum_backend)
+        self.engine_backend = engine_backend or self.default_engine_backend
         self.routing = RoutingInfo(version=1)
         self.servers: list[Server] = []
         self.nodes: list[StorageNode] = []
@@ -42,9 +51,11 @@ class StorageFabric:
     async def start(self) -> None:
         for i in range(self.num_nodes):
             node_id = i + 1
-            node = StorageNode(node_id, lambda: self.routing, Client())
+            node = StorageNode(node_id, lambda: self.routing, Client(),
+                               checksum_backend=self.checksum_backend)
             node.client.add_service(BufferRegistry())  # forwarding conns
-            node.add_target(self.target_id(i), f"{self._tmp.name}/n{node_id}")
+            node.add_target(self.target_id(i), f"{self._tmp.name}/n{node_id}",
+                            engine_backend=self.engine_backend)
             server = Server()
             server.add_service(StorageService(node))
             await server.start()
@@ -82,9 +93,10 @@ class StorageFabric:
         await self.client.close()
         for node in self.nodes:
             await node.client.close()
+            await node.codec.close()
         for server in self.servers:
             await server.stop()
         for node in self.nodes:
             for t in node.targets.values():
-                t.engine.close()
+                t.close()
         self._tmp.cleanup()

@@ -22,6 +22,22 @@ def run(coro):
     return asyncio.run(coro)
 
 
+@pytest.fixture(autouse=True, params=["cpu", "device"])
+def _checksum_backend(request, monkeypatch):
+    """Run the whole suite under both codec backends (the north-star seam):
+    cpu host CRC and the micro-batched device path (interpret mode on the
+    CPU test platform; the real chip in prod).  UnitTestFabric-style suite
+    parameterization (tests/lib/UnitTestFabric.h:86-163)."""
+    if request.param == "cpu":
+        monkeypatch.setattr(StorageFabric, "default_checksum_backend", "cpu")
+    else:
+        from t3fs.storage.codec_backend import DeviceChecksumBackend
+        monkeypatch.setattr(
+            StorageFabric, "default_checksum_backend",
+            staticmethod(lambda: DeviceChecksumBackend(
+                min_device_bytes=0, max_wait_us=200)))
+
+
 def make_write(fabric, cid, data, *, offset=0, seq=1, channel=7,
                update_ver=0, chunk_size=4096):
     return WriteReq(io=UpdateIO(
