@@ -176,16 +176,19 @@ class ChunkReplica:
 
     # --- read path ---
 
-    def read(self, io: ReadIO) -> tuple[IOResult, bytes]:
+    def read(self, io: ReadIO,
+             meta_hint: "ChunkMeta | None" = None) -> tuple[IOResult, bytes]:
         # Optimistic meta validation: reads run concurrently with the update
         # worker (no chunk lock), and engine.get_meta + engine.read are two
         # separately-locked calls — re-check the meta after the data read and
         # retry if an update slipped between them, so the returned bytes
         # always pair with the returned versions/checksum (each engine call
         # is internally atomic; any concurrent put bumps update_ver or
-        # changes the checksum).
-        for _ in range(8):
-            meta = self.engine.get_meta(io.chunk_id)
+        # changes the checksum).  meta_hint lets the caller reuse a meta it
+        # already fetched (sizing decisions) instead of a second lookup.
+        for attempt in range(8):
+            meta = meta_hint if attempt == 0 and meta_hint is not None \
+                else self.engine.get_meta(io.chunk_id)
             if meta is None:
                 raise make_error(StatusCode.CHUNK_NOT_FOUND, str(io.chunk_id))
             if meta.state == ChunkState.DIRTY and not io.allow_uncommitted:

@@ -198,6 +198,7 @@ class DeviceChecksumBackend(ChecksumBackend):
 
             from t3fs.ops.pallas_codec import make_crc32c_words_raw
 
+            _enable_persistent_cache()
             if self._interpret is None:
                 self._interpret = jax.devices()[0].platform != "tpu"
             fn = jax.jit(make_crc32c_words_raw(
@@ -205,13 +206,32 @@ class DeviceChecksumBackend(ChecksumBackend):
             self._fns[chunk_words] = fn
         return fn
 
+    @staticmethod
+    def _n_bucket(n_items: int) -> int:
+        """Pad batch rows to powers of FOUR: bounds compiled shapes per
+        bucket to {1,4,16,64} (first-hit kernel compiles are ~10s even with
+        the persistent cache; per-2x padding waste is compute on zero rows)."""
+        n = 1
+        while n < n_items:
+            n <<= 2
+        return n
+
+    def warmup(self, payload_sizes: list[int]) -> None:
+        """Precompile (and persist) the kernels for the given payload sizes
+        across all n-buckets — call off-path (bench setup, server start)."""
+        for size in payload_sizes:
+            chunk_words = self._bucket_words(size)
+            nb = 1
+            while nb <= self.max_batch:
+                arr = np.zeros((nb, chunk_words), dtype=np.uint32)
+                np.asarray(self._fn(chunk_words)(arr))
+                nb <<= 2
+
     def _flush(self, groups: dict[int, list[_Pending]]) -> None:
         """Runs in the codec thread: one device call per bucket."""
         mats = default_matrices()
         for chunk_words, items in groups.items():
-            n = 1
-            while n < len(items):
-                n <<= 1
+            n = self._n_bucket(len(items))
             arr = np.zeros((n, chunk_words * 4), dtype=np.uint8)
             for i, item in enumerate(items):
                 # FRONT-pad: raw CRC is zero-preserving
@@ -223,6 +243,30 @@ class DeviceChecksumBackend(ChecksumBackend):
                 crc = int(raw[i]) ^ mats.affine_const(len(item.data))
                 item.loop.call_soon_threadsafe(
                     _set_result_safe, item.future, crc)
+
+
+_cache_enabled = False
+
+
+def _enable_persistent_cache() -> None:
+    """Point JAX at an on-disk executable cache so kernel compiles are paid
+    once per machine, not once per process (first 4 MiB-bucket compile is
+    ~10 s — fatal to a freshly started storage node's latency otherwise)."""
+    global _cache_enabled
+    if _cache_enabled:
+        return
+    _cache_enabled = True
+    import os
+
+    import jax
+
+    if jax.config.jax_compilation_cache_dir is None:
+        path = os.environ.get(
+            "T3FS_JAX_CACHE",
+            os.path.join(os.path.expanduser("~"), ".cache", "t3fs-jax"))
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
 
 def make_closed_error() -> Exception:

@@ -90,6 +90,13 @@ class StorageServer:
             refresh_period_s=self.heartbeat_period_s)
         await self.mgmtd.start()
         await self.resync.start()
+        if hasattr(self.node.codec, "warmup"):
+            # precompile common chunk-size buckets in the background so the
+            # first write doesn't eat a ~10s kernel compile on the hot path
+            # (results persist in the on-disk jax cache across restarts)
+            self._warmup_task = asyncio.get_running_loop().run_in_executor(
+                None, self.node.codec.warmup,
+                [64 << 10, 512 << 10, 1 << 20, 4 << 20])
         log.info("storage node %d up at %s", self.node_id, self.server.address)
 
     async def stop(self) -> None:

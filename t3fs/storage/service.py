@@ -43,6 +43,10 @@ from t3fs.utils.tracing import add_event as trace_add
 
 log = logging.getLogger("t3fs.storage")
 
+# reads at or below this run inline on the event loop (thread hop costs more
+# than the read); larger ones go through the bounded read pool
+SMALL_READ_INLINE_BYTES = 64 << 10
+
 
 class StorageTarget:
     """One target (disk) = chunk engine + CRAQ replica + per-chunk locks.
@@ -363,9 +367,20 @@ class StorageService:
             node.read_count.add()
             try:
                 chain, target = node._check_chain(io.chain_id, 0)
-                async with node._read_sem:
-                    result, data = await asyncio.to_thread(
-                        target.replica.read, io)
+                # small IOs run inline: the thread hop costs more than the
+                # read itself (KVCache-style 4-64 KiB random reads); large
+                # reads hop to a worker so they can't stall the event loop
+                meta_hint = None
+                length_hint = io.length
+                if not length_hint:
+                    meta_hint = target.engine.get_meta(io.chunk_id)
+                    length_hint = meta_hint.length if meta_hint else 0
+                if length_hint <= SMALL_READ_INLINE_BYTES:
+                    result, data = target.replica.read(io, meta_hint)
+                else:
+                    async with node._read_sem:
+                        result, data = await asyncio.to_thread(
+                            target.replica.read, io, meta_hint)
                 if io.buf is not None:
                     await remote_write(conn, io.buf.slice(0, len(data)), data)
                     return result, None

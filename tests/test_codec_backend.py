@@ -75,7 +75,10 @@ def test_close_fails_inflight_futures():
         task = asyncio.ensure_future(b.payload_crc(data))
         await asyncio.sleep(0.05)  # worker collects the item, waits for more
         await b.close()
-        with pytest.raises(Exception):
+        # must be the backend-closed error, NOT wait_for's TimeoutError —
+        # a hanging future (the bug this guards) would otherwise still pass
+        from t3fs.utils.status import StatusError
+        with pytest.raises(StatusError, match="closed"):
             await asyncio.wait_for(task, timeout=2)
     run(body())
 
