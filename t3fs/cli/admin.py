@@ -147,6 +147,68 @@ async def routing(ctx: AdminContext, args) -> None:
     print(_fmt_table(rows, ["chain", "ver", "target", "node", "state"]))
 
 
+def _print_chain(chain) -> None:
+    print(f"chain {chain.chain_id} v{chain.chain_ver}: " + " -> ".join(
+        f"t{t.target_id}@n{t.node_id}[{t.public_state.name}]"
+        for t in chain.targets)
+        + (f" preferred={chain.preferred_target_order}"
+           if chain.preferred_target_order else ""))
+
+
+@command("rotate-lastsrv", "rotate a chain's LASTSRV holder (RotateLastSrv)")
+@args_(("chain_id", {"type": int}))
+async def rotate_lastsrv(ctx: AdminContext, args) -> None:
+    from t3fs.mgmtd.service import ChainOpReq
+    rsp, _ = await ctx.cli.call(ctx.mgmtd_address, "Mgmtd.rotate_last_srv",
+                                ChainOpReq(chain_id=args.chain_id))
+    _print_chain(rsp.chain)
+
+
+@command("update-chain", "add/remove a target on a chain (UpdateChain)")
+@args_(("chain_id", {"type": int}), ("mode", {"choices": ["add", "remove"]}),
+       ("target_id", {"type": int}),
+       ("--node", {"type": int, "default": 0, "help": "node id (add mode)"}))
+async def update_chain(ctx: AdminContext, args) -> None:
+    from t3fs.mgmtd.service import ChainOpReq
+    rsp, _ = await ctx.cli.call(
+        ctx.mgmtd_address, "Mgmtd.update_chain",
+        ChainOpReq(chain_id=args.chain_id, target_id=args.target_id,
+                   node_id=args.node, mode=args.mode))
+    _print_chain(rsp.chain)
+
+
+@command("set-preferred-order", "set a chain's preferred target order")
+@args_(("chain_id", {"type": int}),
+       ("order", {"nargs": "+", "type": int}))
+async def set_preferred_order(ctx: AdminContext, args) -> None:
+    from t3fs.mgmtd.service import ChainOpReq
+    rsp, _ = await ctx.cli.call(
+        ctx.mgmtd_address, "Mgmtd.set_preferred_target_order",
+        ChainOpReq(chain_id=args.chain_id, order=list(args.order)))
+    _print_chain(rsp.chain)
+
+
+@command("rotate-preferred", "one rotation step toward the preferred order")
+@args_(("chain_id", {"type": int}))
+async def rotate_preferred(ctx: AdminContext, args) -> None:
+    from t3fs.mgmtd.service import ChainOpReq
+    rsp, _ = await ctx.cli.call(
+        ctx.mgmtd_address, "Mgmtd.rotate_as_preferred_order",
+        ChainOpReq(chain_id=args.chain_id))
+    _print_chain(rsp.chain)
+
+
+@command("client-sessions", "registered client sessions (ListClientSessions)")
+async def client_sessions(ctx: AdminContext, args) -> None:
+    rsp, _ = await ctx.cli.call(ctx.mgmtd_address,
+                                "Mgmtd.list_client_sessions", None)
+    now = time.time()
+    rows = [[s.client_id, s.description,
+             f"{now - s.start:.0f}s" if s.start else "-",
+             f"{now - s.last_extend:.1f}s"] for s in rsp.sessions]
+    print(_fmt_table(rows, ["client", "description", "age", "extend-age"]))
+
+
 @command("gen-chains", "generate + optionally install a chain table")
 @args_(("--nodes", {"required": True,
                     "help": "comma-separated storage node ids"}),

@@ -13,9 +13,11 @@ import time
 from typing import Callable
 
 from t3fs.mgmtd.service import (
-    GetRoutingInfoReq, HeartbeatReq,
+    ClientSessionReq, GetRoutingInfoReq, HeartbeatReq,
 )
-from t3fs.mgmtd.types import LocalTargetState, NodeInfo, RoutingInfo
+from t3fs.mgmtd.types import (
+    ClientSession, LocalTargetState, NodeInfo, RoutingInfo,
+)
 from t3fs.net.client import Client
 from t3fs.utils.status import StatusError
 
@@ -26,16 +28,43 @@ class MgmtdClient:
     """ForClient role: keeps a fresh RoutingInfo cache."""
 
     def __init__(self, mgmtd_address: str, client: Client | None = None,
-                 refresh_period_s: float = 0.5):
+                 refresh_period_s: float = 0.5, client_id: str = "",
+                 description: str = ""):
         self.mgmtd_address = mgmtd_address
         self.client = client or Client()
         self.refresh_period_s = refresh_period_s
+        # non-empty client_id opts into mgmtd client-session tracking
+        # (fbs/mgmtd/ClientSession.h); extended on its own cadence, NOT per
+        # refresh tick — a KV write per 0.5s per client to maintain a 60s
+        # TTL would be ~40x the needed write load
+        self.client_id = client_id
+        self.description = description
+        self.session_extend_period_s = 20.0
+        self._last_extend_sent = 0.0
         self._routing = RoutingInfo(version=0)
         self._task: asyncio.Task | None = None
         self._stopped = asyncio.Event()
 
     def routing(self) -> RoutingInfo:
         return self._routing
+
+    async def extend_session(self) -> None:
+        if not self.client_id:
+            return
+        now = time.time()
+        if now - self._last_extend_sent < self.session_extend_period_s:
+            return
+        self._last_extend_sent = now
+        try:
+            await self.client.call(
+                self.mgmtd_address, "Mgmtd.extend_client_session",
+                ClientSessionReq(session=ClientSession(
+                    client_id=self.client_id,
+                    universal_id=self.client_id,
+                    description=self.description)),
+                timeout=5.0)
+        except StatusError as e:
+            log.warning("client session extend failed: %s", e)
 
     async def refresh(self) -> RoutingInfo:
         try:
@@ -57,6 +86,7 @@ class MgmtdClient:
         while not self._stopped.is_set():
             await asyncio.sleep(self.refresh_period_s)
             await self.refresh()
+            await self.extend_session()
 
     async def stop(self) -> None:
         self._stopped.set()

@@ -17,6 +17,8 @@ class KeyPrefix(bytes, enum.Enum):
     IDEMPOTENT = b"IDEM"         # meta request dedupe records
     ALLOCATOR = b"ALOC"          # inode-id allocator state
     USER = b"USER"
+    CLIENT_SESSION = b"CSES"     # mgmtd client sessions (fbs/mgmtd/ClientSession.h)
+    TARGET_INFO = b"TGTI"        # mgmtd per-target info (MgmtdTargetInfoPersister)
 
     def key(self, *parts: bytes) -> bytes:
         return self.value + b"".join(parts)

@@ -21,8 +21,8 @@ from dataclasses import dataclass, field
 from t3fs.kv.engine import KVEngine, with_transaction
 from t3fs.kv.prefixes import KeyPrefix
 from t3fs.mgmtd.types import (
-    ChainInfo, ChainTable, ChainTargetInfo, LocalTargetState, NodeInfo,
-    PublicTargetState, RoutingInfo,
+    ChainInfo, ChainTable, ChainTargetInfo, ClientSession, LocalTargetState,
+    NodeInfo, PublicTargetState, RoutingInfo,
 )
 from t3fs.net.server import rpc_method, service
 from t3fs.utils import serde
@@ -123,6 +123,8 @@ class MgmtdConfig(ConfigBase):
     chains_update_period_s: float = citem(0.25, validator=lambda v: v > 0)
     lease_ttl_s: float = citem(10.0, validator=lambda v: v > 0)
     lease_extend_period_s: float = citem(3.0, validator=lambda v: v > 0)
+    client_session_ttl_s: float = citem(60.0, validator=lambda v: v > 0)
+    sessions_check_period_s: float = citem(5.0, validator=lambda v: v > 0)
 
 
 class MgmtdState:
@@ -136,6 +138,7 @@ class MgmtdState:
         self.cfg = cfg
         self.last_heartbeat: dict[int, float] = {}
         self.local_states: dict[int, LocalTargetState] = {}   # target -> state
+        self._persisted_states: dict[int, LocalTargetState] = {}
         # targets whose node silently restarted: demote from SERVING so they
         # resync (cleared by the chains updater AFTER a successful save)
         self.restarted_targets: set[int] = set()
@@ -197,8 +200,26 @@ class MgmtdState:
                                   KeyPrefix.CHAIN_TABLE.value + b"\xff", snapshot=True):
             t: ChainTable = serde.loads(v)
             info.chain_tables[t.table_id] = t
+        if not self.local_states:
+            # fresh/failed-over mgmtd: seed target info from the persisted
+            # blob (heartbeats overwrite it live)
+            raw = await txn.get(KeyPrefix.TARGET_INFO.key(), snapshot=True)
+            if raw:
+                blob: "TargetInfoBlob" = serde.loads(raw)
+                self.local_states = {int(k2): LocalTargetState(v2)
+                                     for k2, v2 in blob.states.items()}
         self._routing_cache = info
         return info
+
+    async def persist_target_info(self) -> None:
+        """Persist the current per-target local states (one blob)."""
+        states = dict(self.local_states)
+
+        async def txn_fn(txn):
+            txn.set(KeyPrefix.TARGET_INFO.key(),
+                    serde.dumps(TargetInfoBlob(states=states)))
+        await with_transaction(self.kv, txn_fn)
+        self._persisted_states = states
 
     def routing(self) -> RoutingInfo:
         return self._routing_cache or RoutingInfo()
@@ -210,23 +231,59 @@ class MgmtdState:
 
     async def save_chains(self, chains: list[ChainInfo],
                           tables: list[ChainTable] = (),
-                          nodes: list[NodeInfo] = ()) -> None:
+                          nodes: list[NodeInfo] = (),
+                          guard_versions: bool = True) -> list[int]:
         """Persist chains (+tables, +node records) in ONE transaction — the
         nodes ride along so e.g. a restart-demotion and the node's new
-        generation become durable together."""
+        generation become durable together.
+
+        Each chain write is CAS-guarded inside the transaction: a chain is
+        only stored if the persisted version is exactly new_ver - 1.  The
+        chains updater and the admin chain-surgery ops both read-modify-write
+        from the in-memory routing cache, so without the guard whichever
+        transaction commits second would silently revert the first (both
+        also touch ROUTING_VER, so SSI aborts one — but with_transaction
+        re-runs the closure with the same stale pre-computed value; the
+        in-txn version check is what makes the retry correct).  Returns the
+        chain ids actually written; skipped chains signal a lost race —
+        callers recompute from fresh routing.  guard_versions=False is for
+        installing chains wholesale (admin set_chains)."""
+        written: list[int] = []
+
         async def txn_fn(txn):
+            written.clear()
+            any_write = False
+            skipped = False
             for c in chains:
-                txn.set(KeyPrefix.CHAIN.key(str(c.chain_id).encode()), serde.dumps(c))
+                key = KeyPrefix.CHAIN.key(str(c.chain_id).encode())
+                if guard_versions:
+                    raw = await txn.get(key)
+                    cur_ver = serde.loads(raw).chain_ver if raw else 0
+                    if cur_ver != c.chain_ver - 1:
+                        skipped = True
+                        continue  # someone else advanced this chain: skip
+                txn.set(key, serde.dumps(c))
+                written.append(c.chain_id)
+                any_write = True
             for t in tables or ():
                 txn.set(KeyPrefix.CHAIN_TABLE.key(str(t.table_id).encode()),
                         serde.dumps(t))
-            for n in nodes or ():
-                txn.set(KeyPrefix.NODE.key(str(n.node_id).encode()),
-                        serde.dumps(n))
-            raw = await txn.get(KeyPrefix.ROUTING_VER.key())
-            txn.set(KeyPrefix.ROUTING_VER.key(), str(int(raw or 1) + 1).encode())
+                any_write = True
+            if not skipped:
+                # node-generation records ride ONLY when every guarded chain
+                # landed: persisting a restarted node's generation without
+                # its demotions would lose restart detection on a failover
+                for n in nodes or ():
+                    txn.set(KeyPrefix.NODE.key(str(n.node_id).encode()),
+                            serde.dumps(n))
+                    any_write = True
+            if any_write:
+                raw = await txn.get(KeyPrefix.ROUTING_VER.key())
+                txn.set(KeyPrefix.ROUTING_VER.key(),
+                        str(int(raw or 1) + 1).encode())
         await with_transaction(self.kv, txn_fn)
         await self.load_routing()
+        return written
 
     def node_alive(self, node_id: int) -> bool:
         now = time.time()
@@ -323,7 +380,87 @@ def next_chain_state(chain: ChainInfo,
              PublicTargetState.LASTSRV: 2, PublicTargetState.WAITING: 3,
              PublicTargetState.OFFLINE: 4}
     targets.sort(key=lambda t: order[t.public_state])
-    return ChainInfo(chain.chain_id, chain.chain_ver + 1, targets)
+    return ChainInfo(chain.chain_id, chain.chain_ver + 1, targets,
+                     list(chain.preferred_target_order))
+
+
+def rotate_last_srv(targets: list[ChainTargetInfo]) -> list[ChainTargetInfo]:
+    """Operator chain surgery when the LASTSRV holder is gone for good
+    (updateChain.cc:143-163): move the LASTSRV head to the tail, designate
+    the next target as the new authoritative LASTSRV, everything else
+    OFFLINE.  No-op unless the head is LASTSRV and the chain has >= 2."""
+    if len(targets) < 2 or targets[0].public_state != PublicTargetState.LASTSRV:
+        return targets
+    new = [ChainTargetInfo(t.target_id, t.node_id, t.public_state)
+           for t in targets[1:]]
+    moved = targets[0]
+    new.append(ChainTargetInfo(moved.target_id, moved.node_id,
+                               PublicTargetState.OFFLINE))
+    new[0].public_state = PublicTargetState.LASTSRV
+    for t in new[1:]:
+        t.public_state = PublicTargetState.OFFLINE
+    return new
+
+
+def rotate_as_preferred_order(targets: list[ChainTargetInfo],
+                              preferred: list[int]) -> list[ChainTargetInfo]:
+    """One step toward the operator-preferred order (updateChain.cc:106-141):
+    find the first position whose current target differs from the preferred
+    one; if that target is SERVING, rotate it to the tail as OFFLINE (it will
+    resync back in at the tail).  Repeated invocations converge the chain to
+    the preferred order one resync cycle at a time."""
+    pos = {t.target_id: i for i, t in enumerate(targets)}
+    for i, want in enumerate(preferred):
+        if want not in pos:
+            continue
+        if pos[want] == i:
+            continue
+        cur = targets[i]
+        if cur.public_state != PublicTargetState.SERVING:
+            break
+        new = [ChainTargetInfo(t.target_id, t.node_id, t.public_state)
+               for j, t in enumerate(targets) if j != i]
+        new.append(ChainTargetInfo(cur.target_id, cur.node_id,
+                                   PublicTargetState.OFFLINE))
+        return new
+    return targets
+
+
+@serde_struct
+@dataclass
+class ChainOpReq:
+    chain_id: int = 0
+    target_id: int = 0           # update_chain only
+    node_id: int = 0             # update_chain ADD only
+    mode: str = ""               # update_chain: "add" | "remove"
+    order: list[int] = field(default_factory=list)  # set_preferred_target_order
+
+
+@serde_struct
+@dataclass
+class ChainRsp:
+    chain: ChainInfo | None = None
+
+
+@serde_struct
+@dataclass
+class TargetInfoBlob:
+    """Persisted per-target local states (MgmtdTargetInfoPersister analog):
+    a restarted/failed-over mgmtd reloads the last known target info instead
+    of starting blind until heartbeats repopulate it."""
+    states: dict[int, LocalTargetState] = field(default_factory=dict)
+
+
+@serde_struct
+@dataclass
+class ClientSessionReq:
+    session: ClientSession | None = None
+
+
+@serde_struct
+@dataclass
+class ListClientSessionsRsp:
+    sessions: list[ClientSession] = field(default_factory=list)
 
 
 @service("Mgmtd")
@@ -381,7 +518,8 @@ class MgmtdService:
     async def set_chains(self, req: SetChainsReq, payload, conn):
         """Admin op: install chains/chain tables (UploadChainTable analog)."""
         await self._require_primary()
-        await self.state.save_chains(req.chains, req.tables)
+        await self.state.save_chains(req.chains, req.tables,
+                                     guard_versions=False)
         return OkRsp(), b""
 
     @rpc_method
@@ -402,6 +540,143 @@ class MgmtdService:
         """Who is primary (MgmtdLeaseInfo analog)."""
         lease = await self.state.lease_info()
         return lease, b""
+
+    # ---- chain surgery (admin ops) ----
+
+    async def _load_chain(self, chain_id: int) -> ChainInfo:
+        chain = self.state.routing().chain(chain_id)
+        if chain is None:
+            raise make_error(StatusCode.TARGET_NOT_FOUND, f"chain {chain_id}")
+        return chain
+
+    async def _save_chain_checked(self, chain: ChainInfo) -> None:
+        """CAS-persist one admin-modified chain; a lost race with the
+        background chains updater surfaces as a retryable conflict instead
+        of the op silently being reverted."""
+        written = await self.state.save_chains([chain])
+        if chain.chain_id not in written:
+            raise make_error(
+                StatusCode.CHAIN_VERSION_MISMATCH,
+                f"chain {chain.chain_id} changed concurrently; retry")
+
+    @rpc_method
+    async def rotate_last_srv(self, req: ChainOpReq, payload, conn):
+        """RotateLastSrvOperation analog (mgmtd/ops/RotateLastSrvOperation.cc)."""
+        await self._require_primary()
+        chain = await self._load_chain(req.chain_id)
+        new_targets = rotate_last_srv(chain.targets)
+        if new_targets is chain.targets:
+            return ChainRsp(chain=chain), b""
+        nxt = ChainInfo(chain.chain_id, chain.chain_ver + 1, new_targets,
+                        chain.preferred_target_order)
+        await self._save_chain_checked(nxt)
+        return ChainRsp(chain=nxt), b""
+
+    @rpc_method
+    async def update_chain(self, req: ChainOpReq, payload, conn):
+        """Add/remove a target (UpdateChainOperation.cc): add appends as
+        OFFLINE (it joins via resync); remove requires the target OFFLINE."""
+        await self._require_primary()
+        chain = await self._load_chain(req.chain_id)
+        if not req.target_id:
+            raise make_error(StatusCode.INVALID_ARG, "empty target id")
+        targets = [ChainTargetInfo(t.target_id, t.node_id, t.public_state)
+                   for t in chain.targets]
+        preferred = list(chain.preferred_target_order)
+        if req.mode == "add":
+            for c in self.state.routing().chains.values():
+                if any(t.target_id == req.target_id for t in c.targets):
+                    raise make_error(StatusCode.INVALID_ARG,
+                                     f"target {req.target_id} already in chain "
+                                     f"{c.chain_id}")
+            targets.append(ChainTargetInfo(req.target_id, req.node_id,
+                                           PublicTargetState.OFFLINE))
+            if len(preferred) == len(targets) - 1:
+                preferred.append(req.target_id)
+        elif req.mode == "remove":
+            hit = [t for t in targets if t.target_id == req.target_id]
+            if not hit:
+                raise make_error(StatusCode.TARGET_NOT_FOUND,
+                                 f"target {req.target_id} not in chain")
+            if hit[0].public_state != PublicTargetState.OFFLINE:
+                raise make_error(
+                    StatusCode.INVALID_ARG,
+                    f"target {req.target_id} is {hit[0].public_state.name}, "
+                    "only OFFLINE targets can be removed")
+            targets = [t for t in targets if t.target_id != req.target_id]
+            preferred = [t for t in preferred if t != req.target_id]
+        else:
+            raise make_error(StatusCode.INVALID_ARG, f"mode {req.mode!r}")
+        nxt = ChainInfo(chain.chain_id, chain.chain_ver + 1, targets, preferred)
+        await self._save_chain_checked(nxt)
+        return ChainRsp(chain=nxt), b""
+
+    @rpc_method
+    async def set_preferred_target_order(self, req: ChainOpReq, payload, conn):
+        """SetPreferredTargetOrderOperation analog: record the operator's
+        desired order; rotate_as_preferred_order walks the chain toward it."""
+        await self._require_primary()
+        chain = await self._load_chain(req.chain_id)
+        have = {t.target_id for t in chain.targets}
+        if set(req.order) != have:
+            raise make_error(StatusCode.INVALID_ARG,
+                             f"order {req.order} != chain targets {sorted(have)}")
+        nxt = ChainInfo(chain.chain_id, chain.chain_ver + 1,
+                        chain.targets, list(req.order))
+        await self._save_chain_checked(nxt)
+        return ChainRsp(chain=nxt), b""
+
+    @rpc_method
+    async def rotate_as_preferred_order(self, req: ChainOpReq, payload, conn):
+        """One rotation step toward the preferred order
+        (RotateAsPreferredOrderOperation.cc analog)."""
+        await self._require_primary()
+        chain = await self._load_chain(req.chain_id)
+        if not chain.preferred_target_order:
+            return ChainRsp(chain=chain), b""
+        new_targets = rotate_as_preferred_order(
+            chain.targets, chain.preferred_target_order)
+        if new_targets is chain.targets:
+            return ChainRsp(chain=chain), b""
+        nxt = ChainInfo(chain.chain_id, chain.chain_ver + 1, new_targets,
+                        chain.preferred_target_order)
+        await self._save_chain_checked(nxt)
+        return ChainRsp(chain=nxt), b""
+
+    # ---- client sessions ----
+
+    @rpc_method
+    async def extend_client_session(self, req: ClientSessionReq, payload, conn):
+        """Register/extend a client session (ExtendClientSessionOperation
+        analog); sessions are persisted so a mgmtd failover keeps them."""
+        await self._require_primary()
+        s = req.session
+        if s is None or not s.client_id:
+            raise make_error(StatusCode.INVALID_ARG, "empty session")
+        now = time.time()
+        s.last_extend = now
+
+        async def op(txn):
+            key = KeyPrefix.CLIENT_SESSION.key(s.client_id.encode())
+            raw = await txn.get(key)
+            if raw is not None:
+                prev: ClientSession = serde.loads(raw)
+                s.start = prev.start or now
+            else:
+                s.start = s.start or now
+            txn.set(key, serde.dumps(s))
+        await with_transaction(self.state.kv, op)
+        return OkRsp(), b""
+
+    @rpc_method
+    async def list_client_sessions(self, req, payload, conn):
+        async def op(txn):
+            return await txn.get_range(
+                KeyPrefix.CLIENT_SESSION.value,
+                KeyPrefix.CLIENT_SESSION.value + b"\xff", snapshot=True)
+        rows = await with_transaction(self.state.kv, op)
+        return ListClientSessionsRsp(
+            sessions=[serde.loads(v) for _, v in rows]), b""
 
     @rpc_method
     async def set_config_template(self, req: SetConfigTemplateReq, payload, conn):
@@ -454,6 +729,8 @@ class MgmtdServer:
         self._tasks = [
             asyncio.create_task(self._chains_updater(), name="mgmtd-chains"),
             asyncio.create_task(self._lease_extender(), name="mgmtd-lease"),
+            asyncio.create_task(self._sessions_checker(),
+                                name="mgmtd-sessions"),
         ]
 
     async def stop(self) -> None:
@@ -486,31 +763,78 @@ class MgmtdServer:
             except Exception:
                 log.exception("chains updater failed")
 
+    async def _sessions_checker(self) -> None:
+        """Prune client sessions whose lease expired
+        (MgmtdClientSessionsChecker analog)."""
+        while not self._stopped.is_set():
+            await asyncio.sleep(self.cfg.sessions_check_period_s)
+            try:
+                if not await self.state.is_primary():
+                    continue
+                await self.prune_client_sessions_once()
+            except Exception:
+                log.exception("sessions checker failed")
+
+    async def prune_client_sessions_once(self) -> int:
+        """Remove expired sessions; returns count pruned (test hook)."""
+        cutoff = time.time() - self.cfg.client_session_ttl_s
+        kv = self.state.kv
+
+        async def op(txn):
+            rows = await txn.get_range(
+                KeyPrefix.CLIENT_SESSION.value,
+                KeyPrefix.CLIENT_SESSION.value + b"\xff")
+            dead = []
+            for k, v in rows:
+                s: ClientSession = serde.loads(v)
+                if s.last_extend < cutoff:
+                    txn.clear(k)
+                    dead.append(s.client_id)
+            return dead
+        dead = await with_transaction(kv, op)
+        if dead:
+            log.info("pruned %d expired client sessions: %s",
+                     len(dead), dead[:8])
+        return len(dead)
+
     async def update_chains_once(self) -> int:
-        """One updater tick; returns number of chains changed (test hook)."""
+        """One updater tick; returns number of chains changed (test hook).
+
+        Recomputes and retries when a CAS-guarded save loses a race with an
+        admin chain op (save_chains skips chains whose persisted version
+        moved; node generations only ride on a fully-clean save)."""
         st = self.state
-        routing = st.routing()
-        updated = []
-        handled: set[int] = set()
-        for chain in routing.chains.values():
-            alive = {t.node_id: st.node_alive(t.node_id) for t in chain.targets}
-            nxt = next_chain_state(chain, alive, st.local_states,
-                                   restarted=st.restarted_targets)
-            handled |= {t.target_id for t in chain.targets} \
-                & st.restarted_targets
-            if nxt is not None:
-                updated.append(nxt)
-                log.info("chain %d v%d -> v%d: %s", nxt.chain_id,
-                         chain.chain_ver, nxt.chain_ver,
-                         [(t.target_id, t.public_state.name) for t in nxt.targets])
-        pending_nodes = list(st.pending_node_saves.values())
-        if updated or pending_nodes:
-            # demotions and the new node generations land in ONE txn
-            await st.save_chains(updated, nodes=pending_nodes)
-        # only forget restart flags once the demotions are durably saved —
-        # dropping them before a failed save would leave a stale node
-        # serving forever
-        st.restarted_targets -= handled
-        for n in pending_nodes:
-            st.pending_node_saves.pop(n.node_id, None)
-        return len(updated)
+        for _ in range(3):
+            routing = st.routing()
+            updated = []
+            handled: set[int] = set()
+            for chain in routing.chains.values():
+                alive = {t.node_id: st.node_alive(t.node_id)
+                         for t in chain.targets}
+                nxt = next_chain_state(chain, alive, st.local_states,
+                                       restarted=st.restarted_targets)
+                handled |= {t.target_id for t in chain.targets} \
+                    & st.restarted_targets
+                if nxt is not None:
+                    updated.append(nxt)
+                    log.info("chain %d v%d -> v%d: %s", nxt.chain_id,
+                             chain.chain_ver, nxt.chain_ver,
+                             [(t.target_id, t.public_state.name)
+                              for t in nxt.targets])
+            pending_nodes = list(st.pending_node_saves.values())
+            if updated or pending_nodes:
+                # demotions and the new node generations land in ONE txn
+                written = await st.save_chains(updated, nodes=pending_nodes)
+                if len(written) < len(updated):
+                    continue  # admin op won the race: recompute from fresh
+            # only forget restart flags once the demotions are durably
+            # saved — dropping them before a failed save would leave a
+            # stale node serving forever
+            st.restarted_targets -= handled
+            for n in pending_nodes:
+                st.pending_node_saves.pop(n.node_id, None)
+            if st.local_states != st._persisted_states:
+                # target-info persistence (MgmtdTargetInfoPersister analog)
+                await st.persist_target_info()
+            return len(updated)
+        return 0

@@ -53,6 +53,10 @@ class ChainInfo:
     targets: list[ChainTargetInfo] = field(default_factory=list)
     # targets are in chain order: head first; only SERVING targets form the
     # live chain, SYNCING follow, WAITING/OFFLINE tail out (design_notes 201-231)
+    # operator-preferred target order (fbs/mgmtd ChainInfo.preferredTargetOrder):
+    # rotate_as_preferred_order nudges the chain back toward it one resync
+    # cycle at a time; empty = no preference
+    preferred_target_order: list[int] = field(default_factory=list)
 
     def serving(self) -> list[ChainTargetInfo]:
         return [t for t in self.targets if t.public_state == PublicTargetState.SERVING]
@@ -97,6 +101,19 @@ class ChainTable:
     (fbs/mgmtd/ChainTable.h analog)."""
     table_id: int = 1
     chain_ids: list[int] = field(default_factory=list)
+
+
+@serde_struct
+@dataclass
+class ClientSession:
+    """A registered client (FUSE daemon, bench, library user) with a lease
+    the MgmtdClientSessionsChecker analog prunes (fbs/mgmtd/ClientSession.h:12,
+    mgmtd/background/MgmtdClientSessionsChecker.h)."""
+    client_id: str = ""
+    universal_id: str = ""       # host identity (survives client restart)
+    description: str = ""
+    start: float = 0.0
+    last_extend: float = 0.0
 
 
 @serde_struct

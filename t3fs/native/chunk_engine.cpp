@@ -225,18 +225,34 @@ class Engine {
            uint64_t chunk_size, const Meta& meta) {
     uint32_t lg = class_log2(std::max<uint64_t>(chunk_size, len));
     if (!lg) return fail("bad chunk size");
-    std::unique_lock lk(mu_);
-    SizeClass& sc = get_class(lg);
-    if (sc.fd < 0) return false;
-    uint64_t block = allocate(sc);
+    // COW: reserve the block under the lock, then write+sync the data with
+    // the lock RELEASED — the fresh block is invisible to readers until the
+    // index flip, and holding the exclusive lock across fdatasync (possibly
+    // hundreds of ms) would stall every shared-lock reader on the target.
+    uint64_t block;
+    int data_fd;
     uint64_t bs = 1ull << lg;
-    if (pwrite_all(sc.fd, data, len, block * bs) < 0)
-      { release(sc, block); return fail("pwrite data"); }
-    if (sync_writes && ::fdatasync(sc.fd) != 0)
-      { release(sc, block); return fail("fdatasync data"); }
+    {
+      std::unique_lock lk(mu_);
+      SizeClass& sc = get_class(lg);
+      if (sc.fd < 0) return false;
+      block = allocate(sc);
+      data_fd = sc.fd;
+    }
+    if (pwrite_all(data_fd, data, len, block * bs) < 0) {
+      std::unique_lock lk(mu_);
+      release(get_class(lg), block);
+      return fail("pwrite data");
+    }
+    if (sync_writes && ::fdatasync(data_fd) != 0) {
+      std::unique_lock lk(mu_);
+      release(get_class(lg), block);
+      return fail("fdatasync data");
+    }
     Slot s{lg, block, meta};
     s.meta.length = len;
-    if (!wal_append_put(cid, s)) { release(sc, block); return false; }
+    std::unique_lock lk(mu_);
+    if (!wal_append_put(cid, s)) { release(get_class(lg), block); return false; }
     auto it = index_.find(cid);
     if (it != index_.end()) {
       release(get_class(it->second.size_class_log2), it->second.block);

@@ -148,19 +148,28 @@ class ChunkEngine:
 
     def put(self, chunk_id: ChunkId, content: bytes, meta: ChunkMeta,
             chunk_size: int) -> None:
-        """COW write: new block + atomic metadata flip; old block freed."""
+        """COW write: new block + atomic metadata flip; old block freed.
+
+        The data pwrite/fsync runs OUTSIDE the lock: the fresh block was
+        reserved under the lock and is invisible to readers until the meta
+        flip, so holding the lock across a (potentially hundreds of ms)
+        fsync would only serve to stall every reader — including inline
+        small reads on the event loop."""
         sc = size_class_of(max(chunk_size, len(content)))
         with self._lock:
-            row = self._get_row(chunk_id)
-            old = self._row_to_meta(row) if row else None
-            if old is not None and old[1] == sc:
-                # same size class: still COW into a fresh block
-                pass
             block = self._allocate(sc)
             fd = self._fd(sc)
+        try:
             os.pwrite(fd, content, block * sc)
             if self.sync_writes:
                 os.fsync(fd)
+        except OSError:
+            with self._lock:
+                self._release(sc, block)
+            raise
+        with self._lock:
+            row = self._get_row(chunk_id)
+            old = self._row_to_meta(row) if row else None
             with self._db:
                 self._db.execute(
                     "INSERT OR REPLACE INTO chunks VALUES (?,?,?,?,?,?,?,?,?)",

@@ -27,7 +27,7 @@ from __future__ import annotations
 import asyncio
 import logging
 from collections import defaultdict
-from concurrent.futures import ThreadPoolExecutor
+from concurrent.futures import CancelledError, ThreadPoolExecutor
 from dataclasses import dataclass
 
 import numpy as np
@@ -106,8 +106,9 @@ class DeviceChecksumBackend(ChecksumBackend):
         self._q: asyncio.Queue[_Pending] = asyncio.Queue()
         self._worker: asyncio.Task | None = None
         self._pool = ThreadPoolExecutor(1, thread_name_prefix="t3fs-codec")
-        self._fns: dict[tuple[int, int], object] = {}
+        self._fns: dict[int, object] = {}
         self._interpret: bool | None = None
+        self._closed = False
         self.batches = 0
         self.batched_items = 0
 
@@ -124,6 +125,7 @@ class DeviceChecksumBackend(ChecksumBackend):
         return await fut
 
     async def close(self) -> None:
+        self._closed = True
         if self._worker is not None:
             self._worker.cancel()
             try:
@@ -138,7 +140,9 @@ class DeviceChecksumBackend(ChecksumBackend):
             item = self._q.get_nowait()
             if not item.future.done():
                 item.future.set_exception(err)
-        self._pool.shutdown(wait=True)
+        # cancel_futures drops queued warmup compiles; only an in-flight
+        # one (bounded: a single compile) is waited for
+        self._pool.shutdown(wait=True, cancel_futures=True)
 
     # --- batching worker ---
 
@@ -218,14 +222,33 @@ class DeviceChecksumBackend(ChecksumBackend):
 
     def warmup(self, payload_sizes: list[int]) -> None:
         """Precompile (and persist) the kernels for the given payload sizes
-        across all n-buckets — call off-path (bench setup, server start)."""
+        across all n-buckets — call off-path (bench setup, server start).
+        Runs each compile as its own job on the codec thread so close()
+        (shutdown with cancel_futures) drops whatever hasn't started; a
+        closed backend stops compiling after at most the in-flight one."""
+        def one(chunk_words: int, nb: int) -> None:
+            if self._closed:
+                return
+            arr = np.zeros((nb, chunk_words), dtype=np.uint32)
+            np.asarray(self._fn(chunk_words)(arr))
+
+        futs = []
         for size in payload_sizes:
             chunk_words = self._bucket_words(size)
             nb = 1
             while nb <= self.max_batch:
-                arr = np.zeros((nb, chunk_words), dtype=np.uint32)
-                np.asarray(self._fn(chunk_words)(arr))
+                if self._closed:
+                    return
+                try:
+                    futs.append(self._pool.submit(one, chunk_words, nb))
+                except RuntimeError:   # pool already shut down
+                    return
                 nb <<= 2
+        for f in futs:
+            try:
+                f.result()
+            except (Exception, CancelledError):
+                return
 
     def _flush(self, groups: dict[int, list[_Pending]]) -> None:
         """Runs in the codec thread: one device call per bucket."""

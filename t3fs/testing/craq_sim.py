@@ -77,7 +77,8 @@ class WriteOp:
 
 class CraqSim:
     def __init__(self, seed: int, *, replicas: int = 3, writes: int = 6,
-                 crashes: int = 1, chunks: int = 2, wipe_on_crash: bool = False):
+                 crashes: int = 1, chunks: int = 2, wipe_on_crash: bool = False,
+                 mgmtd_restarts: int = 0):
         self.rng = random.Random(seed)
         self.seed = seed
         self.tmp = tempfile.TemporaryDirectory(prefix="craq-sim-")
@@ -101,8 +102,18 @@ class CraqSim:
         self.resync_inflight: dict[int, list] = {}   # succ target -> steps
         # generation-change detection (heartbeat NodeInfo.generation):
         # restarted targets must be demoted from SERVING even if the crash
-        # fit inside the heartbeat window
-        self.restarted_targets: set[int] = set()
+        # fit inside the heartbeat window.  The manager persists a node's
+        # generation ATOMICALLY with the demotions it implies (service.py
+        # pending_node_saves), so detection survives a mgmtd restart — the
+        # sim models this as persisted per-node generations; the in-memory
+        # restart flags are recomputed every tick from the gen mismatch.
+        self.node_gen: dict[int, int] = {n.node_id: 0
+                                         for n in self.nodes.values()}
+        self.node_gen_persisted: dict[int, int] = dict(self.node_gen)
+        self.mgmtd_restart_budget = mgmtd_restarts
+        # startup grace after a mgmtd restart: empty liveness map == treat
+        # everyone as alive for a window (MgmtdState.started_at analog)
+        self.mgmtd_grace_ticks = 0
         self.violations: list[str] = []
         # expected chunk content after each version — deterministic because
         # versions are assigned sequentially per chunk at launch time
@@ -158,6 +169,8 @@ class CraqSim:
             if not n.alive:
                 acts.append(("restart", n))
         acts.append(("mgmtd_tick", None))
+        if self.mgmtd_restart_budget > 0:
+            acts.append(("mgmtd_restart", None))
         for succ in list(self.resync_inflight):
             acts.append(("resync_step", succ))
         self._maybe_enable_resync(acts)
@@ -302,14 +315,33 @@ class CraqSim:
         # possibly stale) until resync marks it UPTODATE; the next heartbeat
         # carries a new generation, flagging the restart to mgmtd
         node.local_state = LocalTargetState.ONLINE
-        self.restarted_targets.add(node.target_id)
+        self.node_gen[node.node_id] += 1
+
+    def _do_mgmtd_restart(self, _arg) -> None:
+        """The MANAGER restarts: all in-memory liveness/restart tracking is
+        gone; persisted chains + node generations survive.  For a grace
+        window the new primary treats every node as alive (started_at
+        analog) — safety must hold through the delayed failure detection."""
+        self.mgmtd_restart_budget -= 1
+        self.mgmtd_grace_ticks = 2
 
     def _do_mgmtd_tick(self, _arg) -> None:
         alive = {n.node_id: n.alive for n in self.nodes.values()}
+        if self.mgmtd_grace_ticks > 0:
+            self.mgmtd_grace_ticks -= 1
+            alive = {nid: True for nid in alive}
         local = {n.target_id: n.local_state for n in self.nodes.values()}
-        new = next_chain_state(self.chain, alive, local,
-                               restarted=self.restarted_targets)
-        self.restarted_targets -= {t.target_id for t in self.chain.targets}
+        # restart flags derive from persisted-vs-current generation, exactly
+        # like the heartbeat handler (detection survives mgmtd restarts)
+        restarted = {n.target_id for n in self.nodes.values()
+                     if self.node_gen[n.node_id]
+                     != self.node_gen_persisted[n.node_id]}
+        new = next_chain_state(self.chain, alive, local, restarted=restarted)
+        # generation persisted atomically with the (possibly empty) chain
+        # save — mirrors update_chains_once's single-transaction behavior
+        for n in self.nodes.values():
+            if n.target_id in restarted:
+                self.node_gen_persisted[n.node_id] = self.node_gen[n.node_id]
         if new is not None:
             self.chain = new
 

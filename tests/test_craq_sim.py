@@ -46,3 +46,11 @@ def test_schedule_soak():
     assert run_schedules(150, seed0=1000, crashes=2) == {}
     assert run_schedules(100, seed0=5000, crashes=2,
                          wipe_on_crash=True, writes=10, chunks=3) == {}
+
+
+def test_mgmtd_restart_schedules():
+    """Manager restarts mid-protocol: persisted chains + node generations
+    must carry restart detection across the failover; the startup grace
+    (everyone presumed alive) must not break safety."""
+    assert run_schedules(60, crashes=1, mgmtd_restarts=1) == {}
+    assert run_schedules(40, crashes=2, mgmtd_restarts=2) == {}

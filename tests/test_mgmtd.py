@@ -154,3 +154,180 @@ def test_fast_restart_not_demoted_onto_dead_survivor():
     assert states[100] == S          # stays: sole usable copy
     # 101 was not the last serving (100 still is), so it goes OFFLINE
     assert states[101] == OFF
+
+
+# ---- operational surface (rotate/update/preferred/sessions, ref mgmtd/ops) ----
+
+def test_rotate_last_srv_pure():
+    from t3fs.mgmtd.service import rotate_last_srv
+    c = chain(LAST, OFF, OFF)
+    new = rotate_last_srv(c.targets)
+    assert [t.target_id for t in new] == [101, 102, 100]
+    assert new[0].public_state == LAST
+    assert all(t.public_state == OFF for t in new[1:])
+    # no-op when head is not LASTSRV or chain too short
+    c2 = chain(S, S)
+    assert rotate_last_srv(c2.targets) is c2.targets
+    c3 = chain(LAST)
+    assert rotate_last_srv(c3.targets) is c3.targets
+
+
+def test_rotate_as_preferred_order_pure():
+    from t3fs.mgmtd.service import rotate_as_preferred_order
+    # chain order 100,101,102 with preference 101,100,102: first mismatch at
+    # pos 0 (100 != 101), 100 is SERVING -> rotated to tail OFFLINE
+    c = chain(S, S, S)
+    new = rotate_as_preferred_order(c.targets, [101, 100, 102])
+    assert [t.target_id for t in new] == [101, 102, 100]
+    assert new[-1].public_state == OFF
+    # already in preferred order: no-op
+    c2 = chain(S, S, S)
+    assert rotate_as_preferred_order(
+        c2.targets, [100, 101, 102]) is c2.targets
+    # mismatch target not SERVING: stop (no rotation)
+    c3 = chain(SY, S, S)
+    assert rotate_as_preferred_order(
+        c3.targets, [101, 100, 102]) is c3.targets
+
+
+def test_chain_admin_ops_via_state():
+    """update_chain add/remove + set_preferred + rotate via the service."""
+    from t3fs.mgmtd.service import ChainOpReq, MgmtdService
+
+    async def body():
+        kv = MemKVEngine()
+        srv = MgmtdServer(kv, 1, "")
+        await srv.state.try_acquire_lease()
+        await srv.state.load_routing()
+        await srv.state.save_chains([chain(S, S)])
+        svc = MgmtdService(srv.state)
+
+        # add target 300 on node 9 -> appended OFFLINE
+        rsp, _ = await svc.update_chain(
+            ChainOpReq(chain_id=1, target_id=300, node_id=9, mode="add"),
+            b"", None)
+        assert [t.target_id for t in rsp.chain.targets] == [100, 101, 300]
+        assert rsp.chain.targets[-1].public_state == OFF
+
+        # duplicate add rejected
+        from t3fs.utils.status import StatusError
+        with pytest.raises(StatusError):
+            await svc.update_chain(
+                ChainOpReq(chain_id=1, target_id=300, node_id=9, mode="add"),
+                b"", None)
+
+        # remove requires OFFLINE: 100 is SERVING
+        with pytest.raises(StatusError):
+            await svc.update_chain(
+                ChainOpReq(chain_id=1, target_id=100, mode="remove"), b"", None)
+        rsp, _ = await svc.update_chain(
+            ChainOpReq(chain_id=1, target_id=300, mode="remove"), b"", None)
+        assert [t.target_id for t in rsp.chain.targets] == [100, 101]
+
+        # preferred order set + rotation step
+        rsp, _ = await svc.set_preferred_target_order(
+            ChainOpReq(chain_id=1, order=[101, 100]), b"", None)
+        assert rsp.chain.preferred_target_order == [101, 100]
+        rsp, _ = await svc.rotate_as_preferred_order(
+            ChainOpReq(chain_id=1), b"", None)
+        assert [t.target_id for t in rsp.chain.targets] == [101, 100]
+        assert rsp.chain.targets[-1].public_state == OFF
+        # preferred order survives the automatic chain state machine
+        nxt = next_chain_state(rsp.chain, {1: True, 2: True},
+                               {100: LocalTargetState.ONLINE})
+        assert nxt.preferred_target_order == [101, 100]
+    asyncio.run(body())
+
+
+def test_rotate_last_srv_rpc_and_persistence():
+    from t3fs.mgmtd.service import ChainOpReq, MgmtdService
+
+    async def body():
+        kv = MemKVEngine()
+        srv = MgmtdServer(kv, 1, "")
+        await srv.state.try_acquire_lease()
+        await srv.state.load_routing()
+        await srv.state.save_chains([chain(LAST, OFF)])
+        svc = MgmtdService(srv.state)
+        rsp, _ = await svc.rotate_last_srv(ChainOpReq(chain_id=1), b"", None)
+        assert rsp.chain.targets[0].target_id == 101
+        assert rsp.chain.targets[0].public_state == LAST
+        # a NEW state over the same KV (mgmtd restart) sees the rotation
+        st2 = MgmtdState(kv, 2, "b:1", MgmtdConfig())
+        info = await st2.load_routing()
+        assert info.chains[1].targets[0].target_id == 101
+    asyncio.run(body())
+
+
+def test_client_sessions_extend_list_prune():
+    from t3fs.mgmtd.service import ClientSessionReq, MgmtdService
+    from t3fs.mgmtd.types import ClientSession
+
+    async def body():
+        kv = MemKVEngine()
+        srv = MgmtdServer(kv, 1, "", MgmtdConfig(client_session_ttl_s=0.2))
+        await srv.state.try_acquire_lease()
+        await srv.state.load_routing()
+        svc = MgmtdService(srv.state)
+        await svc.extend_client_session(ClientSessionReq(
+            session=ClientSession(client_id="c1", description="fuse")), b"", None)
+        await svc.extend_client_session(ClientSessionReq(
+            session=ClientSession(client_id="c2")), b"", None)
+        rsp, _ = await svc.list_client_sessions(None, b"", None)
+        assert sorted(s.client_id for s in rsp.sessions) == ["c1", "c2"]
+        assert all(s.start > 0 and s.last_extend > 0 for s in rsp.sessions)
+        # extending keeps c1 alive; c2 expires
+        await asyncio.sleep(0.25)
+        await svc.extend_client_session(ClientSessionReq(
+            session=ClientSession(client_id="c1")), b"", None)
+        assert await srv.prune_client_sessions_once() == 1
+        rsp, _ = await svc.list_client_sessions(None, b"", None)
+        assert [s.client_id for s in rsp.sessions] == ["c1"]
+    asyncio.run(body())
+
+
+def test_target_info_persisted_across_mgmtd_restart():
+    async def body():
+        kv = MemKVEngine()
+        srv = MgmtdServer(kv, 1, "")
+        await srv.state.try_acquire_lease()
+        await srv.state.load_routing()
+        await srv.state.save_chains([chain(S, S)])
+        srv.state.local_states = {100: LocalTargetState.UPTODATE,
+                                  101: LocalTargetState.ONLINE}
+        import time
+        srv.state.last_heartbeat = {1: time.time(), 2: time.time()}
+        await srv.update_chains_once()   # persists target info
+        # restarted mgmtd (fresh state over same KV) reloads the blob
+        st2 = MgmtdState(kv, 2, "b:1", MgmtdConfig())
+        await st2.load_routing()
+        assert st2.local_states == {100: LocalTargetState.UPTODATE,
+                                    101: LocalTargetState.ONLINE}
+    asyncio.run(body())
+
+
+def test_save_chains_cas_guard():
+    """A save computed from a stale chain version must be skipped, not
+    silently revert the concurrent writer (admin op vs chains updater)."""
+    async def body():
+        kv = MemKVEngine()
+        st = MgmtdState(kv, 1, "a:1", MgmtdConfig())
+        await st.load_routing()
+        await st.save_chains([chain(S, S)], guard_versions=False)
+        # writer A advances v1 -> v2
+        c2 = ChainInfo(1, 2, chain(S, S).targets)
+        assert await st.save_chains([c2]) == [1]
+        # writer B computed from the OLD v1 chain (its new ver is also 2):
+        # skipped, and A's write survives
+        stale = ChainInfo(1, 2, chain(OFF, S).targets)
+        assert await st.save_chains([stale]) == []
+        info = await st.load_routing()
+        assert info.chains[1].chain_ver == 2
+        assert info.chains[1].targets[0].public_state == S
+        # node records must NOT ride on a save with a skipped chain
+        from t3fs.mgmtd.types import NodeInfo
+        assert await st.save_chains(
+            [stale], nodes=[NodeInfo(node_id=9, generation=5.0)]) == []
+        info = await st.load_routing()
+        assert 9 not in info.nodes
+    asyncio.run(body())
