@@ -12,7 +12,8 @@ import asyncio
 from dataclasses import dataclass
 
 from t3fs.app.base import ApplicationBase, LogConfig
-from t3fs.client.mgmtd_client import MgmtdClient
+from t3fs.client.mgmtd_client import MgmtdClientForServer
+from t3fs.mgmtd.types import NodeInfo
 from t3fs.client.storage_client import StorageClient, StorageClientConfig
 from t3fs.kv.wal_engine import open_kv_engine
 from t3fs.meta.service import MetaServer
@@ -39,13 +40,22 @@ class MetaMainConfig(ConfigBase):
 
 
 async def serve(cfg: MetaMainConfig, app: ApplicationBase) -> None:
+    import time as _time
+
     kv = open_kv_engine(cfg.kv)
     rpc = Server(cfg.listen_host, cfg.listen_port)
-    mgmtd = MgmtdClient(cfg.mgmtd_address)
+    # ForServer role: meta nodes REGISTER with mgmtd so peers (and the
+    # Distributor) can see the live meta-server set
+    mgmtd = MgmtdClientForServer(
+        cfg.mgmtd_address,
+        NodeInfo(cfg.node_id, "", node_type="meta",
+                 generation=_time.time()),
+        lambda: {})
     state: dict = {}
 
     async def start():
-        await mgmtd.start()
+        from t3fs.mgmtd.types import NodeStatus
+
         sc = StorageClient(mgmtd.routing, config=StorageClientConfig(),
                            refresh_routing=mgmtd.refresh)
         store = MetaStore(kv, ChainAllocator(
@@ -53,10 +63,23 @@ async def serve(cfg: MetaMainConfig, app: ApplicationBase) -> None:
             default_stripe=cfg.stripe_size))
         meta = MetaServer(store, sc, gc_period_s=cfg.gc_period_s,
                           session_ttl_s=cfg.session_ttl_s,
-                          node_id=cfg.node_id, admin_token=cfg.admin_token)
+                          node_id=cfg.node_id, admin_token=cfg.admin_token,
+                          # ACTIVE-only: a decommissioned meta server must
+                          # not own Distributor duties forever (mgmtd marks
+                          # dead non-storage nodes FAILED)
+                          meta_servers_provider=lambda: [
+                              n.node_id
+                              for n in mgmtd.routing().nodes.values()
+                              if n.node_type == "meta"
+                              and n.status == NodeStatus.ACTIVE])
+        # register every service BEFORE the socket opens: a half-started
+        # server answering RPC_METHOD_NOT_FOUND (non-retryable) is worse
+        # than a connection refused (retryable)
         for svc in meta.services:
             rpc.add_service(svc)
         await rpc.start()
+        mgmtd.node.address = rpc.address
+        await mgmtd.start()
         await meta.start()
         state["meta"], state["sc"] = meta, sc
         if cfg.port_file:

@@ -10,6 +10,7 @@ from __future__ import annotations
 import itertools
 import logging
 import random
+import uuid as uuidlib
 
 from t3fs.meta.schema import DirEntry, Inode
 from t3fs.meta.service import InodeReq, PathReq
@@ -57,11 +58,16 @@ class MetaClient:
     async def stat_inode(self, inode_id: int) -> Inode:
         return (await self._call("stat_inode", InodeReq(inode_id=inode_id))).inode
 
+    def _rid(self) -> str:
+        """Fresh idempotency key; reused across the retries of ONE logical
+        mutation so a replay returns the recorded result (Idempotent.h)."""
+        return str(uuidlib.uuid4())
+
     async def create(self, path: str, perm: int = 0o644, chunk_size: int = 0,
                      stripe: int = 0) -> tuple[Inode, str]:
         rsp = await self._call("create", PathReq(
             path=path, perm=perm, chunk_size=chunk_size, stripe=stripe,
-            client_id=self.client_id))
+            client_id=self.client_id, request_id=self._rid()))
         return rsp.inode, rsp.session_id
 
     async def open(self, path: str, write: bool = False) -> tuple[Inode, str]:
@@ -84,23 +90,31 @@ class MetaClient:
     async def mkdirs(self, path: str, perm: int = 0o755,
                      recursive: bool = True) -> Inode:
         return (await self._call("mkdirs", PathReq(
-            path=path, perm=perm, recursive=recursive))).inode
+            path=path, perm=perm, recursive=recursive,
+            client_id=self.client_id, request_id=self._rid()))).inode
 
     async def readdir(self, path: str) -> list[DirEntry]:
         return (await self._call("readdir", PathReq(path=path))).entries
 
     async def remove(self, path: str, recursive: bool = False) -> None:
-        await self._call("remove", PathReq(path=path, recursive=recursive))
+        await self._call("remove", PathReq(
+            path=path, recursive=recursive, client_id=self.client_id,
+            request_id=self._rid()))
 
     async def rename(self, src: str, dst: str) -> None:
-        await self._call("rename", PathReq(path=src, target=dst))
+        await self._call("rename", PathReq(
+            path=src, target=dst, client_id=self.client_id,
+            request_id=self._rid()))
 
     async def symlink(self, path: str, target: str) -> Inode:
-        return (await self._call("symlink", PathReq(path=path, target=target))).inode
+        return (await self._call("symlink", PathReq(
+            path=path, target=target, client_id=self.client_id,
+            request_id=self._rid()))).inode
 
     async def hardlink(self, existing: str, new_path: str) -> Inode:
-        return (await self._call("hardlink", PathReq(path=existing,
-                                                     target=new_path))).inode
+        return (await self._call("hardlink", PathReq(
+            path=existing, target=new_path, client_id=self.client_id,
+            request_id=self._rid()))).inode
 
     async def set_attr(self, path: str, perm: int) -> Inode:
         return (await self._call("set_attr", PathReq(path=path, perm=perm))).inode
@@ -111,6 +125,21 @@ class MetaClient:
 
     async def get_real_path(self, inode_id: int) -> str:
         return (await self._call("get_real_path", InodeReq(inode_id=inode_id))).path
+
+    async def lock_directory(self, path: str, unlock: bool = False) -> Inode:
+        return (await self._call("lock_directory", PathReq(
+            path=path, client_id=self.client_id, unlock=unlock))).inode
+
+    async def batch_stat(self, paths: list[str],
+                         follow: bool = True) -> list[Inode | None]:
+        from t3fs.meta.service import BatchStatReq
+        return (await self._call("batch_stat", BatchStatReq(
+            paths=paths, follow=follow))).inodes
+
+    async def batch_stat_inodes(self, inode_ids: list[int]) -> list[Inode | None]:
+        from t3fs.meta.service import BatchStatReq
+        return (await self._call("batch_stat", BatchStatReq(
+            inode_ids=inode_ids))).inodes
 
     async def close_conn(self) -> None:
         await self.client.close()

@@ -46,6 +46,9 @@ class Inode:
     symlink_target: str = ""
     # DIRECTORY
     parent: int = 0
+    # lockDirectory (fbs/meta/Service.h LockDirectoryReq): while set, entry
+    # mutations under this directory are rejected for other clients
+    dir_lock: str = ""
 
     @staticmethod
     def key(inode_id: int) -> bytes:
@@ -100,3 +103,27 @@ def gc_key(inode_id: int) -> bytes:
 
 
 GC_PREFIX = KeyPrefix.IDEMPOTENT.key(b"GC")
+
+
+@serde_struct
+@dataclass
+class IdemRecord:
+    """Recorded outcome of a mutating meta op, keyed by (request_id,
+    client_id) — the retry of an already-committed mutation returns the
+    recorded result instead of re-applying or failing confusingly
+    (reference meta/store/Idempotent.h: Record keyed requestId+clientId)."""
+    client_id: str = ""
+    request_id: str = ""
+    timestamp: float = 0.0
+    op: str = ""
+    inode: Inode | None = None      # result payload where the op returns one
+    extra: str = ""                 # e.g. the session_id a create minted
+
+
+def idem_key(request_id: str, client_id: str) -> bytes:
+    # requestId first to avoid a per-client hotspot (Idempotent.h packKey)
+    return KeyPrefix.IDEMPOTENT.key(b"RQ", request_id.encode(), b"@",
+                                    client_id.encode())
+
+
+IDEM_PREFIX = KeyPrefix.IDEMPOTENT.key(b"RQ")

@@ -36,8 +36,10 @@ class PathReq:
     chunk_size: int = 0
     stripe: int = 0
     client_id: str = ""
+    request_id: str = ""      # idempotency key for mutations (Idempotent.h)
     write: bool = False
     target: str = ""          # symlink target / rename dst / hardlink new path
+    unlock: bool = False      # lock_directory
 
 
 @serde_struct
@@ -70,6 +72,20 @@ class StatFsRsp:
     free: int = 0
 
 
+@serde_struct
+@dataclass
+class BatchStatReq:
+    paths: list[str] = field(default_factory=list)
+    inode_ids: list[int] = field(default_factory=list)
+    follow: bool = True
+
+
+@serde_struct
+@dataclass
+class BatchStatRsp:
+    inodes: list[Inode | None] = field(default_factory=list)
+
+
 @service("Meta")
 class MetaService:
     def __init__(self, store: MetaStore, storage_client=None):
@@ -89,7 +105,8 @@ class MetaService:
     @rpc_method
     async def create(self, req: PathReq, payload, conn):
         inode, session = await self.store.create(
-            req.path, req.perm, req.chunk_size, req.stripe, req.client_id)
+            req.path, req.perm, req.chunk_size, req.stripe, req.client_id,
+            request_id=req.request_id)
         return InodeRsp(inode=inode, session_id=session), b""
 
     @rpc_method
@@ -126,7 +143,8 @@ class MetaService:
     @rpc_method
     async def mkdirs(self, req: PathReq, payload, conn):
         return InodeRsp(inode=await self.store.mkdirs(
-            req.path, req.perm, req.recursive)), b""
+            req.path, req.perm, req.recursive, client_id=req.client_id,
+            request_id=req.request_id)), b""
 
     @rpc_method
     async def readdir(self, req: PathReq, payload, conn):
@@ -134,21 +152,29 @@ class MetaService:
 
     @rpc_method
     async def remove(self, req: PathReq, payload, conn):
-        await self.store.remove(req.path, req.recursive)
+        await self.store.remove(req.path, req.recursive,
+                                client_id=req.client_id,
+                                request_id=req.request_id)
         return InodeRsp(), b""
 
     @rpc_method
     async def rename(self, req: PathReq, payload, conn):
-        await self.store.rename(req.path, req.target)
+        await self.store.rename(req.path, req.target,
+                                client_id=req.client_id,
+                                request_id=req.request_id)
         return InodeRsp(), b""
 
     @rpc_method
     async def symlink(self, req: PathReq, payload, conn):
-        return InodeRsp(inode=await self.store.symlink(req.path, req.target)), b""
+        return InodeRsp(inode=await self.store.symlink(
+            req.path, req.target, client_id=req.client_id,
+            request_id=req.request_id)), b""
 
     @rpc_method
     async def hardlink(self, req: PathReq, payload, conn):
-        return InodeRsp(inode=await self.store.hardlink(req.path, req.target)), b""
+        return InodeRsp(inode=await self.store.hardlink(
+            req.path, req.target, client_id=req.client_id,
+            request_id=req.request_id)), b""
 
     @rpc_method
     async def set_attr(self, req: PathReq, payload, conn):
@@ -171,6 +197,21 @@ class MetaService:
         return PathReq(path=path), b""
 
     @rpc_method
+    async def lock_directory(self, req: PathReq, payload, conn):
+        """lockDirectory (fbs/meta/Service.h:718-741): pin a directory
+        against entry mutations by other clients."""
+        return InodeRsp(inode=await self.store.lock_directory(
+            req.path, req.client_id, unlock=req.unlock)), b""
+
+    @rpc_method
+    async def batch_stat(self, req: BatchStatReq, payload, conn):
+        if req.inode_ids:
+            inodes = await self.store.batch_stat_inodes(req.inode_ids)
+        else:
+            inodes = await self.store.batch_stat(req.paths, req.follow)
+        return BatchStatRsp(inodes=inodes), b""
+
+    @rpc_method
     async def statfs(self, req, payload, conn):
         # aggregated from storage in a later round; placeholder totals
         return StatFsRsp(), b""
@@ -188,10 +229,15 @@ class MetaServer:
 
     def __init__(self, store: MetaStore, storage_client,
                  gc_period_s: float = 0.2, session_ttl_s: float = 3600.0,
-                 node_id: int = 0, admin_token: str = ""):
+                 node_id: int = 0, admin_token: str = "",
+                 meta_servers_provider=None):
+        from t3fs.meta.distributor import Distributor
+
         self.store = store
         self.sc = storage_client
         self.service = MetaService(store, storage_client)
+        # rendezvous-hash duty sharding across meta servers (Distributor.h:29)
+        self.distributor = Distributor(node_id, meta_servers_provider)
         self.cfg = MetaConfig(gc_period_s=gc_period_s, session_ttl_s=session_ttl_s)
         from t3fs.core.service import AppInfo, CoreService
         self.core = CoreService(AppInfo(node_id, "meta"),
@@ -232,15 +278,25 @@ class MetaServer:
             try:
                 now = time.time()
                 if now - last_prune > max(1.0, self.session_ttl_s / 10):
-                    await self.store.prune_sessions(self.session_ttl_s)
+                    # duty-sharded across meta servers: only the rendezvous
+                    # owner of the "sessions"/"idem" duties prunes them
+                    if self.distributor.is_mine("prune-sessions"):
+                        await self.store.prune_sessions(self.session_ttl_s)
+                    if self.distributor.is_mine("prune-idem"):
+                        await self.store.prune_idem_records(
+                            max(600.0, self.session_ttl_s))
                     last_prune = now
                 await self.gc_once()
             except Exception:
                 log.exception("meta gc failed")
 
     async def gc_once(self) -> int:
-        """Reclaim chunks of removed files (GcManager.h:57-118 analog)."""
-        inodes = await self.store.gc_pop()
+        """Reclaim chunks of removed files (GcManager.h:57-118 analog);
+        each inode is GC'd by its rendezvous-hash owner so multiple meta
+        servers don't double-remove the same chunks."""
+        inodes = await self.store.gc_pop(
+            owned=self.distributor.is_mine
+            if self.distributor.servers_provider else None)
         for inode in inodes:
             if inode.layout is not None and self.sc is not None:
                 try:

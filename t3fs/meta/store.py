@@ -19,7 +19,8 @@ from t3fs.client.layout import FileLayout
 from t3fs.kv.engine import KVEngine, Transaction, with_transaction
 from t3fs.kv.prefixes import KeyPrefix
 from t3fs.meta.schema import (
-    GC_PREFIX, DirEntry, FileSession, Inode, InodeType, ROOT_INODE_ID, gc_key,
+    GC_PREFIX, IDEM_PREFIX, DirEntry, FileSession, IdemRecord, Inode,
+    InodeType, ROOT_INODE_ID, gc_key, idem_key,
 )
 from t3fs.utils import serde
 from t3fs.utils.status import StatusCode, StatusError, make_error
@@ -111,6 +112,53 @@ class MetaStore:
         await self._ensure_root()
         return await with_transaction(self.kv, fn)
 
+    async def _txn_idem(self, fn, op: str, client_id: str, request_id: str):
+        """Idempotent mutation driver (reference meta/store/Idempotent.h):
+        with a (client_id, request_id) pair, the op's outcome is recorded in
+        the SAME transaction that applies it — a replay (client retry after
+        a lost response, possibly against another meta server on the same
+        KV) returns the recorded result instead of double-applying or
+        failing with a confusing META_EXISTS / META_NOT_FOUND."""
+        if not request_id or not client_id:
+            return await self._txn(fn)
+
+        tuple_ops = ("create", "open")   # ops returning (inode, session_id)
+
+        async def outer(txn: Transaction):
+            key = idem_key(request_id, client_id)
+            raw = await txn.get(key)
+            if raw is not None:
+                rec: IdemRecord = serde.loads(raw)
+                return (rec.inode, rec.extra) if rec.op in tuple_ops \
+                    else rec.inode
+            result = await fn(txn)
+            if isinstance(result, tuple):
+                inode, extra = result[0], result[1]
+            else:
+                inode = result if isinstance(result, Inode) else None
+                extra = ""
+            txn.set(key, serde.dumps(IdemRecord(
+                client_id=client_id, request_id=request_id,
+                timestamp=time.time(), op=op, inode=inode,
+                extra=extra or "")))
+            return result
+        return await self._txn(outer)
+
+    @staticmethod
+    def _check_dir_lock(inode: Inode, client_id: str, path: str) -> None:
+        """Entry mutations under a locked directory are rejected unless the
+        caller holds the lock (LockDirectory semantics)."""
+        if inode.dir_lock and inode.dir_lock != client_id:
+            raise make_error(
+                StatusCode.META_DIR_LOCKED,
+                f"{path}: directory locked by {inode.dir_lock!r}")
+
+    async def _require_unlocked_dir(self, txn: Transaction, parent: int,
+                                    client_id: str, path: str) -> Inode:
+        inode = await self._require_inode(txn, parent)
+        self._check_dir_lock(inode, client_id, path)
+        return inode
+
     # --- txn helpers ---
 
     @staticmethod
@@ -184,13 +232,15 @@ class MetaStore:
         return await self._txn(fn)
 
     async def mkdirs(self, path: str, perm: int = 0o755,
-                     recursive: bool = True) -> Inode:
+                     recursive: bool = True, client_id: str = "",
+                     request_id: str = "") -> Inode:
         async def fn(txn: Transaction):
             parts = [p for p in path.split("/") if p]
             if not parts:
                 raise make_error(StatusCode.META_EXISTS, "/")
             parent = ROOT_INODE_ID
             created: Inode | None = None
+            lock_checked = False
             for i, name in enumerate(parts):
                 dent = await self._get_dent(txn, parent, name)
                 last = i == len(parts) - 1
@@ -203,6 +253,12 @@ class MetaStore:
                     continue
                 if not last and not recursive:
                     raise make_error(StatusCode.META_NOT_FOUND, name)
+                if not lock_checked:
+                    # only the first (pre-existing) parent can be locked;
+                    # deeper parents are directories this txn just created
+                    await self._require_unlocked_dir(txn, parent, client_id,
+                                                     path)
+                    lock_checked = True
                 inode_id = await self.ids.allocate()
                 inode = Inode(inode_id=inode_id, itype=InodeType.DIRECTORY,
                               perm=perm, nlink=2, parent=parent).touch()
@@ -212,10 +268,11 @@ class MetaStore:
                 parent = inode_id
                 created = inode
             return created
-        return await self._txn(fn)
+        return await self._txn_idem(fn, "mkdirs", client_id, request_id)
 
     async def create(self, path: str, perm: int = 0o644, chunk_size: int = 0,
-                     stripe: int = 0, session_client: str = "") -> tuple[Inode, str]:
+                     stripe: int = 0, session_client: str = "",
+                     request_id: str = "") -> tuple[Inode, str]:
         """Create a file (+ optional write session). Returns (inode, session_id)."""
         layout = self.chains.allocate_layout(chunk_size, stripe)
 
@@ -225,7 +282,7 @@ class MetaStore:
                 raise make_error(StatusCode.META_EXISTS, path)
             if not name:
                 raise make_error(StatusCode.META_INVALID_PATH, path)
-            await self._require_inode(txn, parent)
+            await self._require_unlocked_dir(txn, parent, session_client, path)
             inode_id = await self.ids.allocate()
             inode = Inode(inode_id=inode_id, itype=InodeType.FILE, perm=perm,
                           layout=layout).touch()
@@ -239,7 +296,7 @@ class MetaStore:
                                    time.time())
                 txn.set(FileSession.key(inode_id, session_id), serde.dumps(sess))
             return inode, session_id
-        return await self._txn(fn)
+        return await self._txn_idem(fn, "create", session_client, request_id)
 
     async def open_file(self, path: str, write: bool = False,
                         session_client: str = "") -> tuple[Inode, str]:
@@ -302,11 +359,13 @@ class MetaStore:
             return [serde.loads(v) for _, v in rows]
         return await self._txn(fn)
 
-    async def symlink(self, path: str, target: str) -> Inode:
+    async def symlink(self, path: str, target: str,
+                      client_id: str = "", request_id: str = "") -> Inode:
         async def fn(txn: Transaction):
             parent, name, dent = await self.resolve(txn, path, follow_last=False)
             if dent is not None:
                 raise make_error(StatusCode.META_EXISTS, path)
+            await self._require_unlocked_dir(txn, parent, client_id, path)
             inode_id = await self.ids.allocate()
             inode = Inode(inode_id=inode_id, itype=InodeType.SYMLINK,
                           symlink_target=target).touch()
@@ -314,9 +373,93 @@ class MetaStore:
             txn.set(DirEntry.key(parent, name), serde.dumps(
                 DirEntry(parent, name, inode_id, InodeType.SYMLINK)))
             return inode
+        return await self._txn_idem(fn, "symlink", client_id, request_id)
+
+    async def lock_directory(self, path: str, owner: str,
+                             unlock: bool = False) -> Inode:
+        """Lock/unlock a directory against entry mutations by other clients
+        (fbs/meta/Service.h lockDirectory).  Locking an already-locked dir
+        by a different owner fails; unlock requires the owner (or force via
+        the same RPC with the current owner string)."""
+        async def fn(txn: Transaction):
+            if path.strip("/") == "":
+                inode = await self._require_inode(txn, ROOT_INODE_ID)
+            else:
+                _, _, dent = await self.resolve(txn, path)
+                if dent is None:
+                    raise make_error(StatusCode.META_NOT_FOUND, path)
+                inode = await self._require_inode(txn, dent.inode_id)
+            if inode.itype != InodeType.DIRECTORY:
+                raise make_error(StatusCode.META_NOT_DIR, path)
+            if unlock:
+                if inode.dir_lock and inode.dir_lock != owner:
+                    raise make_error(StatusCode.META_DIR_LOCKED,
+                                     f"{path}: locked by {inode.dir_lock!r}")
+                inode.dir_lock = ""
+            else:
+                if inode.dir_lock and inode.dir_lock != owner:
+                    raise make_error(StatusCode.META_DIR_LOCKED,
+                                     f"{path}: locked by {inode.dir_lock!r}")
+                inode.dir_lock = owner
+            inode.touch()
+            txn.set(Inode.key(inode.inode_id), serde.dumps(inode))
+            return inode
         return await self._txn(fn)
 
-    async def hardlink(self, existing: str, new_path: str) -> Inode:
+    async def batch_stat(self, paths: list[str],
+                         follow: bool = True) -> list[Inode | None]:
+        """Stat many paths in ONE transaction (batchStatByPath,
+        fbs/meta/Service.h:718-741) — one snapshot, one round trip."""
+        async def fn(txn: Transaction):
+            out: list[Inode | None] = []
+            for path in paths:
+                try:
+                    if path.strip("/") == "":
+                        out.append(
+                            await self._require_inode(txn, ROOT_INODE_ID))
+                        continue
+                    _, _, dent = await self.resolve(txn, path,
+                                                    follow_last=follow)
+                    out.append(None if dent is None else
+                               await self._get_inode(txn, dent.inode_id))
+                except StatusError:
+                    out.append(None)
+            return out
+        return await self._txn(fn)
+
+    async def batch_stat_inodes(self, inode_ids: list[int]) -> list[Inode | None]:
+        """Stat many inodes by id in one transaction (batchStat analog)."""
+        async def fn(txn: Transaction):
+            return [await self._get_inode(txn, i) for i in inode_ids]
+        return await self._txn(fn)
+
+    async def prune_idem_records(self, ttl_s: float,
+                                 batch: int = 2048) -> int:
+        """Expire idempotency records (the reference prunes by timestamp:
+        a record only needs to outlive the client's retry horizon).
+
+        Scans a bounded page per call from a rotating in-memory cursor —
+        keys are request-id-random, so fresh records at the front must not
+        pin the scan away from expired ones further in."""
+        cutoff = time.time() - ttl_s
+        begin = getattr(self, "_idem_cursor", IDEM_PREFIX)
+
+        async def fn(txn: Transaction):
+            rows = await txn.get_range(begin, IDEM_PREFIX + b"\xff",
+                                       limit=batch, snapshot=True)
+            dropped = 0
+            for k, v in rows:
+                rec: IdemRecord = serde.loads(v)
+                if rec.timestamp < cutoff:
+                    txn.clear(k)
+                    dropped += 1
+            nxt = rows[-1][0] + b"\x00" if len(rows) == batch else IDEM_PREFIX
+            return dropped, nxt
+        dropped, self._idem_cursor = await self._txn(fn)
+        return dropped
+
+    async def hardlink(self, existing: str, new_path: str,
+                       client_id: str = "", request_id: str = "") -> Inode:
         async def fn(txn: Transaction):
             _, _, src = await self.resolve(txn, existing)
             if src is None:
@@ -326,6 +469,7 @@ class MetaStore:
             parent, name, dent = await self.resolve(txn, new_path, follow_last=False)
             if dent is not None:
                 raise make_error(StatusCode.META_EXISTS, new_path)
+            await self._require_unlocked_dir(txn, parent, client_id, new_path)
             inode = await self._require_inode(txn, src.inode_id)
             inode.nlink += 1
             inode.touch()
@@ -333,16 +477,23 @@ class MetaStore:
             txn.set(DirEntry.key(parent, name), serde.dumps(
                 DirEntry(parent, name, inode.inode_id, src.itype)))
             return inode
-        return await self._txn(fn)
+        return await self._txn_idem(fn, "hardlink", client_id, request_id)
 
-    async def rename(self, src: str, dst: str) -> None:
+    async def rename(self, src: str, dst: str,
+                     client_id: str = "", request_id: str = "") -> None:
         async def fn(txn: Transaction):
             sparent, sname, sdent = await self.resolve(txn, src, follow_last=False)
             if sdent is None:
                 raise make_error(StatusCode.META_NOT_FOUND, src)
+            await self._require_unlocked_dir(txn, sparent, client_id, src)
             dparent, dname, ddent = await self.resolve(txn, dst, follow_last=False)
+            if dparent != sparent:
+                await self._require_unlocked_dir(txn, dparent, client_id, dst)
             if ddent is not None:
                 if ddent.itype == InodeType.DIRECTORY:
+                    # overwriting a locked (even empty) directory destroys it
+                    await self._require_unlocked_dir(txn, ddent.inode_id,
+                                                     client_id, dst)
                     pre = DirEntry.prefix(ddent.inode_id)
                     if await txn.get_range(pre, pre + b"\xff", limit=1):
                         raise make_error(StatusCode.META_NOT_EMPTY, dst)
@@ -355,7 +506,7 @@ class MetaStore:
                 inode = await self._require_inode(txn, sdent.inode_id)
                 inode.parent = dparent
                 txn.set(Inode.key(inode.inode_id), serde.dumps(inode))
-        return await self._txn(fn)
+        return await self._txn_idem(fn, "rename", client_id, request_id)
 
     async def _unlink_entry(self, txn: Transaction, dent: DirEntry) -> None:
         inode = await self._get_inode(txn, dent.inode_id)
@@ -373,12 +524,19 @@ class MetaStore:
             inode.touch()
             txn.set(Inode.key(inode.inode_id), serde.dumps(inode))
 
-    async def remove(self, path: str, recursive: bool = False) -> None:
+    async def remove(self, path: str, recursive: bool = False,
+                     client_id: str = "", request_id: str = "") -> None:
         async def fn(txn: Transaction):
             parent, name, dent = await self.resolve(txn, path, follow_last=False)
             if dent is None:
                 raise make_error(StatusCode.META_NOT_FOUND, path)
+            await self._require_unlocked_dir(txn, parent, client_id, path)
             if dent.itype == InodeType.DIRECTORY:
+                # removing a locked directory (or any locked subdirectory)
+                # IS an entry mutation under it — same lock check applies,
+                # else remove -r bypasses what create/rename enforce
+                await self._require_unlocked_dir(txn, dent.inode_id,
+                                                 client_id, path)
                 pre = DirEntry.prefix(dent.inode_id)
                 children = await txn.get_range(pre, pre + b"\xff")
                 if children and not recursive:
@@ -387,18 +545,21 @@ class MetaStore:
                     child: DirEntry = serde.loads(raw)
                     # recursive removal inside one txn (small trees); big
                     # trees should go through trash + async GC
-                    await self._remove_tree(txn, child)
+                    await self._remove_tree(txn, child, client_id)
                     txn.clear(DirEntry.key(child.parent, child.name))
             await self._unlink_entry(txn, dent)
             txn.clear(DirEntry.key(parent, name))
-        return await self._txn(fn)
+        return await self._txn_idem(fn, "remove", client_id, request_id)
 
-    async def _remove_tree(self, txn: Transaction, dent: DirEntry) -> None:
+    async def _remove_tree(self, txn: Transaction, dent: DirEntry,
+                           client_id: str = "") -> None:
         if dent.itype == InodeType.DIRECTORY:
+            await self._require_unlocked_dir(txn, dent.inode_id, client_id,
+                                             dent.name)
             pre = DirEntry.prefix(dent.inode_id)
             for _, raw in await txn.get_range(pre, pre + b"\xff"):
                 child: DirEntry = serde.loads(raw)
-                await self._remove_tree(txn, child)
+                await self._remove_tree(txn, child, client_id)
                 txn.clear(DirEntry.key(child.parent, child.name))
         await self._unlink_entry(txn, dent)
 
@@ -481,13 +642,17 @@ class MetaStore:
             return dropped
         return await self._txn(fn)
 
-    async def gc_pop(self, limit: int = 16) -> list[Inode]:
-        """Dequeue inodes whose chunks need reclamation."""
+    async def gc_pop(self, limit: int = 16, owned=None) -> list[Inode]:
+        """Dequeue inodes whose chunks need reclamation.  `owned` filters by
+        the Distributor's rendezvous ownership so concurrent meta servers
+        partition the GC queue instead of racing on it."""
         async def fn(txn: Transaction):
             rows = await txn.get_range(GC_PREFIX, GC_PREFIX + b"\xff", limit=limit)
             out = []
             for k, v in rows:
                 inode: Inode = serde.loads(v)
+                if owned is not None and not owned(inode.inode_id):
+                    continue
                 # skip (keep queued) while write sessions remain
                 spre = FileSession.prefix(inode.inode_id)
                 if await txn.get_range(spre, spre + b"\xff", limit=1):

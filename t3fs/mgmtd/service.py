@@ -480,6 +480,14 @@ class MgmtdService:
         await self._require_primary()
         st = self.state
         known = st.routing().nodes.get(req.node.node_id)
+        if known is not None and known.node_type != req.node.node_type:
+            # node ids are cluster-global: a meta server configured with a
+            # storage node's id would otherwise flip the record's generation
+            # every other heartbeat and demote that node's targets forever
+            raise make_error(
+                StatusCode.INVALID_ARG,
+                f"node id {req.node.node_id} already registered as "
+                f"{known.node_type!r}, refusing {req.node.node_type!r}")
         st.last_heartbeat[req.node.node_id] = time.time()
         # generation is PERSISTED with the node record, so restart
         # detection survives an mgmtd restart/failover coinciding with
@@ -822,6 +830,19 @@ class MgmtdServer:
                              [(t.target_id, t.public_state.name)
                               for t in nxt.targets])
             pending_nodes = list(st.pending_node_saves.values())
+            # liveness -> NodeStatus for non-storage nodes (meta servers):
+            # the Distributor must stop hashing duties onto dead/retired
+            # peers, and storage liveness is already expressed via chains
+            from t3fs.mgmtd.types import NodeStatus as _NS
+            for n in routing.nodes.values():
+                if n.node_type == "storage":
+                    continue
+                want = _NS.ACTIVE if st.node_alive(n.node_id) else _NS.FAILED
+                if n.status != want \
+                        and n.node_id not in st.pending_node_saves:
+                    flipped = NodeInfo(**{**n.__dict__})
+                    flipped.status = want
+                    pending_nodes.append(flipped)
             if updated or pending_nodes:
                 # demotions and the new node generations land in ONE txn
                 written = await st.save_chains(updated, nodes=pending_nodes)

@@ -229,8 +229,14 @@ class DeviceChecksumBackend(ChecksumBackend):
         def one(chunk_words: int, nb: int) -> None:
             if self._closed:
                 return
-            arr = np.zeros((nb, chunk_words), dtype=np.uint32)
-            np.asarray(self._fn(chunk_words)(arr))
+            try:
+                arr = np.zeros((nb, chunk_words), dtype=np.uint32)
+                np.asarray(self._fn(chunk_words)(arr))
+            except Exception:
+                # a failed precompile must be LOUD (the affected sizes will
+                # pay the compile on the hot path) but not abort the rest
+                log.exception("codec warmup compile failed "
+                              "(chunk_words=%d, n=%d)", chunk_words, nb)
 
         futs = []
         for size in payload_sizes:
@@ -247,7 +253,7 @@ class DeviceChecksumBackend(ChecksumBackend):
         for f in futs:
             try:
                 f.result()
-            except (Exception, CancelledError):
+            except CancelledError:
                 return
 
     def _flush(self, groups: dict[int, list[_Pending]]) -> None:

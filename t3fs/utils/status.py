@@ -65,6 +65,7 @@ class StatusCode(enum.IntEnum):
     META_NO_PERMISSION = 6007
     META_BUSY = 6008
     META_INVALID_PATH = 6009
+    META_DIR_LOCKED = 6010
 
     # mgmtd (reference: MgmtdCode)
     MGMTD_NOT_PRIMARY = 7001
