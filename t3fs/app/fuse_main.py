@@ -1,0 +1,74 @@
+"""fuse_main: mount a t3fs cluster via the kernel FUSE bridge.
+
+Reference analog: src/fuse/hf3fs_fuse.cpp + FuseMainLoop (the
+hf3fs_fuse_main binary).  Discovers meta servers from mgmtd routing,
+registers a client session, and serves /dev/fuse until SIGINT/SIGTERM.
+
+    python -m t3fs.app.fuse_main --config fuse.toml
+    # or: python -m t3fs.app.fuse_main --set mgmtd_address=... --set mountpoint=/mnt/t3fs
+"""
+
+from __future__ import annotations
+
+import asyncio
+import uuid
+from dataclasses import dataclass
+
+from t3fs.app.base import ApplicationBase, LogConfig
+from t3fs.client.meta_client import MetaClient
+from t3fs.client.mgmtd_client import MgmtdClient
+from t3fs.client.storage_client import StorageClient, StorageClientConfig
+from t3fs.fuse.kernel import FuseKernelMount
+from t3fs.utils.config import ConfigBase, citem, cobj
+
+
+@dataclass
+class FuseMainConfig(ConfigBase):
+    mgmtd_address: str = citem("127.0.0.1:9000", hot=False)
+    mountpoint: str = citem("", hot=False)
+    client_id: str = citem("", hot=False)      # default: random per mount
+    max_write: int = citem(1 << 17, hot=False, validator=lambda v: v >= 4096)
+    log: LogConfig = cobj(LogConfig)
+
+
+async def serve(cfg: FuseMainConfig, app: ApplicationBase) -> None:
+    assert cfg.mountpoint, "mountpoint is required"
+    client_id = cfg.client_id or f"fuse-{uuid.uuid4().hex[:10]}"
+    mgmtd = MgmtdClient(cfg.mgmtd_address, client_id=client_id,
+                        description=f"fuse mount {cfg.mountpoint}")
+    state: dict = {}
+
+    async def start():
+        await mgmtd.start()
+        meta_addrs = [n.address for n in mgmtd.routing().nodes.values()
+                      if n.node_type == "meta" and n.address]
+        if not meta_addrs:
+            raise RuntimeError("no meta servers in routing; is meta up?")
+        mc = MetaClient(meta_addrs, client_id=client_id)
+        sc = StorageClient(mgmtd.routing, config=StorageClientConfig(),
+                           refresh_routing=mgmtd.refresh)
+        fuse = FuseKernelMount(mc, sc, cfg.mountpoint, client_id=client_id,
+                               max_write=cfg.max_write)
+        await fuse.mount()
+        state.update(mc=mc, sc=sc, fuse=fuse)
+
+    async def stop():
+        if "fuse" in state:
+            await state["fuse"].unmount()
+        if "sc" in state:
+            await state["sc"].close()
+        if "mc" in state:
+            await state["mc"].close_conn()
+        await mgmtd.stop()
+
+    await app.run(start, stop)
+
+
+def main(argv: list[str] | None = None) -> None:
+    app = ApplicationBase("fuse", FuseMainConfig)
+    cfg = app.boot(argv)
+    asyncio.run(serve(cfg, app))
+
+
+if __name__ == "__main__":
+    main()

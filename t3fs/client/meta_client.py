@@ -13,7 +13,7 @@ import random
 import uuid as uuidlib
 
 from t3fs.meta.schema import DirEntry, Inode
-from t3fs.meta.service import InodeReq, PathReq
+from t3fs.meta.service import BatchStatReq, EntryReq, InodeReq, PathReq
 from t3fs.net.client import Client
 from t3fs.utils.status import StatusError
 
@@ -126,18 +126,64 @@ class MetaClient:
     async def get_real_path(self, inode_id: int) -> str:
         return (await self._call("get_real_path", InodeReq(inode_id=inode_id))).path
 
+    async def lookup(self, parent: int, name: str) -> Inode:
+        return (await self._call("lookup", EntryReq(
+            parent=parent, name=name))).inode
+
+    async def readdir_inode(self, inode_id: int,
+                            limit: int = 0) -> list[DirEntry]:
+        return (await self._call("readdir_inode", EntryReq(
+            inode_id=inode_id, limit=limit))).entries
+
+    async def create_at(self, parent: int, name: str, perm: int = 0o644,
+                        chunk_size: int = 0,
+                        stripe: int = 0) -> tuple[Inode, str]:
+        rsp = await self._call("create_at", EntryReq(
+            parent=parent, name=name, perm=perm, chunk_size=chunk_size,
+            stripe=stripe, client_id=self.client_id, request_id=self._rid()))
+        return rsp.inode, rsp.session_id
+
+    async def mkdir_at(self, parent: int, name: str,
+                       perm: int = 0o755) -> Inode:
+        return (await self._call("mkdir_at", EntryReq(
+            parent=parent, name=name, perm=perm, client_id=self.client_id,
+            request_id=self._rid()))).inode
+
+    async def symlink_at(self, parent: int, name: str, target: str) -> Inode:
+        return (await self._call("symlink_at", EntryReq(
+            parent=parent, name=name, target=target,
+            client_id=self.client_id, request_id=self._rid()))).inode
+
+    async def unlink_at(self, parent: int, name: str,
+                        recursive: bool = False,
+                        must_dir: bool | None = None) -> None:
+        await self._call("unlink_at", EntryReq(
+            parent=parent, name=name, recursive=recursive,
+            client_id=self.client_id, request_id=self._rid(),
+            must_dir=-1 if must_dir is None else int(must_dir)))
+
+    async def rename_at(self, sparent: int, sname: str, dparent: int,
+                        dname: str) -> None:
+        await self._call("rename_at", EntryReq(
+            parent=sparent, name=sname, dparent=dparent, dname=dname,
+            client_id=self.client_id, request_id=self._rid()))
+
+    async def open_inode(self, inode_id: int,
+                         write: bool = False) -> tuple[Inode, str]:
+        rsp = await self._call("open_inode", EntryReq(
+            inode_id=inode_id, write=write, client_id=self.client_id))
+        return rsp.inode, rsp.session_id
+
     async def lock_directory(self, path: str, unlock: bool = False) -> Inode:
         return (await self._call("lock_directory", PathReq(
             path=path, client_id=self.client_id, unlock=unlock))).inode
 
     async def batch_stat(self, paths: list[str],
                          follow: bool = True) -> list[Inode | None]:
-        from t3fs.meta.service import BatchStatReq
         return (await self._call("batch_stat", BatchStatReq(
             paths=paths, follow=follow))).inodes
 
     async def batch_stat_inodes(self, inode_ids: list[int]) -> list[Inode | None]:
-        from t3fs.meta.service import BatchStatReq
         return (await self._call("batch_stat", BatchStatReq(
             inode_ids=inode_ids))).inodes
 

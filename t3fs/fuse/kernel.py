@@ -1,0 +1,421 @@
+"""Kernel FUSE mount: the raw /dev/fuse wire protocol, no libfuse.
+
+Reference analog: src/fuse/FuseOps.cc:644-2716 (fuse_lowlevel ops bridging
+to MetaClient/StorageClient) + FuseMainLoop.  The reference links libfuse;
+t3fs speaks the kernel protocol directly — open /dev/fuse, mount(2) with
+fd=N (we run as root; no fusermount helper needed), answer FUSE_* requests
+on the asyncio loop.  Every opcode handler is an async task, so meta/storage
+RPC latency never serializes the mount.
+
+Protocol structs follow include/uapi/linux/fuse.h, negotiated at 7.31
+(64-byte fuse_init_out).  Nodeids ARE t3fs inode ids (root nodeid 1 ==
+ROOT_INODE_ID), so LOOKUP/GETATTR need no id translation.
+
+POSIX ops that touch the mount MUST NOT run on the daemon's event loop
+thread (they would deadlock waiting for their own handler) — tests use
+asyncio.to_thread for ls/cat/dd-style access.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import ctypes
+import ctypes.util
+import errno
+import logging
+import os
+import stat as statmod
+import struct
+
+from t3fs.meta.schema import InodeType
+from t3fs.utils.status import StatusCode, StatusError
+
+log = logging.getLogger("t3fs.fuse.kernel")
+
+# --- opcodes (linux/fuse.h) ---
+LOOKUP, FORGET, GETATTR, SETATTR, READLINK, SYMLINK = 1, 2, 3, 4, 5, 6
+MKNOD, MKDIR, UNLINK, RMDIR, RENAME, LINK = 8, 9, 10, 11, 12, 13
+OPEN, READ, WRITE, STATFS, RELEASE, FSYNC = 14, 15, 16, 17, 18, 20
+GETXATTR, LISTXATTR, FLUSH, INIT, OPENDIR, READDIR = 22, 23, 25, 26, 27, 28
+RELEASEDIR, FSYNCDIR, ACCESS, CREATE, INTERRUPT = 29, 30, 34, 35, 36
+DESTROY, BATCH_FORGET, READDIRPLUS, RENAME2 = 38, 42, 44, 45
+
+_IN_HDR = struct.Struct("<IIQQIIII")          # len opcode unique nodeid uid gid pid pad
+_OUT_HDR = struct.Struct("<IiQ")              # len error unique
+_INIT_IN = struct.Struct("<IIII")             # major minor max_readahead flags
+_INIT_OUT = struct.Struct("<IIIIHHIIHHI7I")   # 64 bytes (7.23+)
+_ATTR = struct.Struct("<6Q10I")               # 88 bytes (7.9+)
+_ENTRY_HEAD = struct.Struct("<4QII")          # nodeid gen entry_valid attr_valid nsecs
+_ATTR_OUT_HEAD = struct.Struct("<QII")        # attr_valid nsec dummy
+_OPEN_OUT = struct.Struct("<QII")             # fh open_flags pad
+_WRITE_OUT = struct.Struct("<II")             # size pad
+_STATFS_OUT = struct.Struct("<5Q4I6I")        # kstatfs, 80 bytes
+_READ_IN = struct.Struct("<QQIIQII")          # fh off size rflags lock_owner flags pad
+_WRITE_IN = struct.Struct("<QQIIQII")         # fh off size wflags lock_owner flags pad
+_SETATTR_IN = struct.Struct("<II6Q8I")        # valid pad fh size lock atime mtime ctime + 8I
+_RELEASE_IN = struct.Struct("<QIIQ")
+_FSYNC_IN = struct.Struct("<QII")
+_CREATE_IN = struct.Struct("<IIII")           # flags mode umask pad
+_MKDIR_IN = struct.Struct("<II")              # mode umask
+_RENAME2_IN = struct.Struct("<QII")           # newdir flags pad
+
+FATTR_MODE, FATTR_UID, FATTR_GID, FATTR_SIZE = 1, 2, 4, 8
+MS_NOSUID, MS_NODEV = 2, 4
+MNT_DETACH = 2
+O_ACCMODE = 0o3
+
+_ERRNO = {
+    StatusCode.META_NOT_FOUND: errno.ENOENT,
+    StatusCode.META_EXISTS: errno.EEXIST,
+    StatusCode.META_NOT_DIR: errno.ENOTDIR,
+    StatusCode.META_IS_DIR: errno.EISDIR,
+    StatusCode.META_NOT_EMPTY: errno.ENOTEMPTY,
+    StatusCode.META_DIR_LOCKED: errno.EACCES,
+    StatusCode.META_TOO_MANY_SYMLINKS: errno.ELOOP,
+    StatusCode.META_NO_PERMISSION: errno.EACCES,
+    StatusCode.CHUNK_NOT_FOUND: errno.ENOENT,
+}
+
+_DT = {InodeType.FILE: statmod.S_IFREG >> 12,
+       InodeType.DIRECTORY: statmod.S_IFDIR >> 12,
+       InodeType.SYMLINK: statmod.S_IFLNK >> 12}
+
+_libc = ctypes.CDLL(ctypes.util.find_library("c"), use_errno=True)
+
+
+def _mode_of(inode) -> int:
+    base = {InodeType.FILE: statmod.S_IFREG,
+            InodeType.DIRECTORY: statmod.S_IFDIR,
+            InodeType.SYMLINK: statmod.S_IFLNK}[InodeType(inode.itype)]
+    return base | (inode.perm & 0o7777)
+
+
+class _Handle:
+    __slots__ = ("inode", "session", "writable", "entries")
+
+    def __init__(self, inode, session="", writable=False, entries=None):
+        self.inode = inode
+        self.session = session
+        self.writable = writable
+        self.entries = entries            # dir handles: snapshot listing
+
+
+class FuseKernelMount:
+    """One mounted t3fs instance over MetaClient + StorageClient."""
+
+    def __init__(self, meta_client, storage_client, mountpoint: str,
+                 client_id: str = "t3fs-fuse", max_write: int = 1 << 17):
+        self.mc = meta_client
+        self.sc = storage_client
+        self.mountpoint = os.path.abspath(mountpoint)
+        self.client_id = client_id
+        self.max_write = max_write
+        self.fd = -1
+        self._next_fh = 1
+        self._handles: dict[int, _Handle] = {}
+        # live length high-water per nodeid while written through this mount
+        self._open_len: dict[int, int] = {}
+        self._open_count: dict[int, int] = {}
+        self._buf = bytearray(max_write + (16 << 10))
+        self._closed = asyncio.Event()
+        self.request_count = 0
+
+    # ---- mount / unmount ----
+
+    async def mount(self) -> None:
+        self.fd = os.open("/dev/fuse", os.O_RDWR | os.O_NONBLOCK)
+        opts = (f"fd={self.fd},rootmode=40000,user_id={os.getuid()},"
+                f"group_id={os.getgid()}")
+        r = _libc.mount(b"t3fs", self.mountpoint.encode(), b"fuse.t3fs",
+                        MS_NOSUID | MS_NODEV, opts.encode())
+        if r != 0:
+            e = ctypes.get_errno()
+            os.close(self.fd)
+            self.fd = -1
+            raise OSError(e, f"mount(fuse) failed: {os.strerror(e)}")
+        asyncio.get_running_loop().add_reader(self.fd, self._on_readable)
+        log.info("t3fs mounted at %s", self.mountpoint)
+
+    async def unmount(self) -> None:
+        loop = asyncio.get_running_loop()
+        if self.fd >= 0:
+            loop.remove_reader(self.fd)
+        _libc.umount2(self.mountpoint.encode(), MNT_DETACH)
+        if self.fd >= 0:
+            os.close(self.fd)
+            self.fd = -1
+        self._closed.set()
+        log.info("t3fs unmounted from %s", self.mountpoint)
+
+    # ---- request pump ----
+
+    def _on_readable(self) -> None:
+        while True:
+            try:
+                msg = os.read(self.fd, len(self._buf))
+            except BlockingIOError:
+                return
+            except OSError as e:
+                if e.errno in (errno.ENODEV, errno.EBADF):
+                    # unmounted underneath us
+                    try:
+                        asyncio.get_running_loop().remove_reader(self.fd)
+                    except Exception:
+                        pass
+                    self._closed.set()
+                    return
+                if e.errno == errno.EINTR:
+                    continue
+                raise
+            if not msg:
+                return
+            asyncio.get_running_loop().create_task(self._dispatch(msg))
+
+    async def _dispatch(self, msg: bytes) -> None:
+        (length, opcode, unique, nodeid, uid, gid, pid,
+         _pad) = _IN_HDR.unpack_from(msg)
+        body = msg[_IN_HDR.size:length]
+        self.request_count += 1
+        if opcode in (FORGET, BATCH_FORGET):
+            return                         # MUST not reply
+        try:
+            data = await self._handle(opcode, nodeid, body)
+            if data is None:
+                return                     # handler already replied / no reply
+            self._reply(unique, 0, data)
+        except StatusError as e:
+            self._reply(unique, -_ERRNO.get(e.code, errno.EIO), b"")
+        except NotImplementedError:
+            self._reply(unique, -errno.ENOSYS, b"")
+        except OSError as e:
+            self._reply(unique, -(e.errno or errno.EIO), b"")
+        except Exception:
+            log.exception("fuse op %d failed", opcode)
+            self._reply(unique, -errno.EIO, b"")
+
+    def _reply(self, unique: int, error: int, data: bytes) -> None:
+        if self.fd < 0:
+            return
+        try:
+            os.write(self.fd, _OUT_HDR.pack(_OUT_HDR.size + len(data),
+                                            error, unique) + data)
+        except OSError as e:
+            if e.errno != errno.ENOENT:    # request interrupted: benign
+                log.warning("fuse reply failed: %s", e)
+
+    # ---- encoding helpers ----
+
+    def _attr(self, inode) -> bytes:
+        length = inode.length
+        if inode.itype == InodeType.FILE:
+            length = max(length, inode.length_hint,
+                         self._open_len.get(inode.inode_id, 0))
+        elif inode.itype == InodeType.SYMLINK:
+            length = len(inode.symlink_target)
+        blocks = (length + 511) // 512
+        t = int(inode.mtime)
+        return _ATTR.pack(inode.inode_id, length, blocks,
+                          int(inode.atime) or t, t, int(inode.ctime) or t,
+                          0, 0, 0, _mode_of(inode), max(1, inode.nlink),
+                          inode.uid, inode.gid, 0, 4096, 0)
+
+    def _entry_out(self, inode) -> bytes:
+        return _ENTRY_HEAD.pack(inode.inode_id, 0, 1, 1, 0, 0) \
+            + self._attr(inode)
+
+    def _attr_out(self, inode) -> bytes:
+        return _ATTR_OUT_HEAD.pack(1, 0, 0) + self._attr(inode)
+
+    def _new_fh(self, handle: _Handle) -> int:
+        fh = self._next_fh
+        self._next_fh += 1
+        self._handles[fh] = handle
+        return fh
+
+    # ---- opcode handlers ----
+
+    async def _handle(self, opcode: int, nodeid: int, body: bytes):
+        if opcode == INIT:
+            major, minor, _ra, flags = _INIT_IN.unpack_from(body)
+            if major < 7:
+                return b""                 # unsupportably old; shouldn't happen
+            log.info("FUSE INIT kernel %d.%d flags=%#x", major, minor, flags)
+            return _INIT_OUT.pack(7, 31, 1 << 20, 0, 12, 10, self.max_write,
+                                  1, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0)
+        if opcode == GETATTR:
+            return self._attr_out(await self.mc.stat_inode(nodeid))
+        if opcode == LOOKUP:
+            name = body.split(b"\0", 1)[0].decode()
+            return self._entry_out(await self.mc.lookup(nodeid, name))
+        if opcode == OPENDIR:
+            entries, inode = await asyncio.gather(
+                self.mc.readdir_inode(nodeid), self.mc.stat_inode(nodeid))
+            listing = [(nodeid, ".", InodeType.DIRECTORY),
+                       (inode.parent or nodeid, "..", InodeType.DIRECTORY)]
+            listing += [(e.inode_id, e.name, InodeType(e.itype))
+                        for e in entries]
+            return _OPEN_OUT.pack(
+                self._new_fh(_Handle(inode, entries=listing)), 0, 0)
+        if opcode == READDIR:
+            fh, off, size, *_ = _READ_IN.unpack_from(body)
+            h = self._handles.get(fh)
+            if h is None or h.entries is None:
+                raise OSError(errno.EBADF, "bad dir handle")
+            out = bytearray()
+            idx = off
+            while idx < len(h.entries):
+                ino, name, itype = h.entries[idx]
+                nb = name.encode()
+                rec = 24 + ((len(nb) + 7) & ~7)
+                if len(out) + rec > size:
+                    break
+                out += struct.pack("<QQII", ino, idx + 1, len(nb), _DT[itype])
+                out += nb + b"\0" * (rec - 24 - len(nb))
+                idx += 1
+            return bytes(out)
+        if opcode in (RELEASEDIR, RELEASE):
+            fh, *_ = _RELEASE_IN.unpack_from(body)
+            h = self._handles.pop(fh, None)
+            if opcode == RELEASE and h is not None:
+                await self._settle(h)
+            return b""
+        if opcode == OPEN:
+            flags = struct.unpack_from("<I", body)[0]
+            writable = (flags & O_ACCMODE) != os.O_RDONLY
+            inode, session = await self.mc.open_inode(nodeid, write=writable)
+            if writable:
+                self._track_open(inode)
+            return _OPEN_OUT.pack(
+                self._new_fh(_Handle(inode, session, writable)), 0, 0)
+        if opcode == CREATE:
+            flags, mode, _umask, _ = _CREATE_IN.unpack_from(body)
+            name = body[_CREATE_IN.size:].split(b"\0", 1)[0].decode()
+            inode, session = await self.mc.create_at(nodeid, name,
+                                                     perm=mode & 0o7777)
+            self._track_open(inode)
+            fh = self._new_fh(_Handle(inode, session, True))
+            return self._entry_out(inode) + _OPEN_OUT.pack(fh, 0, 0)
+        if opcode == MKNOD:
+            mode, _rdev = struct.unpack_from("<II", body)
+            name = body[16:].split(b"\0", 1)[0].decode()
+            if not statmod.S_ISREG(mode):
+                raise NotImplementedError
+            inode, _ = await self.mc.create_at(nodeid, name,
+                                               perm=mode & 0o7777)
+            return self._entry_out(inode)
+        if opcode == MKDIR:
+            mode, _umask = _MKDIR_IN.unpack_from(body)
+            name = body[_MKDIR_IN.size:].split(b"\0", 1)[0].decode()
+            return self._entry_out(await self.mc.mkdir_at(
+                nodeid, name, perm=mode & 0o7777))
+        if opcode == SYMLINK:
+            name_b, target_b = body.split(b"\0", 2)[:2]
+            return self._entry_out(await self.mc.symlink_at(
+                nodeid, name_b.decode(), target_b.decode()))
+        if opcode == READLINK:
+            inode = await self.mc.stat_inode(nodeid)
+            return inode.symlink_target.encode()
+        if opcode in (UNLINK, RMDIR):
+            name = body.split(b"\0", 1)[0].decode()
+            # server-side type assertion: the kernel's cached entry type can
+            # be stale, and rmdir(file) / unlink(dir) must fail atomically
+            await self.mc.unlink_at(nodeid, name,
+                                    must_dir=(opcode == RMDIR))
+            return b""
+        if opcode in (RENAME, RENAME2):
+            if opcode == RENAME:
+                newdir = struct.unpack_from("<Q", body)[0]
+                rest = body[8:]
+            else:
+                newdir, flags, _ = _RENAME2_IN.unpack_from(body)
+                if flags:                  # RENAME_NOREPLACE/EXCHANGE
+                    raise NotImplementedError
+                rest = body[_RENAME2_IN.size:]
+            oldname_b, newname_b = rest.split(b"\0", 2)[:2]
+            await self.mc.rename_at(nodeid, oldname_b.decode(),
+                                    newdir, newname_b.decode())
+            return b""
+        if opcode == READ:
+            fh, off, size, *_ = _READ_IN.unpack_from(body)
+            h = self._handles.get(fh)
+            if h is None:
+                raise OSError(errno.EBADF, "bad handle")
+            end = self._length_of(h.inode)
+            if off >= end:
+                return b""
+            size = min(size, end - off)
+            data, _results = await self.sc.read_file_range(
+                h.inode.layout, h.inode.inode_id, off, size)
+            return data
+        if opcode == WRITE:
+            fh, off, size, *_ = _WRITE_IN.unpack_from(body)
+            h = self._handles.get(fh)
+            if h is None or not h.writable:
+                raise OSError(errno.EBADF, "bad handle")
+            data = body[_WRITE_IN.size:_WRITE_IN.size + size]
+            await self.sc.write_file_range(h.inode.layout, h.inode.inode_id,
+                                           off, data)
+            ino = h.inode.inode_id
+            self._open_len[ino] = max(self._open_len.get(ino, 0),
+                                      off + len(data))
+            return _WRITE_OUT.pack(len(data), 0)
+        if opcode in (FLUSH, FSYNC):
+            fh = struct.unpack_from("<Q", body)[0]
+            h = self._handles.get(fh)
+            if h is not None and h.writable:
+                inode = await self.mc.sync(h.inode.inode_id)
+                self._open_len[h.inode.inode_id] = max(
+                    self._open_len.get(h.inode.inode_id, 0), inode.length)
+            return b""
+        if opcode == SETATTR:
+            (valid, _p, fh, size, _lock, _at, _mt, _ct,
+             *_rest) = _SETATTR_IN.unpack_from(body)
+            if valid & FATTR_SIZE:
+                inode = await self.mc.truncate(nodeid, size)
+                if nodeid in self._open_len:
+                    self._open_len[nodeid] = size
+            else:
+                # mode/uid/gid/time updates are accepted and ignored (v1)
+                inode = await self.mc.stat_inode(nodeid)
+            return self._attr_out(inode)
+        if opcode == STATFS:
+            return _STATFS_OUT.pack(1 << 30, 1 << 29, 1 << 29, 1 << 20,
+                                    1 << 19, 4096, 255, 4096, 0,
+                                    0, 0, 0, 0, 0, 0)
+        if opcode == ACCESS:
+            return b""                     # permissive (no default_permissions)
+        if opcode in (GETXATTR, LISTXATTR):
+            raise OSError(errno.ENODATA, "no xattrs")
+        if opcode == INTERRUPT:
+            return None                    # best-effort: ops are short
+        if opcode in (FSYNCDIR, DESTROY):
+            return b""
+        raise NotImplementedError
+
+    # ---- helpers ----
+
+    def _length_of(self, inode) -> int:
+        return max(inode.length, inode.length_hint,
+                   self._open_len.get(inode.inode_id, 0))
+
+    def _track_open(self, inode) -> None:
+        ino = inode.inode_id
+        self._open_count[ino] = self._open_count.get(ino, 0) + 1
+        self._open_len.setdefault(ino, max(inode.length, inode.length_hint))
+
+    async def _settle(self, h: _Handle) -> None:
+        """RELEASE of a writable handle: settle the precise length via meta
+        (close drops the write session; design_notes.md:91-95)."""
+        if not h.writable:
+            return
+        ino = h.inode.inode_id
+        try:
+            await self.mc.close(ino, h.session)
+        except StatusError as e:
+            log.warning("settle of inode %d failed: %s", ino, e)
+        n = self._open_count.get(ino, 1) - 1
+        if n <= 0:
+            self._open_count.pop(ino, None)
+            self._open_len.pop(ino, None)
+        else:
+            self._open_count[ino] = n

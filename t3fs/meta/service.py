@@ -74,6 +74,28 @@ class StatFsRsp:
 
 @serde_struct
 @dataclass
+class EntryReq:
+    """Entry-level op addressing (FUSE lowlevel surface): parent nodeid +
+    name, optional destination pair for rename."""
+    parent: int = 0
+    name: str = ""
+    dparent: int = 0
+    dname: str = ""
+    target: str = ""          # symlink target
+    perm: int = 0o644
+    chunk_size: int = 0
+    stripe: int = 0
+    recursive: bool = False
+    write: bool = False
+    inode_id: int = 0
+    client_id: str = ""
+    request_id: str = ""
+    limit: int = 0
+    must_dir: int = -1        # unlink_at: -1 any, 0 must be file, 1 must be dir
+
+
+@serde_struct
+@dataclass
 class BatchStatReq:
     paths: list[str] = field(default_factory=list)
     inode_ids: list[int] = field(default_factory=list)
@@ -195,6 +217,57 @@ class MetaService:
     async def get_real_path(self, req: InodeReq, payload, conn):
         path = await self.store.get_real_path(req.inode_id)
         return PathReq(path=path), b""
+
+    @rpc_method
+    async def lookup(self, req: EntryReq, payload, conn):
+        """FUSE lookup: (parent nodeid, name) -> inode (FuseOps.cc:644)."""
+        return InodeRsp(inode=await self.store.lookup(
+            req.parent, req.name)), b""
+
+    @rpc_method
+    async def readdir_inode(self, req: EntryReq, payload, conn):
+        return ReaddirRsp(entries=await self.store.readdir_inode(
+            req.inode_id, req.limit)), b""
+
+    @rpc_method
+    async def create_at(self, req: EntryReq, payload, conn):
+        inode, session = await self.store.create_at(
+            req.parent, req.name, req.perm, req.chunk_size, req.stripe,
+            req.client_id, request_id=req.request_id)
+        return InodeRsp(inode=inode, session_id=session), b""
+
+    @rpc_method
+    async def mkdir_at(self, req: EntryReq, payload, conn):
+        return InodeRsp(inode=await self.store.mkdir_at(
+            req.parent, req.name, req.perm, client_id=req.client_id,
+            request_id=req.request_id)), b""
+
+    @rpc_method
+    async def symlink_at(self, req: EntryReq, payload, conn):
+        return InodeRsp(inode=await self.store.symlink_at(
+            req.parent, req.name, req.target, client_id=req.client_id,
+            request_id=req.request_id)), b""
+
+    @rpc_method
+    async def unlink_at(self, req: EntryReq, payload, conn):
+        await self.store.unlink_at(
+            req.parent, req.name, req.recursive, client_id=req.client_id,
+            request_id=req.request_id,
+            must_dir=None if req.must_dir < 0 else bool(req.must_dir))
+        return InodeRsp(), b""
+
+    @rpc_method
+    async def rename_at(self, req: EntryReq, payload, conn):
+        await self.store.rename_at(
+            req.parent, req.name, req.dparent, req.dname,
+            client_id=req.client_id, request_id=req.request_id)
+        return InodeRsp(), b""
+
+    @rpc_method
+    async def open_inode(self, req: EntryReq, payload, conn):
+        inode, session = await self.store.open_inode(
+            req.inode_id, req.write, req.client_id)
+        return InodeRsp(inode=inode, session_id=session), b""
 
     @rpc_method
     async def lock_directory(self, req: PathReq, payload, conn):

@@ -156,6 +156,10 @@ class MetaStore:
     async def _require_unlocked_dir(self, txn: Transaction, parent: int,
                                     client_id: str, path: str) -> Inode:
         inode = await self._require_inode(txn, parent)
+        if inode.itype != InodeType.DIRECTORY:
+            # entry-level callers (FUSE lowlevel) can pass any nodeid as
+            # parent; a DirEntry under a FILE inode would orphan children
+            raise make_error(StatusCode.META_NOT_DIR, path)
         self._check_dir_lock(inode, client_id, path)
         return inode
 
@@ -406,6 +410,144 @@ class MetaStore:
             return inode
         return await self._txn(fn)
 
+    # --- entry-level ops (FUSE lowlevel surface: (parent nodeid, name)) ---
+
+    async def lookup(self, parent: int, name: str) -> Inode:
+        """FUSE lookup (FuseOps.cc:644): (parent inode, name) -> child inode."""
+        async def fn(txn: Transaction):
+            dent = await self._get_dent(txn, parent, name)
+            if dent is None:
+                raise make_error(StatusCode.META_NOT_FOUND,
+                                 f"{parent}/{name}")
+            return await self._require_inode(txn, dent.inode_id)
+        return await self._txn(fn)
+
+    async def readdir_inode(self, inode_id: int,
+                            limit: int = 0) -> list[DirEntry]:
+        async def fn(txn: Transaction):
+            inode = await self._require_inode(txn, inode_id)
+            if inode.itype != InodeType.DIRECTORY:
+                raise make_error(StatusCode.META_NOT_DIR, str(inode_id))
+            pre = DirEntry.prefix(inode_id)
+            rows = await txn.get_range(pre, pre + b"\xff", limit=limit)
+            return [serde.loads(v) for _, v in rows]
+        return await self._txn(fn)
+
+    async def create_at(self, parent: int, name: str, perm: int = 0o644,
+                        chunk_size: int = 0, stripe: int = 0,
+                        session_client: str = "",
+                        request_id: str = "") -> tuple[Inode, str]:
+        layout = self.chains.allocate_layout(chunk_size, stripe)
+
+        async def fn(txn: Transaction):
+            if await self._get_dent(txn, parent, name) is not None:
+                raise make_error(StatusCode.META_EXISTS, name)
+            await self._require_unlocked_dir(txn, parent, session_client, name)
+            inode_id = await self.ids.allocate()
+            inode = Inode(inode_id=inode_id, itype=InodeType.FILE, perm=perm,
+                          layout=layout).touch()
+            txn.set(Inode.key(inode_id), serde.dumps(inode))
+            txn.set(DirEntry.key(parent, name), serde.dumps(
+                DirEntry(parent, name, inode_id, InodeType.FILE)))
+            session_id = ""
+            if session_client:
+                session_id = str(uuidlib.uuid4())
+                txn.set(FileSession.key(inode_id, session_id), serde.dumps(
+                    FileSession(inode_id, session_id, session_client,
+                                time.time())))
+            return inode, session_id
+        return await self._txn_idem(fn, "create", session_client, request_id)
+
+    async def mkdir_at(self, parent: int, name: str, perm: int = 0o755,
+                       client_id: str = "", request_id: str = "") -> Inode:
+        async def fn(txn: Transaction):
+            if await self._get_dent(txn, parent, name) is not None:
+                raise make_error(StatusCode.META_EXISTS, name)
+            await self._require_unlocked_dir(txn, parent, client_id, name)
+            inode_id = await self.ids.allocate()
+            inode = Inode(inode_id=inode_id, itype=InodeType.DIRECTORY,
+                          perm=perm, nlink=2, parent=parent).touch()
+            txn.set(Inode.key(inode_id), serde.dumps(inode))
+            txn.set(DirEntry.key(parent, name), serde.dumps(
+                DirEntry(parent, name, inode_id, InodeType.DIRECTORY)))
+            return inode
+        return await self._txn_idem(fn, "mkdirs", client_id, request_id)
+
+    async def symlink_at(self, parent: int, name: str, target: str,
+                         client_id: str = "", request_id: str = "") -> Inode:
+        async def fn(txn: Transaction):
+            if await self._get_dent(txn, parent, name) is not None:
+                raise make_error(StatusCode.META_EXISTS, name)
+            await self._require_unlocked_dir(txn, parent, client_id, name)
+            inode_id = await self.ids.allocate()
+            inode = Inode(inode_id=inode_id, itype=InodeType.SYMLINK,
+                          symlink_target=target).touch()
+            txn.set(Inode.key(inode_id), serde.dumps(inode))
+            txn.set(DirEntry.key(parent, name), serde.dumps(
+                DirEntry(parent, name, inode_id, InodeType.SYMLINK)))
+            return inode
+        return await self._txn_idem(fn, "symlink", client_id, request_id)
+
+    async def _unlink_body(self, txn: Transaction, parent: int, name: str,
+                           dent: DirEntry, recursive: bool, client_id: str,
+                           must_dir: bool | None = None) -> None:
+        await self._require_unlocked_dir(txn, parent, client_id, name)
+        if must_dir is True and dent.itype != InodeType.DIRECTORY:
+            raise make_error(StatusCode.META_NOT_DIR, name)   # rmdir(file)
+        if must_dir is False and dent.itype == InodeType.DIRECTORY:
+            raise make_error(StatusCode.META_IS_DIR, name)    # unlink(dir)
+        if dent.itype == InodeType.DIRECTORY:
+            await self._require_unlocked_dir(txn, dent.inode_id,
+                                             client_id, name)
+            pre = DirEntry.prefix(dent.inode_id)
+            children = await txn.get_range(pre, pre + b"\xff")
+            if children and not recursive:
+                raise make_error(StatusCode.META_NOT_EMPTY, name)
+            for _, raw in children:
+                child: DirEntry = serde.loads(raw)
+                await self._remove_tree(txn, child, client_id)
+                txn.clear(DirEntry.key(child.parent, child.name))
+        await self._unlink_entry(txn, dent)
+        txn.clear(DirEntry.key(parent, name))
+
+    async def unlink_at(self, parent: int, name: str, recursive: bool = False,
+                        client_id: str = "", request_id: str = "",
+                        must_dir: bool | None = None) -> None:
+        async def fn(txn: Transaction):
+            dent = await self._get_dent(txn, parent, name)
+            if dent is None:
+                raise make_error(StatusCode.META_NOT_FOUND, name)
+            await self._unlink_body(txn, parent, name, dent, recursive,
+                                    client_id, must_dir)
+        return await self._txn_idem(fn, "remove", client_id, request_id)
+
+    async def rename_at(self, sparent: int, sname: str, dparent: int,
+                        dname: str, client_id: str = "",
+                        request_id: str = "") -> None:
+        async def fn(txn: Transaction):
+            sdent = await self._get_dent(txn, sparent, sname)
+            if sdent is None:
+                raise make_error(StatusCode.META_NOT_FOUND, sname)
+            await self._rename_body(txn, sparent, sname, sdent,
+                                    dparent, dname, client_id)
+        return await self._txn_idem(fn, "rename", client_id, request_id)
+
+    async def open_inode(self, inode_id: int, write: bool = False,
+                         session_client: str = "") -> tuple[Inode, str]:
+        """FUSE open by nodeid: like open_file but without a path walk."""
+        async def fn(txn: Transaction):
+            inode = await self._require_inode(txn, inode_id)
+            if inode.itype == InodeType.DIRECTORY and write:
+                raise make_error(StatusCode.META_IS_DIR, str(inode_id))
+            session_id = ""
+            if write and session_client:
+                session_id = str(uuidlib.uuid4())
+                txn.set(FileSession.key(inode_id, session_id),
+                        serde.dumps(FileSession(inode_id, session_id,
+                                                session_client, time.time())))
+            return inode, session_id
+        return await self._txn(fn)
+
     async def batch_stat(self, paths: list[str],
                          follow: bool = True) -> list[Inode | None]:
         """Stat many paths in ONE transaction (batchStatByPath,
@@ -479,33 +621,45 @@ class MetaStore:
             return inode
         return await self._txn_idem(fn, "hardlink", client_id, request_id)
 
+    async def _rename_body(self, txn: Transaction, sparent: int, sname: str,
+                           sdent: DirEntry, dparent: int, dname: str,
+                           client_id: str) -> None:
+        await self._require_unlocked_dir(txn, sparent, client_id, sname)
+        if dparent != sparent:
+            await self._require_unlocked_dir(txn, dparent, client_id, dname)
+        ddent = await self._get_dent(txn, dparent, dname)
+        if ddent is not None:
+            if ddent.inode_id == sdent.inode_id:
+                # POSIX: src and dst resolve to the same file (same entry or
+                # hardlink alias) -> no-op; unlink-then-relink would destroy
+                # the last link and dangle the new entry
+                return
+            if ddent.itype == InodeType.DIRECTORY:
+                # overwriting a locked (even empty) directory destroys it
+                await self._require_unlocked_dir(txn, ddent.inode_id,
+                                                 client_id, dname)
+                pre = DirEntry.prefix(ddent.inode_id)
+                if await txn.get_range(pre, pre + b"\xff", limit=1):
+                    raise make_error(StatusCode.META_NOT_EMPTY, dname)
+            # overwrite: unlink destination
+            await self._unlink_entry(txn, ddent)
+        txn.clear(DirEntry.key(sparent, sname))
+        txn.set(DirEntry.key(dparent, dname), serde.dumps(
+            DirEntry(dparent, dname, sdent.inode_id, sdent.itype)))
+        if sdent.itype == InodeType.DIRECTORY:
+            inode = await self._require_inode(txn, sdent.inode_id)
+            inode.parent = dparent
+            txn.set(Inode.key(inode.inode_id), serde.dumps(inode))
+
     async def rename(self, src: str, dst: str,
                      client_id: str = "", request_id: str = "") -> None:
         async def fn(txn: Transaction):
             sparent, sname, sdent = await self.resolve(txn, src, follow_last=False)
             if sdent is None:
                 raise make_error(StatusCode.META_NOT_FOUND, src)
-            await self._require_unlocked_dir(txn, sparent, client_id, src)
-            dparent, dname, ddent = await self.resolve(txn, dst, follow_last=False)
-            if dparent != sparent:
-                await self._require_unlocked_dir(txn, dparent, client_id, dst)
-            if ddent is not None:
-                if ddent.itype == InodeType.DIRECTORY:
-                    # overwriting a locked (even empty) directory destroys it
-                    await self._require_unlocked_dir(txn, ddent.inode_id,
-                                                     client_id, dst)
-                    pre = DirEntry.prefix(ddent.inode_id)
-                    if await txn.get_range(pre, pre + b"\xff", limit=1):
-                        raise make_error(StatusCode.META_NOT_EMPTY, dst)
-                # overwrite: unlink destination
-                await self._unlink_entry(txn, ddent)
-            txn.clear(DirEntry.key(sparent, sname))
-            txn.set(DirEntry.key(dparent, dname), serde.dumps(
-                DirEntry(dparent, dname, sdent.inode_id, sdent.itype)))
-            if sdent.itype == InodeType.DIRECTORY:
-                inode = await self._require_inode(txn, sdent.inode_id)
-                inode.parent = dparent
-                txn.set(Inode.key(inode.inode_id), serde.dumps(inode))
+            dparent, dname, _ = await self.resolve(txn, dst, follow_last=False)
+            await self._rename_body(txn, sparent, sname, sdent,
+                                    dparent, dname, client_id)
         return await self._txn_idem(fn, "rename", client_id, request_id)
 
     async def _unlink_entry(self, txn: Transaction, dent: DirEntry) -> None:
@@ -526,29 +680,14 @@ class MetaStore:
 
     async def remove(self, path: str, recursive: bool = False,
                      client_id: str = "", request_id: str = "") -> None:
+        # recursive removal runs inside one txn (small trees); big trees
+        # should go through trash + async GC
         async def fn(txn: Transaction):
             parent, name, dent = await self.resolve(txn, path, follow_last=False)
             if dent is None:
                 raise make_error(StatusCode.META_NOT_FOUND, path)
-            await self._require_unlocked_dir(txn, parent, client_id, path)
-            if dent.itype == InodeType.DIRECTORY:
-                # removing a locked directory (or any locked subdirectory)
-                # IS an entry mutation under it — same lock check applies,
-                # else remove -r bypasses what create/rename enforce
-                await self._require_unlocked_dir(txn, dent.inode_id,
-                                                 client_id, path)
-                pre = DirEntry.prefix(dent.inode_id)
-                children = await txn.get_range(pre, pre + b"\xff")
-                if children and not recursive:
-                    raise make_error(StatusCode.META_NOT_EMPTY, path)
-                for _, raw in children:
-                    child: DirEntry = serde.loads(raw)
-                    # recursive removal inside one txn (small trees); big
-                    # trees should go through trash + async GC
-                    await self._remove_tree(txn, child, client_id)
-                    txn.clear(DirEntry.key(child.parent, child.name))
-            await self._unlink_entry(txn, dent)
-            txn.clear(DirEntry.key(parent, name))
+            await self._unlink_body(txn, parent, name, dent, recursive,
+                                    client_id)
         return await self._txn_idem(fn, "remove", client_id, request_id)
 
     async def _remove_tree(self, txn: Transaction, dent: DirEntry,

@@ -356,3 +356,55 @@ def test_distributor_partition():
     # solo server owns everything
     solo = Distributor(7, None)
     assert solo.is_mine(12345)
+
+
+def test_rename_same_inode_posix_noop():
+    """rename where src and dst resolve to the same inode must be a no-op,
+    never an unlink-then-relink (that destroys the last link)."""
+    async def body():
+        from t3fs.kv.engine import MemKVEngine
+        kv = MemKVEngine()
+        st = _mk_store(kv)
+        ino, _ = await st.create("/f")
+        # same entry
+        await st.rename("/f", "/f")
+        assert (await st.stat("/f")).inode_id == ino.inode_id
+        # hardlink alias: rename a -> b where b is a link to the same inode
+        await st.hardlink("/f", "/f2")
+        await st.rename("/f", "/f2")
+        assert (await st.stat("/f")).inode_id == ino.inode_id
+        assert (await st.stat("/f2")).inode_id == ino.inode_id
+        assert (await st.stat("/f")).nlink == 2
+        # entry-level variant
+        await st.rename_at(1, "f", 1, "f2")
+        assert (await st.stat("/f2")).inode_id == ino.inode_id
+    asyncio.run(body())
+
+
+def test_unlink_at_type_discrimination():
+    async def body():
+        from t3fs.kv.engine import MemKVEngine
+        kv = MemKVEngine()
+        st = _mk_store(kv)
+        await st.mkdirs("/d")
+        await st.create("/f")
+        with pytest.raises(StatusError):   # rmdir(file) -> NOT_DIR
+            await st.unlink_at(1, "f", must_dir=True)
+        with pytest.raises(StatusError):   # unlink(dir) -> IS_DIR
+            await st.unlink_at(1, "d", must_dir=False)
+        await st.unlink_at(1, "f", must_dir=False)
+        await st.unlink_at(1, "d", must_dir=True)
+    asyncio.run(body())
+
+
+def test_entry_ops_reject_file_parent():
+    async def body():
+        from t3fs.kv.engine import MemKVEngine
+        kv = MemKVEngine()
+        st = _mk_store(kv)
+        f, _ = await st.create("/f")
+        with pytest.raises(StatusError):
+            await st.create_at(f.inode_id, "child")
+        with pytest.raises(StatusError):
+            await st.mkdir_at(f.inode_id, "child")
+    asyncio.run(body())
