@@ -466,6 +466,54 @@ async def space_info(ctx: AdminContext, args) -> None:
     print(f"capacity={rsp.capacity} used={rsp.used} free={rsp.free}")
 
 
+@command("create-target", "provision a new target dir on a storage node")
+@args_(("addr", {}), ("target_id", {"type": int}), ("root", {}),
+       ("--engine", {"default": "native"}))
+async def create_target(ctx: AdminContext, args) -> None:
+    from t3fs.storage.types import TargetOpReq
+    rsp, _ = await ctx.cli.call(args.addr, "Storage.create_target",
+                                TargetOpReq(target_id=args.target_id,
+                                            root=args.root,
+                                            engine_backend=args.engine))
+    print(f"target {rsp.target_id} created (state={rsp.state})")
+
+
+@command("offline-target", "mark a target OFFLINE on its node")
+@args_(("addr", {}), ("target_id", {"type": int}))
+async def offline_target(ctx: AdminContext, args) -> None:
+    from t3fs.storage.types import TargetOpReq
+    rsp, _ = await ctx.cli.call(args.addr, "Storage.offline_target",
+                                TargetOpReq(target_id=args.target_id))
+    print(f"target {rsp.target_id} offlined")
+
+
+@command("remove-target", "drop an OFFLINE target from its node")
+@args_(("addr", {}), ("target_id", {"type": int}))
+async def remove_target(ctx: AdminContext, args) -> None:
+    from t3fs.storage.types import TargetOpReq
+    rsp, _ = await ctx.cli.call(args.addr, "Storage.remove_target",
+                                TargetOpReq(target_id=args.target_id))
+    print(f"target {rsp.target_id} removed")
+
+
+@command("query-chunk", "one chunk's metadata on a storage node")
+@args_(("addr", {}), ("chain_id", {"type": int}), ("inode", {"type": int}),
+       ("index", {"type": int}))
+async def query_chunk(ctx: AdminContext, args) -> None:
+    from t3fs.storage.types import ChunkId, QueryChunkReq
+    rsp, _ = await ctx.cli.call(
+        args.addr, "Storage.query_chunk",
+        QueryChunkReq(chain_id=args.chain_id,
+                      chunk_id=ChunkId(args.inode, args.index)))
+    if not rsp.found:
+        print("not found")
+        return
+    m = rsp.meta
+    print(f"{m.chunk_id}: len={m.length} update_ver={m.update_ver} "
+          f"commit_ver={m.commit_ver} chain_ver={m.chain_ver} "
+          f"crc={m.checksum:#010x} state={m.state}")
+
+
 @command("dump-chunkmeta", "chunk metadata of a chain on a storage node")
 @args_(("addr", {}), ("chain_id", {"type": int}))
 async def dump_chunkmeta(ctx: AdminContext, args) -> None:

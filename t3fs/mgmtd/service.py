@@ -341,16 +341,22 @@ def next_chain_state(chain: ChainInfo,
             t.public_state = PublicTargetState.SYNCING
             serving_count -= 1
             changed = True
-        elif t.public_state == PublicTargetState.SERVING and not a:
-            # last serving target holds the authoritative copy: LASTSRV
+        elif t.public_state == PublicTargetState.SERVING \
+                and (not a or ls == LocalTargetState.OFFLINE):
+            # node dead OR the node itself reports the target's disk failed
+            # (CheckWorker/write-error -> heartbeat local OFFLINE, reference
+            # StorageOperator.cc:604-606); last serving target holds the
+            # authoritative copy: LASTSRV
             t.public_state = (PublicTargetState.LASTSRV if serving_count == 1
                               else PublicTargetState.OFFLINE)
             serving_count -= 1
             changed = True
-        elif t.public_state == PublicTargetState.SYNCING and not a:
+        elif t.public_state == PublicTargetState.SYNCING \
+                and (not a or ls == LocalTargetState.OFFLINE):
             t.public_state = PublicTargetState.OFFLINE
             changed = True
-        elif t.public_state == PublicTargetState.LASTSRV and a:
+        elif t.public_state == PublicTargetState.LASTSRV and a \
+                and ls != LocalTargetState.OFFLINE:
             t.public_state = PublicTargetState.SERVING
             serving_count += 1
             has_lastsrv = False

@@ -1,0 +1,85 @@
+"""CheckWorker: periodic disk-health probes per storage target.
+
+Reference analog: src/storage/worker/CheckWorker — probe each target's disk
+and flip its local state to OFFLINE on failure so heartbeats propagate it
+and mgmtd pulls the target out of its chains (the passive half of the
+write-error path in StorageOperator.cc:604-606).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+
+from t3fs.mgmtd.types import LocalTargetState
+
+log = logging.getLogger("t3fs.storage.check")
+
+PROBE_NAME = ".t3fs-health-probe"
+
+
+def probe_target_dir(root: str) -> None:
+    """Write+fsync+read+unlink a probe file; raises OSError on disk failure."""
+    path = os.path.join(root, PROBE_NAME)
+    fd = os.open(path, os.O_RDWR | os.O_CREAT | os.O_TRUNC, 0o600)
+    try:
+        os.pwrite(fd, b"t3fs-probe", 0)
+        os.fsync(fd)
+        if os.pread(fd, 10, 0) != b"t3fs-probe":
+            raise OSError("probe readback mismatch")
+    finally:
+        os.close(fd)
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+
+
+class CheckWorker:
+    """Probes every target's data dir; marks failing ones OFFLINE."""
+
+    def __init__(self, node, period_s: float = 5.0):
+        self.node = node
+        self.period_s = period_s
+        self._task: asyncio.Task | None = None
+        self._stopped = asyncio.Event()
+        self.probes = 0
+        self.failures = 0
+
+    async def start(self) -> None:
+        self._task = asyncio.create_task(self._loop(), name="check-worker")
+
+    async def stop(self) -> None:
+        self._stopped.set()
+        if self._task:
+            self._task.cancel()
+            try:
+                await self._task
+            except (asyncio.CancelledError, Exception):
+                pass
+
+    async def _loop(self) -> None:
+        while not self._stopped.is_set():
+            await asyncio.sleep(self.period_s)
+            try:
+                await self.check_once()
+            except Exception:
+                log.exception("check worker tick failed")
+
+    async def check_once(self) -> int:
+        """Probe all targets; returns number of newly-failed ones."""
+        failed = 0
+        for tid, target in list(self.node.targets.items()):
+            if self.node.local_states.get(tid) == LocalTargetState.OFFLINE:
+                continue
+            self.probes += 1
+            try:
+                await asyncio.to_thread(probe_target_dir, target.engine.root)
+            except OSError as e:
+                self.failures += 1
+                failed += 1
+                log.error("target %d: disk probe failed, going OFFLINE: %s",
+                          tid, e)
+                self.node.local_states[tid] = LocalTargetState.OFFLINE
+        return failed

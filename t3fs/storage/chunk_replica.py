@@ -48,6 +48,17 @@ class ChunkReplica:
         meta = self.engine.get_meta(io.chunk_id)
 
         if io.update_type == UpdateType.REMOVE:
+            if io.is_sync and meta is not None:
+                # resync removes are CAS-gated on the snapshot state the
+                # worker diffed against: a live write that touched the chunk
+                # since (new version, or the in-flight write committed)
+                # invalidates the removal — deleting would lose acked data
+                # the tail now has (stale-remove race; the sim found it).
+                if (meta.update_ver, meta.commit_ver, meta.checksum) != \
+                        (io.update_ver, io.commit_ver, io.checksum):
+                    return IOResult(WireStatus(), meta.length, meta.update_ver,
+                                    meta.commit_ver, meta.chain_ver,
+                                    meta.checksum)
             self.engine.remove(io.chunk_id)
             return IOResult(WireStatus(), 0, io.update_ver, io.update_ver, io.chain_ver, 0)
 
@@ -110,7 +121,10 @@ class ChunkReplica:
             raise make_error(StatusCode.CHUNK_MISSING_UPDATE,
                              f"{io.chunk_id}: v{io.update_ver} after v{cur_update}")
         if cur_state == ChunkState.DIRTY:
-            # a different pending update exists; caller must retry after commit
+            # a different pending update exists; caller must retry after
+            # commit.  A retry of a FAILED attempt re-enters with its
+            # remembered version (ReliableUpdate.remember_version) and takes
+            # the idempotent branch above instead of landing here.
             raise make_error(StatusCode.CHUNK_BUSY,
                              f"{io.chunk_id}: pending v{cur_update}")
 

@@ -109,6 +109,15 @@ class NativeChunkEngine:
     def _err(self) -> str:
         return (self._lib.t3fs_ce_last_error(self._h) or b"").decode()
 
+    def _io_error(self, prefix: str):
+        """Typed disk-error for engine I/O failures: the service offlines
+        the target on DISK_ERROR instead of parsing message strings.  Pure
+        validation failures from the C side stay INVALID_ARG."""
+        msg = self._err()
+        if "bad chunk size" in msg:
+            return make_error(StatusCode.INVALID_ARG, f"{prefix}: {msg}")
+        return make_error(StatusCode.DISK_ERROR, f"{prefix}: {msg}")
+
     def get_meta(self, chunk_id: ChunkId) -> ChunkMeta | None:
         cm = _CeMeta()
         r = self._lib.t3fs_ce_get_meta(self._h, chunk_id.encode(), C.byref(cm))
@@ -128,7 +137,7 @@ class NativeChunkEngine:
         r = self._lib.t3fs_ce_read(self._h, chunk_id.encode(), offset, length,
                                    buf, C.byref(out_len))
         if r < 0:
-            raise make_error(StatusCode.INTERNAL, self._err())
+            raise self._io_error("read")
         if r == 0:
             raise make_error(StatusCode.CHUNK_NOT_FOUND, str(chunk_id))
         return buf.raw[: out_len.value]
@@ -139,7 +148,7 @@ class NativeChunkEngine:
         r = self._lib.t3fs_ce_put(self._h, chunk_id.encode(), bytes(content),
                                   len(content), chunk_size, C.byref(cm))
         if r != 1:
-            raise make_error(StatusCode.INTERNAL, f"put failed: {self._err()}")
+            raise self._io_error("put failed")
 
     def set_meta(self, chunk_id: ChunkId, meta: ChunkMeta) -> None:
         cm = _meta_to_c(meta)

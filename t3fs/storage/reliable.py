@@ -24,7 +24,8 @@ class ReliableUpdate:
     Seq regressions are rejected (late duplicates of older requests)."""
 
     def __init__(self):
-        self._sessions: dict[tuple, tuple[int, IOResult | None]] = {}
+        # key -> (last seq, cached result, assigned update_ver, in_flight)
+        self._sessions: dict[tuple, tuple[int, IOResult | None, int, bool]] = {}
         self._locks: dict[tuple, asyncio.Lock] = {}
 
     def lock_for(self, io: UpdateIO) -> asyncio.Lock:
@@ -39,9 +40,13 @@ class ReliableUpdate:
         entry = self._sessions.get(key)
         if entry is None:
             return None
-        last_seq, result = entry
+        last_seq, result, _ver, in_flight = entry
         if io.channel_seq == last_seq:
-            return result or IOResult(WireStatus(int(StatusCode.BUSY), "in flight"))
+            if result is not None:
+                return result
+            if in_flight:
+                return IOResult(WireStatus(int(StatusCode.BUSY), "in flight"))
+            return None   # failed retryably: the retry proceeds (same ver)
         if io.channel_seq < last_seq:
             raise make_error(StatusCode.CHUNK_STALE_UPDATE,
                              f"channel {io.channel} seq {io.channel_seq} < {last_seq}")
@@ -50,12 +55,42 @@ class ReliableUpdate:
     def begin(self, io: UpdateIO) -> None:
         if io.channel:
             key = (io.client_id, io.chain_id, io.channel)
-            self._sessions[key] = (io.channel_seq, None)
+            prev = self._sessions.get(key)
+            keep_ver = prev[2] if prev and prev[0] == io.channel_seq else 0
+            self._sessions[key] = (io.channel_seq, None, keep_ver, True)
 
-    def record(self, io: UpdateIO, result: IOResult) -> None:
+    def remember_version(self, io: UpdateIO) -> None:
+        """Pin the update_ver assigned to this (channel, seq): a retry after
+        a retryable failure re-enters with the SAME version and hits the
+        replica's idempotent-pending branch instead of CHUNK_BUSY-wedging on
+        its own abandoned DIRTY marker."""
         if io.channel:
             key = (io.client_id, io.chain_id, io.channel)
-            self._sessions[key] = (io.channel_seq, result)
+            self._sessions[key] = (io.channel_seq, None, io.update_ver, True)
+
+    def assigned_version(self, io: UpdateIO) -> int:
+        if not io.channel:
+            return 0
+        entry = self._sessions.get((io.client_id, io.chain_id, io.channel))
+        if entry and entry[0] == io.channel_seq:
+            return entry[2]
+        return 0
+
+    def record(self, io: UpdateIO, result: IOResult) -> None:
+        if not io.channel:
+            return
+        from t3fs.utils.status import Status
+        st = Status(StatusCode(result.status.code), result.status.message)
+        key = (io.client_id, io.chain_id, io.channel)
+        if not st.ok and st.retryable:
+            # a RETRYABLE failure (disk error, stale chain, successor down)
+            # must not pin the failure: the client retries the SAME seq after
+            # the chain reshapes — keep only the assigned version so the
+            # retry is idempotent against the pending DIRTY chunk
+            self._sessions[key] = (io.channel_seq, None, io.update_ver,
+                                   False)
+            return
+        self._sessions[key] = (io.channel_seq, result, io.update_ver, False)
 
 
 class ReliableForwarding:

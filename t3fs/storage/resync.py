@@ -114,10 +114,21 @@ class ResyncWorker:
                                   f"replace {cid}: {rsp2.result.status.message}")
         for cid in remote:
             if cid not in local_all:   # truly absent locally (not just DIRTY)
+                # re-check at SEND time: a live write may have CREATED the
+                # chunk here since the diff snapshot (and full-replace-
+                # forwarded it to the successor) — removing it there would
+                # delete acked data
+                if target.engine.get_meta(cid) is not None:
+                    continue
+                rm = remote[cid]
+                # CAS remove: carries the snapshot state; the successor only
+                # removes if its chunk still matches exactly (a racing live
+                # write invalidates the stale removal — replica gating)
                 io = UpdateIO(chunk_id=cid, chain_id=chain.chain_id,
                               chain_ver=chain.chain_ver,
                               update_type=UpdateType.REMOVE,
-                              update_ver=remote[cid].update_ver + 1,
+                              update_ver=rm.update_ver,
+                              commit_ver=rm.commit_ver, checksum=rm.checksum,
                               is_sync=True, from_head=True, inline=True)
                 rsp3, _ = await node.client.call(address, "Storage.update", io)
                 if rsp3.result.status.code != int(StatusCode.OK):

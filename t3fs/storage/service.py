@@ -31,9 +31,10 @@ from t3fs.storage.chunk_replica import ChunkReplica
 from t3fs.storage.reliable import ReliableForwarding, ReliableUpdate
 from t3fs.storage.types import (
     BatchReadReq, BatchReadRsp, ChunkId, IOResult,
-    QueryLastChunkReq, QueryLastChunkRsp, ReadIO, RemoveChunksReq,
-    SpaceInfoRsp, SyncDoneReq, SyncDoneRsp, SyncStartReq, SyncStartRsp,
-    TruncateChunkReq, UpdateIO, UpdateType, WriteReq, WriteRsp,
+    QueryChunkReq, QueryChunkRsp, QueryLastChunkReq, QueryLastChunkRsp,
+    ReadIO, RemoveChunksReq, SpaceInfoRsp, SyncDoneReq, SyncDoneRsp,
+    SyncStartReq, SyncStartRsp, TargetOpReq, TargetOpRsp, TruncateChunkReq,
+    UpdateIO, UpdateType, WriteReq, WriteRsp,
 )
 from t3fs.analytics.trace_log import StorageEventTrace
 from t3fs.utils.fault_injection import fault_raise
@@ -131,6 +132,22 @@ class StorageNode:
 
     # --- chain helpers ---
 
+    def mark_if_disk_error(self, target: StorageTarget, err: Exception) -> bool:
+        """Write-error -> offline the target so heartbeats pull it out of its
+        chains (reference StorageOperator.cc:604-606 offlineTargets).  Only
+        genuine I/O failures qualify: OSError from the python engine, or the
+        native engine's typed DISK_ERROR status."""
+        is_disk = isinstance(err, OSError) or (
+            isinstance(err, StatusError)
+            and err.code == StatusCode.DISK_ERROR)
+        if not is_disk:
+            return False
+        if self.local_states.get(target.target_id) != LocalTargetState.OFFLINE:
+            log.error("target %d: disk error, going OFFLINE: %s",
+                      target.target_id, err)
+            self.local_states[target.target_id] = LocalTargetState.OFFLINE
+        return True
+
     def _target_for_chain(self, chain: ChainInfo) -> StorageTarget | None:
         for ct in chain.targets:
             if ct.node_id == self.node_id and ct.target_id in self.targets:
@@ -169,11 +186,23 @@ class StorageService:
     async def _update_to_result(self, io: UpdateIO, payload: bytes,
                                 conn: Connection, require_head: bool) -> IOResult:
         """All gating/transport failures become per-IO result statuses
-        (reference: IOResult carries status, not RPC-level errors)."""
+        (reference: IOResult carries status, not RPC-level errors).  EVERY
+        failure is recorded against the update channel — an exception that
+        escaped after reliable_update.begin() would otherwise leave the
+        session in_flight forever and BUSY-wedge all retries of that seq."""
         try:
-            return await self._handle_update(io, payload, conn, require_head)
+            result = await self._handle_update(io, payload, conn, require_head)
         except StatusError as e:
-            return IOResult(WireStatus(int(e.code), str(e)))
+            result = IOResult(WireStatus(int(e.code), str(e)))
+        except OSError as e:
+            result = IOResult(WireStatus(int(StatusCode.DISK_ERROR),
+                                         f"i/o error: {e}"))
+        except Exception as e:  # e.g. RuntimeError from a closing executor
+            log.exception("update %s failed unexpectedly", io.chunk_id)
+            result = IOResult(WireStatus(int(StatusCode.INTERNAL), str(e)))
+        if require_head and result.status.code != int(StatusCode.OK):
+            self.node.reliable_update.record(io, result)
+        return result
 
     @rpc_method
     async def write(self, req: WriteReq, payload: bytes, conn: Connection):
@@ -250,8 +279,18 @@ class StorageService:
                 payload = await remote_read(conn, io.buf)
                 trace_add("storage.update.pulled", f"len={len(payload)}")
             if io.update_ver == 0:
-                meta = target.engine.get_meta(io.chunk_id)
-                io.update_ver = (meta.update_ver if meta else 0) + 1
+                # a retry of a retryably-failed attempt reuses the version it
+                # was assigned: the replica's idempotent-pending branch then
+                # accepts it instead of wedging on its own DIRTY marker
+                remembered = node.reliable_update.assigned_version(io) \
+                    if require_head else 0
+                if remembered:
+                    io.update_ver = remembered
+                else:
+                    meta = target.engine.get_meta(io.chunk_id)
+                    io.update_ver = (meta.update_ver if meta else 0) + 1
+                    if require_head:
+                        node.reliable_update.remember_version(io)
             io.chain_ver = chain.chain_ver
 
             # checksum via the codec seam: the device backend micro-batches
@@ -270,8 +309,12 @@ class StorageService:
                 result = await target.run_update(
                     target.replica.apply_update, io, payload, payload_crc)
                 trace_add("storage.update.applied", f"ver={io.update_ver}")
-            except StatusError as e:
-                result = IOResult(WireStatus(int(e.code), str(e)))
+            except (OSError, StatusError) as e:
+                if node.mark_if_disk_error(target, e):
+                    result = IOResult(WireStatus(int(StatusCode.DISK_ERROR),
+                                                 f"disk error: {e}"))
+                else:
+                    result = IOResult(WireStatus(int(e.code), str(e)))
                 if require_head:
                     node.reliable_update.record(io, result)
                 return result
@@ -303,9 +346,15 @@ class StorageService:
                 return result
 
             if io.update_type not in (UpdateType.REMOVE,):
-                result = await target.run_update(
-                    target.replica.commit, io.chunk_id, io.update_ver,
-                    chain.chain_ver)
+                try:
+                    result = await target.run_update(
+                        target.replica.commit, io.chunk_id, io.update_ver,
+                        chain.chain_ver)
+                except (OSError, StatusError) as e:
+                    # a disk that dies between apply and commit must offline
+                    # the target just like one that dies during apply
+                    node.mark_if_disk_error(target, e)
+                    raise
                 trace_add("storage.update.committed")
             if require_head:
                 node.reliable_update.record(io, result)
@@ -439,6 +488,89 @@ class StorageService:
         used = sum(t.engine.stats().used_bytes for t in self.node.targets.values())
         alloc = sum(t.engine.stats().allocated_bytes for t in self.node.targets.values())
         return SpaceInfoRsp(capacity=alloc, used=used, free=max(0, alloc - used)), b""
+
+    # ---- admin target ops (fbs/storage/Service.h:8-24) ----
+
+    @rpc_method
+    async def create_target(self, req: TargetOpReq, payload, conn):
+        """Provision a new target (disk dir) on this node; it joins chains
+        via mgmtd update_chain + resync."""
+        node = self.node
+        if req.target_id in node.targets:
+            raise make_error(StatusCode.INVALID_ARG,
+                             f"target {req.target_id} already exists")
+        if not req.root:
+            raise make_error(StatusCode.INVALID_ARG, "create_target: no root")
+        t = node.add_target(req.target_id, req.root,
+                            state=LocalTargetState.ONLINE,
+                            engine_backend=req.engine_backend)
+        return TargetOpRsp(target_id=t.target_id,
+                           state=int(LocalTargetState.ONLINE)), b""
+
+    @rpc_method
+    async def offline_target(self, req: TargetOpReq, payload, conn):
+        """Operator-initiated offline: heartbeats propagate it and mgmtd
+        pulls the target out of its chains."""
+        node = self.node
+        if req.target_id not in node.targets:
+            raise make_error(StatusCode.TARGET_NOT_FOUND, str(req.target_id))
+        node.local_states[req.target_id] = LocalTargetState.OFFLINE
+        return TargetOpRsp(target_id=req.target_id,
+                           state=int(LocalTargetState.OFFLINE)), b""
+
+    @rpc_method
+    async def remove_target(self, req: TargetOpReq, payload, conn):
+        """Drop a target from this node.  Requires the target locally
+        OFFLINE *and* out of the live chain in routing (OFFLINE/WAITING):
+        removing (then re-creating) a still-SERVING/LASTSRV target would
+        seat an empty disk as an authoritative copy."""
+        node = self.node
+        t = node.targets.get(req.target_id)
+        if t is None:
+            raise make_error(StatusCode.TARGET_NOT_FOUND, str(req.target_id))
+        if node.local_states.get(req.target_id) != LocalTargetState.OFFLINE:
+            raise make_error(StatusCode.INVALID_ARG,
+                             f"target {req.target_id} not OFFLINE")
+        routing = node.routing()
+        if routing is not None:
+            for chain in routing.chains.values():
+                for ct in chain.targets:
+                    if ct.target_id == req.target_id and ct.public_state not \
+                            in (PublicTargetState.OFFLINE,
+                                PublicTargetState.WAITING):
+                        raise make_error(
+                            StatusCode.INVALID_ARG,
+                            f"target {req.target_id} is still "
+                            f"{ct.public_state.name} in chain "
+                            f"{chain.chain_id}; wait for mgmtd to demote it")
+        node.targets.pop(req.target_id, None)
+        node.local_states.pop(req.target_id, None)
+        # close() joins the update worker — never on the event loop
+        await asyncio.to_thread(t.close)
+        return TargetOpRsp(target_id=req.target_id), b""
+
+    @rpc_method
+    async def query_chunk(self, req: QueryChunkReq, payload, conn):
+        """One chunk's metadata (admin/debug; reference queryChunk)."""
+        if req.target_id:
+            target = self.node.targets.get(req.target_id)
+            if target is None:
+                # never silently answer from a different target
+                raise make_error(StatusCode.TARGET_NOT_FOUND,
+                                 f"target {req.target_id}")
+        else:
+            _, target = self.node._check_chain(req.chain_id, 0)
+        meta = target.engine.get_meta(req.chunk_id)
+        return QueryChunkRsp(found=meta is not None, meta=meta), b""
+
+    @rpc_method
+    async def get_all_chunk_metadata(self, req: TargetOpReq, payload, conn):
+        """Full chunk-meta dump by target id (admin sweep analog of the
+        resync-path sync_start, which addresses by chain)."""
+        t = self.node.targets.get(req.target_id)
+        if t is None:
+            raise make_error(StatusCode.TARGET_NOT_FOUND, str(req.target_id))
+        return SyncStartRsp(metas=t.engine.all_metas()), b""
 
     # ---- resync protocol (predecessor-driven, ResyncWorker.cc analog) ----
 

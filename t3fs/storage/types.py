@@ -197,6 +197,41 @@ class SyncStartRsp:
 
 @serde_struct
 @dataclass
+class TargetOpReq:
+    """Admin target ops (fbs/storage/Service.h:8-24: createTarget,
+    offlineTarget, removeTarget, getAllChunkMetadata)."""
+    target_id: int = 0
+    root: str = ""               # create_target: data directory
+    engine_backend: str = "native"
+    chain_id: int = 0            # alternative addressing for meta dumps
+
+
+@serde_struct
+@dataclass
+class TargetOpRsp:
+    ok: bool = True
+    target_id: int = 0
+    state: int = 0               # LocalTargetState after the op
+
+
+@serde_struct
+@dataclass
+class QueryChunkReq:
+    """queryChunk: one chunk's metadata on one target (admin/debug)."""
+    chain_id: int = 0
+    target_id: int = 0
+    chunk_id: ChunkId = field(default_factory=lambda: ChunkId(0, 0))
+
+
+@serde_struct
+@dataclass
+class QueryChunkRsp:
+    found: bool = False
+    meta: ChunkMeta | None = None
+
+
+@serde_struct
+@dataclass
 class SyncDoneReq:
     chain_id: int = 0
 

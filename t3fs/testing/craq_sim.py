@@ -52,6 +52,7 @@ class SimNode:
     engine: ChunkEngine
     replica: ChunkReplica
     alive: bool = True
+    disk_ok: bool = True          # False: disk failed (node alive, data gone)
     local_state: LocalTargetState = LocalTargetState.UPTODATE
     max_commit_seen: dict[bytes, int] = field(default_factory=dict)
 
@@ -78,7 +79,7 @@ class WriteOp:
 class CraqSim:
     def __init__(self, seed: int, *, replicas: int = 3, writes: int = 6,
                  crashes: int = 1, chunks: int = 2, wipe_on_crash: bool = False,
-                 mgmtd_restarts: int = 0):
+                 mgmtd_restarts: int = 0, disk_fails: int = 0):
         self.rng = random.Random(seed)
         self.seed = seed
         self.tmp = tempfile.TemporaryDirectory(prefix="craq-sim-")
@@ -111,6 +112,7 @@ class CraqSim:
                                          for n in self.nodes.values()}
         self.node_gen_persisted: dict[int, int] = dict(self.node_gen)
         self.mgmtd_restart_budget = mgmtd_restarts
+        self.disk_fail_budget = disk_fails
         # startup grace after a mgmtd restart: empty liveness map == treat
         # everyone as alive for a window (MgmtdState.started_at analog)
         self.mgmtd_grace_ticks = 0
@@ -171,6 +173,13 @@ class CraqSim:
         acts.append(("mgmtd_tick", None))
         if self.mgmtd_restart_budget > 0:
             acts.append(("mgmtd_restart", None))
+        if self.disk_fail_budget > 0:
+            for n in self.nodes.values():
+                if n.alive and n.disk_ok:
+                    acts.append(("disk_fail", n))
+        for n in self.nodes.values():
+            if not n.disk_ok and self._replace_allowed(n):
+                acts.append(("disk_replace", n))
         for succ in list(self.resync_inflight):
             acts.append(("resync_step", succ))
         self._maybe_enable_resync(acts)
@@ -185,11 +194,13 @@ class CraqSim:
         if not serving:
             return
         tail = serving[-1]
-        if not self.node_of_target(tail.target_id).alive:
+        tnode = self.node_of_target(tail.target_id)
+        if not tnode.alive or not tnode.disk_ok:
             return
         for succ in self.chain.syncing():
+            snode = self.node_of_target(succ.target_id)
             if succ.target_id not in self.resync_inflight \
-                    and self.node_of_target(succ.target_id).alive:
+                    and snode.alive and snode.disk_ok:
                 acts.append(("resync_start", (tail.target_id, succ.target_id)))
 
     def step(self) -> bool:
@@ -224,8 +235,9 @@ class CraqSim:
                       if t.target_id == target_id), None)
         in_chain = tinfo is not None and tinfo.public_state in (
             PublicTargetState.SERVING, PublicTargetState.SYNCING)
-        if node is None or not node.alive or not in_chain:
-            # RPC to this hop fails; the attempt waits — until mgmtd
+        if node is None or not node.alive or not node.disk_ok \
+                or not in_chain:
+            # RPC/disk error at this hop; the attempt waits — until mgmtd
             # publishes a new chain version, retrying the same membership
             # is pointless (StorageClientImpl backoff)
             return
@@ -304,18 +316,46 @@ class CraqSim:
         node.alive = False
         if self.wipe_on_crash:
             node.wipe()
-            node.local_state = LocalTargetState.ONLINE
-        else:
+        if node.disk_ok:
             node.local_state = LocalTargetState.ONLINE  # stale until resync
+        # else: the dead disk stays OFFLINE through the crash
         self.resync_inflight.pop(node.target_id, None)
 
     def _do_restart(self, node: SimNode) -> None:
         node.alive = True
         # reference semantics: a restarted target reports ONLINE (data
         # possibly stale) until resync marks it UPTODATE; the next heartbeat
-        # carries a new generation, flagging the restart to mgmtd
-        node.local_state = LocalTargetState.ONLINE
+        # carries a new generation, flagging the restart to mgmtd.  A node
+        # booting on a dead disk keeps reporting OFFLINE.
+        node.local_state = (LocalTargetState.ONLINE if node.disk_ok
+                            else LocalTargetState.OFFLINE)
         self.node_gen[node.node_id] += 1
+
+    def _replace_allowed(self, node: SimNode) -> bool:
+        """Operator rule (remove_target/create_target gating): a disk swap
+        only happens after mgmtd pulled the target out of the live chain —
+        swapping a still-SERVING/LASTSRV target would seat an empty disk as
+        an authoritative copy."""
+        t = next((t for t in self.chain.targets
+                  if t.target_id == node.target_id), None)
+        return t is not None and t.public_state in (
+            PublicTargetState.OFFLINE, PublicTargetState.WAITING)
+
+    def _do_disk_fail(self, node: SimNode) -> None:
+        """Disk dies under a live node: the node detects it (write error /
+        CheckWorker probe) and reports local OFFLINE in heartbeats
+        (StorageOperator.cc:604-606 + worker/CheckWorker analog)."""
+        self.disk_fail_budget -= 1
+        node.disk_ok = False
+        node.local_state = LocalTargetState.OFFLINE
+        self.resync_inflight.pop(node.target_id, None)
+
+    def _do_disk_replace(self, node: SimNode) -> None:
+        """Operator replaces the disk (create_target): empty data, local
+        ONLINE; mgmtd re-seats the target as SYNCING and resync refills."""
+        node.wipe()
+        node.disk_ok = True
+        node.local_state = LocalTargetState.ONLINE
 
     def _do_mgmtd_restart(self, _arg) -> None:
         """The MANAGER restarts: all in-memory liveness/restart tracking is
@@ -365,7 +405,7 @@ class CraqSim:
         for key, rm in remote.items():
             if key not in local_all:
                 steps.append(("remove", tail_t, rm.chunk_id,
-                              rm.update_ver + 1, 0, 0))
+                              rm.update_ver, rm.commit_ver, rm.checksum))
         steps.append(("sync_done", tail_t, None, 0, 0, 0))
         self.resync_inflight[succ_t] = steps
 
@@ -377,13 +417,13 @@ class CraqSim:
         succ_node = self.node_of_target(succ_t)
         tinfo = next((t for t in self.chain.targets
                       if t.target_id == succ_t), None)
-        if not succ_node.alive or tinfo is None \
+        if not succ_node.alive or not succ_node.disk_ok or tinfo is None \
                 or tinfo.public_state != PublicTargetState.SYNCING:
             self.resync_inflight.pop(succ_t, None)  # aborted; retried later
             return
         kind, tail_t, chunk_id, uver, cver, crc = steps.pop(0)
         tail = self.node_of_target(tail_t)
-        if not tail.alive:
+        if not tail.alive or not tail.disk_ok:
             self.resync_inflight.pop(succ_t, None)
             return
         try:
@@ -405,10 +445,13 @@ class CraqSim:
                 succ_node.replica.apply_update(io, content)
                 self._note_commit(succ_node, chunk_id)
             elif kind == "remove":
+                if tail.engine.get_meta(chunk_id) is not None:
+                    return  # live write created it since the snapshot
                 io = UpdateIO(chunk_id=chunk_id, chain_id=1,
                               chain_ver=self.chain.chain_ver,
                               update_type=UpdateType.REMOVE,
-                              update_ver=uver, is_sync=True, inline=True)
+                              update_ver=uver, commit_ver=cver, checksum=crc,
+                              is_sync=True, inline=True)
                 succ_node.replica.apply_update(io, b"")
             else:  # sync_done
                 succ_node.local_state = LocalTargetState.UPTODATE
@@ -421,7 +464,7 @@ class CraqSim:
         """Committed read as I4 probe: returned bytes must be SOME applied
         write's content (or empty)."""
         node = self.nodes.get(target_id)
-        if node is None or not node.alive:
+        if node is None or not node.alive or not node.disk_ok:
             return
         chunk = self.rng.choice(self.chunks)
         meta = node.engine.get_meta(chunk)
@@ -455,6 +498,7 @@ class CraqSim:
                 # stop crashing once writes are done so the system can settle
                 if len(self.done) >= self.writes_total:
                     self.crash_budget = 0
+                    self.disk_fail_budget = 0
                 if not self.step():
                     break
                 if self._quiescent():
@@ -472,7 +516,7 @@ class CraqSim:
         return (len(self.done) >= self.writes_total
                 and not self.pending
                 and not self.resync_inflight
-                and all(n.alive for n in self.nodes.values())
+                and all(n.alive and n.disk_ok for n in self.nodes.values())
                 and not self.chain.syncing()
                 and self.crash_budget == 0
                 and len(self.chain.serving()) == len(self.nodes))
@@ -486,7 +530,10 @@ class CraqSim:
             # one round of every recovery mechanism per iteration — a write
             # step may be a no-op while it waits for a routing change, so
             # membership/resync must advance in the same pass
+            self._do_mgmtd_tick(None)
             for n in self.nodes.values():
+                if not n.disk_ok and self._replace_allowed(n):
+                    self._do_disk_replace(n)
                 if not n.alive:
                     self._do_restart(n)
             self._do_mgmtd_tick(None)

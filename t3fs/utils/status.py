@@ -54,6 +54,7 @@ class StatusCode(enum.IntEnum):
     TARGET_SYNCING = 5013            # full-chunk-replace required
     READ_ONLY = 5014
     EC_FORMAT_MISMATCH = 5015        # stripe parity written with another generator
+    DISK_ERROR = 5016                # target disk I/O failure (going OFFLINE)
 
     # meta (reference: MetaCode)
     META_NOT_FOUND = 6001
@@ -82,6 +83,8 @@ RETRYABLE_CODES = frozenset({
     StatusCode.TXN_CONFLICT, StatusCode.TXN_TOO_OLD, StatusCode.TXN_RETRYABLE,
     StatusCode.CHUNK_BUSY, StatusCode.CHAIN_VERSION_MISMATCH,
     StatusCode.TARGET_OFFLINE, StatusCode.NOT_HEAD, StatusCode.TARGET_SYNCING,
+    # the target just offlined itself; mgmtd will reshape the chain shortly
+    StatusCode.DISK_ERROR,
     # routing staleness: the chain/target may simply not have propagated yet
     StatusCode.TARGET_NOT_FOUND,
     StatusCode.MGMTD_NOT_PRIMARY, StatusCode.MGMTD_STALE_ROUTING,

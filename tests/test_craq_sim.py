@@ -54,3 +54,11 @@ def test_mgmtd_restart_schedules():
     (everyone presumed alive) must not break safety."""
     assert run_schedules(60, crashes=1, mgmtd_restarts=1) == {}
     assert run_schedules(40, crashes=2, mgmtd_restarts=2) == {}
+
+
+def test_disk_failure_schedules():
+    """Disk dies under a live node (local OFFLINE via write-error/CheckWorker),
+    chain pulls the target, operator replaces the disk, resync refills it —
+    acked writes must survive throughout."""
+    assert run_schedules(60, crashes=0, disk_fails=1) == {}
+    assert run_schedules(40, crashes=1, disk_fails=1) == {}

@@ -124,3 +124,62 @@ def test_rejoining_node_drops_extra_chunks():
         finally:
             await cluster.stop()
     asyncio.run(body())
+
+
+def test_disk_failure_offline_replace_resync():
+    """Disk dies under a LIVE node mid-writes: write error marks the target
+    OFFLINE, heartbeats propagate, mgmtd pulls it from the chain with no
+    acked-write loss; operator 'replaces the disk' and the target resyncs
+    back to serving (VERDICT item 8 gate; StorageOperator.cc:604-606 +
+    worker/CheckWorker analogs)."""
+    async def body():
+        cluster = LocalCluster(num_nodes=3, replicas=3,
+                               heartbeat_timeout_s=0.6)
+        await cluster.start()
+        try:
+            lay = FileLayout(chunk_size=4096, chains=[1])
+            data1 = b"pre-disk-failure" * 300
+            await cluster.sc.write_file_range(lay, 1, 0, data1)
+
+            # node 2's disk dies: engine.put starts raising EIO
+            victim_target = cluster.target_id(2, 0)
+            node2 = cluster.storage[2].node
+            target = node2.targets[victim_target]
+            real_put = target.engine.put
+
+            def broken_put(*a, **kw):
+                raise OSError(5, "Input/output error")
+            target.engine.put = broken_put
+
+            # writes keep succeeding (chain retries through the reshape)
+            data2 = b"during-disk-failure" * 300
+            results = await cluster.sc.write_file_range(lay, 2, 0, data2)
+            assert all(r.status.code == int(StatusCode.OK) for r in results), \
+                [str(r.status) for r in results]
+
+            # mgmtd pulled the disk-failed target out of the serving set
+            await wait_for(
+                lambda: all(t.target_id != victim_target
+                            for t in cluster.chain().serving()),
+                desc="disk-failed target leaves the serving set")
+
+            # operator replaces the disk: engine works again, target ONLINE
+            from t3fs.mgmtd.types import LocalTargetState
+            target.engine.put = real_put
+            node2.local_states[victim_target] = LocalTargetState.ONLINE
+
+            await wait_for(
+                lambda: any(t.target_id == victim_target
+                            for t in cluster.chain().serving()),
+                timeout=15.0, desc="replaced target promoted to serving")
+
+            # the rejoined replica holds both files byte-exact
+            from t3fs.storage.types import ChunkId
+            for inode, data in ((1, data1), (2, data2)):
+                got = b""
+                for idx in range((len(data) + 4095) // 4096):
+                    got += target.engine.read(ChunkId(inode, idx))
+                assert got == data, f"inode {inode} diverged after disk swap"
+        finally:
+            await cluster.stop()
+    asyncio.run(body())

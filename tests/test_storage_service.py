@@ -229,3 +229,106 @@ def test_uncommitted_not_served_and_concurrent_chunks():
         finally:
             await fabric.stop()
     run(body())
+
+
+def test_admin_target_rpcs():
+    """createTarget/offlineTarget/removeTarget/queryChunk/getAllChunkMetadata
+    (fbs/storage/Service.h:8-24)."""
+    from t3fs.storage.types import QueryChunkReq, TargetOpReq
+
+    async def body():
+        fabric = StorageFabric(num_nodes=1, replicas=1)
+        await fabric.start()
+        try:
+            addr = fabric.head_address()
+            cid = ChunkId(5, 0)
+            data = b"q" * 500
+            await write(fabric, cid, data)
+
+            rsp, _ = await fabric.client.call(
+                addr, "Storage.query_chunk",
+                QueryChunkReq(chain_id=fabric.chain_id, chunk_id=cid))
+            assert rsp.found and rsp.meta.length == 500
+            rsp, _ = await fabric.client.call(
+                addr, "Storage.query_chunk",
+                QueryChunkReq(chain_id=fabric.chain_id,
+                              chunk_id=ChunkId(5, 99)))
+            assert not rsp.found
+
+            tid = fabric.target_id(0)
+            rsp, _ = await fabric.client.call(
+                addr, "Storage.get_all_chunk_metadata",
+                TargetOpReq(target_id=tid))
+            assert [str(m.chunk_id) for m in rsp.metas] == ["5.0"]
+
+            # create a second target, offline it, remove it
+            import tempfile
+            with tempfile.TemporaryDirectory() as d:
+                rsp, _ = await fabric.client.call(
+                    addr, "Storage.create_target",
+                    TargetOpReq(target_id=999, root=d))
+                assert rsp.target_id == 999
+                node = fabric.nodes[0]
+                assert 999 in node.targets
+                # remove refuses while not OFFLINE
+                from t3fs.utils.status import StatusError
+                with pytest.raises(StatusError):
+                    await fabric.client.call(addr, "Storage.remove_target",
+                                             TargetOpReq(target_id=999))
+                await fabric.client.call(addr, "Storage.offline_target",
+                                         TargetOpReq(target_id=999))
+                await fabric.client.call(addr, "Storage.remove_target",
+                                         TargetOpReq(target_id=999))
+                assert 999 not in node.targets
+        finally:
+            await fabric.stop()
+    run(body())
+
+
+def test_write_error_offlines_target():
+    """Engine I/O failure on a write marks the target locally OFFLINE
+    (StorageOperator.cc:604-606 offlineTargets analog)."""
+    from t3fs.mgmtd.types import LocalTargetState
+
+    async def body():
+        fabric = StorageFabric(num_nodes=1, replicas=1)
+        await fabric.start()
+        try:
+            node = fabric.nodes[0]
+            tid = fabric.target_id(0)
+            target = node.targets[tid]
+
+            def broken_put(*a, **kw):
+                raise OSError(5, "Input/output error")
+            target.engine.put = broken_put
+
+            result = await write(fabric, ChunkId(6, 0), b"x" * 100)
+            assert result.status.code != int(StatusCode.OK)
+            assert node.local_states[tid] == LocalTargetState.OFFLINE
+        finally:
+            await fabric.stop()
+    run(body())
+
+
+def test_check_worker_probe():
+    from t3fs.mgmtd.types import LocalTargetState
+    from t3fs.storage.check_worker import CheckWorker
+
+    async def body():
+        fabric = StorageFabric(num_nodes=1, replicas=1)
+        await fabric.start()
+        try:
+            node = fabric.nodes[0]
+            tid = fabric.target_id(0)
+            cw = CheckWorker(node, period_s=60)
+            assert await cw.check_once() == 0
+            assert node.local_states[tid] != LocalTargetState.OFFLINE
+            # disk "dies": probe directory vanishes
+            node.targets[tid].engine.root += "-gone"
+            assert await cw.check_once() == 1
+            assert node.local_states[tid] == LocalTargetState.OFFLINE
+            # already-offline targets aren't re-probed
+            assert await cw.check_once() == 0
+        finally:
+            await fabric.stop()
+    run(body())
