@@ -217,19 +217,27 @@ async def client_sessions(ctx: AdminContext, args) -> None:
        ("--apply", {"action": "store_true",
                     "help": "install via Mgmtd.set_chains"}))
 async def gen_chains(ctx: AdminContext, args) -> None:
-    from t3fs.mgmtd.placement import target_id
+    from t3fs.mgmtd.placement import (
+        build_chain_table, recovery_imbalance, target_id,
+    )
     node_ids = [int(x) for x in args.nodes.split(",")]
+    # recovery-traffic-balanced assignment (BIBD objective; reference
+    # deploy/data_placement integer program): rows are node INDICES 1..N
+    table = build_chain_table(len(node_ids), args.chains, args.replicas)
     chains = []
-    for c in range(args.chains):
+    for c, row in enumerate(table):
         targets = []
-        for r in range(args.replicas):
-            node_id = node_ids[(c + r) % len(node_ids)]
+        for idx in row:
+            node_id = node_ids[idx - 1]
             targets.append(ChainTargetInfo(target_id(node_id, c), node_id,
                                            PublicTargetState.SERVING))
         chains.append(ChainInfo(chain_id=c + 1, chain_ver=1, targets=targets))
     for chain in chains:
         print(f"chain {chain.chain_id}: " + " -> ".join(
             f"t{t.target_id}@n{t.node_id}" for t in chain.targets))
+    print(f"recovery imbalance: "
+          f"{recovery_imbalance(table, len(node_ids)):.3f} "
+          f"(1.0 = perfectly balanced reconstruction load)")
     if args.apply:
         await ctx.cli.call(
             ctx.mgmtd_address, "Mgmtd.set_chains",

@@ -90,9 +90,25 @@ class ECLayout:
 class ECStorageClient:
     """Stripe-granular EC write/read/repair over a StorageClient."""
 
-    def __init__(self, storage_client, use_device_codec: bool = True):
+    def __init__(self, storage_client, use_device_codec: bool = True,
+                 fast_read_retries: int = 4):
         self.sc = storage_client
         self.use_device = use_device_codec
+        # degraded reads must not wait out long retry tails on dead chains:
+        # parity covers a fast-failed shard, so EC reads use a bounded-retry
+        # view of the same client (shared sockets + routing), falling back
+        # to the patient client only when reconstruction lacks shards
+        self._fast = self._bounded_view(storage_client, fast_read_retries)
+
+    @staticmethod
+    def _bounded_view(sc, max_retries: int):
+        import copy
+
+        fast = copy.copy(sc)
+        fast.cfg = copy.copy(sc.cfg)
+        fast.cfg.max_retries = max_retries
+        fast.cfg.retry_backoff_s = min(sc.cfg.retry_backoff_s, 0.03)
+        return fast
 
     # --- codec (TPU path by default; numpy oracle as fallback) ---
 
@@ -171,7 +187,7 @@ class ECStorageClient:
         ios = [ReadIO(chunk_id=layout.data_chunk(inode, stripe, j),
                       chain_id=layout.shard_chain(stripe, j))
                for j in range(k) if lens[j]]
-        results, payloads = await self.sc.batch_read(ios)
+        results, payloads = await self._fast.batch_read(ios)
         chunks: dict[int, bytes] = {}
         missing: list[int] = []
         pos = 0
@@ -228,22 +244,53 @@ class ECStorageClient:
                               chain_id=layout.shard_chain(stripe, s)))
             ids.append(s)
         if ios:
-            results, payloads = await self.sc.batch_read(ios)
+            results, payloads = await self._fast.batch_read(ios)
             for s, r, p in zip(ids, results, payloads):
                 if r.status.code == int(StatusCode.OK):
                     buf = np.zeros(cs, dtype=np.uint8)
                     buf[: len(p)] = np.frombuffer(p, dtype=np.uint8)
                     have[s] = buf
         if len(have) < k:
+            # not enough survivors after the fast pass: one PATIENT retry
+            # wave over everything still missing — including the `want`
+            # shards themselves (a transient blip, e.g. a reshape in
+            # progress, may have fast-failed shards that a patient read
+            # recovers directly, needing no decode at all)
+            ios2, ids2 = [], []
+            for s in range(k + m):
+                if s in have or s in zero_shards:
+                    continue
+                cid = (layout.data_chunk(inode, stripe, s) if s < k
+                       else layout.parity_chunk(inode, stripe, s - k))
+                ios2.append(ReadIO(chunk_id=cid,
+                                   chain_id=layout.shard_chain(stripe, s)))
+                ids2.append(s)
+            if ios2:
+                results2, payloads2 = await self.sc.batch_read(ios2)
+                for s, r, p in zip(ids2, results2, payloads2):
+                    if r.status.code == int(StatusCode.OK):
+                        buf = np.zeros(cs, dtype=np.uint8)
+                        buf[: len(p)] = np.frombuffer(p, dtype=np.uint8)
+                        have[s] = buf
+        if len(have) < k:
             raise make_error(
                 StatusCode.TARGET_OFFLINE,
                 f"EC stripe {stripe}: only {len(have)} of {k + m} shards "
                 f"available, need {k}")
         layout.check_code(default_rs(k, m))
-        present = tuple(sorted(have.keys())[:k])
-        rows = np.stack([have[s] for s in present])
-        out = await self._reconstruct(rows, present, tuple(want), k, m)
-        return [bytes(out[i]) for i in range(len(want))]
+        # shards recovered directly need no decoding
+        still_want = tuple(s for s in want if s not in have)
+        decoded: dict[int, bytes] = {}
+        if still_want:
+            # recovered want-shards may serve as decode inputs; only the
+            # still-missing ones must stay out of the present set
+            present = tuple(sorted(s for s in have.keys()
+                                   if s not in still_want)[:k])
+            rows = np.stack([have[s] for s in present])
+            out = await self._reconstruct(rows, present, still_want, k, m)
+            decoded = {s: bytes(out[i]) for i, s in enumerate(still_want)}
+        return [decoded[s] if s in decoded else bytes(have[s])
+                for s in want]
 
     async def repair_chunk(self, layout: ECLayout, inode: int, stripe: int,
                            shard: int, stripe_len: int) -> IOResult:

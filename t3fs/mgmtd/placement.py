@@ -61,3 +61,157 @@ def validate_ec_chains(routing: RoutingInfo, chains: list[int], m: int) -> bool:
     for cid in chains:
         node_load.update(chain_nodes(routing, cid))
     return all(c <= m for c in node_load.values())
+
+
+# --- recovery-traffic-balanced chain-table construction ----------------------
+#
+# Reference analog: deploy/data_placement/src/model/data_placement.py:30,
+# 484-490 — a Pyomo+HiGHS integer program whose objective approximates a
+# balanced incomplete block design: every PAIR of nodes should co-occur on
+# (nearly) the same number of chains.  Why pairs: when node f fails, each
+# chain through f is recovered by reads from that chain's OTHER members, so
+# node j's share of f's recovery traffic is pair_count(f, j).  A balanced
+# pair matrix spreads reconstruction load evenly and minimizes recovery
+# time.  t3fs solves the same objective with greedy-swap local search
+# (sum-of-squares of pair counts), which reaches the integer optimum's
+# neighborhood for practical topologies without an ILP dependency.
+
+
+def pair_counts(assignment: list[list[int]], num_nodes: int) -> Counter:
+    """(i, j) i<j -> number of chains containing both nodes."""
+    pc: Counter = Counter()
+    for nodes in assignment:
+        s = sorted(set(nodes))
+        for a in range(len(s)):
+            for b in range(a + 1, len(s)):
+                pc[(s[a], s[b])] += 1
+    return pc
+
+
+def recovery_load(assignment: list[list[int]], num_nodes: int,
+                  failed: int) -> Counter:
+    """node -> chains it co-hosts with `failed` (its recovery read share)."""
+    load: Counter = Counter()
+    for nodes in assignment:
+        if failed in nodes:
+            for n in nodes:
+                if n != failed:
+                    load[n] += 1
+    return load
+
+
+def _ss(pc: Counter) -> int:
+    return sum(v * v for v in pc.values())
+
+
+def build_chain_table(num_nodes: int, num_chains: int, replicas: int,
+                      *, sweeps: int = 60, seed: int = 0) -> list[list[int]]:
+    """Assign `replicas` distinct nodes (1-based ids) to each chain with
+    per-node chain counts balanced and pairwise co-occurrence as flat as the
+    integer constraints allow (the BIBD objective).
+
+    Greedy-swap local search: start from the round-robin table, then
+    repeatedly replace one member of one chain with an underloaded/
+    pair-reducing node whenever that strictly lowers the sum of squared pair
+    counts while keeping per-node chain counts within the balanced band."""
+    import random as _random
+
+    assert 1 <= replicas <= num_nodes
+    rng = _random.Random(seed)
+    nodes = list(range(1, num_nodes + 1))
+    assignment = [[nodes[(c + r) % num_nodes] for r in range(replicas)]
+                  for c in range(num_chains)]
+    total = num_chains * replicas
+    cap_lo, cap_hi = total // num_nodes, -(-total // num_nodes)
+    per_node: Counter = Counter(n for ch in assignment for n in ch)
+    pc = pair_counts(assignment, num_nodes)
+
+    def swap_delta(chain: list[int], out_n: int, in_n: int) -> int:
+        """Change in sum-of-squares if out_n -> in_n within this chain."""
+        delta = 0
+        for other in chain:
+            if other in (out_n, in_n):
+                continue
+            ko = tuple(sorted((out_n, other)))
+            ki = tuple(sorted((in_n, other)))
+            delta += -2 * pc[ko] + 1          # (v-1)^2 - v^2
+            delta += 2 * pc[ki] + 1           # (v+1)^2 - v^2
+        return delta
+
+    def apply_swap(chain: list[int], out_n: int, in_n: int) -> None:
+        for other in chain:
+            if other in (out_n, in_n):
+                continue
+            pc[tuple(sorted((out_n, other)))] -= 1
+            pc[tuple(sorted((in_n, other)))] += 1
+        per_node[out_n] -= 1
+        per_node[in_n] += 1
+        chain[chain.index(out_n)] = in_n
+
+    improved = True
+    for _ in range(sweeps):
+        if not improved:
+            break
+        improved = False
+        order = list(range(num_chains))
+        rng.shuffle(order)
+        for ci in order:
+            chain = assignment[ci]
+            # move 1: single replacement within the balanced band
+            best = (0, None, None)
+            for out_n in chain:
+                for in_n in nodes:
+                    if in_n in chain:
+                        continue
+                    if per_node[out_n] - 1 < cap_lo or \
+                            per_node[in_n] + 1 > cap_hi:
+                        continue
+                    d = swap_delta(chain, out_n, in_n)
+                    if d < best[0]:
+                        best = (d, out_n, in_n)
+            d, out_n, in_n = best
+            if out_n is not None:
+                apply_swap(chain, out_n, in_n)
+                improved = True
+                continue
+            # move 2: EXCHANGE members with another chain — per-node counts
+            # are invariant, so this works even when the balanced band has
+            # zero slack (num_chains*replicas divisible by num_nodes)
+            cj = rng.randrange(num_chains)
+            if cj == ci:
+                continue
+            other_chain = assignment[cj]
+            best2 = (0, None, None)
+            for a in chain:
+                if a in other_chain:
+                    continue
+                for b in other_chain:
+                    if b in chain:
+                        continue
+                    d1 = swap_delta(chain, a, b)
+                    # apply tentatively so the second delta sees the first
+                    apply_swap(chain, a, b)
+                    d2 = swap_delta(other_chain, b, a)
+                    apply_swap(chain, b, a)   # revert
+                    if d1 + d2 < best2[0]:
+                        best2 = (d1 + d2, a, b)
+            d, a, b = best2
+            if a is not None:
+                apply_swap(chain, a, b)
+                apply_swap(other_chain, b, a)
+                improved = True
+    return assignment
+
+
+def recovery_imbalance(assignment: list[list[int]], num_nodes: int) -> float:
+    """max over failed nodes of (max peer recovery share / mean share);
+    1.0 = perfectly balanced reconstruction traffic."""
+    worst = 1.0
+    for f in range(1, num_nodes + 1):
+        load = recovery_load(assignment, num_nodes, f)
+        if not load:
+            continue
+        mean = sum(load.values()) / max(1, num_nodes - 1)
+        if mean > 0:
+            worst = max(worst, max(load.values()) / mean)
+    return worst

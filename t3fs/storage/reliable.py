@@ -77,20 +77,46 @@ class ReliableUpdate:
         return 0
 
     def record(self, io: UpdateIO, result: IOResult) -> None:
+        """Record an attempt's outcome.  Guards (each prevents a session-
+        state corruption a failure path could otherwise cause):
+          - seq regressions are ignored (a late duplicate of an older seq
+            must not roll the channel backward past a newer cached result);
+          - a cached FINAL result (ok or non-retryable) is never clobbered
+            by a later failure of the same seq (e.g. a pre-check raise);
+          - the BUSY cache-echo served to concurrent duplicates is never
+            recorded (it would flip in_flight while the original attempt
+            still runs);
+          - a failure recorded before version assignment (io.update_ver==0)
+            preserves the previously remembered version."""
         if not io.channel:
             return
         from t3fs.utils.status import Status
         st = Status(StatusCode(result.status.code), result.status.message)
         key = (io.client_id, io.chain_id, io.channel)
+        prev = self._sessions.get(key)
+        prev_ver = 0
+        if prev is not None:
+            last_seq, prev_res, prev_ver0, _in_flight = prev
+            if io.channel_seq < last_seq:
+                return
+            if io.channel_seq == last_seq:
+                prev_ver = prev_ver0
+                if prev_res is not None:
+                    prev_st = Status(StatusCode(prev_res.status.code),
+                                     prev_res.status.message)
+                    if prev_st.ok or not prev_st.retryable:
+                        return
+        if st.code == StatusCode.BUSY and "in flight" in st.message:
+            return
+        ver = io.update_ver or prev_ver
         if not st.ok and st.retryable:
             # a RETRYABLE failure (disk error, stale chain, successor down)
             # must not pin the failure: the client retries the SAME seq after
             # the chain reshapes — keep only the assigned version so the
             # retry is idempotent against the pending DIRTY chunk
-            self._sessions[key] = (io.channel_seq, None, io.update_ver,
-                                   False)
+            self._sessions[key] = (io.channel_seq, None, ver, False)
             return
-        self._sessions[key] = (io.channel_seq, result, io.update_ver, False)
+        self._sessions[key] = (io.channel_seq, result, ver, False)
 
 
 class ReliableForwarding:

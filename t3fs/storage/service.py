@@ -315,9 +315,7 @@ class StorageService:
                                                  f"disk error: {e}"))
                 else:
                     result = IOResult(WireStatus(int(e.code), str(e)))
-                if require_head:
-                    node.reliable_update.record(io, result)
-                return result
+                return result  # _update_to_result records all failures
 
             # forward down the chain (tail commits first)
             try:
@@ -326,10 +324,7 @@ class StorageService:
                 if succ_result is not None:
                     trace["forward_status"] = succ_result.status.code
             except StatusError as e:
-                result = IOResult(WireStatus(int(e.code), f"forward: {e}"))
-                if require_head:
-                    node.reliable_update.record(io, result)
-                return result
+                return IOResult(WireStatus(int(e.code), f"forward: {e}"))
 
             if succ_result is not None and succ_result.status.code == int(StatusCode.OK):
                 # checksum cross-check vs successor (StorageOperator.cc:464-485)
@@ -340,10 +335,7 @@ class StorageService:
                         f"{io.chunk_id}: successor {succ_result.checksum:#x} "
                         f"!= local {result.checksum:#x}")
             elif succ_result is not None:
-                result = succ_result  # propagate successor failure up the chain
-                if require_head:
-                    node.reliable_update.record(io, result)
-                return result
+                return succ_result  # propagate successor failure up the chain
 
             if io.update_type not in (UpdateType.REMOVE,):
                 try:

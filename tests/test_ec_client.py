@@ -103,3 +103,74 @@ def test_ec_short_stripe_and_repair():
         finally:
             await cluster.stop()
     asyncio.run(body())
+
+
+def test_ec_node_killed_mid_stripe_writes():
+    """BASELINE config #4 fault-injection gate: a storage node dies WHILE a
+    stream of stripe writes is in flight; every acked stripe must read back
+    exactly, via TPU/XLA RS reconstruction where the lost node's shards are
+    gone."""
+    async def body():
+        cluster = LocalCluster(num_nodes=3, replicas=1, num_chains=6,
+                               heartbeat_timeout_s=0.6)
+        await cluster.start()
+        try:
+            lay = ECLayout.create(k=4, m=2, chunk_size=1024,
+                                  chains=[1, 2, 3, 4, 5, 6])
+            # fast-fail writer client: single-replica chains on the dead
+            # node never recover, so long retry tails would stall the test
+            from t3fs.client.storage_client import (
+                StorageClient, StorageClientConfig,
+            )
+            wsc = StorageClient(
+                cluster.mgmtd_client.routing,
+                config=StorageClientConfig(max_retries=3,
+                                           retry_backoff_s=0.02),
+                refresh_routing=cluster.mgmtd_client.refresh)
+            ec_w = ECStorageClient(wsc)
+            ec = ECStorageClient(cluster.sc)
+            stripe_len = 4 * 1024
+            acked: dict[int, bytes] = {}
+
+            # warm the encode path first (first RS jit compile takes seconds;
+            # the killer must land mid-STREAM, not mid-compile)
+            warm = b"w" * stripe_len
+            results = await ec_w.write_stripe(lay, 19, 0, warm)
+            assert all(r.status.code == int(StatusCode.OK) for r in results)
+            acked[19] = warm
+
+            async def writer():
+                rng = __import__("random").Random(3)
+                for i in range(30):
+                    data = bytes([rng.randrange(256)]) * stripe_len
+                    try:
+                        results = await ec_w.write_stripe(lay, 20 + i, 0, data)
+                    except Exception:
+                        continue  # mid-kill failures are allowed (unacked)
+                    if all(r.status.code == int(StatusCode.OK)
+                           for r in results):
+                        acked[20 + i] = data
+                    await asyncio.sleep(0.01)
+
+            async def killer():
+                await asyncio.sleep(0.08)   # land mid-stream
+                await cluster.kill_storage_node(2)
+
+            await asyncio.gather(writer(), killer())
+            assert len(acked) >= 5, "too few acked stripes to be meaningful"
+
+            # wait for the reshape, then every acked stripe reconstructs
+            for _ in range(100):
+                routing = cluster.mgmtd.state.routing()
+                if all(c.chain_ver >= 2 for c in routing.chains.values()
+                       if any(t.node_id == 2 for t in c.targets)):
+                    break
+                await asyncio.sleep(0.1)
+            await cluster.mgmtd_client.refresh()
+            for inode, data in acked.items():
+                got = await ec.read_stripe(lay, inode, 0, stripe_len)
+                assert got == data, f"stripe {inode} lost after mid-write kill"
+            await wsc.close()
+        finally:
+            await cluster.stop()
+    asyncio.run(body())

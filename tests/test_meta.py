@@ -26,11 +26,19 @@ def make_routing(num_chains=3):
     return r
 
 
-@pytest.fixture
-def store():
-    kv = MemKVEngine()
+@pytest.fixture(params=["mem", "wal"])
+def store(request, tmp_path):
+    """Per-op suite runs over BOTH KV engines (reference parameterizes meta
+    tests over MemKV and FoundationDB, tests/meta/MetaTestBase.h:29-30)."""
+    from t3fs.kv.wal_engine import open_kv_engine
+    if request.param == "mem":
+        kv = MemKVEngine()
+    else:
+        kv = open_kv_engine(f"wal:{tmp_path}/meta-kv?sync=os")
     routing = make_routing()
-    return MetaStore(kv, ChainAllocator(lambda: routing, default_chunk_size=4096))
+    yield MetaStore(kv, ChainAllocator(lambda: routing, default_chunk_size=4096))
+    if hasattr(kv, "close"):
+        kv.close()
 
 
 def run(coro):

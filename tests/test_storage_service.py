@@ -22,6 +22,13 @@ def run(coro):
     return asyncio.run(coro)
 
 
+@pytest.fixture(autouse=True, params=["native", "py"])
+def _engine_backend(request, monkeypatch):
+    """Both chunk engines (the reference parameterizes UnitTestFabric over
+    engine types, UnitTestFabric.h:86-163)."""
+    monkeypatch.setattr(StorageFabric, "default_engine_backend", request.param)
+
+
 @pytest.fixture(autouse=True, params=["cpu", "device"])
 def _checksum_backend(request, monkeypatch):
     """Run the whole suite under both codec backends (the north-star seam):
@@ -332,3 +339,49 @@ def test_check_worker_probe():
         finally:
             await fabric.stop()
     run(body())
+
+
+def test_reliable_update_record_guards():
+    """Session-state guards: seq regressions ignored, cached final results
+    never clobbered by later failures, cache-echo BUSY never recorded, and
+    pre-assignment failures preserve the remembered version."""
+    from t3fs.net.wire import WireStatus
+    from t3fs.storage.reliable import ReliableUpdate
+    from t3fs.storage.types import IOResult
+
+    ru = ReliableUpdate()
+
+    def io(seq, ver=0):
+        return UpdateIO(chunk_id=ChunkId(1, 0), chain_id=1, channel=9,
+                        channel_seq=seq, client_id="c", update_ver=ver)
+
+    ok = IOResult(WireStatus())
+    retryable = IOResult(WireStatus(int(StatusCode.DISK_ERROR), "disk"))
+    stale = IOResult(WireStatus(int(StatusCode.CHUNK_STALE_UPDATE), "old"))
+    busy_echo = IOResult(WireStatus(int(StatusCode.BUSY), "in flight"))
+
+    # attempt 1: begin, version assigned, retryable failure
+    ru.begin(io(4))
+    ru.remember_version(io(4, ver=7))
+    ru.record(io(4, ver=7), retryable)
+    assert ru.assigned_version(io(4)) == 7
+    assert ru.check(io(4)) is None      # retry proceeds
+
+    # a pre-assignment failure (update_ver still 0) keeps the version
+    ru.record(io(4, ver=0), retryable)
+    assert ru.assigned_version(io(4)) == 7
+
+    # success cached; a later same-seq failure cannot clobber it
+    ru.record(io(4, ver=7), ok)
+    assert ru.check(io(4)).status.code == int(StatusCode.OK)
+    ru.record(io(4, ver=7), retryable)
+    assert ru.check(io(4)).status.code == int(StatusCode.OK)
+
+    # late duplicate of an OLDER seq must not roll the session backward
+    ru.record(io(3, ver=2), stale)
+    assert ru.check(io(4)).status.code == int(StatusCode.OK)
+
+    # the BUSY cache-echo is never recorded (in_flight stays true)
+    ru.begin(io(5))
+    ru.record(io(5), busy_echo)
+    assert ru.check(io(5)).status.code == int(StatusCode.BUSY)
