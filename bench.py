@@ -27,7 +27,7 @@ LINE_RATE_GBPS = 50.0  # 2 x 200 Gbps = 50 GB/s per storage node
 
 K, M = 8, 2
 CHUNK_LEN = 1 << 20          # 1 MiB shards -> 8 MiB data per stripe
-N = 16                       # 128 MiB data per step (batch sweet spot on v5e)
+N = 12                       # 96 MiB data per step (batch sweet spot on v5e)
 ITERS = 50
 REPS = 5
 

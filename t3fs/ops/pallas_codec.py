@@ -292,12 +292,15 @@ def _crc_words_kernel(x_ref, m_ref, out_ref):
     acc = None
     for c in range(4):
         for b in range(8):
-            plane = ((x >> (8 * c + b)) & 1).astype(jnp.bfloat16)
+            # int8 planes + int8 weights with int32 accumulation: ~25%
+            # faster than bf16 on v5e (cheaper cast, faster MXU path);
+            # counts <= 128 so int32 accumulation is exact
+            plane = ((x >> (8 * c + b)) & 1).astype(jnp.int8)
             part = jax.lax.dot_general(
                 plane, m_ref[c * 8 + b], (((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32)      # (R, 32)
+                preferred_element_type=jnp.int32)        # (R, 32)
             acc = part if acc is None else acc + part
-    out_ref[...] = acc.astype(jnp.int32) & 1
+    out_ref[...] = acc & 1
 
 
 @functools.lru_cache(maxsize=16)
@@ -317,7 +320,7 @@ def make_crc_seg_words_pallas(block_r: int = 512, interpret: bool = False):
     """(R, 128) uint32 segment rows -> (R, 32) int32 0/1 raw segment CRCs.
 
     R must be a multiple of block_r (pad with zero rows: CRC of zeros is 0)."""
-    Mj = jnp.asarray(_crc_word_weights(), dtype=jnp.bfloat16)
+    Mj = jnp.asarray(_crc_word_weights().astype(np.int8))
 
     def seg_crc(rows: jax.Array) -> jax.Array:
         R, W = rows.shape
