@@ -82,14 +82,19 @@ class AdminContext:
             await self._mgmtd_client.start()
         return self._mgmtd_client
 
+    async def storage_client(self) -> StorageClient:
+        if self._sc is None:
+            mg = await self.mgmtd_client()
+            self._sc = StorageClient(mg.routing, config=StorageClientConfig(),
+                                     refresh_routing=mg.refresh)
+        return self._sc
+
     async def fs(self) -> FileSystem:
         if self._fs is None:
             if not self.meta_address:
                 raise SystemExit("file commands need --meta ADDR")
-            mg = await self.mgmtd_client()
-            self._sc = StorageClient(mg.routing, config=StorageClientConfig(),
-                                     refresh_routing=mg.refresh)
-            self._fs = FileSystem(MetaClient([self.meta_address]), self._sc)
+            self._fs = FileSystem(MetaClient([self.meta_address]),
+                                  await self.storage_client())
         return self._fs
 
     async def close(self) -> None:
@@ -145,6 +150,12 @@ async def routing(ctx: AdminContext, args) -> None:
             rows.append([chain.chain_id, chain.chain_ver, t.target_id,
                          t.node_id, t.public_state.name])
     print(_fmt_table(rows, ["chain", "ver", "target", "node", "state"]))
+
+
+def _require_meta(ctx: AdminContext) -> str:
+    if not ctx.meta_address:
+        raise SystemExit("this command needs --meta ADDR")
+    return ctx.meta_address
 
 
 def _print_chain(chain) -> None:
@@ -472,6 +483,115 @@ async def trash_clean(ctx: AdminContext, args) -> None:
 async def space_info(ctx: AdminContext, args) -> None:
     rsp, _ = await ctx.cli.call(args.addr, "Storage.space_info", None)
     print(f"capacity={rsp.capacity} used={rsp.used} free={rsp.free}")
+
+
+@command("dump-inodes", "raw inode table scan (DumpInodes)")
+@args_(("--limit", {"type": int, "default": 50}))
+async def dump_inodes(ctx: AdminContext, args) -> None:
+    from t3fs.meta.service import EntryReq
+    rsp, _ = await ctx.cli.call(_require_meta(ctx), "Meta.list_inodes",
+                                EntryReq(limit=args.limit))
+    rows = [[i.inode_id, i.itype.name, oct(i.perm), i.nlink, i.length,
+             len(i.layout.chains) if i.layout else "-"]
+            for i in rsp.inodes if i]
+    print(_fmt_table(rows, ["inode", "type", "perm", "nlink", "len", "chains"]))
+
+
+@command("dump-dirents", "raw dirent table scan (DumpDirEntries)")
+@args_(("--limit", {"type": int, "default": 50}))
+async def dump_dirents(ctx: AdminContext, args) -> None:
+    from t3fs.meta.service import EntryReq
+    rsp, _ = await ctx.cli.call(_require_meta(ctx), "Meta.list_dirents",
+                                EntryReq(limit=args.limit))
+    rows = [[e.parent, e.name, e.inode_id, e.itype.name] for e in rsp.entries]
+    print(_fmt_table(rows, ["parent", "name", "inode", "type"]))
+
+
+@command("find-orphaned-chunks",
+         "chunks on storage whose inode has no meta record (FindOrphanedChunks)")
+async def find_orphaned_chunks(ctx: AdminContext, args) -> None:
+    from t3fs.client.ec_client import PARITY_NS
+    from t3fs.meta.service import EntryReq
+
+    _require_meta(ctx)
+    # full inode-id set from meta (paged raw scan)
+    known: set[int] = set()
+    cursor = 0
+    while True:
+        rsp, _ = await ctx.cli.call(ctx.meta_address, "Meta.list_inodes",
+                                    EntryReq(inode_id=cursor, limit=1000))
+        inodes = [i for i in rsp.inodes if i]
+        if not inodes:
+            break
+        known |= {i.inode_id for i in inodes}
+        cursor = max(i.inode_id for i in inodes)
+        if len(inodes) < 1000:
+            break
+    mg = await ctx.mgmtd_client()
+    info = await mg.refresh()
+    orphans = 0
+    for chain in info.chains.values():
+        head = chain.head()
+        if head is None:
+            continue
+        rsp, _ = await ctx.cli.call(info.node_address(head.node_id),
+                                    "Storage.sync_start",
+                                    SyncStartReq(chain_id=chain.chain_id))
+        for m in rsp.metas:
+            ino = m.chunk_id.inode & ~PARITY_NS
+            if ino not in known:
+                orphans += 1
+                print(f"orphan: chain {chain.chain_id} chunk {m.chunk_id} "
+                      f"len={m.length}")
+    print(f"{orphans} orphaned chunks "
+          f"({len(known)} live inodes checked)")
+
+
+@command("checksum-sweep",
+         "read-verify every chunk of a chain against stored CRCs (Checksum)")
+@args_(("chain_id", {"type": int}))
+async def checksum_sweep(ctx: AdminContext, args) -> None:
+    from t3fs.storage.types import BatchReadReq, ReadIO
+    mg = await ctx.mgmtd_client()
+    info = await mg.refresh()
+    chain = info.chains.get(args.chain_id)
+    if chain is None or chain.head() is None:
+        print("chain not found / headless")
+        return
+    addr = info.node_address(chain.head().node_id)
+    rsp, _ = await ctx.cli.call(addr, "Storage.sync_start",
+                                SyncStartReq(chain_id=args.chain_id))
+    bad = ok = 0
+    for i in range(0, len(rsp.metas), 16):
+        batch = rsp.metas[i:i + 16]
+        req = BatchReadReq(ios=[ReadIO(chunk_id=m.chunk_id,
+                                       chain_id=args.chain_id,
+                                       verify_checksum=True,
+                                       no_payload=True)
+                                for m in batch])
+        rrsp, _ = await ctx.cli.call(addr, "Storage.batch_read", req)
+        for m, r in zip(batch, rrsp.results):
+            if r.status.code == 0:
+                ok += 1
+            else:
+                bad += 1
+                print(f"BAD {m.chunk_id}: {r.status.message}")
+    print(f"checksum sweep of chain {args.chain_id}: {ok} ok, {bad} bad")
+
+
+@command("fill-zero", "overwrite a chunk range with zeros (FillZero repair)")
+@args_(("chain_id", {"type": int}), ("inode", {"type": int}),
+       ("begin", {"type": int}), ("end", {"type": int}),
+       ("--chunk-size", {"type": int, "default": 1 << 20}))
+async def fill_zero(ctx: AdminContext, args) -> None:
+    from t3fs.storage.types import ChunkId, UpdateType
+    sc = await ctx.storage_client()
+    for idx in range(args.begin, args.end):
+        r = await sc.write_chunk(args.chain_id, ChunkId(args.inode, idx), 0,
+                                 b"\x00" * args.chunk_size,
+                                 chunk_size=args.chunk_size,
+                                 update_type=UpdateType.REPLACE)
+        print(f"chunk {args.inode}.{idx}: {r.status.code}")
 
 
 @command("create-target", "provision a new target dir on a storage node")

@@ -51,6 +51,12 @@ class StorageClientConfig:
     verify_checksums: bool = False
     read_selection: TargetSelection = TargetSelection.LOAD_BALANCE
     num_channels: int = 64
+    # transfer discipline for bulk payloads: "inline" frames data in the RPC
+    # (one round trip; best on TCP), "remote_buf" registers a pooled buffer
+    # and lets the server pull/push one-sided (the reference's RDMA flow,
+    # StorageOperator.cc:560-591/178-226 — the mode a verbs backend uses)
+    transfer_mode: str = "inline"
+    remote_buf_threshold: int = 512 << 10
     # fault-injection flags carried in every request (reference
     # StorageClient.h:162-166 driving DebugFlags, Common.h:290-307)
     debug: DebugFlags = field(default_factory=DebugFlags)
@@ -92,6 +98,17 @@ class StorageClient:
         self.client_id = client_id or f"sc-{random.getrandbits(48):012x}"
         self.channels = UpdateChannelAllocator(self.cfg.num_channels)
         self._rr = itertools.count()
+        # registered-buffer pool for remote_buf transfers (BufferPool.h:24-27
+        # analog); the registry rides this client's duplex connections so
+        # servers can one-sided read/write it
+        from t3fs.net.rdma import BufferPool, BufferRegistry
+        existing = getattr(self.client, "buf_registry", None)
+        if existing is None:
+            existing = BufferRegistry()
+            self.client.add_service(existing)
+            self.client.buf_registry = existing
+        self.buf_registry = existing
+        self.buf_pool = BufferPool(self.buf_registry)
 
     def routing(self) -> RoutingInfo:
         return self._routing()
@@ -141,7 +158,35 @@ class StorageClient:
                 channel=channel, channel_seq=seq,
                 client_id=self.client_id, inline=True,
                 debug=self.cfg.debug)
-            return await self._write_with_retry(io, data)
+            release = None
+            handle = None
+            if (self.cfg.transfer_mode == "remote_buf"
+                    and len(data) >= self.cfg.remote_buf_threshold):
+                # stage the payload in a pooled registered buffer; the head
+                # pulls it one-sided (doUpdate RDMA READ analog)
+                handle, release = self.buf_pool.acquire(len(data))
+                self.buf_registry.local_view(handle)[:] = data
+                io.buf = handle
+                io.inline = False
+                data_on_wire = b""
+            else:
+                data_on_wire = data
+            result = None
+            try:
+                result = await self._write_with_retry(io, data_on_wire)
+                return result
+            finally:
+                if release is not None:
+                    code = (StatusCode(result.status.code) if result
+                            else StatusCode.TIMEOUT)
+                    if code in (StatusCode.TIMEOUT, StatusCode.RPC_TIMEOUT,
+                                StatusCode.RPC_SEND_FAILED):
+                        # server state unknown: a stale one-sided pull may
+                        # still arrive — DEREGISTER so it fails loudly
+                        # instead of reading a reused buffer's new bytes
+                        self.buf_registry.deregister(handle)
+                    else:
+                        release()
         finally:
             await self.channels.release(channel)
 

@@ -285,6 +285,20 @@ class MetaService:
         return BatchStatRsp(inodes=inodes), b""
 
     @rpc_method
+    async def list_inodes(self, req: EntryReq, payload, conn):
+        """Raw inode-table scan (admin DumpInodes analog): returns inodes
+        starting AFTER inode_id, up to limit — orphan auditing needs the raw
+        table, not a tree walk."""
+        inodes = await self.store.list_inodes(req.inode_id, req.limit or 1000)
+        return BatchStatRsp(inodes=inodes), b""
+
+    @rpc_method
+    async def list_dirents(self, req: EntryReq, payload, conn):
+        """Raw dirent-table scan (admin DumpDirEntries analog)."""
+        return ReaddirRsp(entries=await self.store.list_dirents(
+            req.inode_id, req.name, req.limit or 1000)), b""
+
+    @rpc_method
     async def statfs(self, req, payload, conn):
         # aggregated from storage in a later round; placeholder totals
         return StatFsRsp(), b""

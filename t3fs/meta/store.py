@@ -575,6 +575,35 @@ class MetaStore:
             return [await self._get_inode(txn, i) for i in inode_ids]
         return await self._txn(fn)
 
+    async def list_inodes(self, after_inode: int = 0,
+                          limit: int = 1000) -> list[Inode]:
+        """Raw inode-table page (DumpInodes analog); `after_inode` is the
+        pagination cursor (exclusive)."""
+        async def fn(txn: Transaction):
+            begin = Inode.key(after_inode + 1) if after_inode else \
+                KeyPrefix.INODE.value
+            rows = await txn.get_range(begin, KeyPrefix.INODE.value + b"\xff",
+                                       limit=limit, snapshot=True)
+            return [serde.loads(v) for _, v in rows]
+        return await self._txn(fn)
+
+    async def list_dirents(self, after_parent: int = 0,
+                           after_name: str = "",
+                           limit: int = 1000) -> list[DirEntry]:
+        """Raw dirent-table page (DumpDirEntries analog).  The cursor is
+        the full (parent, name) KEY of the last row seen — parent-only
+        granularity would skip the rest of a directory wider than one
+        page."""
+        async def fn(txn: Transaction):
+            if after_parent or after_name:
+                begin = DirEntry.key(after_parent, after_name) + b"\x00"
+            else:
+                begin = KeyPrefix.DENTRY.value
+            rows = await txn.get_range(begin, KeyPrefix.DENTRY.value + b"\xff",
+                                       limit=limit, snapshot=True)
+            return [serde.loads(v) for _, v in rows]
+        return await self._txn(fn)
+
     async def prune_idem_records(self, ttl_s: float,
                                  batch: int = 2048) -> int:
         """Expire idempotency records (the reference prunes by timestamp:

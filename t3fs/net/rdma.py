@@ -83,6 +83,61 @@ class BufferRegistry:
         return None, b""
 
 
+class BufferPool:
+    """Two-tier pool of registered buffers (reference BufferPool.h:24-27:
+    4 MiB x 1024 + 64 MiB x 64 of RDMA-registered memory).
+
+    Pooling matters for two reasons the reference cares about and the TPU
+    staging path inherits: registration is expensive (under verbs it pins
+    pages and programs the NIC; here it allocates + zeroes), and long-lived
+    stable buffers are what pinned-memory device DMA wants.  acquire()
+    returns a (RemoteBuf, release) pair; release returns the buffer to the
+    pool instead of deregistering."""
+
+    SMALL = 4 << 20
+    LARGE = 64 << 20
+
+    def __init__(self, registry: BufferRegistry,
+                 small_count: int = 64, large_count: int = 4):
+        self.registry = registry
+        self._free: dict[int, list[RemoteBuf]] = {self.SMALL: [],
+                                                  self.LARGE: []}
+        self._cap = {self.SMALL: small_count, self.LARGE: large_count}
+        self._live = {self.SMALL: 0, self.LARGE: 0}
+        self.hits = 0
+        self.misses = 0
+
+    def _tier(self, size: int) -> int:
+        if size <= self.SMALL:
+            return self.SMALL
+        if size <= self.LARGE:
+            return self.LARGE
+        return 0   # oversized: unpooled one-off
+
+    def acquire(self, size: int) -> tuple[RemoteBuf, "callable"]:
+        tier = self._tier(size)
+        if tier == 0:
+            handle = self.registry.register(size)
+            return handle, lambda: self.registry.deregister(handle)
+        free = self._free[tier]
+        if free:
+            self.hits += 1
+            buf = free.pop()
+        else:
+            self.misses += 1
+            buf = self.registry.register(tier)
+            self._live[tier] += 1
+        handle = buf.slice(0, size)
+
+        def release(buf=buf, tier=tier):
+            if len(self._free[tier]) < self._cap[tier]:
+                self._free[tier].append(buf)
+            else:
+                self.registry.deregister(buf)
+                self._live[tier] -= 1
+        return handle, release
+
+
 async def remote_read(conn, handle: RemoteBuf, timeout: float = 30.0) -> bytes:
     """Pull the bytes behind a peer's RemoteBuf (server-side doUpdate analog,
     StorageOperator.cc:560-591)."""

@@ -422,6 +422,8 @@ class StorageService:
                     async with node._read_sem:
                         result, data = await asyncio.to_thread(
                             target.replica.read, io, meta_hint)
+                if io.no_payload:
+                    return result, b""   # verify-only: status travels, bytes don't
                 if io.buf is not None:
                     await remote_write(conn, io.buf.slice(0, len(data)), data)
                     return result, None

@@ -96,6 +96,9 @@ class ReadIO:
     buf: RemoteBuf | None = None       # push result into requester (RDMA WRITE)
     verify_checksum: bool = False
     allow_uncommitted: bool = False
+    # verify-only: server reads + checks but returns NO payload (admin
+    # checksum sweeps would otherwise ship every chunk to the operator)
+    no_payload: bool = False
 
 
 @serde_struct

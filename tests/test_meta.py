@@ -416,3 +416,25 @@ def test_entry_ops_reject_file_parent():
         with pytest.raises(StatusError):
             await st.mkdir_at(f.inode_id, "child")
     asyncio.run(body())
+
+
+def test_list_inodes_and_dirents_raw_scan():
+    """Raw table scans with pagination (DumpInodes/DumpDirEntries analog)."""
+    async def body():
+        from t3fs.kv.engine import MemKVEngine
+        kv = MemKVEngine()
+        st = _mk_store(kv)
+        await st.mkdirs("/d")
+        for i in range(5):
+            await st.create(f"/d/f{i}")
+        inodes = await st.list_inodes()
+        ids = [i.inode_id for i in inodes]
+        assert ids == sorted(ids) and len(ids) == 7  # root + dir + 5 files
+        # paginate after the first page
+        page1 = await st.list_inodes(limit=3)
+        page2 = await st.list_inodes(after_inode=page1[-1].inode_id, limit=10)
+        assert [i.inode_id for i in page1 + page2] == ids
+        dents = await st.list_dirents()
+        assert sorted(d.name for d in dents) == ["d", "f0", "f1", "f2",
+                                                 "f3", "f4"]
+    asyncio.run(body())

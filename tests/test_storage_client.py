@@ -129,3 +129,41 @@ def test_inmem_fake_matches_interface():
         await sc.remove_file_chunks(lay, 1)
         assert await sc.query_last_chunk(lay, 1) == 0
     run(body())
+
+
+def test_remote_buf_pooled_writes():
+    """transfer_mode=remote_buf: payload staged in a pooled registered
+    buffer, head pulls it one-sided (doUpdate RDMA READ analog,
+    StorageOperator.cc:560-591); pool reuses buffers across writes."""
+    from t3fs.client.storage_client import StorageClient, StorageClientConfig
+    from t3fs.storage.types import ChunkId
+
+    async def body():
+        fabric = StorageFabric(num_nodes=2, replicas=2)
+        await fabric.start()
+        try:
+            sc = StorageClient(
+                lambda: fabric.routing, client=fabric.client,
+                config=StorageClientConfig(transfer_mode="remote_buf",
+                                           remote_buf_threshold=1024))
+            data1 = bytes(range(256)) * 16     # 4 KiB: over threshold
+            data2 = b"z" * 4096
+            r1 = await sc.write_chunk(fabric.chain_id, ChunkId(31, 0), 0,
+                                      data1, chunk_size=4096)
+            assert r1.status.code == int(StatusCode.OK), str(r1.status)
+            r2 = await sc.write_chunk(fabric.chain_id, ChunkId(31, 1), 0,
+                                      data2, chunk_size=4096)
+            assert r2.status.code == int(StatusCode.OK)
+            # second write reused the pooled buffer
+            assert sc.buf_pool.misses == 1 and sc.buf_pool.hits == 1
+            # small write stays inline (below threshold)
+            r3 = await sc.write_chunk(fabric.chain_id, ChunkId(31, 2), 0,
+                                      b"tiny", chunk_size=4096)
+            assert r3.status.code == int(StatusCode.OK)
+            assert sc.buf_pool.misses == 1
+            # data round-trips byte-exact
+            _, p = await sc.read_chunk(fabric.chain_id, ChunkId(31, 0))
+            assert p == data1
+        finally:
+            await fabric.stop()
+    run(body())

@@ -385,3 +385,34 @@ def test_reliable_update_record_guards():
     ru.begin(io(5))
     ru.record(io(5), busy_echo)
     assert ru.check(io(5)).status.code == int(StatusCode.BUSY)
+
+
+def test_batch_read_no_payload_verify_only():
+    """no_payload reads verify server-side and ship only the status."""
+    async def body():
+        fabric = StorageFabric(num_nodes=1, replicas=1)
+        await fabric.start()
+        try:
+            cid = ChunkId(41, 0)
+            data = b"v" * 2048
+            await write(fabric, cid, data)
+            req = BatchReadReq(ios=[ReadIO(chunk_id=cid,
+                                           chain_id=fabric.chain_id,
+                                           verify_checksum=True,
+                                           no_payload=True)])
+            rsp, payload = await fabric.client.call(
+                fabric.head_address(), "Storage.batch_read", req)
+            assert rsp.results[0].status.code == int(StatusCode.OK)
+            assert payload == b""   # nothing shipped
+            # corrupt the stored checksum: verify-only read must report it
+            t = fabric.nodes[0].targets[fabric.target_id(0)]
+            meta = t.engine.get_meta(cid)
+            meta.checksum ^= 0xDEAD
+            t.engine.set_meta(cid, meta)
+            rsp, payload = await fabric.client.call(
+                fabric.head_address(), "Storage.batch_read", req)
+            assert rsp.results[0].status.code == int(
+                StatusCode.CHECKSUM_MISMATCH)
+        finally:
+            await fabric.stop()
+    run(body())
