@@ -64,9 +64,12 @@ def parse_overrides(pairs: list[str]) -> dict:
     import tomllib
     out = {}
     for pair in pairs:
-        key, _, raw = pair.partition("=")
-        if not raw:
+        key, eq, raw = pair.partition("=")
+        if not eq or not key.strip():
             raise SystemExit(f"--set needs key=value, got {pair!r}")
+        if not raw:
+            out[key.strip()] = ""   # explicit empty value is legitimate
+            continue
         try:
             val = tomllib.loads(f"v = {raw}")["v"]
         except tomllib.TOMLDecodeError:

@@ -1,0 +1,69 @@
+"""kv_main: replicated transactional KV service binary.
+
+The FoundationDB role (reference fdb/HybridKvEngine.h) as a t3fs service:
+meta and mgmtd point their `kv = "remote:primary:port,follower:port"` spec
+at a deployment of these.  One node runs role=primary with the follower
+list; followers run role=follower and are promoted via Kv.promote on
+failover.
+
+    python -m t3fs.app.kv_main --set listen_port=9400 --set role=primary \
+        --set followers=127.0.0.1:9401 --set kv=wal:/data/kv1
+"""
+
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass
+
+from t3fs.app.base import ApplicationBase, LogConfig
+from t3fs.kv.service import KvService
+from t3fs.kv.wal_engine import open_kv_engine
+from t3fs.net.client import Client
+from t3fs.net.server import Server
+from t3fs.utils.config import ConfigBase, citem, cobj
+
+
+@dataclass
+class KvMainConfig(ConfigBase):
+    listen_host: str = citem("127.0.0.1", hot=False)
+    listen_port: int = citem(0, hot=False)
+    role: str = citem("primary", hot=False,
+                      validator=lambda v: v in ("primary", "follower"))
+    followers: str = citem("", hot=False)   # comma-separated addresses
+    kv: str = citem("mem", hot=False)
+    port_file: str = citem("", hot=False)
+    log: LogConfig = cobj(LogConfig)
+
+
+async def serve(cfg: KvMainConfig, app: ApplicationBase) -> None:
+    engine = open_kv_engine(cfg.kv)
+    rpc = Server(cfg.listen_host, cfg.listen_port)
+    client = Client()
+    svc = KvService(engine, primary=(cfg.role == "primary"),
+                    followers=[a for a in cfg.followers.split(",") if a],
+                    client=client)
+    rpc.add_service(svc)
+
+    async def start():
+        await rpc.start()
+        if cfg.port_file:
+            with open(cfg.port_file, "w") as f:
+                f.write(str(rpc.port))
+
+    async def stop():
+        await rpc.stop()
+        await client.close()
+        if hasattr(engine, "close"):
+            engine.close()
+
+    await app.run(start, stop)
+
+
+def main(argv: list[str] | None = None) -> None:
+    app = ApplicationBase("kv", KvMainConfig)
+    cfg = app.boot(argv)
+    asyncio.run(serve(cfg, app))
+
+
+if __name__ == "__main__":
+    main()

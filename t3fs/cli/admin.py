@@ -561,7 +561,7 @@ async def checksum_sweep(ctx: AdminContext, args) -> None:
     addr = info.node_address(chain.head().node_id)
     rsp, _ = await ctx.cli.call(addr, "Storage.sync_start",
                                 SyncStartReq(chain_id=args.chain_id))
-    bad = ok = 0
+    bad = ok = skipped = 0
     for i in range(0, len(rsp.metas), 16):
         batch = rsp.metas[i:i + 16]
         req = BatchReadReq(ios=[ReadIO(chunk_id=m.chunk_id,
@@ -573,10 +573,15 @@ async def checksum_sweep(ctx: AdminContext, args) -> None:
         for m, r in zip(batch, rrsp.results):
             if r.status.code == 0:
                 ok += 1
-            else:
+            elif r.status.code == 5007:   # CHECKSUM_MISMATCH: real corruption
                 bad += 1
                 print(f"BAD {m.chunk_id}: {r.status.message}")
-    print(f"checksum sweep of chain {args.chain_id}: {ok} ok, {bad} bad")
+            else:
+                # DIRTY/busy/racing-write chunks are not corruption —
+                # an active-write sweep must not report false positives
+                skipped += 1
+    print(f"checksum sweep of chain {args.chain_id}: {ok} ok, {bad} bad, "
+          f"{skipped} skipped (busy/uncommitted)")
 
 
 @command("fill-zero", "overwrite a chunk range with zeros (FillZero repair)")

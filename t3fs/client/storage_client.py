@@ -171,26 +171,27 @@ class StorageClient:
                 data_on_wire = b""
             else:
                 data_on_wire = data
-            result = None
+            transport_failures: list[int] = []
             try:
-                result = await self._write_with_retry(io, data_on_wire)
-                return result
+                return await self._write_with_retry(
+                    io, data_on_wire, transport_failures=transport_failures)
             finally:
                 if release is not None:
-                    code = (StatusCode(result.status.code) if result
-                            else StatusCode.TIMEOUT)
-                    if code in (StatusCode.TIMEOUT, StatusCode.RPC_TIMEOUT,
-                                StatusCode.RPC_SEND_FAILED):
-                        # server state unknown: a stale one-sided pull may
-                        # still arrive — DEREGISTER so it fails loudly
+                    if transport_failures:
+                        # ANY attempt that timed out / lost its connection
+                        # may still have a server-side one-sided pull in
+                        # flight (even if a later attempt succeeded) —
+                        # DISCARD the buffer so a stale pull fails loudly
                         # instead of reading a reused buffer's new bytes
-                        self.buf_registry.deregister(handle)
+                        release(discard=True)
                     else:
                         release()
         finally:
             await self.channels.release(channel)
 
-    async def _write_with_retry(self, io: UpdateIO, data: bytes) -> IOResult:
+    async def _write_with_retry(self, io: UpdateIO, data: bytes,
+                                transport_failures: list | None = None
+                                ) -> IOResult:
         last: IOResult | None = None
         for attempt in range(self.cfg.max_retries):
             routing = self.routing()
@@ -215,12 +216,18 @@ class StorageClient:
                 if not status.retryable:
                     return last
             except StatusError as e:
+                if transport_failures is not None:
+                    transport_failures.append(attempt)
                 if not e.status.retryable:
                     raise
                 last = IOResult(WireStatus(int(e.code), str(e)))
             await self._backoff(attempt)
             await self._maybe_refresh()
-        return last if last is not None else IOResult(
+        if last is not None:
+            return last
+        if transport_failures is not None:
+            transport_failures.append(-1)
+        return IOResult(
             WireStatus(int(StatusCode.TIMEOUT), "write retries exhausted"))
 
     async def read_chunk(self, chain_id: int, chunk_id: ChunkId,
@@ -268,8 +275,14 @@ class StorageClient:
                 pos = 0
                 for i, r in zip(idxs, rsp.results):
                     results[i] = r
-                    # inline payloads are concatenated in request order
-                    n = r.length if r.status.code == int(StatusCode.OK) else 0
+                    # inline payloads are concatenated in request order;
+                    # no_payload (verify-only) and buf-push IOs contribute
+                    # zero bytes regardless of r.length
+                    if ios[i].no_payload or ios[i].buf is not None:
+                        n = 0
+                    else:
+                        n = r.length if r.status.code == int(StatusCode.OK) \
+                            else 0
                     payloads[i] = payload[pos: pos + n]
                     pos += n
 

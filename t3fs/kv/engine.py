@@ -128,6 +128,19 @@ class MemKVEngine(KVEngine):
             self._sorted_keys.clear()
             self._version = 0
 
+    # --- service accessors (KvService reads at explicit versions) ---
+
+    def current_version(self) -> int:
+        return self._version
+
+    def read_at(self, key: bytes, version: int) -> bytes | None:
+        return self._get_at(key, version)
+
+    def range_at(self, begin: bytes, end: bytes, version: int,
+                 limit: int = 0) -> list[tuple[bytes, bytes]]:
+        rows = self._range_at(begin, end, version)
+        return rows[:limit] if limit else rows
+
     # --- internals ---
 
     def _get_at(self, key: bytes, version: int) -> bytes | None:

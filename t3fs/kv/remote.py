@@ -1,0 +1,174 @@
+"""RemoteKVEngine: the transactional KV over the wire.
+
+Reference analog: src/fdb/CustomKvEngine.h:14-29 — an external KV service
+reached via cluster_endpoints, selected by the HybridKvEngine switch.  The
+client mirrors the local Transaction surface exactly (meta/mgmtd code is
+engine-agnostic): reads go to the primary at a pinned snapshot version,
+writes buffer locally, and commit ships the read/write sets for the
+server's atomic SSI conflict-check + apply.
+
+Failover: the address list is probed in order; KV_NOT_PRIMARY and transport
+errors rotate to the next address.  A transaction that started on a
+now-dead primary fails with TXN_RETRYABLE, which with_transaction retries
+from scratch against the new primary.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+from t3fs.kv.engine import KVEngine
+from t3fs.kv.service import KvCommitReq, KvRangeReq, KvReadReq
+from t3fs.net.client import Client
+from t3fs.utils.status import StatusCode, StatusError, make_error
+
+log = logging.getLogger("t3fs.kv.remote")
+
+
+class RemoteTransaction:
+    """Client-side transaction buffer mirroring kv.engine.Transaction."""
+
+    def __init__(self, engine: "RemoteKVEngine"):
+        self.engine = engine
+        self.read_version: int | None = None
+        self._writes: dict[bytes, bytes | None] = {}
+        self._range_clears: list[tuple[bytes, bytes]] = []
+        self._read_keys: set[bytes] = set()
+        self._read_ranges: list[tuple[bytes, bytes]] = []
+        self._committed = False
+
+    async def _ver(self) -> int:
+        if self.read_version is None:
+            rsp = await self.engine._call("Kv.get_version", None)
+            self.read_version = rsp.version
+        return self.read_version
+
+    # --- reads ---
+
+    async def get(self, key: bytes, *, snapshot: bool = False) -> bytes | None:
+        if key in self._writes:
+            return self._writes[key]
+        if not snapshot:
+            self._read_keys.add(key)
+        if any(b <= key < e for b, e in self._range_clears):
+            return None
+        ver = await self._ver()
+        rsp = await self.engine._call("Kv.read",
+                                      KvReadReq(keys=[key], version=ver))
+        return rsp.values[0] if rsp.found[0] else None
+
+    async def snapshot_get(self, key: bytes) -> bytes | None:
+        return await self.get(key, snapshot=True)
+
+    async def get_range(self, begin: bytes, end: bytes, *, limit: int = 0,
+                        snapshot: bool = False) -> list[tuple[bytes, bytes]]:
+        if not snapshot:
+            self._read_ranges.append((begin, end))
+        ver = await self._ver()
+        rsp = await self.engine._call(
+            "Kv.read_range",
+            # fetch unlimited when local writes overlay: a write may push a
+            # row out of the limit window
+            KvRangeReq(begin=begin, end=end, version=ver,
+                       limit=0 if self._writes or self._range_clears
+                       else limit))
+        base = dict(zip(rsp.keys, rsp.values))
+        for k, v in self._writes.items():
+            if begin <= k < end:
+                if v is None:
+                    base.pop(k, None)
+                else:
+                    base[k] = v
+        for b, e in self._range_clears:
+            for k in [k for k in base if b <= k < e and k not in self._writes]:
+                base.pop(k)
+        out = sorted(base.items())
+        return out[:limit] if limit else out
+
+    # --- writes ---
+
+    def set(self, key: bytes, value: bytes) -> None:
+        self._writes[key] = bytes(value)
+
+    def clear(self, key: bytes) -> None:
+        self._writes[key] = None
+
+    def clear_range(self, begin: bytes, end: bytes) -> None:
+        self._range_clears.append((begin, end))
+        for k in list(self._writes):
+            if begin <= k < end:
+                self._writes[k] = None
+
+    def add_read_conflict_key(self, key: bytes) -> None:
+        self._read_keys.add(key)
+
+    def add_read_conflict_range(self, begin: bytes, end: bytes) -> None:
+        self._read_ranges.append((begin, end))
+
+    # --- commit ---
+
+    async def commit(self) -> None:
+        assert not self._committed, "transaction reused after commit"
+        ver = await self._ver() if (self._read_keys or self._read_ranges
+                                    or self._writes or self._range_clears) \
+            else 0
+        req = KvCommitReq(
+            read_version=ver,
+            read_keys=sorted(self._read_keys),
+            range_begins=[b for b, _ in self._read_ranges],
+            range_ends=[e for _, e in self._read_ranges],
+            write_keys=list(self._writes.keys()),
+            write_values=[v if v is not None else b""
+                          for v in self._writes.values()],
+            write_deletes=[v is None for v in self._writes.values()],
+            clear_begins=[b for b, _ in self._range_clears],
+            clear_ends=[e for _, e in self._range_clears])
+        await self.engine._call("Kv.commit", req)
+        self._committed = True
+
+
+class RemoteKVEngine(KVEngine):
+    """KVEngine over a replicated KvService deployment."""
+
+    def __init__(self, addresses: list[str], client: Client | None = None,
+                 timeout_s: float = 15.0):
+        assert addresses
+        self.addresses = list(addresses)
+        self.client = client or Client()
+        self.timeout_s = timeout_s
+        self._active = 0        # index of the address last seen as primary
+
+    def transaction(self) -> RemoteTransaction:
+        return RemoteTransaction(self)
+
+    async def _call(self, method: str, req):
+        last: StatusError | None = None
+        for probe in range(len(self.addresses)):
+            idx = (self._active + probe) % len(self.addresses)
+            try:
+                rsp, _ = await self.client.call(
+                    self.addresses[idx], method, req, timeout=self.timeout_s)
+                self._active = idx
+                return rsp
+            except StatusError as e:
+                last = e
+                if e.code in (StatusCode.KV_NOT_PRIMARY,
+                              StatusCode.RPC_CONNECT_FAILED,
+                              StatusCode.RPC_SEND_FAILED,
+                              StatusCode.RPC_TIMEOUT):
+                    continue    # probe the next address for the primary
+                raise
+        # no primary reachable: surface as retryable so with_transaction
+        # restarts the whole transaction once one is promoted
+        raise make_error(StatusCode.TXN_RETRYABLE,
+                         f"no KV primary reachable: {last}")
+
+    async def commit_async(self, txn) -> None:  # pragma: no cover - unused
+        raise NotImplementedError("RemoteTransaction commits via RPC")
+
+    def clear_all(self) -> None:
+        raise NotImplementedError("clear_all is a local-engine test helper")
+
+    async def close(self) -> None:
+        await self.client.close()

@@ -1,0 +1,259 @@
+"""KvService: the transactional KV as a standalone replicated service.
+
+Reference analog: the FoundationDB role (src/fdb/HybridKvEngine.h:13-31) and
+the fork's CustomKvEngine (external KV reached over the network via
+cluster_endpoints, CustomKvEngine.h:14-29).  t3fs runs its own KV service:
+a primary applies SSI transactions against its local engine (WAL-durable)
+and synchronously ships every committed batch to followers before acking,
+so any follower can be promoted without losing acknowledged commits.
+
+Replication protocol:
+  - commits are serialized on the primary (one in flight) and numbered;
+  - followers apply batches strictly in sequence; a gap (follower restarted
+    behind the primary) answers KV_REPLICA_GAP and the primary pushes a full
+    snapshot, then resumes incremental shipping;
+  - promotion is an admin op (Kv.promote); clients fail over by probing
+    their address list for whoever accepts commits (KV_NOT_PRIMARY
+    redirects them) — the same manual-failover model as the fork's external
+    custom KV, with mgmtd-style lease election layered above when desired.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from dataclasses import dataclass, field
+
+from t3fs.kv.engine import KVEngine, Transaction
+from t3fs.net.server import rpc_method, service
+from t3fs.utils import serde
+from t3fs.utils.serde import serde_struct
+from t3fs.utils.status import StatusCode, StatusError, make_error
+
+log = logging.getLogger("t3fs.kv.service")
+
+
+@serde_struct
+@dataclass
+class KvReadReq:
+    keys: list[bytes] = field(default_factory=list)
+    version: int = -1              # -1: read at current (and return it)
+
+
+@serde_struct
+@dataclass
+class KvReadRsp:
+    version: int = 0
+    # parallel to keys; None encoded as missing flag list
+    values: list[bytes] = field(default_factory=list)
+    found: list[bool] = field(default_factory=list)
+
+
+@serde_struct
+@dataclass
+class KvRangeReq:
+    begin: bytes = b""
+    end: bytes = b""
+    limit: int = 0
+    version: int = -1
+
+
+@serde_struct
+@dataclass
+class KvRangeRsp:
+    version: int = 0
+    keys: list[bytes] = field(default_factory=list)
+    values: list[bytes] = field(default_factory=list)
+
+
+@serde_struct
+@dataclass
+class KvCommitReq:
+    read_version: int = 0
+    read_keys: list[bytes] = field(default_factory=list)
+    range_begins: list[bytes] = field(default_factory=list)
+    range_ends: list[bytes] = field(default_factory=list)
+    write_keys: list[bytes] = field(default_factory=list)
+    write_values: list[bytes] = field(default_factory=list)
+    write_deletes: list[bool] = field(default_factory=list)
+    clear_begins: list[bytes] = field(default_factory=list)
+    clear_ends: list[bytes] = field(default_factory=list)
+
+
+@serde_struct
+@dataclass
+class KvCommitRsp:
+    version: int = 0
+
+
+@serde_struct
+@dataclass
+class KvReplicateReq:
+    seq: int = 0
+    write_keys: list[bytes] = field(default_factory=list)
+    write_values: list[bytes] = field(default_factory=list)
+    write_deletes: list[bool] = field(default_factory=list)
+    clear_begins: list[bytes] = field(default_factory=list)
+    clear_ends: list[bytes] = field(default_factory=list)
+
+
+@serde_struct
+@dataclass
+class KvSnapshotReq:
+    seq: int = 0
+    keys: list[bytes] = field(default_factory=list)
+    values: list[bytes] = field(default_factory=list)
+
+
+@serde_struct
+@dataclass
+class KvOkRsp:
+    ok: bool = True
+    seq: int = 0
+
+
+@service("Kv")
+class KvService:
+    def __init__(self, engine: KVEngine, *, primary: bool = True,
+                 followers: list[str] | None = None, client=None):
+        self.engine = engine
+        self.primary = primary
+        self.followers = list(followers or [])
+        self.client = client            # net Client for follower shipping
+        self.seq = 0                    # last shipped/applied batch seq
+        self._commit_lock = asyncio.Lock()
+        self.replicated = 0             # observability
+        self.snapshots_pushed = 0
+
+    # ---- client-facing transactional API ----
+
+    def _require_primary(self) -> None:
+        if not self.primary:
+            raise make_error(StatusCode.KV_NOT_PRIMARY,
+                             "this KV node is a follower")
+
+    @rpc_method
+    async def get_version(self, req, payload, conn):
+        self._require_primary()
+        return KvCommitRsp(version=self.engine.current_version()), b""
+
+    @rpc_method
+    async def read(self, req: KvReadReq, payload, conn):
+        self._require_primary()
+        ver = req.version if req.version >= 0 \
+            else self.engine.current_version()
+        values, found = [], []
+        for k in req.keys:
+            v = self.engine.read_at(k, ver)
+            found.append(v is not None)
+            values.append(v if v is not None else b"")
+        return KvReadRsp(version=ver, values=values, found=found), b""
+
+    @rpc_method
+    async def read_range(self, req: KvRangeReq, payload, conn):
+        self._require_primary()
+        ver = req.version if req.version >= 0 \
+            else self.engine.current_version()
+        rows = self.engine.range_at(req.begin, req.end, ver, req.limit)
+        return KvRangeRsp(version=ver, keys=[k for k, _ in rows],
+                          values=[v for _, v in rows]), b""
+
+    @rpc_method
+    async def commit(self, req: KvCommitReq, payload, conn):
+        self._require_primary()
+        txn = Transaction(self.engine, read_version=req.read_version)
+        for k in req.read_keys:
+            txn._read_keys.add(k)
+        txn._read_ranges = list(zip(req.range_begins, req.range_ends))
+        for k, v, is_del in zip(req.write_keys, req.write_values,
+                                req.write_deletes):
+            txn._writes[k] = None if is_del else v
+        txn._range_clears = list(zip(req.clear_begins, req.clear_ends))
+        async with self._commit_lock:
+            # conflict-check + apply atomically, then ship in commit order
+            await self.engine.commit_async(txn)
+            if txn._writes or txn._range_clears:
+                self.seq += 1
+                await self._replicate(KvReplicateReq(
+                    seq=self.seq,
+                    write_keys=list(txn._writes.keys()),
+                    write_values=[v if v is not None else b""
+                                  for v in txn._writes.values()],
+                    write_deletes=[v is None for v in txn._writes.values()],
+                    clear_begins=[b for b, _ in txn._range_clears],
+                    clear_ends=[e for _, e in txn._range_clears]))
+        return KvCommitRsp(version=self.engine.current_version()), b""
+
+    # ---- replication ----
+
+    async def _replicate(self, req: KvReplicateReq) -> None:
+        """Synchronously ship one batch to every follower; a gap triggers a
+        full snapshot push.  A follower that stays unreachable fails the
+        commit (sync replication: no acked write may exist only on the
+        primary)."""
+        for addr in self.followers:
+            try:
+                await self.client.call(addr, "Kv.apply_replica", req,
+                                       timeout=10.0)
+                self.replicated += 1
+            except StatusError as e:
+                if e.code == StatusCode.KV_REPLICA_GAP:
+                    await self._push_snapshot(addr, req.seq)
+                else:
+                    raise make_error(
+                        StatusCode.KV_REPLICATION_FAILED,
+                        f"follower {addr} unreachable: {e}")
+
+    async def _push_snapshot(self, addr: str, seq: int) -> None:
+        rows = self.engine.range_at(b"", b"\xff" * 8,
+                                    self.engine.current_version(), 0)
+        await self.client.call(addr, "Kv.load_snapshot", KvSnapshotReq(
+            seq=seq, keys=[k for k, _ in rows], values=[v for _, v in rows]),
+            timeout=60.0)
+        self.snapshots_pushed += 1
+        log.info("pushed snapshot (%d keys, seq %d) to %s",
+                 len(rows), seq, addr)
+
+    @rpc_method
+    async def apply_replica(self, req: KvReplicateReq, payload, conn):
+        if self.primary:
+            raise make_error(StatusCode.INVALID_ARG,
+                             "primary cannot apply replica batches")
+        if req.seq != self.seq + 1:
+            raise make_error(StatusCode.KV_REPLICA_GAP,
+                             f"have seq {self.seq}, got {req.seq}")
+        txn = Transaction(self.engine)
+        for k, v, is_del in zip(req.write_keys, req.write_values,
+                                req.write_deletes):
+            txn._writes[k] = None if is_del else v
+        txn._range_clears = list(zip(req.clear_begins, req.clear_ends))
+        await self.engine.commit_async(txn)   # no reads -> no conflicts
+        self.seq = req.seq
+        return KvOkRsp(seq=self.seq), b""
+
+    @rpc_method
+    async def load_snapshot(self, req: KvSnapshotReq, payload, conn):
+        if self.primary:
+            raise make_error(StatusCode.INVALID_ARG,
+                             "primary cannot load snapshots")
+        self.engine.clear_all()
+        txn = Transaction(self.engine)
+        for k, v in zip(req.keys, req.values):
+            txn._writes[k] = v
+        await self.engine.commit_async(txn)
+        self.seq = req.seq
+        return KvOkRsp(seq=self.seq), b""
+
+    # ---- admin ----
+
+    @rpc_method
+    async def promote(self, req, payload, conn):
+        """Failover: this follower becomes the primary (operator/lease-
+        driven; the old primary must be fenced off first)."""
+        self.primary = True
+        log.warning("KV node promoted to primary at seq %d", self.seq)
+        return KvOkRsp(seq=self.seq), b""
+
+    @rpc_method
+    async def status(self, req, payload, conn):
+        return KvOkRsp(ok=self.primary, seq=self.seq), b""

@@ -275,11 +275,16 @@ class WalKVEngine(MemKVEngine):
 
 def open_kv_engine(spec: str) -> KVEngine:
     """HybridKvEngine-style selector (HybridKvEngine.h:13-31):
-      "mem"                  in-memory SSI engine (tests, single node)
-      "wal:/path[?sync=os]"  durable WAL+snapshot engine at /path
+      "mem"                     in-memory SSI engine (tests, single node)
+      "wal:/path[?sync=os]"     durable WAL+snapshot engine at /path
+      "remote:host:p,host:p"    replicated KvService deployment
+                                (CustomKvEngine cluster_endpoints analog)
     """
     if spec == "mem":
         return MemKVEngine()
+    if spec.startswith("remote:"):
+        from t3fs.kv.remote import RemoteKVEngine
+        return RemoteKVEngine(spec[len("remote:"):].split(","))
     if spec.startswith("wal:"):
         rest = spec[4:]
         sync = "always"

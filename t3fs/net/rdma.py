@@ -118,7 +118,10 @@ class BufferPool:
         tier = self._tier(size)
         if tier == 0:
             handle = self.registry.register(size)
-            return handle, lambda: self.registry.deregister(handle)
+
+            def release_oversize(discard: bool = False):
+                self.registry.deregister(handle)
+            return handle, release_oversize
         free = self._free[tier]
         if free:
             self.hits += 1
@@ -129,8 +132,10 @@ class BufferPool:
             self._live[tier] += 1
         handle = buf.slice(0, size)
 
-        def release(buf=buf, tier=tier):
-            if len(self._free[tier]) < self._cap[tier]:
+        def release(buf=buf, tier=tier, discard: bool = False):
+            """discard=True drops the buffer entirely (a stale one-sided op
+            may still target it) with the pool's accounting kept straight."""
+            if not discard and len(self._free[tier]) < self._cap[tier]:
                 self._free[tier].append(buf)
             else:
                 self.registry.deregister(buf)

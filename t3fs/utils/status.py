@@ -68,6 +68,11 @@ class StatusCode(enum.IntEnum):
     META_INVALID_PATH = 6009
     META_DIR_LOCKED = 6010
 
+    # kv service (FoundationDB/CustomKvEngine role)
+    KV_NOT_PRIMARY = 7101
+    KV_REPLICA_GAP = 7102
+    KV_REPLICATION_FAILED = 7103
+
     # mgmtd (reference: MgmtdCode)
     MGMTD_NOT_PRIMARY = 7001
     MGMTD_STALE_ROUTING = 7002
@@ -88,6 +93,8 @@ RETRYABLE_CODES = frozenset({
     # routing staleness: the chain/target may simply not have propagated yet
     StatusCode.TARGET_NOT_FOUND,
     StatusCode.MGMTD_NOT_PRIMARY, StatusCode.MGMTD_STALE_ROUTING,
+    # client probes the address list for the current primary
+    StatusCode.KV_NOT_PRIMARY,
 })
 
 

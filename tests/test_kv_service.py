@@ -1,0 +1,192 @@
+"""Replicated KV service: remote transactions, sync replication, failover.
+
+Reference analogs: the FoundationDB role (fdb/HybridKvEngine.h) and the
+fork's CustomKvEngine (external KV over cluster_endpoints).
+"""
+
+import asyncio
+
+import pytest
+
+from t3fs.kv.engine import MemKVEngine, with_transaction
+from t3fs.kv.remote import RemoteKVEngine
+from t3fs.kv.service import KvService
+from t3fs.net.client import Client
+from t3fs.net.server import Server
+from t3fs.utils.status import StatusCode, StatusError
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def _mk_cluster(n_followers: int = 1):
+    """Primary + followers over real sockets; returns (servers, services,
+    addresses, cleanup)."""
+    servers, services, addrs = [], [], []
+    ship = Client()
+    for i in range(1 + n_followers):
+        svc = KvService(MemKVEngine(), primary=(i == 0), client=ship)
+        srv = Server()
+        srv.add_service(svc)
+        await srv.start()
+        servers.append(srv)
+        services.append(svc)
+        addrs.append(srv.address)
+    services[0].followers = addrs[1:]
+
+    async def cleanup():
+        await ship.close()
+        for s in servers:
+            await s.stop()
+    return servers, services, addrs, cleanup
+
+
+def test_remote_txn_roundtrip_and_conflicts():
+    async def body():
+        _, services, addrs, cleanup = await _mk_cluster(0)
+        kv = RemoteKVEngine(addrs)
+        try:
+            async def w(txn):
+                txn.set(b"a", b"1")
+                txn.set(b"b", b"2")
+            await with_transaction(kv, w)
+
+            txn = kv.transaction()
+            assert await txn.get(b"a") == b"1"
+            assert await txn.get(b"missing") is None
+            rows = await txn.get_range(b"a", b"z")
+            assert rows == [(b"a", b"1"), (b"b", b"2")]
+
+            # SSI conflict: two txns read-modify-write the same key
+            t1, t2 = kv.transaction(), kv.transaction()
+            v1 = await t1.get(b"a")
+            v2 = await t2.get(b"a")
+            t1.set(b"a", v1 + b"x")
+            t2.set(b"a", v2 + b"y")
+            await t1.commit()
+            with pytest.raises(StatusError) as ei:
+                await t2.commit()
+            assert ei.value.code == StatusCode.TXN_CONFLICT
+
+            # read-your-writes + range overlay
+            t3 = kv.transaction()
+            t3.set(b"c", b"3")
+            t3.clear(b"b")
+            assert await t3.get(b"c") == b"3"
+            rows = await t3.get_range(b"a", b"z")
+            assert rows == [(b"a", b"1x"), (b"c", b"3")]
+            await t3.commit()
+        finally:
+            await kv.close()
+            await cleanup()
+    run(body())
+
+
+def test_sync_replication_and_promote_failover():
+    async def body():
+        servers, services, addrs, cleanup = await _mk_cluster(1)
+        kv = RemoteKVEngine(addrs)
+        try:
+            for i in range(5):
+                async def w(txn, i=i):
+                    txn.set(f"k{i}".encode(), f"v{i}".encode())
+                await with_transaction(kv, w)
+            # every commit is on the follower BEFORE the client was acked
+            assert services[1].seq == 5
+            assert services[1].engine.read_at(
+                b"k4", services[1].engine.current_version()) == b"v4"
+
+            # primary dies; follower promoted; client fails over
+            await servers[0].stop()
+            await Client().call(addrs[1], "Kv.promote", None)
+            services[1].followers = []
+            txn = kv.transaction()
+            assert await txn.get(b"k2") == b"v2"   # acked data survived
+            txn.set(b"after", b"failover")
+            await txn.commit()
+            assert services[1].engine.read_at(
+                b"after", services[1].engine.current_version()) == b"failover"
+        finally:
+            await kv.close()
+            await cleanup()
+    run(body())
+
+
+def test_replica_gap_triggers_snapshot_catchup():
+    async def body():
+        servers, services, addrs, cleanup = await _mk_cluster(1)
+        kv = RemoteKVEngine(addrs)
+        try:
+            async def w(txn):
+                txn.set(b"x", b"1")
+            await with_transaction(kv, w)
+            # follower "restarts" empty and behind
+            services[1].engine.clear_all()
+            services[1].seq = 0
+
+            async def w2(txn):
+                txn.set(b"y", b"2")
+            await with_transaction(kv, w2)
+            # gap detected -> snapshot pushed -> follower has BOTH keys
+            assert services[0].snapshots_pushed == 1
+            eng = services[1].engine
+            ver = eng.current_version()
+            assert eng.read_at(b"x", ver) == b"1"
+            assert eng.read_at(b"y", ver) == b"2"
+            assert services[1].seq == services[0].seq
+        finally:
+            await kv.close()
+            await cleanup()
+    run(body())
+
+
+def test_unreachable_follower_fails_commit():
+    """Sync replication: no acked write may exist only on the primary."""
+    async def body():
+        servers, services, addrs, cleanup = await _mk_cluster(1)
+        kv = RemoteKVEngine([addrs[0]])
+        try:
+            await servers[1].stop()   # follower gone
+            txn = kv.transaction()
+            txn.set(b"k", b"v")
+            with pytest.raises(StatusError) as ei:
+                await txn.commit()
+            assert ei.value.code == StatusCode.KV_REPLICATION_FAILED
+        finally:
+            await kv.close()
+            await cleanup()
+    run(body())
+
+
+def test_meta_store_over_remote_kv():
+    """The real consumer: MetaStore runs unmodified on the remote engine."""
+    async def body():
+        _, services, addrs, cleanup = await _mk_cluster(1)
+        kv = RemoteKVEngine(addrs)
+        try:
+            from t3fs.meta.store import ChainAllocator, MetaStore
+            from t3fs.mgmtd.types import (
+                ChainInfo, ChainTable, ChainTargetInfo, PublicTargetState,
+                RoutingInfo,
+            )
+            routing = RoutingInfo(version=1)
+            routing.chains[1] = ChainInfo(1, 1, [
+                ChainTargetInfo(101, 1, PublicTargetState.SERVING)])
+            routing.chain_tables[1] = ChainTable(1, [1])
+            st = MetaStore(kv, ChainAllocator(lambda: routing))
+            await st.mkdirs("/proj")
+            ino, _ = await st.create("/proj/data.bin", chunk_size=4096)
+            got = await st.stat("/proj/data.bin")
+            assert got.inode_id == ino.inode_id
+            await st.rename("/proj/data.bin", "/proj/renamed.bin")
+            names = [e.name for e in await st.readdir("/proj")]
+            assert names == ["renamed.bin"]
+            # and the follower holds every meta record (promotable)
+            eng = services[1].engine
+            rows = eng.range_at(b"", b"\xff" * 8, eng.current_version())
+            assert len(rows) > 3
+        finally:
+            await kv.close()
+            await cleanup()
+    run(body())
