@@ -39,7 +39,7 @@ from t3fs.monitor.service import QueryMetricsReq
 from t3fs.net.client import Client
 from t3fs.ops.codec import crc32c
 from t3fs.storage.types import SyncStartReq
-from t3fs.utils.status import StatusError
+from t3fs.utils.status import StatusCode, StatusError
 
 COMMANDS: dict[str, tuple] = {}    # name -> (configure_fn, handler, help)
 
@@ -561,7 +561,7 @@ async def checksum_sweep(ctx: AdminContext, args) -> None:
     addr = info.node_address(chain.head().node_id)
     rsp, _ = await ctx.cli.call(addr, "Storage.sync_start",
                                 SyncStartReq(chain_id=args.chain_id))
-    bad = ok = skipped = 0
+    bad = ok = skipped = errors = 0
     for i in range(0, len(rsp.metas), 16):
         batch = rsp.metas[i:i + 16]
         req = BatchReadReq(ios=[ReadIO(chunk_id=m.chunk_id,
@@ -573,15 +573,19 @@ async def checksum_sweep(ctx: AdminContext, args) -> None:
         for m, r in zip(batch, rrsp.results):
             if r.status.code == 0:
                 ok += 1
-            elif r.status.code == 5007:   # CHECKSUM_MISMATCH: real corruption
+            elif r.status.code == int(StatusCode.CHECKSUM_MISMATCH):
                 bad += 1
                 print(f"BAD {m.chunk_id}: {r.status.message}")
-            else:
-                # DIRTY/busy/racing-write chunks are not corruption —
-                # an active-write sweep must not report false positives
+            elif r.status.code == int(StatusCode.CHUNK_BUSY):
+                # DIRTY/racing-write chunks are not corruption — an
+                # active-write sweep must not report false positives
                 skipped += 1
+            else:
+                # anything else (missing chunk, IO error) is a real finding
+                errors += 1
+                print(f"ERR {m.chunk_id}: [{r.status.code}] {r.status.message}")
     print(f"checksum sweep of chain {args.chain_id}: {ok} ok, {bad} bad, "
-          f"{skipped} skipped (busy/uncommitted)")
+          f"{errors} errors, {skipped} skipped (busy/uncommitted)")
 
 
 @command("fill-zero", "overwrite a chunk range with zeros (FillZero repair)")

@@ -172,17 +172,21 @@ class StorageClient:
             else:
                 data_on_wire = data
             transport_failures: list[int] = []
+            clean = False
             try:
-                return await self._write_with_retry(
+                result = await self._write_with_retry(
                     io, data_on_wire, transport_failures=transport_failures)
+                clean = True
+                return result
             finally:
                 if release is not None:
-                    if transport_failures:
-                        # ANY attempt that timed out / lost its connection
-                        # may still have a server-side one-sided pull in
-                        # flight (even if a later attempt succeeded) —
-                        # DISCARD the buffer so a stale pull fails loudly
-                        # instead of reading a reused buffer's new bytes
+                    if transport_failures or not clean:
+                        # ANY attempt that timed out / lost its connection —
+                        # or any abnormal exit, incl. CancelledError landing
+                        # mid-RPC — may leave a server-side one-sided pull
+                        # in flight; DISCARD the buffer so a stale pull
+                        # fails loudly instead of reading a reused buffer's
+                        # new bytes
                         release(discard=True)
                     else:
                         release()

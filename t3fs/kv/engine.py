@@ -136,6 +136,21 @@ class MemKVEngine(KVEngine):
     def read_at(self, key: bytes, version: int) -> bytes | None:
         return self._get_at(key, version)
 
+    def snapshot_rows(self) -> list[tuple[bytes, bytes]]:
+        """ALL live rows at the current version — used for follower
+        catch-up snapshots.  Unbounded by construction: a key-range scan
+        with a finite end sentinel would silently drop keys sorting above
+        the sentinel."""
+        out = []
+        with self._lock:
+            for k in self._sorted_keys:
+                for ver, val in reversed(self._data.get(k, ())):
+                    if ver <= self._version:
+                        if val is not None:
+                            out.append((k, val))
+                        break
+        return out
+
     def range_at(self, begin: bytes, end: bytes, version: int,
                  limit: int = 0) -> list[tuple[bytes, bytes]]:
         rows = self._range_at(begin, end, version)
@@ -169,6 +184,24 @@ class MemKVEngine(KVEngine):
     def _latest_write_version(self, key: bytes) -> int:
         versions = self._data.get(key)
         return versions[-1][0] if versions else 0
+
+    def check_conflicts(self, txn: Transaction) -> None:
+        """Conflict-check WITHOUT applying.  The replicated KvService uses
+        this to validate a commit before shipping it to followers, so a
+        replication failure leaves nothing applied on the primary."""
+        with self._lock:
+            self._check_conflicts_locked(txn)
+
+    def advance_version(self, version: int) -> None:
+        """Fast-forward the MVCC clock (never backward).  Followers call
+        this with the primary's version so that version numbers stay
+        comparable across a promotion: a client transaction pinned at the
+        old primary's read_version must see consistent snapshots and real
+        conflict detection on the new primary.  Not WAL-logged: a follower
+        that crashes re-syncs via the replica-gap -> snapshot path, which
+        re-advances the clock."""
+        with self._lock:
+            self._version = max(self._version, version)
 
     def _commit(self, txn: Transaction) -> None:
         with self._lock:

@@ -124,7 +124,8 @@ class RemoteTransaction:
             write_deletes=[v is None for v in self._writes.values()],
             clear_begins=[b for b, _ in self._range_clears],
             clear_ends=[e for _, e in self._range_clears])
-        await self.engine._call("Kv.commit", req)
+        mutates = bool(self._writes or self._range_clears)
+        await self.engine._call("Kv.commit", req, commit_ambiguous=mutates)
         self._committed = True
 
 
@@ -142,7 +143,7 @@ class RemoteKVEngine(KVEngine):
     def transaction(self) -> RemoteTransaction:
         return RemoteTransaction(self)
 
-    async def _call(self, method: str, req):
+    async def _call(self, method: str, req, *, commit_ambiguous: bool = False):
         last: StatusError | None = None
         for probe in range(len(self.addresses)):
             idx = (self._active + probe) % len(self.addresses)
@@ -153,6 +154,22 @@ class RemoteKVEngine(KVEngine):
                 return rsp
             except StatusError as e:
                 last = e
+                if commit_ambiguous and e.code in (
+                        StatusCode.RPC_TIMEOUT, StatusCode.RPC_SEND_FAILED,
+                        StatusCode.KV_REPLICATION_FAILED):
+                    # a mutating commit whose RPC reached (or may have
+                    # reached) the primary and then timed out MAY have
+                    # applied — blind re-execution would double-apply.
+                    # KV_REPLICATION_FAILED is ambiguous too: some follower
+                    # may hold the batch and resurrect it after a failover.
+                    # Surface the ambiguity (FDB commit_unknown_result /
+                    # reference retryMaybeCommitted, MetaStore.h:54-66);
+                    # idempotent callers (meta ops carry idempotency
+                    # records) retry safely, others must check first.
+                    raise make_error(
+                        StatusCode.TXN_MAYBE_COMMITTED,
+                        f"commit to {self.addresses[idx]} ambiguous: {e}"
+                    ) from None
                 if e.code in (StatusCode.KV_NOT_PRIMARY,
                               StatusCode.RPC_CONNECT_FAILED,
                               StatusCode.RPC_SEND_FAILED,

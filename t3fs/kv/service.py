@@ -90,6 +90,7 @@ class KvCommitRsp:
 @dataclass
 class KvReplicateReq:
     seq: int = 0
+    version: int = 0               # primary's MVCC version for this batch
     write_keys: list[bytes] = field(default_factory=list)
     write_values: list[bytes] = field(default_factory=list)
     write_deletes: list[bool] = field(default_factory=list)
@@ -101,6 +102,7 @@ class KvReplicateReq:
 @dataclass
 class KvSnapshotReq:
     seq: int = 0
+    version: int = 0               # primary's MVCC version at snapshot time
     keys: list[bytes] = field(default_factory=list)
     values: list[bytes] = field(default_factory=list)
 
@@ -170,45 +172,79 @@ class KvService:
             txn._writes[k] = None if is_del else v
         txn._range_clears = list(zip(req.clear_begins, req.clear_ends))
         async with self._commit_lock:
-            # conflict-check + apply atomically, then ship in commit order
-            await self.engine.commit_async(txn)
+            # Order: conflict-check -> replicate -> apply.  Nothing becomes
+            # visible on the primary until every follower holds the batch,
+            # so a commit that fails with KV_REPLICATION_FAILED leaves the
+            # primary exactly as it was (no write visible to clients exists
+            # only here).  A follower that applied the batch before a later
+            # follower failed is healed by seq reuse: the next commit ships
+            # the same seq, the stale follower answers KV_REPLICA_GAP, and
+            # the snapshot push resets it to the primary's true state.
+            self.engine.check_conflicts(txn)
             if txn._writes or txn._range_clears:
                 self.seq += 1
-                await self._replicate(KvReplicateReq(
-                    seq=self.seq,
-                    write_keys=list(txn._writes.keys()),
-                    write_values=[v if v is not None else b""
-                                  for v in txn._writes.values()],
-                    write_deletes=[v is None for v in txn._writes.values()],
-                    clear_begins=[b for b, _ in txn._range_clears],
-                    clear_ends=[e for _, e in txn._range_clears]))
+                try:
+                    await self._replicate(KvReplicateReq(
+                        seq=self.seq,
+                        version=self.engine.current_version() + 1,
+                        write_keys=list(txn._writes.keys()),
+                        write_values=[v if v is not None else b""
+                                      for v in txn._writes.values()],
+                        write_deletes=[v is None for v in txn._writes.values()],
+                        clear_begins=[b for b, _ in txn._range_clears],
+                        clear_ends=[e for _, e in txn._range_clears]))
+                    # the local apply is INSIDE the rollback scope: if the
+                    # WAL append fails (OSError: disk full) after followers
+                    # applied this seq, rolling seq back makes the next
+                    # commit reuse it, the followers answer KV_REPLICA_GAP,
+                    # and the snapshot push resets them to the primary's
+                    # true (unapplied) state — no silent divergence
+                    await self.engine.commit_async(txn)
+                except Exception:
+                    self.seq -= 1
+                    raise
         return KvCommitRsp(version=self.engine.current_version()), b""
 
     # ---- replication ----
 
     async def _replicate(self, req: KvReplicateReq) -> None:
-        """Synchronously ship one batch to every follower; a gap triggers a
-        full snapshot push.  A follower that stays unreachable fails the
-        commit (sync replication: no acked write may exist only on the
-        primary)."""
-        for addr in self.followers:
-            try:
-                await self.client.call(addr, "Kv.apply_replica", req,
-                                       timeout=10.0)
-                self.replicated += 1
-            except StatusError as e:
-                if e.code == StatusCode.KV_REPLICA_GAP:
-                    await self._push_snapshot(addr, req.seq)
-                else:
-                    raise make_error(
-                        StatusCode.KV_REPLICATION_FAILED,
-                        f"follower {addr} unreachable: {e}")
+        """Synchronously ship one batch to every follower IN PARALLEL; a
+        gap triggers a full snapshot push.  A follower that stays
+        unreachable fails the commit (sync replication: no acked write may
+        exist only on the primary)."""
+        results = await asyncio.gather(
+            *(self._replicate_one(a, req) for a in self.followers),
+            return_exceptions=True)
+        for addr, res in zip(self.followers, results):
+            if isinstance(res, BaseException):
+                # NOTE: another follower may already hold this batch — the
+                # commit outcome is ambiguous under a later failover, which
+                # the client surfaces as TXN_MAYBE_COMMITTED
+                raise make_error(
+                    StatusCode.KV_REPLICATION_FAILED,
+                    f"follower {addr} unreachable: {res}")
+
+    async def _replicate_one(self, addr: str, req: KvReplicateReq) -> None:
+        try:
+            await self.client.call(addr, "Kv.apply_replica", req,
+                                   timeout=10.0)
+            self.replicated += 1
+        except StatusError as e:
+            if e.code != StatusCode.KV_REPLICA_GAP:
+                raise
+            # the engine still holds the PRE-batch state (apply happens
+            # after replication), so snapshot at seq-1 and then ship this
+            # batch incrementally on top
+            await self._push_snapshot(addr, req.seq - 1)
+            await self.client.call(addr, "Kv.apply_replica", req,
+                                   timeout=10.0)
+            self.replicated += 1
 
     async def _push_snapshot(self, addr: str, seq: int) -> None:
-        rows = self.engine.range_at(b"", b"\xff" * 8,
-                                    self.engine.current_version(), 0)
+        rows = self.engine.snapshot_rows()
         await self.client.call(addr, "Kv.load_snapshot", KvSnapshotReq(
-            seq=seq, keys=[k for k, _ in rows], values=[v for _, v in rows]),
+            seq=seq, version=self.engine.current_version(),
+            keys=[k for k, _ in rows], values=[v for _, v in rows]),
             timeout=60.0)
         self.snapshots_pushed += 1
         log.info("pushed snapshot (%d keys, seq %d) to %s",
@@ -227,6 +263,10 @@ class KvService:
                                 req.write_deletes):
             txn._writes[k] = None if is_del else v
         txn._range_clears = list(zip(req.clear_begins, req.clear_ends))
+        # stamp this batch with the PRIMARY's version so versions stay
+        # comparable across a promotion (pinned read_versions, SSI checks)
+        if req.version > 0:
+            self.engine.advance_version(req.version - 1)
         await self.engine.commit_async(txn)   # no reads -> no conflicts
         self.seq = req.seq
         return KvOkRsp(seq=self.seq), b""
@@ -241,6 +281,10 @@ class KvService:
         for k, v in zip(req.keys, req.values):
             txn._writes[k] = v
         await self.engine.commit_async(txn)
+        # fast-forward to the primary's clock: post-promotion, reads pinned
+        # at old-primary versions resolve against this snapshot and new
+        # writes version strictly above it (conflict checks stay sound)
+        self.engine.advance_version(req.version)
         self.seq = req.seq
         return KvOkRsp(seq=self.seq), b""
 

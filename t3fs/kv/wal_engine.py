@@ -241,6 +241,15 @@ class WalKVEngine(MemKVEngine):
         with self._io_lock:
             self._compact_locked()
 
+    def clear_all(self) -> None:
+        """Wipe memory AND durable state.  The inherited (memory-only)
+        clear_all would let pre-clear WAL frames replay on restart and
+        resurrect keys that a subsequent snapshot load (KvService follower
+        catch-up) had deleted cluster-wide."""
+        super().clear_all()
+        with self._io_lock:
+            self._compact_locked()   # empty snapshot + fresh WAL
+
     def _compact_locked(self) -> None:
         with self._lock:
             latest = []

@@ -14,7 +14,7 @@ import time
 from dataclasses import dataclass, field
 
 from t3fs.client.layout import FileLayout
-from t3fs.meta.schema import DirEntry, FileSession, Inode
+from t3fs.meta.schema import DirEntry, FileSession, Inode, InodeType
 from t3fs.meta.store import ChainAllocator, MetaStore
 from t3fs.net.server import rpc_method, service
 from t3fs.utils.config import ConfigBase as _ConfigBase, citem as _citem
@@ -368,7 +368,9 @@ class MetaServer:
                     # duty-sharded across meta servers: only the rendezvous
                     # owner of the "sessions"/"idem" duties prunes them
                     if self.distributor.is_mine("prune-sessions"):
-                        await self.store.prune_sessions(self.session_ttl_s)
+                        pruned = await self.store.prune_sessions_report(
+                            self.session_ttl_s)
+                        await self.reconcile_lengths(pruned)
                     if self.distributor.is_mine("prune-idem"):
                         await self.store.prune_idem_records(
                             max(600.0, self.session_ttl_s))
@@ -376,6 +378,35 @@ class MetaServer:
                 await self.gc_once()
             except Exception:
                 log.exception("meta gc failed")
+
+    async def reconcile_lengths(self, inode_ids: list[int]) -> int:
+        """Settle precise lengths for files whose writer died without close.
+
+        A crashed writer leaves the inode at its last 5-second
+        report_write_position hint; the reference's Distributor periodically
+        recomputes the true length from storage queryLastChunk
+        (docs/design_notes.md:91-95, meta/components/FileHelper.h).  Runs
+        whenever session pruning evicts dead-writer sessions."""
+        if self.sc is None:
+            return 0
+        fixed = 0
+        for inode_id in set(inode_ids):
+            try:
+                inode = await self.store.stat_inode(inode_id)
+                if inode.itype != InodeType.FILE or inode.layout is None:
+                    continue
+                # skip while other writers hold live sessions — their close
+                # will settle the length with fresher information
+                if await self.store.sessions_of(inode_id):
+                    continue
+                length = await self.sc.query_last_chunk(inode.layout, inode_id)
+                if length != inode.length:
+                    await self.store.set_length(inode_id, length)
+                    fixed += 1
+            except StatusError as e:
+                log.warning("length reconcile of inode %d failed: %s",
+                            inode_id, e)
+        return fixed
 
     async def gc_once(self) -> int:
         """Reclaim chunks of removed files (GcManager.h:57-118 analog);

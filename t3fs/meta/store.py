@@ -797,17 +797,24 @@ class MetaStore:
         """Drop write sessions older than ttl (SessionManager.h:44-83 analog:
         dead clients must not pin deferred deletions forever).  Live clients
         are expected to refresh/close well within the ttl."""
+        return len(await self.prune_sessions_report(ttl_s))
+
+    async def prune_sessions_report(self, ttl_s: float) -> list[int]:
+        """Like prune_sessions, but returns the affected inode ids so the
+        caller can reconcile their lengths: a crashed writer's close never
+        ran, so the settled length may trail what storage actually holds
+        (docs/design_notes.md:91-95 — Distributor length reconciliation)."""
         cutoff = time.time() - ttl_s
 
         async def fn(txn: Transaction):
             pre = KeyPrefix.INODE_SESSION.value
-            dropped = 0
+            pruned: list[int] = []
             for k, v in await txn.get_range(pre, pre + b"\xff", snapshot=True):
                 sess: FileSession = serde.loads(v)
                 if sess.created_at < cutoff:
                     txn.clear(k)
-                    dropped += 1
-            return dropped
+                    pruned.append(sess.inode_id)
+            return pruned
         return await self._txn(fn)
 
     async def gc_pop(self, limit: int = 16, owned=None) -> list[Inode]:

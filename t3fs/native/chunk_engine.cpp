@@ -28,11 +28,13 @@
 #include <cstring>
 #include <map>
 #include <mutex>
+#include <set>
 #include <shared_mutex>
 #include <string>
 #include <vector>
 
 #include <fcntl.h>
+#include <linux/falloc.h>
 #include <sys/stat.h>
 #include <sys/types.h>
 #include <unistd.h>
@@ -182,6 +184,7 @@ struct SizeClass {
   std::vector<uint64_t> bitmap;  // 1 bit per block, grows by groups
   uint64_t alloc_hint = 0;
   uint64_t high_water = 0;       // blocks ever allocated (file length / bs)
+  std::set<uint64_t> punched;    // freed blocks already hole-punched
 };
 
 class Engine {
@@ -344,6 +347,32 @@ class Engine {
     return snapshot_locked();
   }
 
+  // Punch-hole reclaim of freed blocks (reference PunchHoleWorker analog):
+  // returns bytes reclaimed.  Runs under the exclusive lock so a block can't
+  // be re-allocated between the free-bit check and the punch; each punch is
+  // a fast metadata op, and max_blocks bounds the lock hold per call.
+  uint64_t punch_freed(uint64_t max_blocks) {
+    std::unique_lock lk(mu_);
+    uint64_t reclaimed = 0, punched = 0;
+    for (auto& [lg, sc] : classes_) {
+      if (sc.fd < 0) continue;
+      uint64_t bs = 1ull << lg;
+      for (uint64_t blk = 0; blk < sc.high_water && punched < max_blocks;
+           blk++) {
+        bool free_bit = blk / 64 >= sc.bitmap.size() ||
+                        !(sc.bitmap[blk / 64] & (1ull << (blk % 64)));
+        if (!free_bit || sc.punched.count(blk)) continue;
+        if (::fallocate(sc.fd, FALLOC_FL_PUNCH_HOLE | FALLOC_FL_KEEP_SIZE,
+                        blk * bs, bs) == 0) {
+          sc.punched.insert(blk);
+          reclaimed += bs;
+          punched++;
+        }
+      }
+    }
+    return reclaimed;
+  }
+
   static void encode_row(uint8_t* p, const Cid& cid, const Meta& m) {
     memcpy(p, cid.data(), 16);
     memcpy(p + 16, &m, sizeof(Meta));
@@ -390,6 +419,7 @@ class Engine {
         sc.bitmap[w] |= 1ull << bit;
         sc.alloc_hint = blk;
         sc.high_water = std::max(sc.high_water, blk + 1);
+        sc.punched.erase(blk);  // re-used block is no longer a hole
         return blk;
       }
     }
@@ -689,6 +719,10 @@ void t3fs_ce_stats(void* h, uint64_t* chunks, uint64_t* used,
 
 int t3fs_ce_compact(void* h) {
   return static_cast<Engine*>(h)->compact() ? 1 : 0;
+}
+
+uint64_t t3fs_ce_punch_freed(void* h, uint64_t max_blocks) {
+  return static_cast<Engine*>(h)->punch_freed(max_blocks);
 }
 
 uint32_t t3fs_crc32c(const uint8_t* p, uint64_t n, uint32_t crc) {

@@ -64,6 +64,9 @@ class CheckWorker:
             await asyncio.sleep(self.period_s)
             try:
                 await self.check_once()
+                # piggyback housekeeping on the health tick: expire idle
+                # update channels so the dedupe map stays bounded
+                self.node.reliable_update.sweep()
             except Exception:
                 log.exception("check worker tick failed")
 
@@ -83,3 +86,61 @@ class CheckWorker:
                           tid, e)
                 self.node.local_states[tid] = LocalTargetState.OFFLINE
         return failed
+
+
+class MaintenanceWorker:
+    """Background space/metadata maintenance per target.
+
+    Reference analogs: PunchHoleWorker (hole-punch freed blocks so the
+    filesystem reclaims their space), SyncMetaKvWorker + DumpWorker (flush
+    and snapshot chunk metadata — here the native engine's WAL compaction).
+    Each tick runs on worker threads via each target's update executor so
+    engine locking stays off the event loop.
+    """
+
+    def __init__(self, node, period_s: float = 30.0,
+                 punch_batch: int = 1024):
+        self.node = node
+        self.period_s = period_s
+        self.punch_batch = punch_batch
+        self._task: asyncio.Task | None = None
+        self._stopped = asyncio.Event()
+        self.bytes_reclaimed = 0
+        self.ticks = 0
+
+    async def start(self) -> None:
+        self._task = asyncio.create_task(self._loop(), name="maint-worker")
+
+    async def stop(self) -> None:
+        self._stopped.set()
+        if self._task:
+            self._task.cancel()
+            try:
+                await self._task
+            except (asyncio.CancelledError, Exception):
+                pass
+
+    async def _loop(self) -> None:
+        while not self._stopped.is_set():
+            await asyncio.sleep(self.period_s)
+            try:
+                await self.tick()
+            except Exception:
+                log.exception("maintenance tick failed")
+
+    async def tick(self) -> int:
+        """One maintenance pass over all targets; returns bytes reclaimed."""
+        reclaimed = 0
+        for tid, target in list(self.node.targets.items()):
+            if self.node.local_states.get(tid) == LocalTargetState.OFFLINE:
+                continue
+            engine = target.engine
+            if hasattr(engine, "punch_freed"):
+                reclaimed += await target.run_update(
+                    engine.punch_freed, self.punch_batch)
+            # no unconditional compact here: the native engine already
+            # snapshots threshold-based on mutation and on close; forcing a
+            # full metadata rewrite every tick is pure write amplification
+        self.bytes_reclaimed += reclaimed
+        self.ticks += 1
+        return reclaimed

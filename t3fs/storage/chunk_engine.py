@@ -72,6 +72,7 @@ class ChunkEngine:
         self._files: dict[int, int] = {}          # size_class -> fd
         self._next_block: dict[int, int] = {}     # size_class -> watermark
         self._free: dict[int, list[int]] = {}     # size_class -> free blocks
+        self._punched: dict[int, set[int]] = {}   # free blocks already punched
         self._rebuild_allocator()
 
     # --- allocator ---
@@ -97,15 +98,47 @@ class ChunkEngine:
     def _allocate(self, size_class: int) -> int:
         free = self._free.setdefault(size_class, [])
         if free:
-            return free.pop()
+            block = free.pop()
+            self._punched.get(size_class, set()).discard(block)
+            return block
         block = self._next_block.get(size_class, 0)
         self._next_block[size_class] = block + 1
         return block
 
     def _release(self, size_class: int, block: int) -> None:
-        # freed blocks are reused by _allocate; punch-hole space reclaim is a
-        # separate background worker concern (reference PunchHoleWorker)
+        # freed blocks are reused by _allocate; punch-hole space reclaim runs
+        # in the background via punch_freed() (reference PunchHoleWorker)
         self._free.setdefault(size_class, []).append(block)
+
+    def punch_freed(self, max_blocks: int = 1024) -> int:
+        """Hole-punch free blocks so the filesystem reclaims their space
+        (PunchHoleWorker analog).  Runs under the engine lock so a block
+        cannot be re-allocated mid-punch; returns bytes reclaimed."""
+        import fcntl as _fcntl  # noqa: F401  (presence implies linux)
+        FALLOC_FL_KEEP_SIZE, FALLOC_FL_PUNCH_HOLE = 0x1, 0x2
+        try:
+            import ctypes
+            libc = ctypes.CDLL(None, use_errno=True)
+            fallocate = libc.fallocate
+        except (OSError, AttributeError):
+            return 0
+        reclaimed = punched = 0
+        with self._lock:
+            for sc, free in self._free.items():
+                fd = self._fd(sc)
+                pending = self._punched.setdefault(sc, set())
+                for block in free:
+                    if punched >= max_blocks:
+                        break
+                    if block in pending:
+                        continue
+                    if fallocate(fd, FALLOC_FL_PUNCH_HOLE | FALLOC_FL_KEEP_SIZE,
+                                 ctypes.c_uint64(block * sc),
+                                 ctypes.c_uint64(sc)) == 0:
+                        pending.add(block)
+                        reclaimed += sc
+                        punched += 1
+        return reclaimed
 
     # --- meta helpers ---
 

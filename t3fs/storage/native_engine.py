@@ -55,6 +55,8 @@ def _bind():
                                   C.POINTER(C.c_uint64),
                                   C.POINTER(C.c_uint64)]
     lib.t3fs_ce_compact.argtypes = [C.c_void_p]
+    lib.t3fs_ce_punch_freed.restype = C.c_uint64
+    lib.t3fs_ce_punch_freed.argtypes = [C.c_void_p, C.c_uint64]
     lib.t3fs_crc32c.restype = C.c_uint32
     lib.t3fs_crc32c.argtypes = [C.c_char_p, C.c_uint64, C.c_uint32]
     lib.t3fs_crc32c_combine.restype = C.c_uint32
@@ -194,6 +196,11 @@ class NativeChunkEngine:
 
     def compact(self) -> None:
         self._lib.t3fs_ce_compact(self._h)
+
+    def punch_freed(self, max_blocks: int = 1024) -> int:
+        """Hole-punch freed blocks; returns bytes reclaimed
+        (PunchHoleWorker analog)."""
+        return self._lib.t3fs_ce_punch_freed(self._h, max_blocks)
 
     def close(self) -> None:
         if self._h:

@@ -23,14 +23,32 @@ class ReliableUpdate:
     A client serializes updates per channel; a retry re-sends the same seq.
     Seq regressions are rejected (late duplicates of older requests)."""
 
-    def __init__(self):
+    # A channel that has seen no traffic for this long is forgotten; a client
+    # that comes back later starts a fresh dedupe window (it must bump seq
+    # monotonically per its own channel allocator anyway).  The reference
+    # bounds the same map through mgmtd client-session expiry
+    # (MgmtdClientSessionsChecker.h); t3fs bounds it locally.
+    SESSION_TTL_S = 3600.0
+    SESSION_CAPACITY = 65536
+
+    def __init__(self, ttl_s: float = SESSION_TTL_S,
+                 capacity: int = SESSION_CAPACITY):
+        from t3fs.utils.lock_manager import ExpiringMap, LockManager
+
         # key -> (last seq, cached result, assigned update_ver, in_flight)
-        self._sessions: dict[tuple, tuple[int, IOResult | None, int, bool]] = {}
-        self._locks: dict[tuple, asyncio.Lock] = {}
+        # in-flight entries are pinned: evicting one mid-update would let a
+        # concurrent duplicate run instead of seeing BUSY
+        self._sessions = ExpiringMap(ttl_s=ttl_s, capacity=capacity,
+                                     pin=lambda v: bool(v and v[3]))
+        self._locks = LockManager(high_water=capacity)
 
     def lock_for(self, io: UpdateIO) -> asyncio.Lock:
         key = (io.client_id, io.chain_id, io.channel)
-        return self._locks.setdefault(key, asyncio.Lock())
+        return self._locks.get(key)
+
+    def sweep(self) -> int:
+        """Expire idle channels (called from the node's background sweep)."""
+        return self._sessions.sweep()
 
     def check(self, io: UpdateIO) -> IOResult | None:
         """Returns cached result for a retry, None for a fresh update."""

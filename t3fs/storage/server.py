@@ -8,6 +8,7 @@ runs the resync worker.
 
 from __future__ import annotations
 
+import asyncio
 import logging
 import time as _time
 
@@ -33,6 +34,7 @@ class StorageConfig(ConfigBase):
     heartbeat_period_s: float = citem(0.3, validator=lambda v: v > 0)
     resync_period_s: float = citem(0.2, validator=lambda v: v > 0)
     disk_check_period_s: float = citem(5.0, validator=lambda v: v > 0)
+    maintenance_period_s: float = citem(30.0, validator=lambda v: v > 0)
     # the codec seam (BASELINE north star): cpu | tpu | null
     checksum_backend: str = citem(
         "cpu", hot=False, validator=lambda v: v in ("cpu", "tpu", "device", "null"))
@@ -59,13 +61,15 @@ class StorageServer:
         self.core = CoreService(AppInfo(node_id, "storage"), config=self.cfg,
                                 admin_token=admin_token)
         self.server.add_service(self.core)
-        from t3fs.storage.check_worker import CheckWorker
+        from t3fs.storage.check_worker import CheckWorker, MaintenanceWorker
 
         self.mgmtd_address = mgmtd_address
         self.heartbeat_period_s = self.cfg.heartbeat_period_s
         self.resync = ResyncWorker(self.node, period_s=self.cfg.resync_period_s)
         self.check = CheckWorker(self.node,
                                  period_s=self.cfg.disk_check_period_s)
+        self.maintenance = MaintenanceWorker(
+            self.node, period_s=self.cfg.maintenance_period_s)
         self.mgmtd: MgmtdClientForServer | None = None
 
     def _routing(self):
@@ -96,6 +100,7 @@ class StorageServer:
         await self.mgmtd.start()
         await self.resync.start()
         await self.check.start()
+        await self.maintenance.start()
         if hasattr(self.node.codec, "warmup"):
             # precompile common chunk-size buckets in the background so the
             # first write doesn't eat a ~10s kernel compile on the hot path
@@ -106,6 +111,7 @@ class StorageServer:
         log.info("storage node %d up at %s", self.node_id, self.server.address)
 
     async def stop(self) -> None:
+        await self.maintenance.stop()
         await self.check.stop()
         await self.resync.stop()
         if self.mgmtd:

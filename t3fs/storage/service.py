@@ -65,15 +65,16 @@ class StorageTarget:
         self.target_id = target_id
         self.engine = make_engine(root, backend=engine_backend)
         self.replica = ChunkReplica(self.engine)
-        self._chunk_locks: dict[ChunkId, asyncio.Lock] = {}
+        from t3fs.utils.lock_manager import LockManager
+
+        # bounded keyed lock table (LockManager reclaims idle locks; the
+        # round-1 plain dict grew one asyncio.Lock per chunk forever)
+        self._chunk_locks = LockManager(high_water=8192)
         self.update_executor = ThreadPoolExecutor(
             1, thread_name_prefix=f"t3fs-upd-{target_id}")
 
     def chunk_lock(self, chunk_id: ChunkId) -> asyncio.Lock:
-        lock = self._chunk_locks.get(chunk_id)
-        if lock is None:
-            lock = self._chunk_locks[chunk_id] = asyncio.Lock()
-        return lock
+        return self._chunk_locks.get(chunk_id)
 
     async def run_update(self, fn, *args):
         """Run a replica/engine mutation on this target's update worker."""

@@ -152,7 +152,12 @@ def test_unreachable_follower_fails_commit():
             txn.set(b"k", b"v")
             with pytest.raises(StatusError) as ei:
                 await txn.commit()
-            assert ei.value.code == StatusCode.KV_REPLICATION_FAILED
+            # surfaced as MAYBE_COMMITTED: with multiple followers, another
+            # follower may already hold the batch and resurrect it after a
+            # failover — the client must not blind-retry
+            assert ei.value.code == StatusCode.TXN_MAYBE_COMMITTED
+            assert "KV_REPLICATION_FAILED" in str(ei.value) or \
+                   "unreachable" in str(ei.value)
         finally:
             await kv.close()
             await cleanup()
@@ -189,4 +194,107 @@ def test_meta_store_over_remote_kv():
         finally:
             await kv.close()
             await cleanup()
+    run(body())
+
+
+def test_failed_replication_leaves_primary_unchanged():
+    """Commit order is check -> replicate -> apply: a KV_REPLICATION_FAILED
+    commit must leave NO trace on the primary (no phantom reads, and a
+    retried with_transaction re-executes against pristine state)."""
+    async def body():
+        servers, services, addrs, cleanup = await _mk_cluster(1)
+        kv = RemoteKVEngine([addrs[0]])
+        try:
+            await servers[1].stop()
+            txn = kv.transaction()
+            txn.set(b"ghost", b"v")
+            with pytest.raises(StatusError):
+                await txn.commit()
+            # the primary's engine must not contain the failed write
+            eng = services[0].engine
+            assert eng.read_at(b"ghost", eng.current_version()) is None
+            assert services[0].seq == 0      # seq allocation rolled back
+        finally:
+            await kv.close()
+            await cleanup()
+    run(body())
+
+
+def test_version_clock_survives_failover():
+    """Followers track the primary's MVCC clock (batch + snapshot carry it),
+    so post-promotion version numbers stay comparable: a conflict against a
+    pre-failover read_version is still detected."""
+    async def body():
+        servers, services, addrs, cleanup = await _mk_cluster(1)
+        kv = RemoteKVEngine(addrs)
+        cli = Client()
+        try:
+            for i in range(3):                   # advance the primary clock
+                txn = kv.transaction()
+                txn.set(f"k{i}".encode(), b"v")
+                await txn.commit()
+            primary_ver = services[0].engine.current_version()
+            assert services[1].engine.current_version() == primary_ver
+
+            # a client pins a read_version on the OLD primary
+            txn = kv.transaction()
+            assert await txn.get(b"k0") == b"v"
+            pinned = txn.read_version
+
+            # failover: old primary dies, follower promoted
+            await servers[0].stop()
+            await cli.call(addrs[1], "Kv.promote", None)
+
+            # another writer updates k0 on the NEW primary (version above
+            # the old clock, not re-counted from 1)
+            txn2 = kv.transaction()
+            txn2.set(b"k0", b"v2")
+            await txn2.commit()
+            assert services[1].engine.current_version() > primary_ver
+
+            # the pinned transaction now conflicts -- NOT silently commits
+            txn.set(b"other", b"x")
+            with pytest.raises(StatusError) as ei:
+                await txn.commit()
+            assert ei.value.code in (StatusCode.TXN_CONFLICT,
+                                     StatusCode.TXN_RETRYABLE,
+                                     StatusCode.TXN_MAYBE_COMMITTED)
+            assert pinned <= primary_ver
+        finally:
+            await cli.close()
+            await kv.close()
+            await cleanup()
+    run(body())
+
+
+def test_commit_timeout_is_maybe_committed():
+    """A mutating commit whose RPC times out must surface
+    TXN_MAYBE_COMMITTED, not blind-retry (double-apply hazard)."""
+    async def body():
+        from t3fs.net.server import rpc_method, service
+
+        @service("Kv")
+        class BlackholeKv:
+            @rpc_method
+            async def get_version(self, req, payload, conn):
+                from t3fs.kv.service import KvCommitRsp
+                return KvCommitRsp(version=1), b""
+
+            @rpc_method
+            async def commit(self, req, payload, conn):
+                await asyncio.sleep(30)          # never answers in time
+
+        srv = Server()
+        srv.add_service(BlackholeKv())
+        await srv.start()
+        kv = RemoteKVEngine([srv.address], timeout_s=0.3)
+        try:
+            txn = kv.transaction()
+            txn.set(b"k", b"v")
+            with pytest.raises(StatusError) as ei:
+                await txn.commit()
+            assert ei.value.code == StatusCode.TXN_MAYBE_COMMITTED
+        finally:
+            await kv.close()
+            await srv.stop()
     run(body())

@@ -438,3 +438,25 @@ def test_list_inodes_and_dirents_raw_scan():
         assert sorted(d.name for d in dents) == ["d", "f0", "f1", "f2",
                                                  "f3", "f4"]
     asyncio.run(body())
+
+
+def test_dead_writer_length_reconciliation(store):
+    """A crashed writer (no close) leaves a stale settled length; pruning its
+    session triggers query_last_chunk reconciliation (design_notes.md:91-95)."""
+    async def body():
+        from t3fs.client.storage_client_inmem import StorageClientInMem
+        from t3fs.meta.service import MetaServer
+
+        sc = StorageClientInMem()
+        server = MetaServer(store, sc, gc_period_s=3600)   # loops quiescent
+        inode, _sess = await store.create("/crashed", chunk_size=1024,
+                                          session_client="dead")
+        data = b"x" * 3000
+        await sc.write_file_range(inode.layout, inode.inode_id, 0, data)
+        await store.report_write_position(inode.inode_id, 100)  # stale hint
+        assert (await store.stat("/crashed")).length == 100
+        pruned = await store.prune_sessions_report(ttl_s=0.0)
+        assert pruned == [inode.inode_id]
+        assert await server.reconcile_lengths(pruned) == 1
+        assert (await store.stat("/crashed")).length == len(data)
+    run(body())

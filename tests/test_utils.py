@@ -143,3 +143,73 @@ def test_fault_injection():
         assert not fault_point("a")
     d = DebugFlags(inject_server_error_prob=1.0)
     assert serde.loads(serde.dumps(d)).inject_server_error_prob == 1.0
+
+
+# --- lock manager / expiring map (bounded server maps) ---
+
+def test_lock_manager_bounds_and_identity():
+    import asyncio
+
+    from t3fs.utils.lock_manager import LockManager
+
+    async def run():
+        lm = LockManager(high_water=8)
+        first = lm.get("k0")
+        assert lm.get("k0") is first          # stable identity while cached
+        async with first:
+            for i in range(20):               # force shrink while k0 is held
+                lm.get(f"x{i}")
+            assert len(lm) <= 16
+            assert lm.get("k0") is first      # held locks are never evicted
+
+    asyncio.run(run())
+
+
+def test_expiring_map_ttl_capacity_and_pin():
+    from t3fs.utils.lock_manager import ExpiringMap
+
+    now = [0.0]
+    m = ExpiringMap(ttl_s=10.0, capacity=4, touch_on_get=False,
+                    pin=lambda v: v == "pinned", clock=lambda: now[0])
+    m["a"] = "pinned"
+    m["b"] = 2
+    now[0] = 5.0
+    for k in ("c", "d", "e"):                 # over capacity: oldest unpinned goes
+        m[k] = 1
+    assert m.get("a") == "pinned" and m.get("b") is None
+    now[0] = 20.0                             # everything unpinned expires
+    assert m.sweep() >= 3
+    assert m.get("a") == "pinned" and len(m) == 1
+
+
+def test_reliable_update_sweep_keeps_inflight():
+    from t3fs.storage.reliable import ReliableUpdate
+    from t3fs.storage.types import UpdateIO
+
+    ru = ReliableUpdate(ttl_s=0.0)            # everything expires instantly
+    io = UpdateIO(client_id="c1", chain_id=1, channel=3, channel_seq=1)
+    ru.begin(io)                              # in flight -> pinned
+    assert ru.sweep() == 0
+    assert ru.check(io) is not None           # BUSY echo still served
+
+
+def test_lock_manager_never_evicts_waited_locks():
+    """release() clears locked() before the woken waiter runs; eviction in
+    that window must not mint a second lock for the same key."""
+    import asyncio
+
+    from t3fs.utils.lock_manager import LockManager
+
+    async def run():
+        lm = LockManager(high_water=2)
+        lock = lm.get("hot")
+        await lock.acquire()
+        waiter = asyncio.create_task(lock.acquire())
+        await asyncio.sleep(0)            # waiter parks in _waiters
+        lock.release()                    # locked()==False, waiter pending
+        lm._shrink()                      # the race window
+        assert lm.get("hot") is lock      # same object: exclusion preserved
+        await waiter
+        lock.release()
+
+    asyncio.run(run())

@@ -175,3 +175,33 @@ def test_meta_store_on_wal_engine():
 
     with tempfile.TemporaryDirectory() as d:
         asyncio.run(body(d))
+
+
+def test_clear_all_is_durable(tmp_path):
+    """clear_all on the WAL engine must reset durable state too: pre-clear
+    frames must not resurrect deleted keys on restart (follower snapshot
+    catch-up correctness)."""
+    import asyncio
+
+    from t3fs.kv.engine import Transaction
+    from t3fs.kv.wal_engine import WalKVEngine
+
+    async def body():
+        root = str(tmp_path / "kv")
+        eng = WalKVEngine(root)
+        t = Transaction(eng)
+        t.set(b"stale", b"1")
+        await eng.commit_async(t)
+        eng.clear_all()
+        t = Transaction(eng)
+        t.set(b"fresh", b"2")
+        await eng.commit_async(t)
+        eng.close()
+
+        eng2 = WalKVEngine(root)
+        ver = eng2.current_version()
+        assert eng2.read_at(b"stale", ver) is None     # did not resurrect
+        assert eng2.read_at(b"fresh", ver) == b"2"
+        eng2.close()
+
+    asyncio.run(body())
