@@ -1,0 +1,186 @@
+#!/usr/bin/env python3
+"""Generate per-node t3fs TOML configs from a small topology spec.
+
+Reference analog: deploy/data_placement/src/setup/gen_chain_table.py plus the
+per-binary config triplets under configs/ — here collapsed into one generator
+that emits everything a multi-node rollout needs:
+
+    python deploy/gen_configs.py --out /tmp/t3fs-etc \
+        --mgmtd 10.0.0.1:9000 \
+        --meta 10.0.0.1 10.0.0.2 \
+        --storage 10.0.0.3 10.0.0.4 10.0.0.5 10.0.0.6 10.0.0.7 \
+        --targets-per-node 2 --replicas 3 --chains 10
+
+Writes mgmtd.toml, kv-*.toml, meta-*.toml, storage-*.toml, fuse.toml,
+monitor.toml plus bootstrap.sh (admin-CLI commands to register targets and
+install the recovery-balanced chain table).  Review, copy to /etc/t3fs on
+each host, then follow deploy/README.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+MGMTD_PORT = 9000
+META_PORT = 9100
+STORAGE_PORT = 9200
+KV_PORT = 9400
+MONITOR_PORT = 9300
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--out", required=True)
+    p.add_argument("--mgmtd", required=True, help="host:port of mgmtd")
+    p.add_argument("--meta", nargs="+", required=True, help="meta hosts")
+    p.add_argument("--storage", nargs="+", required=True, help="storage hosts")
+    p.add_argument("--kv", nargs="*", default=[],
+                   help="replicated-KV hosts (first is primary); empty -> "
+                        "mgmtd/meta use their local WAL engines")
+    p.add_argument("--targets-per-node", type=int, default=2)
+    p.add_argument("--replicas", type=int, default=3)
+    p.add_argument("--chains", type=int, default=0,
+                   help="0 -> one chain per target")
+    p.add_argument("--chunk-size", type=int, default=1 << 20)
+    p.add_argument("--data-dir", default="/var/t3fs")
+    args = p.parse_args()
+
+    os.makedirs(args.out, exist_ok=True)
+    mgmtd_host = args.mgmtd.split(":")[0]
+    kv_addrs = [f"{h}:{KV_PORT}" for h in args.kv]
+    kv_spec = ("remote:" + ",".join(kv_addrs)) if kv_addrs else None
+
+    def w(name: str, text: str) -> None:
+        path = os.path.join(args.out, name)
+        with open(path, "w") as f:
+            f.write(text)
+        print("wrote", path)
+
+    # --- replicated KV nodes (optional FoundationDB-role deployment) ---
+    for i, host in enumerate(args.kv):
+        role = "primary" if i == 0 else "follower"
+        followers = ",".join(a for j, a in enumerate(kv_addrs) if j != i)
+        w(f"kv-{i + 1}.toml", f"""\
+# t3fs replicated KV node {i + 1} ({host}) — role: {role}
+listen_host = "0.0.0.0"
+listen_port = {KV_PORT}
+role = "{role}"
+kv = "wal:{args.data_dir}/kv"
+followers = "{followers if role == 'primary' else ''}"
+
+[log]
+level = "INFO"
+file = "/var/log/t3fs/kv.log"
+""")
+
+    # --- mgmtd ---
+    mgmtd_kv = kv_spec or f"wal:{args.data_dir}/mgmtd-kv"
+    w("mgmtd.toml", f"""\
+# t3fs mgmtd ({mgmtd_host})
+node_id = 1
+listen_host = "0.0.0.0"
+listen_port = {MGMTD_PORT}
+kv = "{mgmtd_kv}"
+
+[service]
+heartbeat_timeout_s = 2.0
+chains_update_period_s = 0.25
+lease_ttl_s = 10.0
+lease_extend_period_s = 3.0
+
+[log]
+level = "INFO"
+file = "/var/log/t3fs/mgmtd.log"
+""")
+
+    # --- meta nodes ---
+    meta_kv = kv_spec or f"wal:{args.data_dir}/meta-kv"
+    if not kv_spec and len(args.meta) > 1:
+        print("WARNING: multiple meta servers need a shared KV "
+              "(--kv hosts); per-node WAL engines would diverge.")
+    for i, host in enumerate(args.meta):
+        w(f"meta-{i + 1}.toml", f"""\
+# t3fs meta node {i + 1} ({host})
+node_id = {100 + i}
+listen_host = "0.0.0.0"
+listen_port = {META_PORT}
+mgmtd_address = "{args.mgmtd}"
+kv = "{meta_kv}"
+default_chunk_size = {args.chunk_size}
+stripe_size = {min(4, len(args.storage))}
+gc_period_s = 0.5
+session_ttl_s = 3600.0
+
+[log]
+level = "INFO"
+file = "/var/log/t3fs/meta.log"
+""")
+
+    # --- storage nodes ---
+    node_ids = []
+    for i, host in enumerate(args.storage):
+        node_id = 200 + i
+        node_ids.append(node_id)
+        tids = [node_id * 100 + t for t in range(args.targets_per_node)]
+        w(f"storage-{i + 1}.toml", f"""\
+# t3fs storage node {i + 1} ({host})
+node_id = {node_id}
+mgmtd_address = "{args.mgmtd}"
+data_dir = "{args.data_dir}/storage"
+target_ids = {tids}
+engine_backend = "native"
+
+[service]
+host = "0.0.0.0"
+port = {STORAGE_PORT}
+heartbeat_period_s = 0.3
+resync_period_s = 0.2
+disk_check_period_s = 5.0
+maintenance_period_s = 30.0
+checksum_backend = "tpu"   # cpu | tpu | null — the codec seam
+
+[log]
+level = "INFO"
+file = "/var/log/t3fs/storage.log"
+""")
+
+    # --- monitor + fuse ---
+    w("monitor.toml", f"""\
+# t3fs monitor collector
+listen_host = "0.0.0.0"
+listen_port = {MONITOR_PORT}
+
+[log]
+level = "INFO"
+file = "/var/log/t3fs/monitor.log"
+""")
+    w("fuse.toml", f"""\
+# t3fs FUSE mount
+mountpoint = "/mnt/t3fs"
+mgmtd_address = "{args.mgmtd}"
+
+[log]
+level = "INFO"
+file = "/var/log/t3fs/fuse.log"
+""")
+
+    # --- bootstrap script: chain table install via admin CLI ---
+    chains = args.chains or len(args.storage) * args.targets_per_node
+    nodes_csv = ",".join(str(n) for n in node_ids)
+    w("bootstrap.sh", f"""\
+#!/bin/sh
+# Run ONCE after mgmtd + all storage nodes are up (they self-register via
+# heartbeats).  Installs the recovery-balanced chain table.
+set -e
+ADMIN="python3 -m t3fs.cli.admin --mgmtd {args.mgmtd}"
+$ADMIN list-nodes
+$ADMIN gen-chains --nodes {nodes_csv} --replicas {args.replicas} \\
+    --chains {chains} --apply
+$ADMIN routing
+""")
+    os.chmod(os.path.join(args.out, "bootstrap.sh"), 0o755)
+
+
+if __name__ == "__main__":
+    main()

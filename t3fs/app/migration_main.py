@@ -1,0 +1,56 @@
+"""migration_main: target-migration orchestration binary (reference:
+src/migration/ migration_main — a stub there; a real service here, see
+t3fs/migration/service.py).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass
+
+from t3fs.app.base import ApplicationBase, LogConfig
+from t3fs.migration.service import MigrationService
+from t3fs.net.client import Client
+from t3fs.net.server import Server
+from t3fs.utils.config import ConfigBase, citem, cobj
+
+
+@dataclass
+class MigrationMainConfig(ConfigBase):
+    listen_host: str = citem("127.0.0.1", hot=False)
+    listen_port: int = citem(0, hot=False)
+    mgmtd_address: str = citem("127.0.0.1:9000", hot=False)
+    sync_timeout_s: float = citem(3600.0, validator=lambda v: v > 0)
+    port_file: str = citem("", hot=False)
+    log: LogConfig = cobj(LogConfig)
+
+
+async def serve(cfg: MigrationMainConfig, app: ApplicationBase) -> None:
+    cli = Client()
+    svc = MigrationService(cfg.mgmtd_address, client=cli,
+                           sync_timeout_s=cfg.sync_timeout_s)
+    srv = Server(cfg.listen_host, cfg.listen_port)
+    srv.add_service(svc)
+
+    async def start():
+        await srv.start()
+        if cfg.port_file:
+            with open(cfg.port_file, "w") as f:
+                f.write(str(srv.port))
+
+    async def stop():
+        await svc.stop()
+        await srv.stop()
+        await cli.close()
+
+    await app.run(start, stop)
+
+
+def main(argv: list[str] | None = None) -> None:
+    app = ApplicationBase("migration", MigrationMainConfig)
+    cfg = app.boot(argv)
+    asyncio.run(serve(cfg, app))
+
+
+if __name__ == "__main__":
+    main()

@@ -355,15 +355,43 @@ class StorageClient:
         return best
 
     async def remove_file_chunks(self, layout: FileLayout, inode: int) -> None:
-        routing = self.routing()
+        """Remove the file's chunks on every chain; raises on failure so
+        callers (meta GC) requeue instead of leaking chunks."""
         for chain_id in set(layout.chains):
-            chain = routing.chain(chain_id)
-            if chain is None or chain.head() is None:
-                continue
-            await self.client.call(
-                routing.node_address(chain.head().node_id),
-                "Storage.remove_chunks",
-                RemoveChunksReq(chain_id=chain_id, inode=inode))
+            last: StatusError | None = None
+            for attempt in range(self.cfg.max_retries):
+                routing = self.routing()
+                chain = routing.chain(chain_id)
+                if chain is None or chain.head() is None:
+                    # missing chain/head is a FAILURE if it persists —
+                    # returning success here would let meta GC mark the
+                    # inode reclaimed while its chunks still exist
+                    last = StatusError(StatusCode.TARGET_NOT_FOUND,
+                                       f"chain {chain_id}: no head in routing")
+                    await self._backoff(attempt)
+                    await self._maybe_refresh()
+                    continue
+                try:
+                    rsp, _ = await self.client.call(
+                        routing.node_address(chain.head().node_id),
+                        "Storage.remove_chunks",
+                        RemoveChunksReq(chain_id=chain_id, inode=inode))
+                    st = Status(StatusCode(rsp.result.status.code),
+                                rsp.result.status.message)
+                    if st.ok:
+                        last = None
+                        break
+                    last = StatusError(st.code, st.message)
+                    if not st.retryable:
+                        break
+                except StatusError as e:
+                    last = e
+                    if not e.status.retryable:
+                        break
+                await self._backoff(attempt)
+                await self._maybe_refresh()
+            if last is not None:
+                raise last
 
     async def truncate_file(self, layout: FileLayout, inode: int,
                             new_length: int) -> None:

@@ -242,9 +242,21 @@ class MemKVEngine(KVEngine):
 async def with_transaction(engine: KVEngine,
                            fn: Callable[[Transaction], Awaitable],
                            *, max_retries: int = 10,
-                           backoff_s: float = 0.001):
+                           backoff_s: float = 0.001,
+                           retry_maybe_committed: bool = False):
     """Run fn(txn) and commit, retrying on TXN_CONFLICT/TXN_RETRYABLE with
-    jittered backoff (reference: TransactionRetry / retryMaybeCommitted)."""
+    jittered backoff (reference: TransactionRetry / retryMaybeCommitted).
+
+    retry_maybe_committed=True additionally retries TXN_MAYBE_COMMITTED
+    (a mutating commit whose RPC timed out and MAY have applied).  Only
+    set it when fn is replay-safe — e.g. meta ops carrying idempotency
+    records, whose re-execution reads the record the committed attempt
+    wrote and returns it instead of double-applying (Idempotent.h /
+    MetaStore.h:54-66 retryMaybeCommitted)."""
+    retry_codes = {StatusCode.TXN_CONFLICT, StatusCode.TXN_RETRYABLE,
+                   StatusCode.TXN_TOO_OLD}
+    if retry_maybe_committed:
+        retry_codes.add(StatusCode.TXN_MAYBE_COMMITTED)
     attempt = 0
     while True:
         txn = engine.transaction()
@@ -253,8 +265,7 @@ async def with_transaction(engine: KVEngine,
             await txn.commit()
             return result
         except StatusError as e:
-            if e.code not in (StatusCode.TXN_CONFLICT, StatusCode.TXN_RETRYABLE,
-                              StatusCode.TXN_TOO_OLD):
+            if e.code not in retry_codes:
                 raise
             attempt += 1
             if attempt > max_retries:

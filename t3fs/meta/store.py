@@ -142,7 +142,11 @@ class MetaStore:
                 timestamp=time.time(), op=op, inode=inode,
                 extra=extra or "")))
             return result
-        return await self._txn(outer)
+        # replay-safe (the idem record absorbs double-execution), so an
+        # ambiguous commit outcome is retried instead of surfacing
+        await self._ensure_root()
+        return await with_transaction(self.kv, outer,
+                                      retry_maybe_committed=True)
 
     @staticmethod
     def _check_dir_lock(inode: Inode, client_id: str, path: str) -> None:

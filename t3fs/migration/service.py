@@ -1,42 +1,255 @@
-"""Migration service (stub).
+"""Migration service: orchestrated target moves between storage nodes.
 
-Reference analog: src/migration/ — the reference ships a STUB migration
-service binary (migration_main, SURVEY.md §1 L6 "migration (stub)");
-mirrored here so the binary inventory matches: the service registers,
-reports its status, and rejects job submission as unimplemented.
+Reference analog: src/migration/ — the reference ships only a STUB binary
+(migration_main, SURVEY.md §1 L6 "migration (stub)").  t3fs implements the
+real capability on top of machinery that already exists: chain surgery
+(Mgmtd.update_chain, UpdateChainOperation.cc analog), target provisioning
+(Storage.create_target), the chain public-state machine, and resync
+(full-chunk replace, ResyncWorker.cc:101-389).  A migration job is:
+
+    1. CREATE   — provision the destination target on its node
+    2. JOIN     — add it to the chain (enters OFFLINE; the chain state
+                  machine walks it OFFLINE -> SYNCING -> SERVING while the
+                  predecessor streams chunks via resync)
+    3. WAIT     — poll routing until the new target is SERVING
+    4. DRAIN    — offline the source target (local state -> heartbeat ->
+                  public OFFLINE, moved to chain tail)
+    5. DETACH   — remove the source target from the chain
+
+Every step is idempotent/resumable: the driver re-derives progress from the
+observed routing state, so a restarted migration service re-attaches to
+in-flight jobs instead of double-applying.
 """
 
 from __future__ import annotations
 
+import asyncio
+import logging
 from dataclasses import dataclass, field
+from enum import Enum
 
 from t3fs.net.server import rpc_method, service
 from t3fs.utils.serde import serde_struct
-from t3fs.utils.status import StatusCode, make_error
+from t3fs.utils.status import StatusCode, StatusError, make_error
+
+log = logging.getLogger("t3fs.migration")
+
+
+class JobState(str, Enum):
+    PENDING = "pending"
+    CREATING = "creating"
+    JOINING = "joining"
+    WAITING_SYNC = "waiting_sync"
+    DRAINING = "draining"
+    DETACHING = "detaching"
+    DONE = "done"
+    FAILED = "failed"
 
 
 @serde_struct
 @dataclass
-class MigrationStatusRsp:
-    implemented: bool = False
-    jobs: list[str] = field(default_factory=list)
+class MigrationJob:
+    job_id: int = 0
+    chain_id: int = 0
+    src_target_id: int = 0
+    dst_target_id: int = 0
+    dst_node_id: int = 0
+    dst_root: str = ""
+    state: str = JobState.PENDING.value
+    error: str = ""
 
 
 @serde_struct
 @dataclass
 class SubmitMigrationReq:
-    src_chain: int = 0
-    dst_chain: int = 0
+    chain_id: int = 0
+    src_target_id: int = 0
+    dst_target_id: int = 0
+    dst_node_id: int = 0
+    dst_root: str = ""
+
+
+@serde_struct
+@dataclass
+class SubmitMigrationRsp:
+    job_id: int = 0
+
+
+@serde_struct
+@dataclass
+class MigrationStatusRsp:
+    implemented: bool = True
+    jobs: list[MigrationJob] = field(default_factory=list)
 
 
 @service("Migration")
 class MigrationService:
+    """Job queue + driver.  Needs a net client and the mgmtd address; talks
+    to mgmtd for routing/chain surgery and to storage nodes for target
+    provisioning/offlining."""
+
+    MAX_FINISHED_JOBS = 256   # retained DONE/FAILED history
+
+    def __init__(self, mgmtd_address: str = "", client=None,
+                 poll_period_s: float = 0.2, sync_timeout_s: float = 120.0):
+        self.mgmtd_address = mgmtd_address
+        self.client = client
+        self.poll_period_s = poll_period_s
+        self.sync_timeout_s = sync_timeout_s
+        self.jobs: dict[int, MigrationJob] = {}
+        self._next_id = 1
+        self._tasks: dict[int, asyncio.Task] = {}
+
+    def _prune_finished(self, job_id: int) -> None:
+        """Driver-done callback: drop the task handle and cap the retained
+        job history — a long-running daemon must not grow per job forever."""
+        self._tasks.pop(job_id, None)
+        finished = [j for j in self.jobs.values()
+                    if j.state in (JobState.DONE.value, JobState.FAILED.value)]
+        for j in sorted(finished, key=lambda j: j.job_id)[
+                : max(0, len(finished) - self.MAX_FINISHED_JOBS)]:
+            self.jobs.pop(j.job_id, None)
+
+    # ---- RPC surface ----
+
     @rpc_method
     async def status(self, req, payload, conn):
-        return MigrationStatusRsp(), b""
+        return MigrationStatusRsp(jobs=list(self.jobs.values())), b""
 
     @rpc_method
     async def submit(self, req: SubmitMigrationReq, payload, conn):
-        raise make_error(StatusCode.NOT_IMPLEMENTED,
-                         "migration jobs are not implemented (stub, as in "
-                         "the reference)")
+        if self.client is None or not self.mgmtd_address:
+            raise make_error(StatusCode.NOT_IMPLEMENTED,
+                             "migration service not wired to a cluster")
+        if not (req.chain_id and req.src_target_id and req.dst_target_id
+                and req.dst_node_id and req.dst_root):
+            raise make_error(StatusCode.INVALID_ARG,
+                             "chain_id, src/dst target ids, dst_node_id and "
+                             "dst_root are all required")
+        job = MigrationJob(
+            job_id=self._next_id, chain_id=req.chain_id,
+            src_target_id=req.src_target_id,
+            dst_target_id=req.dst_target_id, dst_node_id=req.dst_node_id,
+            dst_root=req.dst_root)
+        self._next_id += 1
+        self.jobs[job.job_id] = job
+        task = asyncio.create_task(self._drive(job),
+                                   name=f"migration-{job.job_id}")
+        task.add_done_callback(lambda _t: self._prune_finished(job.job_id))
+        self._tasks[job.job_id] = task
+        return SubmitMigrationRsp(job_id=job.job_id), b""
+
+    async def stop(self) -> None:
+        for t in self._tasks.values():
+            t.cancel()
+        for t in self._tasks.values():
+            try:
+                await t
+            except (asyncio.CancelledError, Exception):
+                pass
+
+    # ---- driver ----
+
+    async def _routing(self):
+        from t3fs.mgmtd.service import GetRoutingInfoReq
+        rsp, _ = await self.client.call(
+            self.mgmtd_address, "Mgmtd.get_routing_info",
+            GetRoutingInfoReq(known_version=0))
+        return rsp.info
+
+    async def _drive(self, job: MigrationJob) -> None:
+        try:
+            await self._run_steps(job)
+            job.state = JobState.DONE.value
+            log.info("migration %d done: chain %d target %d -> %d@n%d",
+                     job.job_id, job.chain_id, job.src_target_id,
+                     job.dst_target_id, job.dst_node_id)
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            job.error = str(e)
+            job.state = JobState.FAILED.value
+            log.error("migration %d failed: %s", job.job_id, e)
+
+    async def _run_steps(self, job: MigrationJob) -> None:
+        from t3fs.mgmtd.service import ChainOpReq
+        from t3fs.mgmtd.types import PublicTargetState
+        from t3fs.storage.types import TargetOpReq
+
+        routing = await self._routing()
+        chain = routing.chain(job.chain_id)
+        if chain is None:
+            raise make_error(StatusCode.TARGET_NOT_FOUND,
+                             f"chain {job.chain_id}")
+        if not any(t.target_id == job.src_target_id for t in chain.targets):
+            raise make_error(StatusCode.TARGET_NOT_FOUND,
+                             f"target {job.src_target_id} not in chain")
+        dst_addr = routing.node_address(job.dst_node_id)
+        if dst_addr is None:
+            raise make_error(StatusCode.TARGET_NOT_FOUND,
+                             f"node {job.dst_node_id} not registered")
+
+        # 1. CREATE the destination target (create_target is idempotent for
+        # the same id+root, so a restarted driver re-attaches cleanly)
+        job.state = JobState.CREATING.value
+        await self.client.call(dst_addr, "Storage.create_target",
+                               TargetOpReq(target_id=job.dst_target_id,
+                                           root=job.dst_root))
+
+        # 2. JOIN the chain (skipped when already a member)
+        job.state = JobState.JOINING.value
+        if not any(t.target_id == job.dst_target_id for t in chain.targets):
+            await self.client.call(
+                self.mgmtd_address, "Mgmtd.update_chain",
+                ChainOpReq(chain_id=job.chain_id,
+                           target_id=job.dst_target_id,
+                           node_id=job.dst_node_id, mode="add"))
+
+        # 3. WAIT for resync to bring it SERVING
+        job.state = JobState.WAITING_SYNC.value
+        await self._wait_state(job, job.dst_target_id,
+                               {PublicTargetState.SERVING})
+
+        # 4. DRAIN the source: offline it on its node; the chain state
+        # machine demotes it publicly and moves it to the tail.  Routing is
+        # re-fetched: the WAIT step may have taken minutes, during which
+        # the source node could have re-registered at a new address
+        job.state = JobState.DRAINING.value
+        routing = await self._routing()
+        src_node = next(t.node_id for t in chain.targets
+                        if t.target_id == job.src_target_id)
+        src_addr = routing.node_address(src_node)
+        if src_addr is not None:
+            try:
+                await self.client.call(
+                    src_addr, "Storage.offline_target",
+                    TargetOpReq(target_id=job.src_target_id))
+            except StatusError:
+                pass   # node itself may be dead — mgmtd will notice
+        await self._wait_state(job, job.src_target_id,
+                               {PublicTargetState.OFFLINE})
+
+        # 5. DETACH the source from the chain
+        job.state = JobState.DETACHING.value
+        await self.client.call(
+            self.mgmtd_address, "Mgmtd.update_chain",
+            ChainOpReq(chain_id=job.chain_id, target_id=job.src_target_id,
+                       mode="remove"))
+
+    async def _wait_state(self, job: MigrationJob, target_id: int,
+                          wanted) -> None:
+        deadline = asyncio.get_running_loop().time() + self.sync_timeout_s
+        while True:
+            routing = await self._routing()
+            chain = routing.chain(job.chain_id)
+            hit = [t for t in chain.targets if t.target_id == target_id] \
+                if chain else []
+            if hit and hit[0].public_state in wanted:
+                return
+            if asyncio.get_running_loop().time() > deadline:
+                state = hit[0].public_state.name if hit else "GONE"
+                raise make_error(
+                    StatusCode.TIMEOUT,
+                    f"target {target_id} stuck in {state}, wanted "
+                    f"{[s.name for s in wanted]}")
+            await asyncio.sleep(self.poll_period_s)

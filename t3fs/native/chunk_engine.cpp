@@ -184,7 +184,7 @@ struct SizeClass {
   std::vector<uint64_t> bitmap;  // 1 bit per block, grows by groups
   uint64_t alloc_hint = 0;
   uint64_t high_water = 0;       // blocks ever allocated (file length / bs)
-  std::set<uint64_t> punched;    // freed blocks already hole-punched
+  std::set<uint64_t> punch_pending;  // freed since last punch pass
 };
 
 class Engine {
@@ -348,26 +348,28 @@ class Engine {
   }
 
   // Punch-hole reclaim of freed blocks (reference PunchHoleWorker analog):
-  // returns bytes reclaimed.  Runs under the exclusive lock so a block can't
-  // be re-allocated between the free-bit check and the punch; each punch is
-  // a fast metadata op, and max_blocks bounds the lock hold per call.
+  // returns bytes reclaimed.  release() queues each freed block; this
+  // drains up to max_blocks of the queue under the exclusive lock (so a
+  // block can't be re-allocated between the free-bit check and the punch)
+  // — the lock hold is O(drained), never a scan of the whole allocator.
   uint64_t punch_freed(uint64_t max_blocks) {
     std::unique_lock lk(mu_);
     uint64_t reclaimed = 0, punched = 0;
     for (auto& [lg, sc] : classes_) {
       if (sc.fd < 0) continue;
       uint64_t bs = 1ull << lg;
-      for (uint64_t blk = 0; blk < sc.high_water && punched < max_blocks;
-           blk++) {
+      auto it = sc.punch_pending.begin();
+      while (it != sc.punch_pending.end() && punched < max_blocks) {
+        uint64_t blk = *it;
         bool free_bit = blk / 64 >= sc.bitmap.size() ||
                         !(sc.bitmap[blk / 64] & (1ull << (blk % 64)));
-        if (!free_bit || sc.punched.count(blk)) continue;
-        if (::fallocate(sc.fd, FALLOC_FL_PUNCH_HOLE | FALLOC_FL_KEEP_SIZE,
+        if (free_bit &&
+            ::fallocate(sc.fd, FALLOC_FL_PUNCH_HOLE | FALLOC_FL_KEEP_SIZE,
                         blk * bs, bs) == 0) {
-          sc.punched.insert(blk);
           reclaimed += bs;
           punched++;
         }
+        it = sc.punch_pending.erase(it);
       }
     }
     return reclaimed;
@@ -419,7 +421,7 @@ class Engine {
         sc.bitmap[w] |= 1ull << bit;
         sc.alloc_hint = blk;
         sc.high_water = std::max(sc.high_water, blk + 1);
-        sc.punched.erase(blk);  // re-used block is no longer a hole
+        sc.punch_pending.erase(blk);  // re-used: nothing left to punch
         return blk;
       }
     }
@@ -435,6 +437,7 @@ class Engine {
     if (blk / 64 < sc.bitmap.size()) {
       sc.bitmap[blk / 64] &= ~(1ull << (blk % 64));
       sc.alloc_hint = std::min(sc.alloc_hint, blk);
+      sc.punch_pending.insert(blk);  // queue for background reclaim
     }
   }
 
@@ -449,6 +452,14 @@ class Engine {
 
   void rebuild_allocator() {
     for (auto& [cid, s] : index_) mark_used(s.size_class_log2, s.block);
+    // queue pre-restart free blocks for reclaim: holes punched in a past
+    // life re-punch as cheap no-ops, blocks freed just before a crash get
+    // their space back (one-time cost, drained in bounded batches)
+    for (auto& [lg, sc] : classes_) {
+      for (uint64_t blk = 0; blk < sc.high_water; blk++)
+        if (!(sc.bitmap[blk / 64] & (1ull << (blk % 64))))
+          sc.punch_pending.insert(blk);
+    }
   }
 
   // ---- WAL / snapshot ----

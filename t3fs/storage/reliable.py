@@ -157,6 +157,21 @@ class ReliableForwarding:
             if chain is None:
                 raise make_error(StatusCode.TARGET_NOT_FOUND,
                                  f"chain {io.chain_id} gone from routing")
+            if chain.chain_ver != io.chain_ver:
+                # The chain reshaped between this update's validation and
+                # its forward.  Adopting the NEW topology here is how acked
+                # data gets lost: a head whose successors were just demoted
+                # would see "no successor", declare itself the tail, and
+                # commit a single-copy write that mgmtd's authoritative
+                # lineage (LASTSRV) later erases via resync.  The reference
+                # instead pins every step to the update's chain version
+                # (VersionedChainId re-check in StorageOperator::handleUpdate)
+                # — fail retryably and let the client re-route at the new
+                # version.
+                raise make_error(
+                    StatusCode.CHAIN_VERSION_MISMATCH,
+                    f"chain {io.chain_id} moved v{io.chain_ver} -> "
+                    f"v{chain.chain_ver} mid-update")
             succ = chain.successor_of(target_id)
             if succ is None:
                 return None

@@ -113,6 +113,9 @@ class StorageNode:
         self.read_count = CountRecorder(f"storage.read_ios.n{node_id}")
         # optional StructuredTraceLog[StorageEventTrace] (analytics §5.1)
         self.trace_log = None
+        # optional CriticalSectionAuditor (t3fs/testing/race.py §5.2 analog);
+        # tests/sims set it to assert per-chunk mutual exclusion live
+        self.audit = None
 
     def routing(self) -> RoutingInfo:
         return self._routing_provider()
@@ -273,85 +276,104 @@ class StorageService:
                 return cached
 
         async with target.chunk_lock(io.chunk_id):
-            if require_head:
-                node.reliable_update.begin(io)
-            # fetch payload: one-sided pull from requester, or inline frame
-            if io.buf is not None and not io.inline:
-                payload = await remote_read(conn, io.buf)
-                trace_add("storage.update.pulled", f"len={len(payload)}")
-            if io.update_ver == 0:
-                # a retry of a retryably-failed attempt reuses the version it
-                # was assigned: the replica's idempotent-pending branch then
-                # accepts it instead of wedging on its own DIRTY marker
-                remembered = node.reliable_update.assigned_version(io) \
-                    if require_head else 0
-                if remembered:
-                    io.update_ver = remembered
-                else:
-                    meta = target.engine.get_meta(io.chunk_id)
-                    io.update_ver = (meta.update_ver if meta else 0) + 1
-                    if require_head:
-                        node.reliable_update.remember_version(io)
-            io.chain_ver = chain.chain_ver
+            if node.audit is not None:
+                # sanitizer hook (t3fs/testing/race.py): the region from
+                # here to return must be per-chunk mutually exclusive —
+                # overlap means the chunk lock is broken, and the auditor
+                # reports it at the interleaving itself (TSan analog)
+                node.audit.enter(("chunk", target.target_id, io.chunk_id),
+                                 f"update v{io.update_ver}")
+            try:
+                return await self._locked_update(
+                    node, chain, target, io, payload, conn, require_head,
+                    trace)
+            finally:
+                if node.audit is not None:
+                    node.audit.exit(("chunk", target.target_id, io.chunk_id))
 
-            # checksum via the codec seam: the device backend micro-batches
-            # CRCs across every update concurrently in flight on this node
-            # (BASELINE north star; replaces folly::crc32c, Common.h:158)
-            payload_crc: int | None = None
-            if payload and io.update_type in (UpdateType.WRITE,
-                                              UpdateType.REPLACE):
-                if not node.codec.verify_enabled:
-                    io.checksum = 0
-                    payload_crc = 0
-                else:
-                    payload_crc = await node.codec.payload_crc(payload)
+    async def _locked_update(self, node, chain, target, io: UpdateIO,
+                             payload: bytes, conn: Connection,
+                             require_head: bool, trace: dict) -> IOResult:
+        from t3fs.storage.types import UpdateType
+        if require_head:
+            node.reliable_update.begin(io)
+        # fetch payload: one-sided pull from requester, or inline frame
+        if io.buf is not None and not io.inline:
+            payload = await remote_read(conn, io.buf)
+            trace_add("storage.update.pulled", f"len={len(payload)}")
+        if io.update_ver == 0:
+            # a retry of a retryably-failed attempt reuses the version it
+            # was assigned: the replica's idempotent-pending branch then
+            # accepts it instead of wedging on its own DIRTY marker
+            remembered = node.reliable_update.assigned_version(io) \
+                if require_head else 0
+            if remembered:
+                io.update_ver = remembered
+            else:
+                meta = target.engine.get_meta(io.chunk_id)
+                io.update_ver = (meta.update_ver if meta else 0) + 1
+                if require_head:
+                    node.reliable_update.remember_version(io)
+        io.chain_ver = chain.chain_ver
 
+        # checksum via the codec seam: the device backend micro-batches
+        # CRCs across every update concurrently in flight on this node
+        # (BASELINE north star; replaces folly::crc32c, Common.h:158)
+        payload_crc: int | None = None
+        if payload and io.update_type in (UpdateType.WRITE,
+                                          UpdateType.REPLACE):
+            if not node.codec.verify_enabled:
+                io.checksum = 0
+                payload_crc = 0
+            else:
+                payload_crc = await node.codec.payload_crc(payload)
+
+        try:
+            result = await target.run_update(
+                target.replica.apply_update, io, payload, payload_crc)
+            trace_add("storage.update.applied", f"ver={io.update_ver}")
+        except (OSError, StatusError) as e:
+            if node.mark_if_disk_error(target, e):
+                result = IOResult(WireStatus(int(StatusCode.DISK_ERROR),
+                                             f"disk error: {e}"))
+            else:
+                result = IOResult(WireStatus(int(e.code), str(e)))
+            return result  # _update_to_result records all failures
+
+        # forward down the chain (tail commits first)
+        try:
+            succ_result = await self._forward(chain, target, io, payload)
+            trace_add("storage.update.forwarded")
+            if succ_result is not None:
+                trace["forward_status"] = succ_result.status.code
+        except StatusError as e:
+            return IOResult(WireStatus(int(e.code), f"forward: {e}"))
+
+        if succ_result is not None and succ_result.status.code == int(StatusCode.OK):
+            # checksum cross-check vs successor (StorageOperator.cc:464-485)
+            if (io.update_type == UpdateType.WRITE
+                    and succ_result.checksum != result.checksum):
+                raise make_error(
+                    StatusCode.CHECKSUM_MISMATCH,
+                    f"{io.chunk_id}: successor {succ_result.checksum:#x} "
+                    f"!= local {result.checksum:#x}")
+        elif succ_result is not None:
+            return succ_result  # propagate successor failure up the chain
+
+        if io.update_type not in (UpdateType.REMOVE,):
             try:
                 result = await target.run_update(
-                    target.replica.apply_update, io, payload, payload_crc)
-                trace_add("storage.update.applied", f"ver={io.update_ver}")
+                    target.replica.commit, io.chunk_id, io.update_ver,
+                    chain.chain_ver)
             except (OSError, StatusError) as e:
-                if node.mark_if_disk_error(target, e):
-                    result = IOResult(WireStatus(int(StatusCode.DISK_ERROR),
-                                                 f"disk error: {e}"))
-                else:
-                    result = IOResult(WireStatus(int(e.code), str(e)))
-                return result  # _update_to_result records all failures
-
-            # forward down the chain (tail commits first)
-            try:
-                succ_result = await self._forward(chain, target, io, payload)
-                trace_add("storage.update.forwarded")
-                if succ_result is not None:
-                    trace["forward_status"] = succ_result.status.code
-            except StatusError as e:
-                return IOResult(WireStatus(int(e.code), f"forward: {e}"))
-
-            if succ_result is not None and succ_result.status.code == int(StatusCode.OK):
-                # checksum cross-check vs successor (StorageOperator.cc:464-485)
-                if (io.update_type == UpdateType.WRITE
-                        and succ_result.checksum != result.checksum):
-                    raise make_error(
-                        StatusCode.CHECKSUM_MISMATCH,
-                        f"{io.chunk_id}: successor {succ_result.checksum:#x} "
-                        f"!= local {result.checksum:#x}")
-            elif succ_result is not None:
-                return succ_result  # propagate successor failure up the chain
-
-            if io.update_type not in (UpdateType.REMOVE,):
-                try:
-                    result = await target.run_update(
-                        target.replica.commit, io.chunk_id, io.update_ver,
-                        chain.chain_ver)
-                except (OSError, StatusError) as e:
-                    # a disk that dies between apply and commit must offline
-                    # the target just like one that dies during apply
-                    node.mark_if_disk_error(target, e)
-                    raise
-                trace_add("storage.update.committed")
-            if require_head:
-                node.reliable_update.record(io, result)
-            return result
+                # a disk that dies between apply and commit must offline
+                # the target just like one that dies during apply
+                node.mark_if_disk_error(target, e)
+                raise
+            trace_add("storage.update.committed")
+        if require_head:
+            node.reliable_update.record(io, result)
+        return result
 
     async def _forward(self, chain: ChainInfo, target: StorageTarget,
                        io: UpdateIO, payload: bytes) -> IOResult | None:
@@ -455,18 +477,40 @@ class StorageService:
 
     @rpc_method
     async def remove_chunks(self, req: RemoveChunksReq, payload, conn):
-        """Range remove via the chain (head entry), chunk by chunk."""
-        chain, target = self.node._check_chain(req.chain_id, 0, require_head=True)
+        """Range remove via the chain (head entry), chunk by chunk.
+
+        Each chunk's remove re-resolves the chain and retries bounded on
+        retryable failures: a chain-version bump mid-loop (e.g. our own
+        routing refresh landing between IOs) must not silently skip chunks
+        — a skipped remove leaves the chunk resurrectable by resync.  A
+        chunk that still fails makes the whole RPC report that failure so
+        the caller can retry."""
+        _, target = self.node._check_chain(req.chain_id, 0, require_head=True)
         removed = 0
+        first_fail: IOResult | None = None
         for meta in target.engine.query_range(req.inode, req.begin_index,
                                               req.end_index):
-            io = UpdateIO(chunk_id=meta.chunk_id, chain_id=req.chain_id,
-                          chain_ver=chain.chain_ver,
-                          update_type=UpdateType.REMOVE,
-                          update_ver=meta.update_ver + 1, from_head=True)
-            result = await self._update_to_result(io, b"", conn, require_head=False)
-            if result.status.code == int(StatusCode.OK):
+            result = None
+            for _ in range(5):
+                chain, _t = self.node._check_chain(req.chain_id, 0,
+                                                   require_head=True)
+                io = UpdateIO(chunk_id=meta.chunk_id, chain_id=req.chain_id,
+                              chain_ver=chain.chain_ver,
+                              update_type=UpdateType.REMOVE,
+                              update_ver=meta.update_ver + 1, from_head=True)
+                result = await self._update_to_result(io, b"", conn,
+                                                      require_head=False)
+                st = Status(StatusCode(result.status.code),
+                            result.status.message)
+                if st.ok or not st.retryable:
+                    break
+                await asyncio.sleep(0.05)
+            if result is not None and result.status.code == int(StatusCode.OK):
                 removed += 1
+            elif first_fail is None:
+                first_fail = result
+        if first_fail is not None:
+            return WriteRsp(result=first_fail), b""
         return WriteRsp(result=IOResult(WireStatus(), removed)), b""
 
     @rpc_method
@@ -491,11 +535,21 @@ class StorageService:
         """Provision a new target (disk dir) on this node; it joins chains
         via mgmtd update_chain + resync."""
         node = self.node
-        if req.target_id in node.targets:
-            raise make_error(StatusCode.INVALID_ARG,
-                             f"target {req.target_id} already exists")
         if not req.root:
             raise make_error(StatusCode.INVALID_ARG, "create_target: no root")
+        existing = node.targets.get(req.target_id)
+        if existing is not None:
+            # idempotent re-create: same id + same root is a no-op success
+            # (a restarted orchestrator re-attaches); a different root is a
+            # conflict — silently reusing the other disk would be wrong
+            if existing.engine.root == req.root:
+                return TargetOpRsp(
+                    target_id=req.target_id,
+                    state=int(node.local_states.get(
+                        req.target_id, LocalTargetState.ONLINE))), b""
+            raise make_error(StatusCode.INVALID_ARG,
+                             f"target {req.target_id} already exists at "
+                             f"{existing.engine.root}")
         t = node.add_target(req.target_id, req.root,
                             state=LocalTargetState.ONLINE,
                             engine_backend=req.engine_backend)

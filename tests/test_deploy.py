@@ -1,0 +1,50 @@
+"""Deploy tooling: generated configs must parse against the real per-binary
+config schemas (the reference ships ready-to-run TOML triplets; a generator
+emitting unparseable configs would fail at service start)."""
+
+import subprocess
+import sys
+
+
+def test_generated_configs_parse(tmp_path):
+    out = tmp_path / "etc"
+    subprocess.run(
+        [sys.executable, "deploy/gen_configs.py", "--out", str(out),
+         "--mgmtd", "10.0.0.1:9000", "--kv", "10.0.0.1", "10.0.0.2",
+         "--meta", "10.0.0.1", "10.0.0.2",
+         "--storage", "10.0.0.3", "10.0.0.4", "10.0.0.5",
+         "--targets-per-node", "2", "--replicas", "3"],
+        check=True, capture_output=True)
+
+    from t3fs.app.fuse_main import FuseMainConfig
+    from t3fs.app.kv_main import KvMainConfig
+    from t3fs.app.meta_main import MetaMainConfig
+    from t3fs.app.mgmtd_main import MgmtdMainConfig
+    from t3fs.app.monitor_main import MonitorMainConfig
+    from t3fs.app.storage_main import StorageMainConfig
+
+    schema = {"mgmtd": MgmtdMainConfig, "meta": MetaMainConfig,
+              "storage": StorageMainConfig, "kv": KvMainConfig,
+              "monitor": MonitorMainConfig, "fuse": FuseMainConfig}
+    parsed = 0
+    for path in out.glob("*.toml"):
+        kind = path.name.split("-")[0].split(".")[0]
+        cfg = schema[kind].from_toml(str(path))    # raises on unknown keys
+        parsed += 1
+        if kind == "storage":
+            assert cfg.node_id >= 200 and len(cfg.target_ids) == 2
+        if kind == "kv" and "kv-1" in path.name:
+            assert cfg.role == "primary" and cfg.followers
+    assert parsed == 10  # mgmtd + 2 kv + 2 meta + 3 storage + monitor + fuse
+    assert (out / "bootstrap.sh").stat().st_mode & 0o111
+
+
+def test_systemd_units_reference_real_binaries():
+    import os
+    import re
+    for unit in os.listdir("deploy/systemd"):
+        text = open(f"deploy/systemd/{unit}").read()
+        m = re.search(r"-m (t3fs\.app\.\w+)", text)
+        assert m, unit
+        mod = m.group(1)
+        __import__(mod)          # binary module must exist

@@ -298,3 +298,36 @@ def test_commit_timeout_is_maybe_committed():
             await kv.close()
             await srv.stop()
     run(body())
+
+
+def test_maybe_committed_retry_opt_in():
+    """with_transaction retries TXN_MAYBE_COMMITTED only for replay-safe
+    callers (meta idempotent ops opt in; everyone else sees the ambiguity)."""
+    async def body():
+        from t3fs.kv.engine import MemKVEngine, with_transaction
+        from t3fs.utils.status import make_error
+
+        class FlakyCommitEngine(MemKVEngine):
+            def __init__(self):
+                super().__init__()
+                self.failures = 1
+
+            async def commit_async(self, txn):
+                if self.failures > 0:
+                    self.failures -= 1
+                    raise make_error(StatusCode.TXN_MAYBE_COMMITTED, "rpc timeout")
+                self._commit(txn)
+
+        async def put(txn):
+            txn.set(b"k", b"v")
+
+        eng = FlakyCommitEngine()
+        with pytest.raises(StatusError) as ei:
+            await with_transaction(eng, put)
+        assert ei.value.code == StatusCode.TXN_MAYBE_COMMITTED
+
+        eng2 = FlakyCommitEngine()
+        await with_transaction(eng2, put, retry_maybe_committed=True)
+        ver = eng2.current_version()
+        assert eng2.read_at(b"k", ver) == b"v"
+    run(body())

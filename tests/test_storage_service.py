@@ -10,9 +10,10 @@ import pytest
 
 from t3fs.mgmtd.types import ChainTargetInfo, PublicTargetState
 from t3fs.ops.crc32c import crc32c_ref
+from t3fs.client.storage_client import StorageClient, StorageClientConfig
 from t3fs.storage.types import (
-    BatchReadReq, ChunkId, QueryLastChunkReq, ReadIO, RemoveChunksReq, UpdateIO,
-    UpdateType, WriteReq,
+    BatchReadReq, ChunkId, ChunkState, QueryLastChunkReq, ReadIO,
+    RemoveChunksReq, UpdateIO, UpdateType, WriteReq,
 )
 from t3fs.testing.fabric import StorageFabric
 from t3fs.utils.status import StatusCode
@@ -413,6 +414,66 @@ def test_batch_read_no_payload_verify_only():
                 fabric.head_address(), "Storage.batch_read", req)
             assert rsp.results[0].status.code == int(
                 StatusCode.CHECKSUM_MISMATCH)
+        finally:
+            await fabric.stop()
+    run(body())
+
+
+def test_stale_head_cannot_single_copy_commit():
+    """Acked-write-loss regression: a head whose routing jumps mid-update to
+    a chain where its successors were demoted must FAIL the write with
+    CHAIN_VERSION_MISMATCH — not adopt the new topology, find no successor,
+    declare itself tail, and commit a single-copy write that the LASTSRV
+    lineage later erases via resync (the reference pins every step to the
+    update's chain version, StorageOperator handleUpdate re-check)."""
+    async def body():
+        from t3fs.mgmtd.types import ChainInfo, ChainTargetInfo, \
+            PublicTargetState, RoutingInfo
+
+        fabric = StorageFabric(num_nodes=3, replicas=3)
+        await fabric.start()
+        try:
+            head_node = fabric.nodes[0]
+            v1 = fabric.routing
+            # the reshape mgmtd applied while this node's view lagged:
+            # successors demoted, tail is the authoritative LASTSRV
+            v2 = RoutingInfo(version=2)
+            v2.nodes = v1.nodes
+            v2.chain_tables = v1.chain_tables
+            c1 = v1.chains[fabric.chain_id]
+            v2.chains[fabric.chain_id] = ChainInfo(
+                c1.chain_id, c1.chain_ver + 1,
+                [ChainTargetInfo(c1.targets[2].target_id,
+                                 c1.targets[2].node_id,
+                                 PublicTargetState.LASTSRV),
+                 ChainTargetInfo(c1.targets[0].target_id,
+                                 c1.targets[0].node_id,
+                                 PublicTargetState.OFFLINE),
+                 ChainTargetInfo(c1.targets[1].target_id,
+                                 c1.targets[1].node_id,
+                                 PublicTargetState.OFFLINE)])
+            calls = {"n": 0}
+
+            def flipping_provider():
+                # entry validation sees the stale v1; every later call
+                # (the forward path) sees the reshaped v2
+                calls["n"] += 1
+                return v1 if calls["n"] <= 1 else v2
+
+            head_node._routing_provider = flipping_provider
+
+            sc = StorageClient(lambda: v1, client=fabric.client,
+                               config=StorageClientConfig(
+                                   retry_backoff_s=0.01, max_retries=3))
+            cid = ChunkId(77, 0)
+            result = await sc.write_chunk(fabric.chain_id, cid, 0,
+                                          b"x" * 4096, chunk_size=4096)
+            assert result.status.code != int(StatusCode.OK), \
+                "stale head acked a single-copy write"
+            # nothing may be COMMITTED on the stale head
+            eng = head_node.targets[fabric.target_id(0)].engine
+            meta = eng.get_meta(cid)
+            assert meta is None or int(meta.state) != int(ChunkState.COMMIT)
         finally:
             await fabric.stop()
     run(body())

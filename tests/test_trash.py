@@ -76,7 +76,9 @@ def test_trash_put_list_clean_cycle():
     asyncio.run(body())
 
 
-def test_migration_stub_service():
+def test_migration_service_unwired_rejects():
+    """A migration service with no cluster wiring reports implemented=True
+    but refuses job submission (it needs mgmtd + a client)."""
     from t3fs.migration.service import MigrationService, SubmitMigrationReq
     from t3fs.net.client import Client
     from t3fs.net.server import Server
@@ -88,7 +90,7 @@ def test_migration_stub_service():
         cli = Client()
         try:
             rsp, _ = await cli.call(srv.address, "Migration.status", None)
-            assert rsp.implemented is False
+            assert rsp.implemented is True and rsp.jobs == []
             with pytest.raises(StatusError):
                 await cli.call(srv.address, "Migration.submit",
                                SubmitMigrationReq(1, 2))
