@@ -142,6 +142,7 @@ class DevCluster:
                 kv=self._kv_spec("meta"),
                 default_chunk_size=self.chunk_size,
                 port_file=self._path("meta.port"),
+                event_trace_path=self._path("meta_events.parquet"),
                 log=LogConfig(file=self._path("meta.log"))))
             self.meta_address = await self._wait_port("meta")
 

@@ -36,6 +36,9 @@ class MetaMainConfig(ConfigBase):
     session_ttl_s: float = citem(3600.0, validator=lambda v: v > 0)
     admin_token: str = citem("", hot=False)
     port_file: str = citem("", hot=False)
+    # meta event trace -> Parquet (src/meta/event/Event.h analog); empty
+    # keeps the JSON log-line mirror only
+    event_trace_path: str = citem("", hot=False)
     log: LogConfig = cobj(LogConfig)
 
 
@@ -58,12 +61,26 @@ async def serve(cfg: MetaMainConfig, app: ApplicationBase) -> None:
 
         sc = StorageClient(mgmtd.routing, config=StorageClientConfig(),
                            refresh_routing=mgmtd.refresh)
+        from t3fs.meta.events import MetaEventLog
         store = MetaStore(kv, ChainAllocator(
             mgmtd.routing, default_chunk_size=cfg.default_chunk_size,
-            default_stripe=cfg.stripe_size))
+            default_stripe=cfg.stripe_size),
+            event_log=MetaEventLog(cfg.event_trace_path or None))
+        async def live_clients():
+            """Live client ids from mgmtd (MgmtdClientSessionsChecker input);
+            None on failure -> pruner falls back to TTL-only."""
+            try:
+                rsp, _ = await mgmtd.client.call(
+                    cfg.mgmtd_address, "Mgmtd.list_client_sessions", None,
+                    timeout=5.0)
+                return {s.client_id for s in rsp.sessions}
+            except Exception:
+                return None
+
         meta = MetaServer(store, sc, gc_period_s=cfg.gc_period_s,
                           session_ttl_s=cfg.session_ttl_s,
                           node_id=cfg.node_id, admin_token=cfg.admin_token,
+                          live_clients_provider=live_clients,
                           # ACTIVE-only: a decommissioned meta server must
                           # not own Distributor duties forever (mgmtd marks
                           # dead non-storage nodes FAILED)
@@ -89,6 +106,8 @@ async def serve(cfg: MetaMainConfig, app: ApplicationBase) -> None:
     async def stop():
         if "meta" in state:
             await state["meta"].stop()
+            if state["meta"].store.events is not None:
+                state["meta"].store.events.close()
         await rpc.stop()
         if "sc" in state:
             await state["sc"].close()

@@ -64,10 +64,13 @@ class MetaClient:
         return str(uuidlib.uuid4())
 
     async def create(self, path: str, perm: int = 0o644, chunk_size: int = 0,
-                     stripe: int = 0) -> tuple[Inode, str]:
+                     stripe: int = 0, write: bool = False) -> tuple[Inode, str]:
+        """write=True opens a write session with the create (O_CREAT|O_WRONLY);
+        the caller must close(inode_id, session_id) or the session pins GC
+        until the dead-client pruner reaps it."""
         rsp = await self._call("create", PathReq(
             path=path, perm=perm, chunk_size=chunk_size, stripe=stripe,
-            client_id=self.client_id, request_id=self._rid()))
+            write=write, client_id=self.client_id, request_id=self._rid()))
         return rsp.inode, rsp.session_id
 
     async def open(self, path: str, write: bool = False) -> tuple[Inode, str]:
@@ -136,11 +139,12 @@ class MetaClient:
             inode_id=inode_id, limit=limit))).entries
 
     async def create_at(self, parent: int, name: str, perm: int = 0o644,
-                        chunk_size: int = 0,
-                        stripe: int = 0) -> tuple[Inode, str]:
+                        chunk_size: int = 0, stripe: int = 0,
+                        write: bool = False) -> tuple[Inode, str]:
         rsp = await self._call("create_at", EntryReq(
             parent=parent, name=name, perm=perm, chunk_size=chunk_size,
-            stripe=stripe, client_id=self.client_id, request_id=self._rid()))
+            stripe=stripe, write=write, client_id=self.client_id,
+            request_id=self._rid()))
         return rsp.inode, rsp.session_id
 
     async def mkdir_at(self, parent: int, name: str,

@@ -291,7 +291,8 @@ class FuseKernelMount:
             flags, mode, _umask, _ = _CREATE_IN.unpack_from(body)
             name = body[_CREATE_IN.size:].split(b"\0", 1)[0].decode()
             inode, session = await self.mc.create_at(nodeid, name,
-                                                     perm=mode & 0o7777)
+                                                     perm=mode & 0o7777,
+                                                     write=True)
             self._track_open(inode)
             fh = self._new_fh(_Handle(inode, session, True))
             return self._entry_out(inode) + _OPEN_OUT.pack(fh, 0, 0)

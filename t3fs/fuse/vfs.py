@@ -73,7 +73,8 @@ class FileSystem:
 
     async def create(self, path: str, perm: int = 0o644,
                      chunk_size: int = 0) -> FileHandle:
-        ino, session = await self.meta.create(path, perm, chunk_size)
+        ino, session = await self.meta.create(path, perm, chunk_size,
+                                              write=True)
         return self._register(ino, session, writable=True)
 
     async def open(self, path: str, mode: str = "r") -> FileHandle:

@@ -14,6 +14,7 @@ import time
 from dataclasses import dataclass, field
 
 from t3fs.client.layout import FileLayout
+from t3fs.meta.events import MetaEventType
 from t3fs.meta.schema import DirEntry, FileSession, Inode, InodeType
 from t3fs.meta.store import ChainAllocator, MetaStore
 from t3fs.net.server import rpc_method, service
@@ -126,9 +127,11 @@ class MetaService:
 
     @rpc_method
     async def create(self, req: PathReq, payload, conn):
+        # a write session only when the create is an open-for-write
+        # (O_CREAT|O_WRONLY); a bare create (mknod-style) must not pin GC
         inode, session = await self.store.create(
             req.path, req.perm, req.chunk_size, req.stripe, req.client_id,
-            request_id=req.request_id)
+            request_id=req.request_id, want_session=req.write)
         return InodeRsp(inode=inode, session_id=session), b""
 
     @rpc_method
@@ -211,6 +214,10 @@ class MetaService:
             await self.sc.truncate_file(inode.layout, req.inode_id,
                                         max(0, req.length))
         inode = await self.store.set_length(req.inode_id, max(0, req.length))
+        # user-driven truncate only; set_length from length reconciliation
+        # deliberately does not event (it is repair, not mutation)
+        self.store._emit(MetaEventType.TRUNCATE, inode_id=req.inode_id,
+                         length=max(0, req.length))
         return InodeRsp(inode=inode), b""
 
     @rpc_method
@@ -233,7 +240,8 @@ class MetaService:
     async def create_at(self, req: EntryReq, payload, conn):
         inode, session = await self.store.create_at(
             req.parent, req.name, req.perm, req.chunk_size, req.stripe,
-            req.client_id, request_id=req.request_id)
+            req.client_id, request_id=req.request_id,
+            want_session=req.write)
         return InodeRsp(inode=inode, session_id=session), b""
 
     @rpc_method
@@ -309,6 +317,9 @@ class MetaConfig(_ConfigBase):
     """Hot meta-service knobs (GC loop reads them live each iteration)."""
     gc_period_s: float = _citem(0.2, validator=lambda v: v > 0)
     session_ttl_s: float = _citem(3600.0, validator=lambda v: v > 0)
+    # sessions of clients absent from mgmtd's client-session registry are
+    # pruned after this grace (must exceed the client's first-extend delay)
+    dead_client_grace_s: float = _citem(120.0, validator=lambda v: v > 0)
 
 
 class MetaServer:
@@ -317,7 +328,7 @@ class MetaServer:
     def __init__(self, store: MetaStore, storage_client,
                  gc_period_s: float = 0.2, session_ttl_s: float = 3600.0,
                  node_id: int = 0, admin_token: str = "",
-                 meta_servers_provider=None):
+                 meta_servers_provider=None, live_clients_provider=None):
         from t3fs.meta.distributor import Distributor
 
         self.store = store
@@ -325,6 +336,9 @@ class MetaServer:
         self.service = MetaService(store, storage_client)
         # rendezvous-hash duty sharding across meta servers (Distributor.h:29)
         self.distributor = Distributor(node_id, meta_servers_provider)
+        # async () -> set[str] | None: live client ids from mgmtd's
+        # client-session registry; None = tracking unavailable (TTL-only)
+        self.live_clients_provider = live_clients_provider
         self.cfg = MetaConfig(gc_period_s=gc_period_s, session_ttl_s=session_ttl_s)
         from t3fs.core.service import AppInfo, CoreService
         self.core = CoreService(AppInfo(node_id, "meta"),
@@ -332,6 +346,10 @@ class MetaServer:
                                 admin_token=admin_token)
         self._task: asyncio.Task | None = None
         self._stopped = asyncio.Event()
+        # client_id -> first time it was observed absent from mgmtd's
+        # registry while still holding sessions; deadness requires a FULL
+        # grace period of absence, not one missing observation
+        self._client_missing_since: dict[str, float] = {}
         self.gc_count = 0
 
     @property
@@ -359,17 +377,19 @@ class MetaServer:
                 pass
 
     async def _gc_loop(self) -> None:
+        log.info("meta gc loop started (period %.2fs)", self.gc_period_s)
         last_prune = 0.0
         while not self._stopped.is_set():
             await asyncio.sleep(self.gc_period_s)
             try:
                 now = time.time()
-                if now - last_prune > max(1.0, self.session_ttl_s / 10):
+                prune_every = min(max(1.0, self.session_ttl_s / 10),
+                                  max(1.0, self.cfg.dead_client_grace_s / 4))
+                if now - last_prune > prune_every:
                     # duty-sharded across meta servers: only the rendezvous
                     # owner of the "sessions"/"idem" duties prunes them
                     if self.distributor.is_mine("prune-sessions"):
-                        pruned = await self.store.prune_sessions_report(
-                            self.session_ttl_s)
+                        pruned = await self._prune_sessions_once(now)
                         await self.reconcile_lengths(pruned)
                     if self.distributor.is_mine("prune-idem"):
                         await self.store.prune_idem_records(
@@ -378,6 +398,35 @@ class MetaServer:
                 await self.gc_once()
             except Exception:
                 log.exception("meta gc failed")
+
+    async def _prune_sessions_once(self, now: float) -> list[int]:
+        """One prune tick: a single session scan feeds both the TTL pruner
+        and the dead-client pruner (SessionManager.h:44-83 x
+        MgmtdClientSessionsChecker).  A client is dead only after being
+        absent from mgmtd's registry for dead_client_grace_s of CONTINUOUS
+        observation — a single missing snapshot (mgmtd failover, transient
+        client<->mgmtd blip) must not reap a healthy mount's sessions."""
+        sessions = await self.store.scan_sessions()
+        if not sessions:
+            self._client_missing_since.clear()
+            return []
+        to_prune = {(s.inode_id, s.session_id): s for s in sessions
+                    if s.created_at < now - self.session_ttl_s}
+        if self.live_clients_provider is not None:
+            live = await self.live_clients_provider()
+            if live is not None:
+                holders = {s.client_id for s in sessions if s.client_id}
+                for c in list(self._client_missing_since):
+                    if c in live or c not in holders:
+                        del self._client_missing_since[c]
+                for c in holders - live:
+                    self._client_missing_since.setdefault(c, now)
+                dead = {c for c, t0 in self._client_missing_since.items()
+                        if now - t0 >= self.cfg.dead_client_grace_s}
+                for s in sessions:
+                    if s.client_id in dead:
+                        to_prune[(s.inode_id, s.session_id)] = s
+        return await self.store.clear_sessions(list(to_prune.values()))
 
     async def reconcile_lengths(self, inode_ids: list[int]) -> int:
         """Settle precise lengths for files whose writer died without close.
@@ -432,4 +481,6 @@ class MetaServer:
                     await with_transaction(self.store.kv, requeue)
                     continue
             self.gc_count += 1
+            self.store._emit(MetaEventType.GC, inode_id=inode.inode_id,
+                             length=inode.length)
         return len(inodes)

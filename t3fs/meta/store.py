@@ -18,6 +18,7 @@ import uuid as uuidlib
 from t3fs.client.layout import FileLayout
 from t3fs.kv.engine import KVEngine, Transaction, with_transaction
 from t3fs.kv.prefixes import KeyPrefix
+from t3fs.meta.events import MetaEventType as Ev
 from t3fs.meta.schema import (
     GC_PREFIX, IDEM_PREFIX, DirEntry, FileSession, IdemRecord, Inode,
     InodeType, ROOT_INODE_ID, gc_key, idem_key,
@@ -80,12 +81,22 @@ class ChainAllocator:
 
 
 class MetaStore:
-    def __init__(self, kv: KVEngine, chain_allocator: ChainAllocator):
+    def __init__(self, kv: KVEngine, chain_allocator: ChainAllocator,
+                 event_log=None):
         self.kv = kv
         self.chains = chain_allocator
         self.ids = InodeIdAllocator(kv)
+        self.events = event_log    # MetaEventLog | None (meta/events.py)
         self._root_ready = False
         self._root_lock = asyncio.Lock()
+
+    def _emit(self, etype, **fields) -> None:
+        """Post-commit event emission (src/meta/event/Event.h): callers emit
+        only after the transaction driver returned success, so aborted ops
+        never log.  Replays of idempotent ops may re-emit — events are
+        observability, duplicates are harmless."""
+        if self.events is not None:
+            self.events.emit(etype, **fields)
 
     async def _ensure_root(self) -> None:
         """Bootstrap the root inode on a fresh store.  _root_ready flips only
@@ -276,12 +287,20 @@ class MetaStore:
                 parent = inode_id
                 created = inode
             return created
-        return await self._txn_idem(fn, "mkdirs", client_id, request_id)
+        created = await self._txn_idem(fn, "mkdirs", client_id, request_id)
+        if created is not None:
+            self._emit(Ev.MKDIR, inode_id=created.inode_id,
+                       parent_id=created.parent, entry_name=path,
+                       inode_type="dir", client_id=client_id)
+        return created
 
     async def create(self, path: str, perm: int = 0o644, chunk_size: int = 0,
                      stripe: int = 0, session_client: str = "",
-                     request_id: str = "") -> tuple[Inode, str]:
-        """Create a file (+ optional write session). Returns (inode, session_id)."""
+                     request_id: str = "",
+                     want_session: bool = True) -> tuple[Inode, str]:
+        """Create a file (+ optional write session). Returns (inode, session_id).
+        want_session=False creates without a write session (mknod-style) while
+        session_client still keys idempotency."""
         layout = self.chains.allocate_layout(chunk_size, stripe)
 
         async def fn(txn: Transaction):
@@ -298,13 +317,17 @@ class MetaStore:
             txn.set(DirEntry.key(parent, name), serde.dumps(
                 DirEntry(parent, name, inode_id, InodeType.FILE)))
             session_id = ""
-            if session_client:
+            if session_client and want_session:
                 session_id = str(uuidlib.uuid4())
                 sess = FileSession(inode_id, session_id, session_client,
                                    time.time())
                 txn.set(FileSession.key(inode_id, session_id), serde.dumps(sess))
             return inode, session_id
-        return await self._txn_idem(fn, "create", session_client, request_id)
+        inode, session_id = await self._txn_idem(
+            fn, "create", session_client, request_id)
+        self._emit(Ev.CREATE, inode_id=inode.inode_id, entry_name=path,
+                   inode_type="file", client_id=session_client)
+        return inode, session_id
 
     async def open_file(self, path: str, write: bool = False,
                         session_client: str = "") -> tuple[Inode, str]:
@@ -322,7 +345,11 @@ class MetaStore:
                         serde.dumps(FileSession(inode.inode_id, session_id,
                                                 session_client, time.time())))
             return inode, session_id
-        return await self._txn(fn)
+        inode, session_id = await self._txn(fn)
+        if write:
+            self._emit(Ev.OPEN_WRITE, inode_id=inode.inode_id,
+                       entry_name=path, client_id=session_client)
+        return inode, session_id
 
     async def close_file(self, inode_id: int, session_id: str = "",
                          length: int | None = None) -> Inode:
@@ -337,7 +364,10 @@ class MetaStore:
             if session_id:
                 txn.clear(FileSession.key(inode_id, session_id))
             return inode
-        return await self._txn(fn)
+        inode = await self._txn(fn)
+        if session_id:   # read-only closes and fsyncs are not write closes
+            self._emit(Ev.CLOSE_WRITE, inode_id=inode_id, length=inode.length)
+        return inode
 
     async def report_write_position(self, inode_id: int, position: int) -> None:
         """Max-write-position hint, reported every few seconds by writers
@@ -381,7 +411,10 @@ class MetaStore:
             txn.set(DirEntry.key(parent, name), serde.dumps(
                 DirEntry(parent, name, inode_id, InodeType.SYMLINK)))
             return inode
-        return await self._txn_idem(fn, "symlink", client_id, request_id)
+        inode = await self._txn_idem(fn, "symlink", client_id, request_id)
+        self._emit(Ev.SYMLINK, inode_id=inode.inode_id, entry_name=path,
+                   symlink_target=target, client_id=client_id)
+        return inode
 
     async def lock_directory(self, path: str, owner: str,
                              unlock: bool = False) -> Inode:
@@ -439,8 +472,8 @@ class MetaStore:
 
     async def create_at(self, parent: int, name: str, perm: int = 0o644,
                         chunk_size: int = 0, stripe: int = 0,
-                        session_client: str = "",
-                        request_id: str = "") -> tuple[Inode, str]:
+                        session_client: str = "", request_id: str = "",
+                        want_session: bool = True) -> tuple[Inode, str]:
         layout = self.chains.allocate_layout(chunk_size, stripe)
 
         async def fn(txn: Transaction):
@@ -454,13 +487,18 @@ class MetaStore:
             txn.set(DirEntry.key(parent, name), serde.dumps(
                 DirEntry(parent, name, inode_id, InodeType.FILE)))
             session_id = ""
-            if session_client:
+            if session_client and want_session:
                 session_id = str(uuidlib.uuid4())
                 txn.set(FileSession.key(inode_id, session_id), serde.dumps(
                     FileSession(inode_id, session_id, session_client,
                                 time.time())))
             return inode, session_id
-        return await self._txn_idem(fn, "create", session_client, request_id)
+        inode, session_id = await self._txn_idem(
+            fn, "create", session_client, request_id)
+        self._emit(Ev.CREATE, inode_id=inode.inode_id, parent_id=parent,
+                   entry_name=name, inode_type="file",
+                   client_id=session_client)
+        return inode, session_id
 
     async def mkdir_at(self, parent: int, name: str, perm: int = 0o755,
                        client_id: str = "", request_id: str = "") -> Inode:
@@ -475,7 +513,10 @@ class MetaStore:
             txn.set(DirEntry.key(parent, name), serde.dumps(
                 DirEntry(parent, name, inode_id, InodeType.DIRECTORY)))
             return inode
-        return await self._txn_idem(fn, "mkdirs", client_id, request_id)
+        inode = await self._txn_idem(fn, "mkdirs", client_id, request_id)
+        self._emit(Ev.MKDIR, inode_id=inode.inode_id, parent_id=parent,
+                   entry_name=name, inode_type="dir", client_id=client_id)
+        return inode
 
     async def symlink_at(self, parent: int, name: str, target: str,
                          client_id: str = "", request_id: str = "") -> Inode:
@@ -490,7 +531,11 @@ class MetaStore:
             txn.set(DirEntry.key(parent, name), serde.dumps(
                 DirEntry(parent, name, inode_id, InodeType.SYMLINK)))
             return inode
-        return await self._txn_idem(fn, "symlink", client_id, request_id)
+        inode = await self._txn_idem(fn, "symlink", client_id, request_id)
+        self._emit(Ev.SYMLINK, inode_id=inode.inode_id, parent_id=parent,
+                   entry_name=name, symlink_target=target,
+                   client_id=client_id)
+        return inode
 
     async def _unlink_body(self, txn: Transaction, parent: int, name: str,
                            dent: DirEntry, recursive: bool, client_id: str,
@@ -523,7 +568,10 @@ class MetaStore:
                 raise make_error(StatusCode.META_NOT_FOUND, name)
             await self._unlink_body(txn, parent, name, dent, recursive,
                                     client_id, must_dir)
-        return await self._txn_idem(fn, "remove", client_id, request_id)
+        result = await self._txn_idem(fn, "remove", client_id, request_id)
+        self._emit(Ev.REMOVE, parent_id=parent, entry_name=name,
+                   recursive_remove=recursive, client_id=client_id)
+        return result
 
     async def rename_at(self, sparent: int, sname: str, dparent: int,
                         dname: str, client_id: str = "",
@@ -534,7 +582,11 @@ class MetaStore:
                 raise make_error(StatusCode.META_NOT_FOUND, sname)
             await self._rename_body(txn, sparent, sname, sdent,
                                     dparent, dname, client_id)
-        return await self._txn_idem(fn, "rename", client_id, request_id)
+        result = await self._txn_idem(fn, "rename", client_id, request_id)
+        self._emit(Ev.RENAME, parent_id=sparent, entry_name=sname,
+                   dst_parent_id=dparent, dst_entry_name=dname,
+                   client_id=client_id)
+        return result
 
     async def open_inode(self, inode_id: int, write: bool = False,
                          session_client: str = "") -> tuple[Inode, str]:
@@ -550,7 +602,11 @@ class MetaStore:
                         serde.dumps(FileSession(inode_id, session_id,
                                                 session_client, time.time())))
             return inode, session_id
-        return await self._txn(fn)
+        inode, session_id = await self._txn(fn)
+        if write:
+            self._emit(Ev.OPEN_WRITE, inode_id=inode_id,
+                       client_id=session_client)
+        return inode, session_id
 
     async def batch_stat(self, paths: list[str],
                          follow: bool = True) -> list[Inode | None]:
@@ -652,7 +708,10 @@ class MetaStore:
             txn.set(DirEntry.key(parent, name), serde.dumps(
                 DirEntry(parent, name, inode.inode_id, src.itype)))
             return inode
-        return await self._txn_idem(fn, "hardlink", client_id, request_id)
+        inode = await self._txn_idem(fn, "hardlink", client_id, request_id)
+        self._emit(Ev.HARDLINK, inode_id=inode.inode_id, entry_name=new_path,
+                   nlink=inode.nlink, client_id=client_id)
+        return inode
 
     async def _rename_body(self, txn: Transaction, sparent: int, sname: str,
                            sdent: DirEntry, dparent: int, dname: str,
@@ -693,7 +752,10 @@ class MetaStore:
             dparent, dname, _ = await self.resolve(txn, dst, follow_last=False)
             await self._rename_body(txn, sparent, sname, sdent,
                                     dparent, dname, client_id)
-        return await self._txn_idem(fn, "rename", client_id, request_id)
+        result = await self._txn_idem(fn, "rename", client_id, request_id)
+        self._emit(Ev.RENAME, entry_name=src, dst_entry_name=dst,
+                   client_id=client_id)
+        return result
 
     async def _unlink_entry(self, txn: Transaction, dent: DirEntry) -> None:
         inode = await self._get_inode(txn, dent.inode_id)
@@ -721,7 +783,10 @@ class MetaStore:
                 raise make_error(StatusCode.META_NOT_FOUND, path)
             await self._unlink_body(txn, parent, name, dent, recursive,
                                     client_id)
-        return await self._txn_idem(fn, "remove", client_id, request_id)
+        result = await self._txn_idem(fn, "remove", client_id, request_id)
+        self._emit(Ev.REMOVE, entry_name=path, recursive_remove=recursive,
+                   client_id=client_id)
+        return result
 
     async def _remove_tree(self, txn: Transaction, dent: DirEntry,
                            client_id: str = "") -> None:
@@ -809,17 +874,44 @@ class MetaStore:
         ran, so the settled length may trail what storage actually holds
         (docs/design_notes.md:91-95 — Distributor length reconciliation)."""
         cutoff = time.time() - ttl_s
+        sessions = await self.scan_sessions()
+        return await self.clear_sessions(
+            [s for s in sessions if s.created_at < cutoff])
 
+    async def scan_sessions(self) -> list[FileSession]:
+        """Snapshot of all write sessions (one range scan; the prune tick
+        derives both TTL expiry and dead-client sets from it)."""
         async def fn(txn: Transaction):
             pre = KeyPrefix.INODE_SESSION.value
-            pruned: list[int] = []
-            for k, v in await txn.get_range(pre, pre + b"\xff", snapshot=True):
-                sess: FileSession = serde.loads(v)
-                if sess.created_at < cutoff:
-                    txn.clear(k)
-                    pruned.append(sess.inode_id)
-            return pruned
+            rows = await txn.get_range(pre, pre + b"\xff", snapshot=True)
+            return [serde.loads(v) for _, v in rows]
         return await self._txn(fn)
+
+    async def clear_sessions(self, sessions: list[FileSession]) -> list[int]:
+        """Remove the given sessions; returns affected inode ids (callers
+        reconcile their lengths — a reaped writer's close never ran)."""
+        if not sessions:
+            return []
+
+        async def fn(txn: Transaction):
+            for s in sessions:
+                txn.clear(FileSession.key(s.inode_id, s.session_id))
+            return [s.inode_id for s in sessions]
+        return await self._txn(fn)
+
+    async def prune_dead_client_sessions(
+            self, dead_clients: set[str]) -> list[int]:
+        """Prune write sessions of clients CONFIRMED dead
+        (MgmtdClientSessionsChecker analog, SessionManager.h:44-83).  The
+        caller decides deadness — a client must be absent from mgmtd's
+        registry for a full grace period, not merely missing at one
+        observation (a mgmtd failover or a client<->mgmtd blip must not
+        reap a healthy mount's sessions)."""
+        if not dead_clients:
+            return []
+        sessions = await self.scan_sessions()
+        return await self.clear_sessions(
+            [s for s in sessions if s.client_id in dead_clients])
 
     async def gc_pop(self, limit: int = 16, owned=None) -> list[Inode]:
         """Dequeue inodes whose chunks need reclamation.  `owned` filters by

@@ -140,9 +140,11 @@ class MigrationService:
         return SubmitMigrationRsp(job_id=job.job_id), b""
 
     async def stop(self) -> None:
-        for t in self._tasks.values():
+        # copy: each task's done-callback pops it from _tasks as it settles
+        tasks = list(self._tasks.values())
+        for t in tasks:
             t.cancel()
-        for t in self._tasks.values():
+        for t in tasks:
             try:
                 await t
             except (asyncio.CancelledError, Exception):

@@ -185,6 +185,13 @@ struct SizeClass {
   uint64_t alloc_hint = 0;
   uint64_t high_water = 0;       // blocks ever allocated (file length / bs)
   std::set<uint64_t> punch_pending;  // freed since last punch pass
+  // restart rescan: instead of materializing every pre-restart free block
+  // in punch_pending (O(free blocks) of std::set nodes on a mostly-empty
+  // target), sweep the bitmap once with a cursor in bounded batches
+  bool punch_rescan = false;
+  uint64_t punch_cursor = 0;
+  // PUNCH_HOLE unsupported on this fs (EOPNOTSUPP): stop queueing/punching
+  bool punch_disabled = false;
 };
 
 class Engine {
@@ -354,22 +361,56 @@ class Engine {
   // — the lock hold is O(drained), never a scan of the whole allocator.
   uint64_t punch_freed(uint64_t max_blocks) {
     std::unique_lock lk(mu_);
-    uint64_t reclaimed = 0, punched = 0;
+    uint64_t reclaimed = 0, attempts = 0;
     for (auto& [lg, sc] : classes_) {
       if (sc.fd < 0) continue;
+      if (sc.punch_disabled) {
+        sc.punch_pending.clear();
+        sc.punch_rescan = false;
+        continue;
+      }
       uint64_t bs = 1ull << lg;
       auto it = sc.punch_pending.begin();
-      while (it != sc.punch_pending.end() && punched < max_blocks) {
+      while (it != sc.punch_pending.end() && attempts < max_blocks) {
         uint64_t blk = *it;
         bool free_bit = blk / 64 >= sc.bitmap.size() ||
                         !(sc.bitmap[blk / 64] & (1ull << (blk % 64)));
-        if (free_bit &&
-            ::fallocate(sc.fd, FALLOC_FL_PUNCH_HOLE | FALLOC_FL_KEEP_SIZE,
+        if (!free_bit) {           // re-allocated since freeing: stale entry
+          it = sc.punch_pending.erase(it);
+          continue;
+        }
+        attempts++;
+        if (::fallocate(sc.fd, FALLOC_FL_PUNCH_HOLE | FALLOC_FL_KEEP_SIZE,
                         blk * bs, bs) == 0) {
           reclaimed += bs;
-          punched++;
+          it = sc.punch_pending.erase(it);
+        } else if (errno == EOPNOTSUPP || errno == EINVAL || errno == ENOSYS) {
+          sc.punch_disabled = true;  // fs can't punch: stop trying forever
+          sc.punch_pending.clear();
+          sc.punch_rescan = false;
+          break;
+        } else {
+          break;                   // transient (EINTR/EIO): retry next pass,
+        }                          // don't burn the budget on one sick class
+      }
+      if (sc.punch_disabled) continue;
+      // restart sweep: punch free blocks below high_water in cursor order
+      while (sc.punch_rescan && attempts < max_blocks) {
+        if (sc.punch_cursor >= sc.high_water) {
+          sc.punch_rescan = false;
+          break;
         }
-        it = sc.punch_pending.erase(it);
+        uint64_t blk = sc.punch_cursor;
+        bool free_bit = blk / 64 >= sc.bitmap.size() ||
+                        !(sc.bitmap[blk / 64] & (1ull << (blk % 64)));
+        if (free_bit) {
+          attempts++;
+          if (::fallocate(sc.fd, FALLOC_FL_PUNCH_HOLE | FALLOC_FL_KEEP_SIZE,
+                          blk * bs, bs) != 0)
+            break;                 // keep cursor: retry this block next pass
+          reclaimed += bs;
+        }
+        sc.punch_cursor++;
       }
     }
     return reclaimed;
@@ -433,11 +474,24 @@ class Engine {
     return nbits;
   }
 
+  static constexpr size_t kPunchPendingCap = 1 << 18;  // bound set memory
+
   void release(SizeClass& sc, uint64_t blk) {
     if (blk / 64 < sc.bitmap.size()) {
       sc.bitmap[blk / 64] &= ~(1ull << (blk % 64));
       sc.alloc_hint = std::min(sc.alloc_hint, blk);
-      sc.punch_pending.insert(blk);  // queue for background reclaim
+      if (sc.punch_disabled) return;
+      if (sc.punch_pending.size() >= kPunchPendingCap) {
+        // overflow (punching persistently failing or far behind): fall
+        // back to a full cursor sweep, which finds every free block with
+        // O(1) memory, and drop the per-block queue
+        sc.punch_pending.clear();
+        sc.punch_rescan = true;
+        sc.punch_cursor = 0;
+        return;
+      }
+      if (!(sc.punch_rescan && blk >= sc.punch_cursor))
+        sc.punch_pending.insert(blk);  // queue for background reclaim
     }
   }
 
@@ -452,13 +506,15 @@ class Engine {
 
   void rebuild_allocator() {
     for (auto& [cid, s] : index_) mark_used(s.size_class_log2, s.block);
-    // queue pre-restart free blocks for reclaim: holes punched in a past
-    // life re-punch as cheap no-ops, blocks freed just before a crash get
-    // their space back (one-time cost, drained in bounded batches)
+    // reclaim pre-restart free blocks: holes punched in a past life
+    // re-punch as cheap no-ops, blocks freed just before a crash get their
+    // space back.  A cursor sweep (drained in punch_freed batches) instead
+    // of inserting every free block into punch_pending — a near-empty
+    // target with a high high_water would otherwise pay one std::set node
+    // per free block up front.
     for (auto& [lg, sc] : classes_) {
-      for (uint64_t blk = 0; blk < sc.high_water; blk++)
-        if (!(sc.bitmap[blk / 64] & (1ull << (blk % 64))))
-          sc.punch_pending.insert(blk);
+      sc.punch_rescan = sc.high_water > 0;
+      sc.punch_cursor = 0;
     }
   }
 

@@ -209,6 +209,75 @@ def test_session_prune_unblocks_gc(store):
     run(body())
 
 
+def test_create_without_write_session_does_not_pin_gc(store):
+    """mknod-style create (want_session=False) must not leave a write
+    session behind: remove -> immediately GC-able."""
+    async def body():
+        inode, sess = await store.create("/bare", session_client="c1",
+                                         request_id="r1", want_session=False)
+        assert sess == ""
+        await store.remove("/bare")
+        gc = await store.gc_pop()
+        assert [i.inode_id for i in gc] == [inode.inode_id]
+    run(body())
+
+
+def test_dead_client_session_prune(store):
+    """Sessions of clients absent from mgmtd's registry are reaped after
+    the grace period (SessionManager x MgmtdClientSessionsChecker)."""
+    async def body():
+        inode, _ = await store.create("/dead", session_client="ghost")
+        inode2, _ = await store.create("/alive", session_client="live")
+        await store.remove("/dead")
+        await store.remove("/alive")
+        assert await store.gc_pop() == []
+        # ghost confirmed dead -> reaped; live's session survives
+        pruned = await store.prune_dead_client_sessions({"ghost"})
+        assert pruned == [inode.inode_id]
+        gc = await store.gc_pop()
+        assert [i.inode_id for i in gc] == [inode.inode_id]
+    run(body())
+
+
+def test_dead_client_grace_requires_continuous_absence(store):
+    """One missing observation (mgmtd failover / client<->mgmtd blip) must
+    NOT reap a mature session; continuous absence past the grace must."""
+    from t3fs.client.storage_client_inmem import StorageClientInMem
+    from t3fs.meta.service import MetaServer
+
+    async def body():
+        live: set = set()
+        async def provider():
+            return set(live)
+        srv = MetaServer(store, StorageClientInMem(),
+                         live_clients_provider=provider)
+        srv.cfg.dead_client_grace_s = 0.2
+        # a session far older than the grace period
+        inode, _ = await store.create("/f", session_client="mount-1")
+        sess = (await store.scan_sessions())[0]
+        sess.created_at -= 3000   # mature, but inside the 3600s TTL
+        from t3fs.utils import serde as _s
+        from t3fs.meta.schema import FileSession
+        async def age(txn):
+            txn.set(FileSession.key(sess.inode_id, sess.session_id),
+                    _s.dumps(sess))
+        from t3fs.kv.engine import with_transaction
+        await with_transaction(store.kv, age)
+        import time as _t
+        # first observation of absence: session must survive (grace)
+        assert await srv._prune_sessions_once(_t.time()) == []
+        # client returns: missing-tracker resets
+        live.add("mount-1")
+        assert await srv._prune_sessions_once(_t.time()) == []
+        assert srv._client_missing_since == {}
+        # absent continuously past the grace: reaped
+        live.clear()
+        assert await srv._prune_sessions_once(_t.time()) == []
+        await asyncio.sleep(0.25)
+        assert await srv._prune_sessions_once(_t.time()) == [inode.inode_id]
+    run(body())
+
+
 # ---- multi-server robustness (Idempotent.h, Distributor.h, lockDirectory) ----
 
 def _mk_store(kv):
