@@ -63,6 +63,7 @@ def main() -> None:
         followers = ",".join(a for j, a in enumerate(kv_addrs) if j != i)
         w(f"kv-{i + 1}.toml", f"""\
 # t3fs replicated KV node {i + 1} ({host}) — role: {role}
+node_id = {i + 1}
 listen_host = "0.0.0.0"
 listen_port = {KV_PORT}
 role = "{role}"

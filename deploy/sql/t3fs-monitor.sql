@@ -1,0 +1,25 @@
+-- t3fs monitor_collector metric store DDL.
+--
+-- Reference analog: deploy/sql/3fs-monitor.sql (ClickHouse DDL for the
+-- metric tables that src/monitor_collector/ writes).  t3fs's collector
+-- sinks to sqlite (zero-dependency, queryable in place); this file is the
+-- canonical schema — t3fs/monitor/service.py applies the identical DDL at
+-- startup, and tests/test_deploy.py asserts the two never drift.
+--
+-- Row shape: one row per recorder sample per collection tick.
+--   kind     'count' | 'value' | 'dist' | 'latency'
+--   value    the scalar for count/value kinds; p50 for dist/latency
+--   payload  full JSON snapshot (tags, p90/p99/min/max/mean for dists)
+--
+-- Apply manually (operators):  sqlite3 metrics.sqlite < t3fs-monitor.sql
+
+CREATE TABLE IF NOT EXISTS metrics (
+  ts REAL NOT NULL,
+  node_id INTEGER NOT NULL,
+  node_type TEXT NOT NULL,
+  name TEXT NOT NULL,
+  kind TEXT NOT NULL,
+  value REAL,
+  payload TEXT NOT NULL
+);
+CREATE INDEX IF NOT EXISTS metrics_name_ts ON metrics (name, ts);

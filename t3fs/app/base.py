@@ -83,6 +83,37 @@ class ApplicationBase:
         self.node_type = node_type
         self.config_cls = config_cls
         self.cfg: ConfigBase | None = None
+        self._collector = None
+        self._reporter = None
+
+    def start_metrics(self, monitor_address: str = "", node_id: int = 0,
+                      period_s: float = 10.0) -> None:
+        """Start the per-process metric Collector: memory gauges sampled
+        each tick (src/memory AllocatedMemoryCounter analog), snapshots
+        pushed to monitor_collector when an address is configured, logged
+        otherwise (Collector::periodicallyCollect, Monitor.h:22,92)."""
+        from t3fs.monitor.reporter import MonitorReporter
+        from t3fs.utils.mem import MemoryWatcher
+        from t3fs.utils.metrics import Collector
+
+        watcher = MemoryWatcher(tags={"node_type": self.node_type,
+                                      "node_id": str(node_id)})
+        reporters = None
+        if monitor_address:
+            self._reporter = MonitorReporter(monitor_address, node_id,
+                                             self.node_type)
+            reporters = [self._reporter]
+        self._collector = Collector(period_s=period_s, reporters=reporters,
+                                    samplers=[watcher.sample])
+        self._collector.start()
+
+    def stop_metrics(self) -> None:
+        if self._collector is not None:
+            self._collector.stop()
+            self._collector = None
+        if self._reporter is not None:
+            self._reporter.close()   # its thread + TCP conn to the monitor
+            self._reporter = None
 
     def boot(self, argv: list[str] | None = None) -> ConfigBase:
         ap = argparse.ArgumentParser(prog=f"t3fs-{self.node_type}")
@@ -149,3 +180,4 @@ class ApplicationBase:
         await stopping.wait()
         log.info("%s stopping", self.node_type)
         await stop()
+        self.stop_metrics()

@@ -119,9 +119,19 @@ class DevCluster:
     async def start(self) -> None:
         os.makedirs(self.run_dir, exist_ok=True)
 
+        # monitor first: every other service pushes its metrics there
+        if self.with_monitor:
+            self._spawn("monitor", "t3fs.app.monitor_main", MonitorMainConfig(
+                db_path=self._path("metrics.sqlite"),
+                port_file=self._path("monitor.port"),
+                log=LogConfig(file=self._path("monitor.log"))))
+            self.monitor_address = await self._wait_port("monitor")
+
         self._spawn("mgmtd", "t3fs.app.mgmtd_main", MgmtdMainConfig(
             node_id=1, kv=self._kv_spec("mgmtd"),
             port_file=self._path("mgmtd.port"),
+            monitor_address=self.monitor_address,
+            metrics_period_s=2.0,
             service=MgmtdConfig(
                 heartbeat_timeout_s=self.heartbeat_timeout_s,
                 chains_update_period_s=0.25,
@@ -143,15 +153,11 @@ class DevCluster:
                 default_chunk_size=self.chunk_size,
                 port_file=self._path("meta.port"),
                 event_trace_path=self._path("meta_events.parquet"),
+                monitor_address=self.monitor_address,
+                metrics_period_s=2.0,
                 log=LogConfig(file=self._path("meta.log"))))
             self.meta_address = await self._wait_port("meta")
 
-        if self.with_monitor:
-            self._spawn("monitor", "t3fs.app.monitor_main", MonitorMainConfig(
-                db_path=self._path("metrics.sqlite"),
-                port_file=self._path("monitor.port"),
-                log=LogConfig(file=self._path("monitor.log"))))
-            self.monitor_address = await self._wait_port("monitor")
 
     def start_storage_node(self, node_id: int) -> None:
         name = f"storage{node_id}"
@@ -164,6 +170,8 @@ class DevCluster:
             target_ids=[self.target_id(node_id, c)
                         for c in range(self.num_chains)],
             port_file=port_path,
+            monitor_address=self.monitor_address,
+            metrics_period_s=2.0,
             service=StorageConfig(heartbeat_period_s=0.3,
                                   resync_period_s=0.3),
             log=LogConfig(file=self._path(f"{name}.log"))))

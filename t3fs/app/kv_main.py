@@ -25,6 +25,7 @@ from t3fs.utils.config import ConfigBase, citem, cobj
 
 @dataclass
 class KvMainConfig(ConfigBase):
+    node_id: int = citem(0, hot=False)
     listen_host: str = citem("127.0.0.1", hot=False)
     listen_port: int = citem(0, hot=False)
     role: str = citem("primary", hot=False,
@@ -32,12 +33,17 @@ class KvMainConfig(ConfigBase):
     followers: str = citem("", hot=False)   # comma-separated addresses
     kv: str = citem("mem", hot=False)
     port_file: str = citem("", hot=False)
+    # compress RPC frames >= this size (0 = off; UseCompress analog)
+    compress_threshold: int = citem(0, hot=False)
+    monitor_address: str = citem("", hot=False)   # push metrics here
+    metrics_period_s: float = citem(10.0, hot=False)
     log: LogConfig = cobj(LogConfig)
 
 
 async def serve(cfg: KvMainConfig, app: ApplicationBase) -> None:
     engine = open_kv_engine(cfg.kv)
-    rpc = Server(cfg.listen_host, cfg.listen_port)
+    rpc = Server(cfg.listen_host, cfg.listen_port,
+                 compress_threshold=cfg.compress_threshold)
     client = Client()
     svc = KvService(engine, primary=(cfg.role == "primary"),
                     followers=[a for a in cfg.followers.split(",") if a],
@@ -46,6 +52,8 @@ async def serve(cfg: KvMainConfig, app: ApplicationBase) -> None:
 
     async def start():
         await rpc.start()
+        app.start_metrics(cfg.monitor_address, cfg.node_id,
+                          cfg.metrics_period_s)
         if cfg.port_file:
             with open(cfg.port_file, "w") as f:
                 f.write(str(rpc.port))

@@ -27,6 +27,8 @@ class MetaMainConfig(ConfigBase):
     node_id: int = citem(0, hot=False)
     listen_host: str = citem("127.0.0.1", hot=False)
     listen_port: int = citem(0, hot=False)
+    # compress RPC frames >= this size (0 = off; UseCompress analog)
+    compress_threshold: int = citem(0, hot=False)
     mgmtd_address: str = citem("127.0.0.1:9000", hot=False)
     kv: str = citem("mem", hot=False)
     default_chunk_size: int = citem(1 << 20, hot=False,
@@ -39,6 +41,8 @@ class MetaMainConfig(ConfigBase):
     # meta event trace -> Parquet (src/meta/event/Event.h analog); empty
     # keeps the JSON log-line mirror only
     event_trace_path: str = citem("", hot=False)
+    monitor_address: str = citem("", hot=False)   # push metrics here
+    metrics_period_s: float = citem(10.0, hot=False)
     log: LogConfig = cobj(LogConfig)
 
 
@@ -46,7 +50,8 @@ async def serve(cfg: MetaMainConfig, app: ApplicationBase) -> None:
     import time as _time
 
     kv = open_kv_engine(cfg.kv)
-    rpc = Server(cfg.listen_host, cfg.listen_port)
+    rpc = Server(cfg.listen_host, cfg.listen_port,
+                 compress_threshold=cfg.compress_threshold)
     # ForServer role: meta nodes REGISTER with mgmtd so peers (and the
     # Distributor) can see the live meta-server set
     mgmtd = MgmtdClientForServer(
@@ -98,6 +103,8 @@ async def serve(cfg: MetaMainConfig, app: ApplicationBase) -> None:
         mgmtd.node.address = rpc.address
         await mgmtd.start()
         await meta.start()
+        app.start_metrics(cfg.monitor_address, cfg.node_id,
+                          cfg.metrics_period_s)
         state["meta"], state["sc"] = meta, sc
         if cfg.port_file:
             with open(cfg.port_file, "w") as f:

@@ -23,6 +23,8 @@ class MgmtdMainConfig(ConfigBase):
     kv: str = citem("mem", hot=False)       # open_kv_engine spec
     admin_token: str = citem("", hot=False)
     port_file: str = citem("", hot=False)   # write bound port here (dev clusters)
+    monitor_address: str = citem("", hot=False)   # push metrics here
+    metrics_period_s: float = citem(10.0, hot=False)
     service: MgmtdConfig = cobj(MgmtdConfig)
     log: LogConfig = cobj(LogConfig)
 
@@ -41,6 +43,8 @@ async def serve(cfg: MgmtdMainConfig, app: ApplicationBase) -> None:
             rpc.add_service(svc)
         await srv.start()
         mgmtd.append(srv)
+        app.start_metrics(cfg.monitor_address, cfg.node_id,
+                          cfg.metrics_period_s)
         if cfg.port_file:
             with open(cfg.port_file, "w") as f:
                 f.write(str(rpc.port))

@@ -27,6 +27,8 @@ class StorageMainConfig(ConfigBase):
     engine_backend: str = citem("native", hot=False)
     admin_token: str = citem("", hot=False)
     port_file: str = citem("", hot=False)
+    monitor_address: str = citem("", hot=False)   # push metrics here
+    metrics_period_s: float = citem(10.0, hot=False)
     service: StorageConfig = cobj(StorageConfig)
     log: LogConfig = cobj(LogConfig)
 
@@ -41,6 +43,8 @@ async def serve(cfg: StorageMainConfig, app: ApplicationBase) -> None:
 
     async def start():
         await ss.start()
+        app.start_metrics(cfg.monitor_address, cfg.node_id,
+                          cfg.metrics_period_s)
         if cfg.port_file:
             with open(cfg.port_file, "w") as f:
                 f.write(str(ss.server.port))

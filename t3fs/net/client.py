@@ -20,8 +20,10 @@ log = logging.getLogger("t3fs.net")
 
 
 class Client:
-    def __init__(self, connect_timeout: float = 5.0):
+    def __init__(self, connect_timeout: float = 5.0,
+                 compress_threshold: int = 0):
         self.connect_timeout = connect_timeout
+        self.compress_threshold = compress_threshold
         self.dispatcher: dict = {}
         self._conns: dict[str, Connection] = {}
         self._locks: dict[str, asyncio.Lock] = {}
@@ -46,7 +48,9 @@ class Client:
             except (OSError, asyncio.TimeoutError) as e:
                 raise make_error(StatusCode.RPC_CONNECT_FAILED,
                                  f"connect {address}: {e}") from None
-            conn = Connection(reader, writer, self.dispatcher, name=f"cli->{address}")
+            conn = Connection(reader, writer, self.dispatcher,
+                              name=f"cli->{address}",
+                              compress_threshold=self.compress_threshold)
             conn.start()
             self._conns[address] = conn
             return conn

@@ -16,8 +16,8 @@ import time
 from typing import Awaitable, Callable
 
 from t3fs.net.wire import (
-    HEADER_SIZE, FLAG_IS_REQ, FrameError, MessagePacket, WireStatus,
-    pack_header, unpack_header,
+    HEADER_SIZE, FLAG_COMPRESS, FLAG_IS_REQ, FrameError, MessagePacket,
+    WireStatus, decompress_frame, maybe_compress, pack_header, unpack_header,
 )
 from t3fs.utils import serde
 from t3fs.utils.status import Status, StatusCode, StatusError, make_error
@@ -35,12 +35,18 @@ class Connection:
 
     def __init__(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter,
                  dispatcher: dict[str, Handler] | None = None, name: str = "?",
-                 on_close: Callable[["Connection"], None] | None = None):
+                 on_close: Callable[["Connection"], None] | None = None,
+                 compress_threshold: int = 0, compress_level: int = 1):
         self.reader = reader
         self.writer = writer
         self.dispatcher = dispatcher if dispatcher is not None else {}
         self.name = name
         self.on_close = on_close
+        # outbound frames >= threshold bytes ship zlib-compressed
+        # (UseCompress analog); 0 disables.  Inbound compressed frames are
+        # always understood regardless of this setting.
+        self.compress_threshold = compress_threshold
+        self.compress_level = compress_level
         self._waiters: dict[int, asyncio.Future] = {}
         self._send_lock = asyncio.Lock()
         self._closed = False
@@ -83,8 +89,23 @@ class Connection:
                 fut.set_exception(err)
         self._waiters.clear()
 
+    # frames past this size compress/decompress in a worker thread so a
+    # multi-MiB zlib pass never stalls the event loop (heartbeats, other
+    # conns); below it the thread hop costs more than the compression
+    OFFLOAD_BYTES = 1 << 20
+
     async def _send_frame(self, packet: MessagePacket, payload: bytes, flags: int) -> None:
         msg = serde.dumps(packet)
+        if self.compress_threshold > 0:
+            if len(msg) + len(payload) >= self.OFFLOAD_BYTES:
+                msg, payload, zflag = await asyncio.to_thread(
+                    maybe_compress, msg, payload,
+                    self.compress_threshold, self.compress_level)
+            else:
+                msg, payload, zflag = maybe_compress(
+                    msg, payload, self.compress_threshold,
+                    self.compress_level)
+            flags |= zflag
         async with self._send_lock:
             if self._closed:
                 raise make_error(StatusCode.RPC_SEND_FAILED, "connection closed")
@@ -127,6 +148,12 @@ class Connection:
                 msg_len, payload_len, flags = unpack_header(head)
                 msg = await self.reader.readexactly(msg_len) if msg_len else b""
                 payload = await self.reader.readexactly(payload_len) if payload_len else b""
+                if flags & FLAG_COMPRESS and \
+                        msg_len + payload_len >= self.OFFLOAD_BYTES:
+                    msg, payload = await asyncio.to_thread(
+                        decompress_frame, msg, payload, flags)
+                else:
+                    msg, payload = decompress_frame(msg, payload, flags)
                 packet = serde.loads(msg)
                 if packet.is_req:
                     self._spawn(self._handle_request(packet, payload),

@@ -46,9 +46,11 @@ def build_dispatcher(*services: Any) -> dict[str, Handler]:
 class Server:
     """Asyncio TCP server hosting a set of serde services."""
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 compress_threshold: int = 0):
         self.host = host
         self.port = port
+        self.compress_threshold = compress_threshold
         self.dispatcher: dict[str, Handler] = {}
         self._server: asyncio.AbstractServer | None = None
         self._conns: set[Connection] = set()
@@ -65,7 +67,8 @@ class Server:
     async def _on_conn(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
         peer = writer.get_extra_info("peername")
         conn = Connection(reader, writer, self.dispatcher, name=f"srv<-{peer}",
-                          on_close=self._conns.discard)
+                          on_close=self._conns.discard,
+                          compress_threshold=self.compress_threshold)
         self._conns.add(conn)
         conn.start()
 

@@ -29,6 +29,50 @@ class FrameError(Exception):
     pass
 
 
+def maybe_compress(msg: bytes, payload: bytes, threshold: int,
+                   level: int = 1) -> tuple[bytes, bytes, int]:
+    """Compress a frame when it pays (MessagePacket UseCompress analog,
+    common/serde/MessagePacket.h:12-63; zlib instead of the reference's
+    zstd — stdlib, no extra dependency).  threshold<=0 disables; frames
+    that don't shrink by >=10% ship uncompressed (chunk payloads are often
+    already-incompressible random data).  Returns (msg, payload, flag)."""
+    import zlib
+    total = len(msg) + len(payload)
+    if threshold <= 0 or total < threshold:
+        return msg, payload, 0
+    zmsg = zlib.compress(msg, level) if msg else b""
+    zpay = zlib.compress(payload, level) if payload else b""
+    if len(zmsg) + len(zpay) > total * 9 // 10:
+        return msg, payload, 0
+    return zmsg, zpay, FLAG_COMPRESS
+
+
+def _safe_decompress(data: bytes) -> bytes:
+    """Bounded decompression: a hostile/corrupt frame must not expand past
+    MAX_FRAME (decompression-bomb guard)."""
+    import zlib
+    d = zlib.decompressobj()
+    try:
+        out = d.decompress(data, MAX_FRAME + 1)
+    except zlib.error as e:
+        raise FrameError(f"bad compressed frame: {e}") from None
+    if len(out) > MAX_FRAME or d.unconsumed_tail:
+        raise FrameError("decompressed frame exceeds MAX_FRAME")
+    if not d.eof:
+        # valid prefix of a cut-short stream decompresses without error;
+        # partial data must not reach a handler as if complete
+        raise FrameError("truncated compressed frame")
+    return out
+
+
+def decompress_frame(msg: bytes, payload: bytes,
+                     flags: int) -> tuple[bytes, bytes]:
+    if not flags & FLAG_COMPRESS:
+        return msg, payload
+    return (_safe_decompress(msg) if msg else b"",
+            _safe_decompress(payload) if payload else b"")
+
+
 def pack_header(msg_len: int, payload_len: int, flags: int) -> bytes:
     head = struct.pack("<IIII", MAGIC, msg_len, payload_len, flags)
     crc = crc32c_ref(head)

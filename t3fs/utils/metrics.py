@@ -157,13 +157,22 @@ class Collector:
     """Periodic sampler pushing snapshots to reporters (list of callables)."""
 
     def __init__(self, period_s: float = 10.0,
-                 reporters: list[Callable[[list[dict]], None]] | None = None):
+                 reporters: list[Callable[[list[dict]], None]] | None = None,
+                 samplers: list[Callable[[], None]] | None = None):
         self.period_s = period_s
         self.reporters = reporters if reporters is not None else [log_reporter]
+        # gauges that must be refreshed at collection time (e.g. process
+        # memory) rather than on the hot path
+        self.samplers = samplers if samplers is not None else []
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
 
     def collect_once(self) -> list[dict]:
+        for s in self.samplers:
+            try:
+                s()
+            except Exception:
+                log.exception("metric sampler failed")
         snap = [r.collect() for r in all_recorders()]
         for rep in self.reporters:
             try:

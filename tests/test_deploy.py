@@ -48,3 +48,15 @@ def test_systemd_units_reference_real_binaries():
         assert m, unit
         mod = m.group(1)
         __import__(mod)          # binary module must exist
+
+
+def test_monitor_ddl_matches_service_schema():
+    """deploy/sql/t3fs-monitor.sql is the canonical DDL; the collector's
+    embedded schema must never drift from it (3fs-monitor.sql analog)."""
+    import re
+
+    from t3fs.monitor.service import _SCHEMA
+
+    ddl = open("deploy/sql/t3fs-monitor.sql").read()
+    strip = lambda s: re.sub(r"\s+", " ", re.sub(r"--[^\n]*", "", s)).strip()
+    assert strip(ddl) == strip(_SCHEMA)

@@ -145,3 +145,117 @@ def test_remote_buf_write_back(loop_run):
             await client.close()
             await server.stop()
     loop_run(body())
+
+
+# ---- wire compression (MessagePacket UseCompress analog) ----
+
+def test_compressed_roundtrip(loop_run):
+    """Both directions compressed: large compressible body + payload
+    round-trip intact through a compress-enabled client and server."""
+    async def body():
+        server = Server(compress_threshold=1024)
+        server.add_service(EchoService())
+        await server.start()
+        client = Client(compress_threshold=1024)
+        try:
+            text = "pattern " * 4096            # highly compressible
+            payload = b"\x00" * 65536
+            rsp, pay = await client.call(server.address, "Echo.echo",
+                                         NetEchoReq(text=text, n=1),
+                                         payload=payload)
+            assert rsp.text == text and pay == payload
+        finally:
+            await client.close()
+            await server.stop()
+    loop_run(body())
+
+
+def test_mixed_peers_compression(loop_run):
+    """A compressing client against a non-compressing server (and back):
+    receivers always understand FLAG_COMPRESS regardless of local config."""
+    async def body():
+        server = Server()                        # compression off
+        server.add_service(EchoService())
+        await server.start()
+        client = Client(compress_threshold=128)  # compression on
+        try:
+            text = "x" * 10000
+            rsp, _ = await client.call(server.address, "Echo.echo",
+                                       NetEchoReq(text=text))
+            assert rsp.text == text
+        finally:
+            await client.close()
+            await server.stop()
+    loop_run(body())
+
+
+def test_maybe_compress_policy():
+    from t3fs.net.wire import FLAG_COMPRESS, maybe_compress
+
+    # under threshold: untouched
+    m, p, f = maybe_compress(b"abc", b"def", threshold=1024)
+    assert (m, p, f) == (b"abc", b"def", 0)
+    # compressible above threshold: flagged + smaller
+    big = b"A" * 10000
+    m, p, f = maybe_compress(big, big, threshold=1024)
+    assert f == FLAG_COMPRESS and len(m) + len(p) < 2 * len(big)
+    # incompressible (random) payload: shipped raw, no flag
+    import os as _os
+    rnd = _os.urandom(65536)
+    m, p, f = maybe_compress(b"", rnd, threshold=1024)
+    assert f == 0 and p is rnd
+    # threshold 0 disables
+    assert maybe_compress(big, b"", threshold=0)[2] == 0
+
+
+def test_decompress_bomb_guard():
+    import zlib
+
+    import pytest as _pytest
+
+    from t3fs.net.wire import FLAG_COMPRESS, FrameError, decompress_frame
+
+    # corrupt stream -> FrameError (not a crash, not an OOM)
+    with _pytest.raises(FrameError):
+        decompress_frame(b"not-zlib", b"", FLAG_COMPRESS)
+    # a genuine bomb: tiny compressed, expands past MAX_FRAME
+    from t3fs.net import wire as _wire
+    orig = _wire.MAX_FRAME
+    _wire.MAX_FRAME = 1 << 16
+    try:
+        bomb = zlib.compress(b"\x00" * (1 << 20))
+        with _pytest.raises(FrameError):
+            decompress_frame(bomb, b"", FLAG_COMPRESS)
+    finally:
+        _wire.MAX_FRAME = orig
+
+
+def test_truncated_compressed_frame_rejected():
+    import zlib
+
+    import pytest as _pytest
+
+    from t3fs.net.wire import FLAG_COMPRESS, FrameError, decompress_frame
+
+    full = zlib.compress(b"payload " * 1000)
+    truncated = full[: len(full) - 4]    # valid prefix, missing final block
+    with _pytest.raises(FrameError):
+        decompress_frame(truncated, b"", FLAG_COMPRESS)
+
+
+def test_compressed_large_frame_offload(loop_run):
+    """Frames past OFFLOAD_BYTES take the to_thread path; data intact."""
+    async def body():
+        server = Server(compress_threshold=1024)
+        server.add_service(EchoService())
+        await server.start()
+        client = Client(compress_threshold=1024)
+        try:
+            payload = b"Z" * (4 << 20)     # > OFFLOAD_BYTES, compressible
+            rsp, pay = await client.call(server.address, "Echo.echo",
+                                         NetEchoReq(n=7), payload=payload)
+            assert rsp.n == 8 and pay == payload
+        finally:
+            await client.close()
+            await server.stop()
+    loop_run(body())
