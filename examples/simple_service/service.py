@@ -1,0 +1,101 @@
+"""SimpleExample: the template for writing a new t3fs service.
+
+Reference analog: src/simple_example/ — the reference ships a minimal
+service to copy when adding a new server binary (its README is a
+copy-and-rename recipe; migration_main was created exactly that way).
+This is the t3fs equivalent: one serde-typed RPC service, a config
+dataclass with hot-updatable items, CoreService for config introspection,
+and an ApplicationBase entry so `--config`/`--set`/two-phase launch all
+work like every other t3fs binary.
+
+Run it:
+    python -m examples.simple_service.service --set listen_port=7070
+Call it:
+    t3fs-admin echo 127.0.0.1:7070        # CoreService echo
+See README.md next to this file for the copy-and-rename recipe.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass
+
+from t3fs.app.base import ApplicationBase, LogConfig
+from t3fs.core.service import AppInfo, CoreService
+from t3fs.net.server import Server, rpc_method, service
+from t3fs.utils.config import ConfigBase, citem, cobj
+from t3fs.utils.metrics import CountRecorder
+from t3fs.utils.serde import serde_struct
+
+
+# ---- wire schema (the src/fbs/simple_example analog) ----
+
+@serde_struct
+@dataclass
+class GreetReq:
+    name: str = ""
+
+
+@serde_struct
+@dataclass
+class GreetRsp:
+    message: str = ""
+    calls: int = 0
+
+
+# ---- service ----
+
+@service("SimpleExample")
+class SimpleExampleService:
+    def __init__(self, greeting_provider):
+        self._greeting = greeting_provider      # hot-updatable via config
+        self.calls = CountRecorder("simple_example.greet_calls")
+        self._n = 0
+
+    @rpc_method
+    async def greet(self, req: GreetReq, payload: bytes, conn):
+        self._n += 1
+        self.calls.add()
+        return GreetRsp(message=f"{self._greeting()}, {req.name}!",
+                        calls=self._n), b""
+
+
+# ---- config ----
+
+@dataclass
+class SimpleExampleConfig(ConfigBase):
+    listen_host: str = citem("127.0.0.1", hot=False)
+    listen_port: int = citem(0, hot=False)
+    greeting: str = citem("hello")              # hot-updatable
+    admin_token: str = citem("", hot=False)
+    port_file: str = citem("", hot=False)
+    monitor_address: str = citem("", hot=False)
+    log: LogConfig = cobj(LogConfig)
+
+
+# ---- binary ----
+
+async def serve(cfg: SimpleExampleConfig, app: ApplicationBase) -> None:
+    rpc = Server(cfg.listen_host, cfg.listen_port)
+    rpc.add_service(SimpleExampleService(lambda: cfg.greeting))
+    rpc.add_service(CoreService(AppInfo(0, "simple_example"), config=cfg,
+                                admin_token=cfg.admin_token))
+
+    async def start():
+        await rpc.start()
+        app.start_metrics(cfg.monitor_address)
+        if cfg.port_file:
+            with open(cfg.port_file, "w") as f:
+                f.write(str(rpc.port))
+
+    await app.run(start, rpc.stop)
+
+
+def main(argv: list[str] | None = None) -> None:
+    app = ApplicationBase("simple_example", SimpleExampleConfig)
+    cfg = app.boot(argv)
+    asyncio.run(serve(cfg, app))
+
+
+if __name__ == "__main__":
+    main()

@@ -28,6 +28,12 @@ class FuseMainConfig(ConfigBase):
     mountpoint: str = citem("", hot=False)
     client_id: str = citem("", hot=False)      # default: random per mount
     max_write: int = citem(1 << 17, hot=False, validator=lambda v: v >= 4096)
+    # mount-wide user-config defaults; per-uid overrides happen live via
+    # /t3fs-virt/set-conf (src/fuse/UserConfig analog)
+    readonly: bool = citem(False, hot=False)
+    attr_timeout: float = citem(1.0, hot=False)
+    entry_timeout: float = citem(1.0, hot=False)
+    sync_on_stat: bool = citem(False, hot=False)
     log: LogConfig = cobj(LogConfig)
 
 
@@ -47,8 +53,14 @@ async def serve(cfg: FuseMainConfig, app: ApplicationBase) -> None:
         mc = MetaClient(meta_addrs, client_id=client_id)
         sc = StorageClient(mgmtd.routing, config=StorageClientConfig(),
                            refresh_routing=mgmtd.refresh)
+        from t3fs.fuse.user_config import MountUserConfig
         fuse = FuseKernelMount(mc, sc, cfg.mountpoint, client_id=client_id,
-                               max_write=cfg.max_write)
+                               max_write=cfg.max_write,
+                               user_config=MountUserConfig(
+                                   readonly=cfg.readonly,
+                                   attr_timeout=cfg.attr_timeout,
+                                   entry_timeout=cfg.entry_timeout,
+                                   sync_on_stat=cfg.sync_on_stat))
         await fuse.mount()
         state.update(mc=mc, sc=sc, fuse=fuse)
 

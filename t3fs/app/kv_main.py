@@ -44,7 +44,9 @@ async def serve(cfg: KvMainConfig, app: ApplicationBase) -> None:
     engine = open_kv_engine(cfg.kv)
     rpc = Server(cfg.listen_host, cfg.listen_port,
                  compress_threshold=cfg.compress_threshold)
-    client = Client()
+    # replication pushes to followers are the node's biggest frames —
+    # the compression knob must cover them, not just responses
+    client = Client(compress_threshold=cfg.compress_threshold)
     svc = KvService(engine, primary=(cfg.role == "primary"),
                     followers=[a for a in cfg.followers.split(",") if a],
                     client=client)

@@ -27,7 +27,10 @@ import os
 import stat as statmod
 import struct
 
-from t3fs.meta.schema import InodeType
+from t3fs.fuse.user_config import (
+    VIRT_NAME, MountUserConfig, UserConfig, VirtualTree,
+)
+from t3fs.meta.schema import InodeType, ROOT_INODE_ID
 from t3fs.utils.status import StatusCode, StatusError
 
 log = logging.getLogger("t3fs.fuse.kernel")
@@ -104,12 +107,17 @@ class FuseKernelMount:
     """One mounted t3fs instance over MetaClient + StorageClient."""
 
     def __init__(self, meta_client, storage_client, mountpoint: str,
-                 client_id: str = "t3fs-fuse", max_write: int = 1 << 17):
+                 client_id: str = "t3fs-fuse", max_write: int = 1 << 17,
+                 user_config: MountUserConfig | None = None):
         self.mc = meta_client
         self.sc = storage_client
         self.mountpoint = os.path.abspath(mountpoint)
         self.client_id = client_id
         self.max_write = max_write
+        # per-uid config + /t3fs-virt magic tree (UserConfig.h, FuseOps.cc
+        # virtual-inode paths)
+        self.user_config = UserConfig(user_config)
+        self.virt = VirtualTree(self.user_config, self._rmrf)
         self.fd = -1
         self._next_fh = 1
         self._handles: dict[int, _Handle] = {}
@@ -179,7 +187,7 @@ class FuseKernelMount:
         if opcode in (FORGET, BATCH_FORGET):
             return                         # MUST not reply
         try:
-            data = await self._handle(opcode, nodeid, body)
+            data = await self._handle(opcode, nodeid, body, uid)
             if data is None:
                 return                     # handler already replied / no reply
             self._reply(unique, 0, data)
@@ -219,12 +227,19 @@ class FuseKernelMount:
                           0, 0, 0, _mode_of(inode), max(1, inode.nlink),
                           inode.uid, inode.gid, 0, 4096, 0)
 
-    def _entry_out(self, inode) -> bytes:
-        return _ENTRY_HEAD.pack(inode.inode_id, 0, 1, 1, 0, 0) \
+    @staticmethod
+    def _split_s(t: float) -> tuple[int, int]:
+        return int(t), int((t - int(t)) * 1e9)
+
+    def _entry_out(self, inode, ucfg: MountUserConfig | None = None) -> bytes:
+        at, an = self._split_s(ucfg.attr_timeout if ucfg else 1.0)
+        et, en = self._split_s(ucfg.entry_timeout if ucfg else 1.0)
+        return _ENTRY_HEAD.pack(inode.inode_id, 0, et, at, en, an) \
             + self._attr(inode)
 
-    def _attr_out(self, inode) -> bytes:
-        return _ATTR_OUT_HEAD.pack(1, 0, 0) + self._attr(inode)
+    def _attr_out(self, inode, ucfg: MountUserConfig | None = None) -> bytes:
+        at, an = self._split_s(ucfg.attr_timeout if ucfg else 1.0)
+        return _ATTR_OUT_HEAD.pack(at, an, 0) + self._attr(inode)
 
     def _new_fh(self, handle: _Handle) -> int:
         fh = self._next_fh
@@ -234,7 +249,16 @@ class FuseKernelMount:
 
     # ---- opcode handlers ----
 
-    async def _handle(self, opcode: int, nodeid: int, body: bytes):
+    async def _handle(self, opcode: int, nodeid: int, body: bytes,
+                      uid: int = 0):
+        ucfg = self.user_config.get(uid)
+        virt = await self._handle_virtual(opcode, nodeid, body, uid, ucfg)
+        if virt is not NotImplemented:
+            return virt
+        if ucfg.readonly and opcode in (WRITE, CREATE, MKNOD, MKDIR, SYMLINK,
+                                        UNLINK, RMDIR, RENAME, RENAME2, LINK,
+                                        SETATTR):
+            raise OSError(errno.EROFS, "readonly mount (user config)")
         if opcode == INIT:
             major, minor, _ra, flags = _INIT_IN.unpack_from(body)
             if major < 7:
@@ -243,10 +267,17 @@ class FuseKernelMount:
             return _INIT_OUT.pack(7, 31, 1 << 20, 0, 12, 10, self.max_write,
                                   1, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0)
         if opcode == GETATTR:
-            return self._attr_out(await self.mc.stat_inode(nodeid))
+            if ucfg.sync_on_stat:
+                # settle the precise length before answering (reference
+                # flush/sync_on_stat user keys, UserConfig.h:33-39)
+                try:
+                    return self._attr_out(await self.mc.sync(nodeid), ucfg)
+                except StatusError:
+                    pass                   # directories/symlinks: plain stat
+            return self._attr_out(await self.mc.stat_inode(nodeid), ucfg)
         if opcode == LOOKUP:
             name = body.split(b"\0", 1)[0].decode()
-            return self._entry_out(await self.mc.lookup(nodeid, name))
+            return self._entry_out(await self.mc.lookup(nodeid, name), ucfg)
         if opcode == OPENDIR:
             entries, inode = await asyncio.gather(
                 self.mc.readdir_inode(nodeid), self.mc.stat_inode(nodeid))
@@ -282,6 +313,8 @@ class FuseKernelMount:
         if opcode == OPEN:
             flags = struct.unpack_from("<I", body)[0]
             writable = (flags & O_ACCMODE) != os.O_RDONLY
+            if writable and ucfg.readonly:
+                raise OSError(errno.EROFS, "readonly mount (user config)")
             inode, session = await self.mc.open_inode(nodeid, write=writable)
             if writable:
                 self._track_open(inode)
@@ -295,7 +328,7 @@ class FuseKernelMount:
                                                      write=True)
             self._track_open(inode)
             fh = self._new_fh(_Handle(inode, session, True))
-            return self._entry_out(inode) + _OPEN_OUT.pack(fh, 0, 0)
+            return self._entry_out(inode, ucfg) + _OPEN_OUT.pack(fh, 0, 0)
         if opcode == MKNOD:
             mode, _rdev = struct.unpack_from("<II", body)
             name = body[16:].split(b"\0", 1)[0].decode()
@@ -303,16 +336,16 @@ class FuseKernelMount:
                 raise NotImplementedError
             inode, _ = await self.mc.create_at(nodeid, name,
                                                perm=mode & 0o7777)
-            return self._entry_out(inode)
+            return self._entry_out(inode, ucfg)
         if opcode == MKDIR:
             mode, _umask = _MKDIR_IN.unpack_from(body)
             name = body[_MKDIR_IN.size:].split(b"\0", 1)[0].decode()
             return self._entry_out(await self.mc.mkdir_at(
-                nodeid, name, perm=mode & 0o7777))
+                nodeid, name, perm=mode & 0o7777), ucfg)
         if opcode == SYMLINK:
             name_b, target_b = body.split(b"\0", 2)[:2]
             return self._entry_out(await self.mc.symlink_at(
-                nodeid, name_b.decode(), target_b.decode()))
+                nodeid, name_b.decode(), target_b.decode()), ucfg)
         if opcode == READLINK:
             inode = await self.mc.stat_inode(nodeid)
             return inode.symlink_target.encode()
@@ -378,7 +411,7 @@ class FuseKernelMount:
             else:
                 # mode/uid/gid/time updates are accepted and ignored (v1)
                 inode = await self.mc.stat_inode(nodeid)
-            return self._attr_out(inode)
+            return self._attr_out(inode, ucfg)
         if opcode == STATFS:
             return _STATFS_OUT.pack(1 << 30, 1 << 29, 1 << 29, 1 << 20,
                                     1 << 19, 4096, 255, 4096, 0,
@@ -392,6 +425,63 @@ class FuseKernelMount:
         if opcode in (FSYNCDIR, DESTROY):
             return b""
         raise NotImplementedError
+
+    # ---- /t3fs-virt magic tree ----
+
+    async def _handle_virtual(self, opcode: int, nodeid: int, body: bytes,
+                              uid: int, ucfg) -> object:
+        """Serve the virtual config/rm-rf tree; NotImplemented = not ours."""
+        v = self.virt
+        if opcode == LOOKUP:
+            name = body.split(b"\0", 1)[0].decode()
+            if nodeid == ROOT_INODE_ID and name == VIRT_NAME:
+                pass                       # /t3fs-virt itself
+            elif not v.is_virtual(nodeid):
+                return NotImplemented
+            ino = v.lookup(nodeid, name, uid)
+            if ino is None:
+                raise OSError(errno.ENOENT, name)
+            return self._entry_out(ino, ucfg)
+        if not v.is_virtual(nodeid):
+            return NotImplemented
+        if opcode == GETATTR:
+            return self._attr_out(v.getattr(nodeid, uid), ucfg)
+        if opcode == READLINK:
+            return v.readlink(nodeid, uid).encode()
+        if opcode == OPENDIR:
+            listing = v.listing(nodeid, uid)
+            return _OPEN_OUT.pack(
+                self._new_fh(_Handle(v.getattr(nodeid, uid),
+                                     entries=listing)), 0, 0)
+        if opcode == SYMLINK:
+            name_b, target_b = body.split(b"\0", 2)[:2]
+            from t3fs.fuse.user_config import RMRF_DIR
+            if nodeid == RMRF_DIR and ucfg.readonly:
+                # rm-rf is a WRITE: readonly must block the most
+                # destructive op, not just the small ones
+                raise OSError(errno.EROFS, "readonly mount (user config)")
+            ino = await v.symlink(nodeid, name_b.decode(),
+                                  target_b.decode(), uid)
+            # zero timeouts: the next ln -s to the same mailbox name must
+            # LOOKUP fresh (a cached positive dentry would EEXIST it)
+            return self._entry_out(ino, MountUserConfig(attr_timeout=0,
+                                                        entry_timeout=0))
+        if opcode in (READDIR, RELEASEDIR, RELEASE, ACCESS, STATFS,
+                      FSYNCDIR):
+            return NotImplemented          # generic handlers work as-is
+        raise OSError(errno.EACCES, "virtual tree is config-only")
+
+    async def _rmrf(self, target: str, uid: int) -> None:
+        """`ln -s <path> /t3fs-virt/rm-rf/x`: recursive server-side remove
+        (reference rm-rf virtual dir, FuseOps.cc:369-371)."""
+        path = target
+        if path.startswith(self.mountpoint):
+            path = path[len(self.mountpoint):] or "/"
+        if not path.startswith("/"):
+            raise OSError(errno.EINVAL, "rm-rf target must be absolute")
+        if path == "/":
+            raise OSError(errno.EPERM, "refusing rm-rf of the root")
+        await self.mc.remove(path, recursive=True)
 
     # ---- helpers ----
 

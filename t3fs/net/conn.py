@@ -148,12 +148,12 @@ class Connection:
                 msg_len, payload_len, flags = unpack_header(head)
                 msg = await self.reader.readexactly(msg_len) if msg_len else b""
                 payload = await self.reader.readexactly(payload_len) if payload_len else b""
-                if flags & FLAG_COMPRESS and \
-                        msg_len + payload_len >= self.OFFLOAD_BYTES:
+                if flags & FLAG_COMPRESS:
+                    # always off-thread: on-wire size says nothing about
+                    # decompressed size (a zeros-heavy 256 MiB frame can
+                    # arrive <1 MiB), and the hop is cheap vs any zlib pass
                     msg, payload = await asyncio.to_thread(
                         decompress_frame, msg, payload, flags)
-                else:
-                    msg, payload = decompress_frame(msg, payload, flags)
                 packet = serde.loads(msg)
                 if packet.is_req:
                     self._spawn(self._handle_request(packet, payload),

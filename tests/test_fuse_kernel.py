@@ -100,3 +100,77 @@ def test_mount_dd_multi_chunk_io():
             await cluster.stop()
             shutil.rmtree(tmp, ignore_errors=True)
     run(body())
+
+
+def test_virtual_tree_and_user_config():
+    """/t3fs-virt magic paths (FuseOps.cc virtual inodes + UserConfig):
+    readlink = config read, symlink into set-conf = config write,
+    symlink into rm-rf = recursive server-side remove."""
+    async def body():
+        tmp = tempfile.mkdtemp(prefix="t3fs-fusevirt-")
+        cluster, fuse, mnt = await _mounted(tmp)
+        try:
+            def posix_ops():
+                virt = f"{mnt}/t3fs-virt"
+                assert sorted(os.listdir(virt)) == \
+                    ["get-conf", "rm-rf", "set-conf"]
+                keys = sorted(os.listdir(f"{virt}/get-conf"))
+                assert "readonly" in keys and "attr_timeout" in keys
+                # read a config value
+                assert os.readlink(f"{virt}/get-conf/readonly") == "0"
+                assert os.readlink(f"{virt}/get-conf/attr_timeout") == "1.0"
+                # set a value: ln -s 0.25 set-conf/attr_timeout
+                os.symlink("0.25", f"{virt}/set-conf/attr_timeout")
+                assert os.readlink(f"{virt}/get-conf/attr_timeout") == "0.25"
+                # unknown key rejected
+                try:
+                    os.symlink("1", f"{virt}/set-conf/nonsense")
+                    raise AssertionError("unknown key accepted")
+                except FileNotFoundError:
+                    pass
+                # rm-rf: build a tree, nuke it with one symlink
+                os.makedirs(f"{mnt}/big/tree/deep")
+                with open(f"{mnt}/big/tree/deep/f", "wb") as f:
+                    f.write(b"x" * 1000)
+                os.symlink(f"{mnt}/big", f"{virt}/rm-rf/job1")
+                assert not os.path.exists(f"{mnt}/big")
+                # readonly flips writes off (uid 0 sets the mount default)
+                os.symlink("1", f"{virt}/set-conf/readonly")
+                assert os.readlink(f"{virt}/get-conf/readonly") == "1"
+                try:
+                    open(f"{mnt}/nope", "wb")
+                    raise AssertionError("write allowed on readonly mount")
+                except OSError as e:
+                    import errno as _e
+                    assert e.errno == _e.EROFS, e
+                os.symlink("0", f"{virt}/set-conf/readonly")
+                with open(f"{mnt}/yes", "wb") as f:
+                    f.write(b"ok")
+            await asyncio.to_thread(posix_ops)
+        finally:
+            await fuse.unmount()
+            await cluster.stop()
+            shutil.rmtree(tmp, ignore_errors=True)
+    run(body())
+
+
+def test_user_config_per_uid_isolation():
+    """Non-root overrides shadow the mount default for that uid only."""
+    from t3fs.fuse.user_config import MountUserConfig, UserConfig
+
+    cfg = UserConfig(MountUserConfig())
+    cfg.set_key(1000, "readonly", "1")
+    assert cfg.get(1000).readonly is True
+    assert cfg.get(1001).readonly is False
+    assert cfg.get(0).readonly is False
+    # root writes move the default for everyone without an override
+    cfg.set_key(0, "sync_on_stat", "true")
+    assert cfg.get(1001).sync_on_stat is True
+    assert cfg.value_str(1000, "readonly") == "1"
+    # a negative/absurd timeout would break fuse_entry_out packing forever
+    for bad in ("-1", "1e20"):
+        try:
+            cfg.set_key(0, "attr_timeout", bad)
+            raise AssertionError(f"accepted {bad}")
+        except ValueError:
+            pass

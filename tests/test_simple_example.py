@@ -1,0 +1,49 @@
+"""Drive the simple_example template service as a REAL subprocess binary
+(reference src/simple_example: the new-service template must stay runnable
+or the recipe rots)."""
+
+import asyncio
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+import pytest
+
+
+def test_simple_example_binary_end_to_end(tmp_path):
+    async def body():
+        from t3fs.net.client import Client
+
+        port_file = tmp_path / "port"
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "examples.simple_service.service",
+             "--set", f"port_file={port_file}",
+             "--set", f"log.file={tmp_path}/log"],
+            cwd="/root/repo", stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT)
+        try:
+            deadline = time.time() + 15
+            while not port_file.exists() or not port_file.read_text():
+                assert proc.poll() is None, proc.stdout.read().decode()
+                assert time.time() < deadline, "no port file"
+                await asyncio.sleep(0.05)
+            addr = f"127.0.0.1:{port_file.read_text().strip()}"
+            cli = Client()
+            from examples.simple_service.service import GreetReq
+            rsp, _ = await cli.call(addr, "SimpleExample.greet",
+                                    GreetReq(name="world"))
+            assert rsp.message == "hello, world!" and rsp.calls == 1
+            # hot config update through the standard CoreService
+            from t3fs.core.service import HotUpdateConfigReq
+            await cli.call(addr, "Core.hotUpdateConfig",
+                           HotUpdateConfigReq({"greeting": "ahoy"}, ""))
+            rsp, _ = await cli.call(addr, "SimpleExample.greet",
+                                    GreetReq(name="t3fs"))
+            assert rsp.message == "ahoy, t3fs!" and rsp.calls == 2
+            await cli.close()
+        finally:
+            proc.terminate()
+            proc.wait(timeout=10)
+    asyncio.run(body())
