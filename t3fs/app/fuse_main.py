@@ -31,8 +31,12 @@ class FuseMainConfig(ConfigBase):
     # mount-wide user-config defaults; per-uid overrides happen live via
     # /t3fs-virt/set-conf (src/fuse/UserConfig analog)
     readonly: bool = citem(False, hot=False)
-    attr_timeout: float = citem(1.0, hot=False)
-    entry_timeout: float = citem(1.0, hot=False)
+    # same [0, 3600] bound the set-conf write path enforces: a negative or
+    # absurd timeout would make every fuse_entry_out pack raise (EIO mount)
+    attr_timeout: float = citem(1.0, hot=False,
+                                validator=lambda v: 0 <= v <= 3600)
+    entry_timeout: float = citem(1.0, hot=False,
+                                 validator=lambda v: 0 <= v <= 3600)
     sync_on_stat: bool = citem(False, hot=False)
     log: LogConfig = cobj(LogConfig)
 

@@ -17,8 +17,10 @@ from typing import Awaitable, Callable
 
 from t3fs.net.wire import (
     HEADER_SIZE, FLAG_COMPRESS, FLAG_IS_REQ, FrameError, MessagePacket,
-    WireStatus, decompress_frame, maybe_compress, pack_header, unpack_header,
+    WireStatus, check_msg_crc, decompress_frame, maybe_compress, pack_header,
+    unpack_header,
 )
+from t3fs.ops.codec import crc32c
 from t3fs.utils import serde
 from t3fs.utils.status import Status, StatusCode, StatusError, make_error
 
@@ -106,11 +108,18 @@ class Connection:
                     msg, payload, self.compress_threshold,
                     self.compress_level)
             flags |= zflag
+        # envelope CRC (post-compression bytes); off-thread for big
+        # envelopes so the CRC pass never stalls the loop either
+        if len(msg) >= self.OFFLOAD_BYTES:
+            mcrc = await asyncio.to_thread(crc32c, msg)
+        else:
+            mcrc = crc32c(msg) if msg else 0
         async with self._send_lock:
             if self._closed:
                 raise make_error(StatusCode.RPC_SEND_FAILED, "connection closed")
             try:
-                self.writer.write(pack_header(len(msg), len(payload), flags))
+                self.writer.write(pack_header(len(msg), len(payload), flags,
+                                              mcrc))
                 self.writer.write(msg)
                 if payload:
                     self.writer.write(payload)
@@ -145,15 +154,21 @@ class Connection:
         try:
             while True:
                 head = await self.reader.readexactly(HEADER_SIZE)
-                msg_len, payload_len, flags = unpack_header(head)
+                msg_len, payload_len, flags, msg_crc = unpack_header(head)
                 msg = await self.reader.readexactly(msg_len) if msg_len else b""
                 payload = await self.reader.readexactly(payload_len) if payload_len else b""
                 if flags & FLAG_COMPRESS:
                     # always off-thread: on-wire size says nothing about
                     # decompressed size (a zeros-heavy 256 MiB frame can
                     # arrive <1 MiB), and the hop is cheap vs any zlib pass
-                    msg, payload = await asyncio.to_thread(
-                        decompress_frame, msg, payload, flags)
+                    def _verify_inflate(m=msg, p=payload, f=flags, c=msg_crc):
+                        check_msg_crc(m, c)   # CRC covers on-wire bytes
+                        return decompress_frame(m, p, f)
+                    msg, payload = await asyncio.to_thread(_verify_inflate)
+                elif msg_len >= self.OFFLOAD_BYTES:
+                    await asyncio.to_thread(check_msg_crc, msg, msg_crc)
+                else:
+                    check_msg_crc(msg, msg_crc)
                 packet = serde.loads(msg)
                 if packet.is_req:
                     self._spawn(self._handle_request(packet, payload),

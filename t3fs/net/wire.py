@@ -14,8 +14,17 @@ from t3fs.ops.codec import crc32c as crc32c_ref
 from t3fs.utils.serde import serde_struct
 from t3fs.utils.status import Status, StatusCode
 
-MAGIC = 0x74336673  # "t3fs"
-HEADER_FMT = "<IIIII"  # magic, msg_len, payload_len, flags, header_crc
+# "t3f" + wire version.  v2 added msg_crc (header 20 -> 24 bytes); bumping
+# the magic makes a mixed-version peer fail as an explicit "bad magic"
+# instead of a phantom "header crc mismatch" during rolling restarts.
+MAGIC = 0x74336632  # "t3f2"
+# magic, msg_len, payload_len, flags, msg_crc, header_crc.  msg_crc covers
+# the serde MessagePacket bytes (envelope integrity: ids, methods, status,
+# inline bodies); the bulk payload is NOT wire-checksummed — chunk data
+# carries its own end-to-end ChecksumInfo at the app layer, exactly like
+# the reference (MessageHeader.h CRCs the header; fbs/storage/Common.h:113
+# checksums the data).
+HEADER_FMT = "<IIIIII"
 HEADER_SIZE = struct.calcsize(HEADER_FMT)
 
 FLAG_IS_REQ = 1 << 0
@@ -73,21 +82,30 @@ def decompress_frame(msg: bytes, payload: bytes,
             _safe_decompress(payload) if payload else b"")
 
 
-def pack_header(msg_len: int, payload_len: int, flags: int) -> bytes:
-    head = struct.pack("<IIII", MAGIC, msg_len, payload_len, flags)
+def pack_header(msg_len: int, payload_len: int, flags: int,
+                msg_crc: int = 0) -> bytes:
+    head = struct.pack("<IIIII", MAGIC, msg_len, payload_len, flags, msg_crc)
     crc = crc32c_ref(head)
     return head + struct.pack("<I", crc)
 
 
-def unpack_header(data: bytes) -> tuple[int, int, int]:
-    magic, msg_len, payload_len, flags, crc = struct.unpack(HEADER_FMT, data)
+def unpack_header(data: bytes) -> tuple[int, int, int, int]:
+    (magic, msg_len, payload_len, flags, msg_crc,
+     crc) = struct.unpack(HEADER_FMT, data)
     if magic != MAGIC:
         raise FrameError(f"bad magic {magic:#x}")
-    if crc != crc32c_ref(data[:16]):
+    if crc != crc32c_ref(data[:20]):
         raise FrameError("header crc mismatch")
     if msg_len > MAX_FRAME or payload_len > MAX_FRAME:
         raise FrameError(f"oversized frame {msg_len}/{payload_len}")
-    return msg_len, payload_len, flags
+    return msg_len, payload_len, flags, msg_crc
+
+
+def check_msg_crc(msg: bytes, msg_crc: int) -> None:
+    """Envelope integrity: the serde packet bytes must match the header's
+    msg_crc (a torn/bit-flipped envelope must fail closed, not decode)."""
+    if msg and crc32c_ref(msg) != msg_crc:
+        raise FrameError("message crc mismatch")
 
 
 @serde_struct

@@ -6,10 +6,9 @@ import asyncio
 import os
 import subprocess
 import sys
-import tempfile
 import time
 
-import pytest
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def test_simple_example_binary_end_to_end(tmp_path):
@@ -21,7 +20,7 @@ def test_simple_example_binary_end_to_end(tmp_path):
             [sys.executable, "-m", "examples.simple_service.service",
              "--set", f"port_file={port_file}",
              "--set", f"log.file={tmp_path}/log"],
-            cwd="/root/repo", stdout=subprocess.PIPE,
+            cwd=REPO_ROOT, stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT)
         try:
             deadline = time.time() + 15
