@@ -19,18 +19,7 @@ import jax
 import jax.numpy as jnp
 
 
-def chained_time(op, x, iters: int = 100, reps: int = 5) -> float:
-    """Raw seconds per iteration of [xor-perturb pass + op(x)] on device.
-
-    The xor pass (one elementwise HBM read+write of x) makes each iteration
-    data-dependent on the last so XLA can't hoist or CSE the op; its cost is
-    one full r+w pass over x — calibrate with a pallas copy kernel (whose
-    loop = xor pass + copy pass, i.e. 2 identical passes) and subtract.
-
-    op: fn(array) -> array or pytree.  Must be opaque to XLA (pallas_call);
-    plain elementwise ops get DCE-sliced to the one element the carry reads.
-    """
-
+def _build_chained(op, iters: int):
     def run(x0):
         def body(i, carry):
             x, acc = carry
@@ -48,8 +37,22 @@ def chained_time(op, x, iters: int = 100, reps: int = 5) -> float:
             return x, acc
         _, acc = jax.lax.fori_loop(0, iters, body, (x0, jnp.uint32(0)))
         return acc
+    return jax.jit(run)
 
-    fn = jax.jit(run)
+
+def chained_time(op, x, iters: int = 100, reps: int = 5) -> float:
+    """Raw seconds per iteration of [xor-perturb pass + op(x)] on device.
+
+    The xor pass (one elementwise HBM read+write of x) makes each iteration
+    data-dependent on the last so XLA can't hoist or CSE the op; its cost is
+    one full r+w pass over x — calibrate with a pallas copy kernel (whose
+    loop = xor pass + copy pass, i.e. 2 identical passes) and subtract.
+
+    op: fn(array) -> array or pytree.  Must be opaque to XLA (pallas_call);
+    plain elementwise ops get DCE-sliced to the one element the carry reads.
+    """
+
+    fn = _build_chained(op, iters)
     _ = int(fn(x))                                   # compile + warm
     ts = []
     for _ in range(reps):
@@ -57,6 +60,21 @@ def chained_time(op, x, iters: int = 100, reps: int = 5) -> float:
         _ = int(fn(x))                               # readback = real sync
         ts.append(time.perf_counter() - t0)
     return min(ts) / iters
+
+
+def chained_timer(op, x, iters: int = 100):
+    """Like chained_time but returns a zero-arg callable timing ONE pass
+    (compile+warm done here).  Lets callers interleave measurement and
+    calibration reps so clock-drift on a shared/tunneled device hits both
+    equally instead of skewing the subtraction."""
+    fn = _build_chained(op, iters)
+    _ = int(fn(x))                                   # compile + warm
+
+    def one() -> float:
+        t0 = time.perf_counter()
+        _ = int(fn(x))
+        return time.perf_counter() - t0
+    return one
 
 
 def op_time(op, x, xor_pass_s: float, iters: int = 100) -> float:

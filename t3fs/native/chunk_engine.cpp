@@ -175,6 +175,11 @@ struct Slot {
   uint32_t size_class_log2 = 0;  // block size = 1 << log2
   uint64_t block = 0;
   Meta meta;
+  // process-lifetime allocation generation: bumped on every index flip so
+  // lock-free readers can detect remove+recreate ABA even when the new
+  // incarnation has identical meta AND lands on the same block (locate()
+  // returns it; not persisted — uniqueness within one process suffices)
+  uint64_t gen = 0;
 };
 
 enum WalOp : uint8_t { kPut = 1, kSetMeta = 2, kRemove = 3 };
@@ -262,6 +267,7 @@ class Engine {
     Slot s{lg, block, meta};
     s.meta.length = len;
     std::unique_lock lk(mu_);
+    s.gen = ++gen_counter_;
     if (!wal_append_put(cid, s)) { release(get_class(lg), block); return false; }
     auto it = index_.find(cid);
     if (it != index_.end()) {
@@ -292,6 +298,30 @@ class Engine {
       g_error = std::string("pread: ") + strerror(errno);
       return -1;
     }
+    return 1;
+  }
+
+  // Lock-free-read descriptor: where the chunk's bytes live RIGHT NOW.
+  // Callers pread(fd, abs_off, n) outside any engine lock, then re-check
+  // get_meta: updates are COW (a put moves the chunk to a fresh block and
+  // bumps update_ver), a freed block is never punched or re-allocated
+  // while still owned, so unchanged meta => the preaded bytes are that
+  // version's bytes.  This is the seam the aio/io_uring reader uses
+  // (reference: AioStatus.h:50-69 reads into caller buffers the same way;
+  // the Rust engine's Arc<ChunkPos> solves the same race by refcounting).
+  int locate(const Cid& cid, uint64_t off, uint64_t want,
+             int32_t* fd, uint64_t* abs_off, uint64_t* n, uint64_t* gen) {
+    std::shared_lock lk(mu_);
+    auto it = index_.find(cid);
+    if (it == index_.end()) return 0;
+    const Slot& s = it->second;
+    *n = off < s.meta.length ? std::min(want, s.meta.length - off) : 0;
+    uint64_t bs = 1ull << s.size_class_log2;
+    auto cit = classes_.find(s.size_class_log2);
+    if (cit == classes_.end() || cit->second.fd < 0) return 0;
+    *fd = cit->second.fd;
+    *abs_off = s.block * bs + off;
+    *gen = s.gen;
     return 1;
   }
 
@@ -423,6 +453,7 @@ class Engine {
 
  private:
   std::shared_mutex mu_;
+  uint64_t gen_counter_ = 0;     // Slot::gen source (under mu_)
   std::map<Cid, Slot> index_;
   std::map<uint32_t, SizeClass> classes_;
   int wal_fd_ = -1;
@@ -754,6 +785,13 @@ int t3fs_ce_put(void* h, const uint8_t* cid, const uint8_t* data,
 int t3fs_ce_read(void* h, const uint8_t* cid, uint64_t off, uint64_t len,
                  uint8_t* out, uint64_t* out_len) {
   return static_cast<Engine*>(h)->read(to_cid(cid), off, len, out, out_len);
+}
+
+int t3fs_ce_locate(void* h, const uint8_t* cid, uint64_t off, uint64_t want,
+                   int32_t* fd, uint64_t* abs_off, uint64_t* n,
+                   uint64_t* gen) {
+  return static_cast<Engine*>(h)->locate(to_cid(cid), off, want, fd,
+                                         abs_off, n, gen);
 }
 
 int t3fs_ce_get_meta(void* h, const uint8_t* cid, CeMeta* out) {

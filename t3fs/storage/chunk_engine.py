@@ -52,6 +52,10 @@ class ChunkEngine:
         self.sync_writes = sync_writes
         os.makedirs(root, exist_ok=True)
         self._lock = threading.RLock()
+        # allocation generation per chunk (ABA guard for lock-free aio
+        # reads; process-lifetime only, mirrors the native engine Slot::gen)
+        self._gen_counter = 0
+        self._gens: dict[bytes, int] = {}
         self._db = sqlite3.connect(os.path.join(root, "meta.db"),
                                    check_same_thread=False)
         self._db.execute("PRAGMA journal_mode=WAL")
@@ -161,6 +165,21 @@ class ChunkEngine:
             row = self._get_row(chunk_id)
             return self._row_to_meta(row)[0] if row else None
 
+    def locate(self, chunk_id: ChunkId, offset: int,
+               length: int) -> tuple[int, int, int, int] | None:
+        """(fd, abs_offset, n, gen) for lock-free aio preads; same seqlock
+        + allocation-generation contract as the native engine (re-locate
+        after reading, require same gen and unchanged meta)."""
+        with self._lock:
+            row = self._get_row(chunk_id)
+            if row is None:
+                return None
+            meta, sc, block = self._row_to_meta(row)
+            n = max(0, min(length, meta.length - offset)) \
+                if offset < meta.length else 0
+            return (self._fd(sc), block * sc + offset, n,
+                    self._gens.get(chunk_id.encode(), 0))
+
     def read(self, chunk_id: ChunkId, offset: int = 0, length: int = -1) -> bytes:
         with self._lock:
             row = self._get_row(chunk_id)
@@ -211,6 +230,8 @@ class ChunkEngine:
                      meta.checksum, int(meta.state)))
             if old is not None:
                 self._release(old[1], old[2])
+            self._gen_counter += 1
+            self._gens[chunk_id.encode()] = self._gen_counter
 
     def set_meta(self, chunk_id: ChunkId, meta: ChunkMeta) -> None:
         """Metadata-only flip (commit: DIRTY -> COMMIT), atomic."""
@@ -236,6 +257,7 @@ class ChunkEngine:
                 self._db.execute("DELETE FROM chunks WHERE cid=?",
                                  (chunk_id.encode(),))
             self._release(sc, block)
+            self._gens.pop(chunk_id.encode(), None)
             return True
 
     def query_range(self, inode: int, begin_index: int = 0,

@@ -190,38 +190,32 @@ class ChunkReplica:
 
     # --- read path ---
 
-    def read(self, io: ReadIO,
-             meta_hint: "ChunkMeta | None" = None) -> tuple[IOResult, bytes]:
-        # Optimistic meta validation: reads run concurrently with the update
-        # worker (no chunk lock), and engine.get_meta + engine.read are two
-        # separately-locked calls — re-check the meta after the data read and
-        # retry if an update slipped between them, so the returned bytes
-        # always pair with the returned versions/checksum (each engine call
-        # is internally atomic; any concurrent put bumps update_ver or
-        # changes the checksum).  meta_hint lets the caller reuse a meta it
-        # already fetched (sizing decisions) instead of a second lookup.
-        for attempt in range(8):
-            meta = meta_hint if attempt == 0 and meta_hint is not None \
-                else self.engine.get_meta(io.chunk_id)
-            if meta is None:
-                raise make_error(StatusCode.CHUNK_NOT_FOUND, str(io.chunk_id))
-            if meta.state == ChunkState.DIRTY and not io.allow_uncommitted:
-                # only committed versions are served (design_notes.md:169-173);
-                # client retries — commit latency is one chain round trip
-                raise make_error(StatusCode.CHUNK_BUSY,
-                                 f"{io.chunk_id}: uncommitted v{meta.update_ver}")
-            data = self.engine.read(io.chunk_id, io.offset,
-                                    io.length if io.length else -1)
-            meta2 = self.engine.get_meta(io.chunk_id)
-            if meta2 is not None \
-                    and meta2.update_ver == meta.update_ver \
-                    and meta2.checksum == meta.checksum \
-                    and meta2.length == meta.length:
-                meta = meta2  # commit_ver/state may have advanced; report newest
-                break
-        else:
+    # Shared skeleton of the optimistic read protocol: reads run
+    # concurrently with the update worker (no chunk lock), so the meta is
+    # re-checked after the data fetch and the attempt retried if an update
+    # slipped between them — the returned bytes always pair with the
+    # returned versions/checksum.
+
+    def _read_meta_checked(self, io: ReadIO, meta_hint, attempt):
+        meta = meta_hint if attempt == 0 and meta_hint is not None \
+            else self.engine.get_meta(io.chunk_id)
+        if meta is None:
+            raise make_error(StatusCode.CHUNK_NOT_FOUND, str(io.chunk_id))
+        if meta.state == ChunkState.DIRTY and not io.allow_uncommitted:
+            # only committed versions are served (design_notes.md:169-173);
+            # client retries — commit latency is one chain round trip
             raise make_error(StatusCode.CHUNK_BUSY,
-                             f"{io.chunk_id}: update storm during read")
+                             f"{io.chunk_id}: uncommitted v{meta.update_ver}")
+        return meta
+
+    @staticmethod
+    def _meta_unchanged(meta, meta2) -> bool:
+        return meta2 is not None \
+            and meta2.update_ver == meta.update_ver \
+            and meta2.checksum == meta.checksum \
+            and meta2.length == meta.length
+
+    def _read_finish(self, io: ReadIO, meta, data) -> tuple[IOResult, bytes]:
         if io.verify_checksum and io.offset == 0 and len(data) == meta.length:
             actual = self.crc(data)
             if actual != meta.checksum:
@@ -229,3 +223,54 @@ class ChunkReplica:
                                  f"{io.chunk_id}: stored {meta.checksum:#x} != read {actual:#x}")
         return IOResult(WireStatus(), len(data), meta.update_ver, meta.commit_ver,
                         meta.chain_ver, meta.checksum), data
+
+    def read(self, io: ReadIO,
+             meta_hint: "ChunkMeta | None" = None) -> tuple[IOResult, bytes]:
+        # meta_hint lets the caller reuse a meta it already fetched
+        # (sizing decisions) instead of a second lookup
+        for attempt in range(8):
+            meta = self._read_meta_checked(io, meta_hint, attempt)
+            data = self.engine.read(io.chunk_id, io.offset,
+                                    io.length if io.length else -1)
+            meta2 = self.engine.get_meta(io.chunk_id)
+            if self._meta_unchanged(meta, meta2):
+                # commit_ver/state may have advanced; report newest
+                return self._read_finish(io, meta2, data)
+        raise make_error(StatusCode.CHUNK_BUSY,
+                         f"{io.chunk_id}: update storm during read")
+
+    async def read_aio(self, io: ReadIO, aio,
+                       meta_hint: "ChunkMeta | None" = None
+                       ) -> tuple[IOResult, bytes]:
+        """read() with the disk pread submitted through the io_uring worker
+        (AioReadWorker) instead of the engine's locked pread.  The aio read
+        holds NO engine lock, so validation is locate -> pread -> locate:
+        the post-read locate must return the SAME allocation generation
+        (Slot::gen — a put/remove/recreate bumps it, closing the ABA where
+        a recreated chunk reproduces identical meta on a reused block) and
+        the meta must be unchanged.  Falls back to the locked thread-pool
+        read when the engine can't locate or the aio worker errors."""
+        import asyncio as _a
+
+        locate = getattr(self.engine, "locate", None)
+        for attempt in range(8):
+            meta = self._read_meta_checked(io, meta_hint, attempt)
+            loc = locate(io.chunk_id, io.offset,
+                         io.length if io.length else meta.length) \
+                if locate is not None else None
+            if loc is None:
+                return await _a.to_thread(self.read, io, meta_hint)
+            fd, abs_off, n, gen = loc
+            try:
+                data = await aio.submit_read(fd, abs_off, n) if n else b""
+            except OSError:
+                # ring dead/full: self-heal onto the thread pipeline
+                return await _a.to_thread(self.read, io, meta_hint)
+            meta2 = self.engine.get_meta(io.chunk_id)
+            loc2 = locate(io.chunk_id, io.offset,
+                          io.length if io.length else meta.length)
+            if self._meta_unchanged(meta, meta2) and loc2 is not None \
+                    and loc2[3] == gen and len(data) == n:
+                return self._read_finish(io, meta2, data)
+        raise make_error(StatusCode.CHUNK_BUSY,
+                         f"{io.chunk_id}: update storm during read")

@@ -43,6 +43,11 @@ def _bind():
     lib.t3fs_ce_read.argtypes = [C.c_void_p, C.c_char_p, C.c_uint64,
                                  C.c_uint64, C.c_void_p,
                                  C.POINTER(C.c_uint64)]
+    lib.t3fs_ce_locate.argtypes = [C.c_void_p, C.c_char_p, C.c_uint64,
+                                   C.c_uint64, C.POINTER(C.c_int32),
+                                   C.POINTER(C.c_uint64),
+                                   C.POINTER(C.c_uint64),
+                                   C.POINTER(C.c_uint64)]
     lib.t3fs_ce_get_meta.argtypes = [C.c_void_p, C.c_char_p,
                                      C.POINTER(_CeMeta)]
     lib.t3fs_ce_set_meta.argtypes = [C.c_void_p, C.c_char_p,
@@ -124,6 +129,25 @@ class NativeChunkEngine:
         cm = _CeMeta()
         r = self._lib.t3fs_ce_get_meta(self._h, chunk_id.encode(), C.byref(cm))
         return _meta_from_c(chunk_id, cm) if r == 1 else None
+
+    def locate(self, chunk_id: ChunkId, offset: int,
+               length: int) -> tuple[int, int, int, int] | None:
+        """(fd, abs_offset, n, gen) of the chunk's CURRENT bytes for
+        lock-free aio preads.  gen is the slot's allocation generation:
+        callers re-locate after the read and require the SAME gen (plus
+        unchanged meta) — this closes the remove+recreate ABA where a new
+        incarnation reproduces identical meta on a reused block.  None =
+        unknown chunk."""
+        fd = C.c_int32()
+        abs_off = C.c_uint64()
+        n = C.c_uint64()
+        gen = C.c_uint64()
+        r = self._lib.t3fs_ce_locate(self._h, chunk_id.encode(), offset,
+                                     length, C.byref(fd), C.byref(abs_off),
+                                     C.byref(n), C.byref(gen))
+        if r != 1:
+            return None
+        return fd.value, abs_off.value, n.value, gen.value
 
     def read(self, chunk_id: ChunkId, offset: int = 0, length: int = -1) -> bytes:
         meta = self.get_meta(chunk_id)

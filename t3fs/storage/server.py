@@ -38,6 +38,9 @@ class StorageConfig(ConfigBase):
     # the codec seam (BASELINE north star): cpu | tpu | null
     checksum_backend: str = citem(
         "cpu", hot=False, validator=lambda v: v in ("cpu", "tpu", "device", "null"))
+    # io_uring read pipeline (AioReadWorker analog); auto-disables when the
+    # kernel lacks io_uring
+    aio_read: bool = citem(True, hot=False)
 
 
 class StorageServer:
@@ -87,6 +90,13 @@ class StorageServer:
         self.resync.period_s = self.cfg.resync_period_s
 
     async def start(self) -> None:
+        if self.cfg.aio_read:
+            from t3fs.storage.aio import AioReadWorker
+            if AioReadWorker.available():
+                self.node.aio = AioReadWorker()
+                self.node.aio.start()
+            else:
+                log.info("io_uring unavailable; thread-pool reads")
         await self.server.start()
         self.core.app_info.address = self.server.address
         self.core.on_config_updated = self._on_config_updated
@@ -119,5 +129,10 @@ class StorageServer:
         await self.node.client.close()
         await self.node.codec.close()
         await self.server.stop()
+        # only after the RPC server stops: in-flight batch_reads may hold
+        # node.aio, and closing the ring under them is a use-after-free
+        if self.node.aio is not None:
+            await self.node.aio.close()
+            self.node.aio = None
         for t in self.node.targets.values():
             t.close()

@@ -102,6 +102,10 @@ class StorageNode:
         self.codec = make_checksum_backend(checksum_backend)
         self.read_concurrency = read_concurrency
         self._read_sem: asyncio.Semaphore | None = None
+        # io_uring read pipeline (AioReadWorker.h:21-44 analog); started by
+        # the server when the kernel supports it, else large reads keep the
+        # thread-pool path
+        self.aio = None
         self.targets: dict[int, StorageTarget] = {}
         # local target states reported in heartbeats (failure-detection input,
         # fbs/mgmtd/LocalTargetInfo.h analog): a fresh/restarted target is
@@ -441,6 +445,12 @@ class StorageService:
                     length_hint = meta_hint.length if meta_hint else 0
                 if length_hint <= SMALL_READ_INLINE_BYTES:
                     result, data = target.replica.read(io, meta_hint)
+                elif node.aio is not None:
+                    # io_uring path: disk read runs in the kernel, no
+                    # thread hop, no engine lock held across the IO
+                    async with node._read_sem:
+                        result, data = await target.replica.read_aio(
+                            io, node.aio, meta_hint)
                 else:
                     async with node._read_sem:
                         result, data = await asyncio.to_thread(

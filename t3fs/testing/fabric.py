@@ -27,13 +27,17 @@ class StorageFabric:
     # (UnitTestFabric SystemSetupConfig analog, tests/lib/UnitTestFabric.h:86)
     default_checksum_backend: str = "cpu"
     default_engine_backend: str = "native"
+    default_aio_read: bool = True
 
     def __init__(self, num_nodes: int = 3, replicas: int = 3, chain_id: int = 1,
-                 checksum_backend=None, engine_backend: str | None = None):
+                 checksum_backend=None, engine_backend: str | None = None,
+                 aio_read: bool | None = None):
         assert replicas <= num_nodes
         self.num_nodes = num_nodes
         self.replicas = replicas
         self.chain_id = chain_id
+        self.aio_read = (aio_read if aio_read is not None
+                         else self.default_aio_read)
         self.checksum_backend = (checksum_backend if checksum_backend is not None
                                  else self.default_checksum_backend)
         self.engine_backend = engine_backend or self.default_engine_backend
@@ -53,6 +57,11 @@ class StorageFabric:
             node_id = i + 1
             node = StorageNode(node_id, lambda: self.routing, Client(),
                                checksum_backend=self.checksum_backend)
+            if self.aio_read:
+                from t3fs.storage.aio import AioReadWorker
+                if AioReadWorker.available():
+                    node.aio = AioReadWorker()
+                    node.aio.start()
             node.client.add_service(BufferRegistry())  # forwarding conns
             node.add_target(self.target_id(i), f"{self._tmp.name}/n{node_id}",
                             engine_backend=self.engine_backend)
@@ -96,6 +105,11 @@ class StorageFabric:
             await node.codec.close()
         for server in self.servers:
             await server.stop()
+        for node in self.nodes:
+            # after the RPC servers: in-flight reads may hold node.aio
+            if node.aio is not None:
+                await node.aio.close()
+                node.aio = None
         for node in self.nodes:
             for t in node.targets.values():
                 t.close()

@@ -30,6 +30,14 @@ def _engine_backend(request, monkeypatch):
     monkeypatch.setattr(StorageFabric, "default_engine_backend", request.param)
 
 
+@pytest.fixture(autouse=True, params=["aio", "thread"])
+def _read_pipeline(request, monkeypatch):
+    """Both read pipelines: io_uring (AioReadWorker analog) and the
+    thread-pool fallback."""
+    monkeypatch.setattr(StorageFabric, "default_aio_read",
+                        request.param == "aio")
+
+
 @pytest.fixture(autouse=True, params=["cpu", "device"])
 def _checksum_backend(request, monkeypatch):
     """Run the whole suite under both codec backends (the north-star seam):
@@ -476,4 +484,114 @@ def test_stale_head_cannot_single_copy_commit():
             assert meta is None or int(meta.state) != int(ChunkState.COMMIT)
         finally:
             await fabric.stop()
+    run(body())
+
+
+def test_large_read_exercises_aio_pipeline():
+    """>64 KiB reads route through io_uring when enabled (AioReadWorker
+    analog); bytes + versions identical on both pipelines."""
+    async def body():
+        fabric = StorageFabric(num_nodes=1, replicas=1)
+        await fabric.start()
+        try:
+            cid = ChunkId(77, 0)
+            data = bytes(range(256)) * 1024            # 256 KiB
+            result = await write(fabric, cid, data)
+            assert result.status.code == int(StatusCode.OK)
+            r, payload = await read(fabric, cid)
+            assert payload == data
+            r, tailp = await read(fabric, cid, offset=100_000, length=70_000)
+            assert tailp == data[100_000:170_000]
+            if fabric.aio_read and fabric.nodes[0].aio is not None:
+                assert fabric.nodes[0].aio.completed >= 2
+        finally:
+            await fabric.stop()
+    run(body())
+
+
+def test_aio_read_consistent_under_update_storm():
+    """The locate->pread->meta-recheck seqlock: readers racing COW updates
+    must always return a (version, checksum, bytes) triple that matches —
+    never bytes of one version with the checksum of another."""
+    async def body():
+        fabric = StorageFabric(num_nodes=1, replicas=1)
+        await fabric.start()
+        try:
+            cid = ChunkId(88, 0)
+            versions = [bytes([v]) * (128 << 10) for v in range(1, 9)]
+            await write(fabric, cid, versions[0])
+
+            async def writer():
+                for seq, data in enumerate(versions[1:], start=2):
+                    r = await write(fabric, cid, data, seq=seq)
+                    assert r.status.code == int(StatusCode.OK), r.status
+                    await asyncio.sleep(0)
+
+            async def reader():
+                mismatches = []
+                for _ in range(30):
+                    r, payload = await read(fabric, cid)
+                    if r.status.code == int(StatusCode.OK) and payload:
+                        if crc32c_ref(payload) != r.checksum:
+                            mismatches.append(r)
+                    await asyncio.sleep(0)
+                return mismatches
+
+            results = await asyncio.gather(writer(), reader(), reader())
+            assert results[1] == [] and results[2] == [], results[1:]
+            r, payload = await read(fabric, cid)
+            assert payload == versions[-1]
+        finally:
+            await fabric.stop()
+    run(body())
+
+
+def test_aio_read_aba_remove_recreate_detected():
+    """ABA guard: remove + recreate with IDENTICAL meta (same bytes, same
+    versions) while an aio read is paused mid-flight must NOT validate —
+    the allocation generation differs, forcing a retry that returns the
+    new incarnation's bytes, never a freed/reused block's."""
+    async def body():
+        import tempfile as _tf
+
+        from t3fs.ops.codec import crc32c as _crc
+        from t3fs.storage.aio import AioReadWorker
+        from t3fs.storage.chunk_engine import ChunkEngine
+        from t3fs.storage.chunk_replica import ChunkReplica
+        from t3fs.storage.types import ChunkMeta
+
+        tmp = _tf.mkdtemp(prefix="t3fs-aba-")
+        engine = ChunkEngine(tmp)
+        replica = ChunkReplica(engine)
+        aio = AioReadWorker(depth=32)
+        aio.start()
+        try:
+            cid = ChunkId(99, 0)
+            data = b"\xab" * (96 << 10)
+            meta = ChunkMeta(chunk_id=cid, length=len(data), update_ver=3,
+                             commit_ver=3, chain_ver=1, checksum=_crc(data))
+            engine.put(cid, data, meta, chunk_size=len(data))
+
+            flipped = asyncio.Event()
+            real_submit = aio.submit_read
+            calls = {"n": 0}
+
+            async def paused_submit(fd, off, ln):
+                calls["n"] += 1
+                if calls["n"] == 1:
+                    # remove + recreate SAME bytes/meta mid-read
+                    engine.remove(cid)
+                    engine.put(cid, data, meta, chunk_size=len(data))
+                    flipped.set()
+                return await real_submit(fd, off, ln)
+
+            aio.submit_read = paused_submit
+            io = ReadIO(chunk_id=cid, chain_id=1)
+            result, payload = await replica.read_aio(io, aio)
+            assert flipped.is_set() and calls["n"] >= 2, calls
+            assert payload == data and result.checksum == _crc(data)
+        finally:
+            aio.submit_read = real_submit
+            await aio.close()
+            engine.close()
     run(body())
