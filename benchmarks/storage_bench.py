@@ -31,7 +31,8 @@ async def _mk_local(args):
     from t3fs.testing.fabric import StorageFabric
     from t3fs.utils.fault_injection import DebugFlags
     fab = StorageFabric(num_nodes=args.nodes, replicas=args.replicas,
-                        checksum_backend=args.checksum_backend)
+                        checksum_backend=args.checksum_backend,
+                        aio_read=not args.no_aio)
     await fab.start()
     sc = StorageClient(
         lambda: fab.routing, client=fab.client,
@@ -174,6 +175,8 @@ def parse_args(argv=None):
                     help="IOs per batch_read RPC in read mode (KVCache-style)")
     ap.add_argument("--seconds", type=float, default=5.0)
     ap.add_argument("--verify-checksums", action="store_true")
+    ap.add_argument("--no-aio", action="store_true",
+                    help="disable the io_uring read pipeline (A/B)")
     ap.add_argument("--checksum-backend", default="cpu",
                     choices=["cpu", "tpu", "null"],
                     help="server-side codec seam (local cluster mode)")
