@@ -1,0 +1,141 @@
+"""KVCache bench: batched-get IOPS + GC removal IOPS over KVCacheStore.
+
+Reference analog: the README.md:45-51 KVCache figures (peak read throughput,
+GC removal IOPS).  Drives t3fs/lib/kvcache.py against the in-process fabric
+(default) or a live cluster (--mgmtd).
+
+    python -m benchmarks.kvcache_bench --blocks 2048 --value-size 16384 \
+        --batch 32 --concurrency 16 --seconds 5 --json
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import random
+import sys
+import time
+
+from t3fs.client.storage_client import StorageClient, StorageClientConfig
+from t3fs.lib.kvcache import KVCacheConfig, KVCacheStore
+from t3fs.utils.metrics import LatencyRecorder
+
+
+async def _mk_local(args):
+    from t3fs.testing.fabric import StorageFabric
+    fab = StorageFabric(num_nodes=args.nodes, replicas=args.replicas,
+                        aio_read=not args.no_aio)
+    await fab.start()
+    sc = StorageClient(lambda: fab.routing, client=fab.client,
+                       config=StorageClientConfig())
+    return fab, sc, [fab.chain_id]
+
+
+async def _mk_remote(args):
+    from t3fs.client.mgmtd_client import MgmtdClient
+    mg = MgmtdClient(args.mgmtd, refresh_period_s=0.5)
+    await mg.start()
+    sc = StorageClient(mg.routing, refresh_routing=mg.refresh,
+                       config=StorageClientConfig())
+    return mg, sc, sorted(mg.routing().chains)
+
+
+async def run_bench(args) -> dict:
+    env, sc, chains = await (_mk_remote(args) if args.mgmtd
+                             else _mk_local(args))
+    block_cap = 1 << (args.value_size + 256 - 1).bit_length()
+    kv = KVCacheStore(sc, chains, namespace=f"bench-{args.seed}",
+                      config=KVCacheConfig(block_size=block_cap,
+                                           gc_concurrency=args.concurrency))
+    try:
+        return await _run_phases(args, kv)
+    finally:
+        await sc.close()
+        await env.stop()
+
+
+async def _run_phases(args, kv: KVCacheStore) -> dict:
+    rng = random.Random(args.seed)
+    keys = [f"kv-{args.seed}-{i}".encode() for i in range(args.blocks)]
+    value = bytes(rng.getrandbits(8) for _ in range(256)) * (
+        args.value_size // 256 + 1)
+    value = value[:args.value_size]
+
+    # populate
+    t0 = time.perf_counter()
+    await asyncio.gather(*(kv.put(k, value) for k in keys))
+    t_pop = time.perf_counter() - t0
+
+    # batched random gets
+    lat = LatencyRecorder("kvcache.get_many")
+    counters = {"ops": 0, "bytes": 0, "miss": 0}
+    stop_at = time.perf_counter() + args.seconds
+
+    async def getter(widx: int) -> None:
+        g = random.Random(args.seed * 1000 + widx)
+        while time.perf_counter() < stop_at:
+            batch = [keys[g.randrange(len(keys))] for _ in range(args.batch)]
+            with lat.time():
+                values = await kv.get_many(batch)
+            for v in values:
+                if v is None:
+                    counters["miss"] += 1
+                else:
+                    counters["ops"] += 1
+                    counters["bytes"] += len(v)
+
+    t0 = time.perf_counter()
+    await asyncio.gather(*(getter(w) for w in range(args.concurrency)))
+    t_get = time.perf_counter() - t0
+    snap = lat.collect()
+
+    # GC removal
+    t0 = time.perf_counter()
+    removed = await kv.remove_many(keys)
+    t_gc = time.perf_counter() - t0
+
+    return {
+        "blocks": args.blocks, "value_size": args.value_size,
+        "batch": args.batch, "concurrency": args.concurrency,
+        "populate_put_iops": round(args.blocks / t_pop, 1),
+        "get_iops": round(counters["ops"] / t_get, 1),
+        "get_MB_s": round(counters["bytes"] / t_get / 1e6, 2),
+        "get_miss": counters["miss"],
+        "get_p50_ms": round(snap.get("p50", 0) * 1e3, 3),
+        "get_p99_ms": round(snap.get("p99", 0) * 1e3, 3),
+        "gc_removed": removed,
+        "gc_remove_iops": round(removed / t_gc, 1),
+    }
+
+
+def parse_args(argv=None):
+    ap = argparse.ArgumentParser(prog="kvcache_bench")
+    ap.add_argument("--mgmtd", default="",
+                    help="live cluster address; omit for in-process fabric")
+    ap.add_argument("--nodes", type=int, default=3)
+    ap.add_argument("--replicas", type=int, default=3)
+    ap.add_argument("--blocks", type=int, default=1024)
+    ap.add_argument("--value-size", type=int, default=16 << 10)
+    ap.add_argument("--batch", type=int, default=32)
+    ap.add_argument("--concurrency", type=int, default=16)
+    ap.add_argument("--seconds", type=float, default=5.0)
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--no-aio", action="store_true")
+    ap.add_argument("--json", action="store_true")
+    return ap.parse_args(argv)
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+    result = asyncio.run(run_bench(args))
+    if args.json:
+        print(json.dumps(result))
+    else:
+        for k, v in result.items():
+            print(f"{k:>18}: {v}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,200 @@
+"""KVCache store: block-oriented LLM KV-cache over the chunk layer.
+
+Reference analog: the KVCache workload in README.md:45-51 — a cost-effective
+alternative to DRAM caching of inference KV state, with a peak read
+throughput figure (~40 GiB/s/cluster) and a GC removal-IOPS figure.  In the
+reference this is an *application* of 3FS (files over chunks); t3fs ships it
+as a first-class library because the mapping is pure chunk I/O: cache blocks
+never need directories, sessions, or file lengths, so the meta service can
+stay out of the hot path entirely (the same zero-metadata placement argument
+as file striping, docs/design_notes.md:57-59).
+
+Design:
+
+- A **namespace** owns a slice of the 128-bit ChunkId space:
+  ``inode = (1<<63) | blake2b-63(namespace)`` (the high bit keeps it disjoint
+  from meta-allocated inode ids, which grow from 1), and each cache key maps
+  to ``index = blake2b-64(key)``.  Chain placement is ``hash(key)`` over the
+  namespace's chain list — clients compute placement with zero metadata
+  involvement.
+- **Blocks are self-describing**: [magic u32 | key_len u32 | value_len u32 |
+  key | value].  A 64-bit index collision between two live keys makes the
+  newer block win (cache-eviction semantics); `get` verifies the stored key
+  and reports a clean miss on mismatch, never wrong bytes.
+- **put** is one CRAQ chunk write (exactly-once via client channels);
+  **get_many** is one `batch_read` fan-out grouped by serving node — the
+  high-IOPS random-read path (BASELINE config #5); **remove_many** issues
+  REMOVE updates through the same chains — the GC removal-IOPS path.
+- **Prefix caching** (the LLM-serving access pattern): block keys form a
+  rolling hash chain over token blocks, ``h_i = H(h_{i-1} || tokens_i)``, so
+  a shared prompt prefix yields shared keys regardless of what follows.
+  `longest_prefix` probes the whole chain with a single batched read.
+
+Bench: ``benchmarks/kvcache_bench.py`` (get IOPS + GC removal IOPS).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import struct
+from dataclasses import dataclass
+
+from t3fs.client.storage_client import StorageClient
+from t3fs.storage.types import ChunkId, ReadIO, UpdateType
+from t3fs.utils.status import Status, StatusCode, StatusError, make_error
+
+_MAGIC = 0x7C3F5CAB
+_HDR = struct.Struct("<III")
+
+
+def _h64(data: bytes, *, person: bytes) -> int:
+    return int.from_bytes(
+        hashlib.blake2b(data, digest_size=8, person=person).digest(), "big")
+
+
+def _pack_block(key: bytes, value: bytes) -> bytes:
+    return _HDR.pack(_MAGIC, len(key), len(value)) + key + value
+
+
+def _unpack_block(blob: bytes, key: bytes) -> bytes | None:
+    """Return the value iff the block is intact and stores `key`."""
+    if len(blob) < _HDR.size:
+        return None
+    magic, klen, vlen = _HDR.unpack_from(blob)
+    if magic != _MAGIC or len(blob) < _HDR.size + klen + vlen:
+        return None
+    if blob[_HDR.size:_HDR.size + klen] != key:
+        return None  # index collision: another key lives here
+    off = _HDR.size + klen
+    return bytes(blob[off:off + vlen])
+
+
+@dataclass
+class KVCacheConfig:
+    block_size: int = 64 << 10        # chunk allocation class for blocks
+    gc_concurrency: int = 64          # parallel REMOVEs in remove_many
+
+
+class KVCacheStore:
+    """One cache namespace over a set of chains.
+
+    `chains` is the namespace's placement domain (typically a chain table's
+    chains).  All methods are safe to call concurrently.
+    """
+
+    def __init__(self, client: StorageClient, chains: list[int],
+                 namespace: str = "default",
+                 config: KVCacheConfig | None = None):
+        if not chains:
+            raise make_error(StatusCode.INVALID_ARG, "empty chain list")
+        self.client = client
+        self.chains = list(chains)
+        self.cfg = config or KVCacheConfig()
+        self.namespace = namespace
+        self.inode = (1 << 63) | _h64(namespace.encode(), person=b"t3fs-ns")
+
+    # --- placement ---
+
+    def locate(self, key: bytes) -> tuple[int, ChunkId]:
+        idx = _h64(key, person=b"t3fs-key")
+        chain = self.chains[_h64(key, person=b"t3fs-chn") % len(self.chains)]
+        return chain, ChunkId(self.inode, idx)
+
+    # --- data path ---
+
+    async def put(self, key: bytes, value: bytes) -> None:
+        blob = _pack_block(key, value)
+        if len(blob) > self.cfg.block_size:
+            raise make_error(
+                StatusCode.INVALID_ARG,
+                f"block {len(blob)}B exceeds block_size {self.cfg.block_size}")
+        chain, cid = self.locate(key)
+        result = await self.client.write_chunk(
+            chain, cid, 0, blob, self.cfg.block_size)
+        st = Status(StatusCode(result.status.code), result.status.message)
+        if not st.ok:
+            raise StatusError(st.code, st.message)
+
+    async def get(self, key: bytes) -> bytes | None:
+        values = await self.get_many([key])
+        return values[0]
+
+    async def get_many(self, keys: list[bytes]) -> list[bytes | None]:
+        """One batched read across all keys; None = miss (absent, collided,
+        or torn block — never wrong bytes)."""
+        ios = []
+        for key in keys:
+            chain, cid = self.locate(key)
+            ios.append(ReadIO(chunk_id=cid, chain_id=chain, offset=0,
+                              length=0,
+                              verify_checksum=self.client.cfg.verify_checksums))
+        results, payloads = await self.client.batch_read(ios)
+        out: list[bytes | None] = []
+        for key, result, payload in zip(keys, results, payloads):
+            if result.status.code != int(StatusCode.OK):
+                out.append(None)
+            else:
+                out.append(_unpack_block(payload, key))
+        return out
+
+    async def remove_many(self, keys: list[bytes]) -> int:
+        """GC path: REMOVE each key's block via its chain head (removing an
+        absent block is acked like the reference's idempotent removes).
+        Returns the number of acknowledged removals; the first hard error
+        raises.  Bounded-concurrent."""
+        sem = asyncio.Semaphore(self.cfg.gc_concurrency)
+        removed = 0
+
+        async def one(key: bytes) -> None:
+            nonlocal removed
+            chain, cid = self.locate(key)
+            async with sem:
+                result = await self.client.write_chunk(
+                    chain, cid, 0, b"", self.cfg.block_size,
+                    update_type=UpdateType.REMOVE)
+            code = StatusCode(result.status.code)
+            if code in (StatusCode.OK, StatusCode.CHUNK_NOT_FOUND):
+                removed += 1
+            else:
+                raise StatusError(code, result.status.message)
+
+        # return_exceptions so a failing chain doesn't leave the other
+        # in-flight REMOVE tasks running detached; first error raises after
+        # every task has settled
+        settled = await asyncio.gather(*(one(k) for k in keys),
+                                       return_exceptions=True)
+        for r in settled:
+            if isinstance(r, BaseException):
+                raise r
+        return removed
+
+    # --- LLM prefix-caching helpers ---
+
+    @staticmethod
+    def prefix_keys(model_tag: str, token_blocks: list[bytes]) -> list[bytes]:
+        """Rolling-hash chain over token blocks: key_i commits to the model
+        tag and ALL tokens up to block i, so equal prompt prefixes produce
+        equal keys and any divergence changes every later key."""
+        keys = []
+        h = hashlib.blake2b(model_tag.encode(), digest_size=16,
+                            person=b"t3fs-pfx").digest()
+        for block in token_blocks:
+            h = hashlib.blake2b(h + block, digest_size=16,
+                                person=b"t3fs-pfx").digest()
+            keys.append(h)
+        return keys
+
+    async def longest_prefix(self, model_tag: str,
+                             token_blocks: list[bytes]
+                             ) -> tuple[int, list[bytes]]:
+        """(number of leading cached blocks, their values) — one batched
+        read for the entire chain."""
+        keys = self.prefix_keys(model_tag, token_blocks)
+        values = await self.get_many(keys)
+        out: list[bytes] = []
+        for v in values:
+            if v is None:
+                break
+            out.append(v)
+        return len(out), out

@@ -1,0 +1,118 @@
+"""KVCache store over the fabric: put/get_many/remove_many + prefix chain
+(reference analog: the KVCache workload, README.md:45-51)."""
+
+import asyncio
+
+import pytest
+
+from t3fs.client.storage_client import StorageClient
+from t3fs.lib.kvcache import KVCacheStore, _pack_block, _unpack_block
+from t3fs.testing.fabric import StorageFabric
+from t3fs.utils.status import StatusError
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def test_block_codec_self_describing():
+    blob = _pack_block(b"key", b"value")
+    assert _unpack_block(blob, b"key") == b"value"
+    assert _unpack_block(blob, b"other") is None          # collision -> miss
+    assert _unpack_block(blob[:-1], b"key") is None       # torn -> miss
+    assert _unpack_block(b"", b"key") is None
+    # trailing garbage from a longer previous block is ignored
+    assert _unpack_block(blob + b"\xff" * 16, b"key") == b"value"
+
+
+def test_placement_stable_and_namespaced():
+    sc = object.__new__(StorageClient)  # placement only; no I/O
+    a = KVCacheStore.__new__(KVCacheStore)
+    KVCacheStore.__init__(a, sc, chains=[1, 2, 3], namespace="a")
+    b = KVCacheStore.__new__(KVCacheStore)
+    KVCacheStore.__init__(b, sc, chains=[1, 2, 3], namespace="b")
+    ch1, cid1 = a.locate(b"k")
+    ch2, cid2 = a.locate(b"k")
+    assert (ch1, cid1) == (ch2, cid2)          # deterministic across calls
+    assert a.inode != b.inode                  # namespaces are disjoint
+    assert a.inode >> 63 == 1                  # clear of meta inode space
+
+
+def test_put_get_remove_roundtrip():
+    async def body():
+        fab = StorageFabric(num_nodes=3, replicas=3)
+        await fab.start()
+        try:
+            sc = StorageClient(lambda: fab.routing, client=fab.client)
+            kv = KVCacheStore(sc, chains=[fab.chain_id], namespace="t")
+            keys = [f"blk-{i}".encode() for i in range(24)]
+            vals = [bytes([i]) * (512 + 64 * i) for i in range(24)]
+            await asyncio.gather(*(kv.put(k, v) for k, v in zip(keys, vals)))
+
+            got = await kv.get_many(keys)
+            assert got == vals
+            assert await kv.get(b"absent") is None
+
+            # overwrite with a SHORTER value must not leak old bytes
+            await kv.put(keys[0], b"short")
+            assert await kv.get(keys[0]) == b"short"
+
+            n = await kv.remove_many(keys[:10])
+            assert n == 10
+            got = await kv.get_many(keys)
+            assert got[:10] == [None] * 10 and got[10:] == vals[10:]
+            # idempotent GC: re-removing acks
+            assert await kv.remove_many(keys[:10]) == 10
+        finally:
+            await fab.stop()
+    run(body())
+
+
+def test_block_size_enforced():
+    async def body():
+        fab = StorageFabric(num_nodes=1, replicas=1)
+        await fab.start()
+        try:
+            sc = StorageClient(lambda: fab.routing, client=fab.client)
+            from t3fs.lib.kvcache import KVCacheConfig
+            kv = KVCacheStore(sc, chains=[fab.chain_id],
+                              config=KVCacheConfig(block_size=1024))
+            with pytest.raises(StatusError):
+                await kv.put(b"k", b"x" * 2048)
+        finally:
+            await fab.stop()
+    run(body())
+
+
+def test_prefix_chain_semantics():
+    blocks_a = [b"tok0", b"tok1", b"tok2"]
+    blocks_b = [b"tok0", b"tok1", b"DIVERGES"]
+    ka = KVCacheStore.prefix_keys("model-x", blocks_a)
+    kb = KVCacheStore.prefix_keys("model-x", blocks_b)
+    assert ka[:2] == kb[:2]            # shared prefix -> shared keys
+    assert ka[2] != kb[2]              # divergence changes later keys
+    assert KVCacheStore.prefix_keys("model-y", blocks_a)[0] != ka[0]
+
+
+def test_longest_prefix_batched_probe():
+    async def body():
+        fab = StorageFabric(num_nodes=3, replicas=2)
+        await fab.start()
+        try:
+            sc = StorageClient(lambda: fab.routing, client=fab.client)
+            kv = KVCacheStore(sc, chains=[fab.chain_id], namespace="pfx")
+            blocks = [f"tokens-{i}".encode() for i in range(6)]
+            keys = kv.prefix_keys("m", blocks)
+            # cache the first 4 blocks' KV state
+            for i in range(4):
+                await kv.put(keys[i], f"kvstate-{i}".encode())
+            n, values = await kv.longest_prefix("m", blocks)
+            assert n == 4
+            assert values == [f"kvstate-{i}".encode() for i in range(4)]
+            # a hole breaks the prefix even if later blocks exist
+            await kv.remove_many([keys[1]])
+            n, values = await kv.longest_prefix("m", blocks)
+            assert n == 1 and values == [b"kvstate-0"]
+        finally:
+            await fab.stop()
+    run(body())
