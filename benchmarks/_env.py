@@ -1,0 +1,30 @@
+"""Shared bench bootstrap: in-process fabric (default) or live cluster.
+
+All benches (`storage_bench`, `kvcache_bench`, `sort_bench`) build their
+environment here so client-setup fixes land in one place.  Returns
+(env, sc, chains): `env` has an async `stop()`, `sc` is a ready
+StorageClient, `chains` the usable chain ids.
+"""
+
+from __future__ import annotations
+
+from t3fs.client.storage_client import StorageClient, StorageClientConfig
+
+
+async def make_env(args, config: StorageClientConfig | None = None):
+    config = config or StorageClientConfig()
+    if getattr(args, "mgmtd", ""):
+        from t3fs.client.mgmtd_client import MgmtdClient
+        mg = MgmtdClient(args.mgmtd, refresh_period_s=0.5)
+        await mg.start()
+        sc = StorageClient(mg.routing, refresh_routing=mg.refresh,
+                           config=config)
+        return mg, sc, sorted(mg.routing().chains)
+    from t3fs.testing.fabric import StorageFabric
+    fab = StorageFabric(
+        num_nodes=args.nodes, replicas=args.replicas,
+        checksum_backend=getattr(args, "checksum_backend", None),
+        aio_read=not getattr(args, "no_aio", False))
+    await fab.start()
+    sc = StorageClient(lambda: fab.routing, client=fab.client, config=config)
+    return fab, sc, [fab.chain_id]

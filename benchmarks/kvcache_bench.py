@@ -17,33 +17,13 @@ import random
 import sys
 import time
 
-from t3fs.client.storage_client import StorageClient, StorageClientConfig
 from t3fs.lib.kvcache import KVCacheConfig, KVCacheStore
 from t3fs.utils.metrics import LatencyRecorder
 
 
-async def _mk_local(args):
-    from t3fs.testing.fabric import StorageFabric
-    fab = StorageFabric(num_nodes=args.nodes, replicas=args.replicas,
-                        aio_read=not args.no_aio)
-    await fab.start()
-    sc = StorageClient(lambda: fab.routing, client=fab.client,
-                       config=StorageClientConfig())
-    return fab, sc, [fab.chain_id]
-
-
-async def _mk_remote(args):
-    from t3fs.client.mgmtd_client import MgmtdClient
-    mg = MgmtdClient(args.mgmtd, refresh_period_s=0.5)
-    await mg.start()
-    sc = StorageClient(mg.routing, refresh_routing=mg.refresh,
-                       config=StorageClientConfig())
-    return mg, sc, sorted(mg.routing().chains)
-
-
 async def run_bench(args) -> dict:
-    env, sc, chains = await (_mk_remote(args) if args.mgmtd
-                             else _mk_local(args))
+    from benchmarks._env import make_env
+    env, sc, chains = await make_env(args)
     block_cap = 1 << (args.value_size + 256 - 1).bit_length()
     kv = KVCacheStore(sc, chains, namespace=f"bench-{args.seed}",
                       config=KVCacheConfig(block_size=block_cap,

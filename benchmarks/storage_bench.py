@@ -27,43 +27,13 @@ from t3fs.utils.metrics import LatencyRecorder
 BENCH_INODE = 0xBE7C
 
 
-async def _mk_local(args):
-    from t3fs.testing.fabric import StorageFabric
-    from t3fs.utils.fault_injection import DebugFlags
-    fab = StorageFabric(num_nodes=args.nodes, replicas=args.replicas,
-                        checksum_backend=args.checksum_backend,
-                        aio_read=not args.no_aio)
-    await fab.start()
-    sc = StorageClient(
-        lambda: fab.routing, client=fab.client,
-        config=StorageClientConfig(
-            verify_checksums=args.verify_checksums,
-            debug=DebugFlags(
-                inject_server_error_prob=args.inject_server_error),
-        ))
-    return fab, sc, fab.chain_id
-
-
-async def _mk_remote(args):
-    from t3fs.client.mgmtd_client import MgmtdClient
-    from t3fs.utils.fault_injection import DebugFlags
-    mg = MgmtdClient(args.mgmtd, refresh_period_s=0.5)
-    await mg.start()
-    sc = StorageClient(
-        mg.routing, refresh_routing=mg.refresh,
-        config=StorageClientConfig(
-            verify_checksums=args.verify_checksums,
-            debug=DebugFlags(
-                inject_server_error_prob=args.inject_server_error),
-        ))
-    routing = mg.routing()
-    chain_id = sorted(routing.chains)[0]
-    return mg, sc, chain_id
-
-
 async def run_bench(args) -> dict:
-    env, sc, chain_id = await (_mk_remote(args) if args.mgmtd
-                               else _mk_local(args))
+    from benchmarks._env import make_env
+    from t3fs.utils.fault_injection import DebugFlags
+    env, sc, chains = await make_env(args, StorageClientConfig(
+        verify_checksums=args.verify_checksums,
+        debug=DebugFlags(inject_server_error_prob=args.inject_server_error)))
+    chain_id = chains[0]
     lat = LatencyRecorder("bench.op")
     stop_at = 0.0  # set after warmup, just before the timed phase
     counters = {"ops": 0, "bytes": 0, "errors": 0}

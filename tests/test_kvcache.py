@@ -27,10 +27,8 @@ def test_block_codec_self_describing():
 
 def test_placement_stable_and_namespaced():
     sc = object.__new__(StorageClient)  # placement only; no I/O
-    a = KVCacheStore.__new__(KVCacheStore)
-    KVCacheStore.__init__(a, sc, chains=[1, 2, 3], namespace="a")
-    b = KVCacheStore.__new__(KVCacheStore)
-    KVCacheStore.__init__(b, sc, chains=[1, 2, 3], namespace="b")
+    a = KVCacheStore(sc, chains=[1, 2, 3], namespace="a")
+    b = KVCacheStore(sc, chains=[1, 2, 3], namespace="b")
     ch1, cid1 = a.locate(b"k")
     ch2, cid2 = a.locate(b"k")
     assert (ch1, cid1) == (ch2, cid2)          # deterministic across calls
