@@ -1,0 +1,169 @@
+"""EC recovery bench — BASELINE config #4: RS(8+2) stripe writes, degraded
+reads after a node loss, and shard repair (reconstruct + write back).
+
+The reference has no EC data path (SURVEY header note) — its config-#4
+analog is plain replica resync (src/storage/sync/ResyncWorker.cc:101-389).
+t3fs's EC client makes recovery a *decode*: parity masks a lost node at
+read time, and `repair_chunk` rebuilds the lost shards from the survivors.
+
+Phases (all timed separately, MB/s of logical stripe data):
+  write     — RS(8+2)-encoded stripe writes across single-replica chains
+  degraded  — full-stripe reads with one node fail-stopped (reconstruction
+              masks its shards on the fly)
+  repair    — reconstruct the dead node's shards and re-write them to the
+              recovered chains (the resync-with-decode path)
+
+    python -m benchmarks.ec_recovery_bench --stripes 24 --json
+    (--device runs RS on the accelerator; default numpy keeps the bench
+     honest on machines where the chip is tunneled/absent)
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+import time
+
+import numpy as np
+
+from t3fs.client.ec_client import ECLayout, ECStorageClient
+from t3fs.testing.cluster import LocalCluster
+from t3fs.utils.status import StatusCode
+
+
+async def run_bench(args) -> dict:
+    k, m = args.k, args.m
+    num_chains = k + m
+    # one chain per shard slot, single replica: parity replaces replication
+    cluster = LocalCluster(num_nodes=args.nodes, replicas=1,
+                           num_chains=num_chains, heartbeat_timeout_s=0.6)
+    await cluster.start()
+    try:
+        return await _run(args, cluster, k, m, num_chains)
+    finally:
+        await cluster.stop()
+
+
+async def _run(args, cluster: LocalCluster, k: int, m: int,
+               num_chains: int) -> dict:
+    lay = ECLayout.create(k=k, m=m, chunk_size=args.chunk_size,
+                          chains=list(range(1, num_chains + 1)))
+    ec = ECStorageClient(cluster.sc, use_device_codec=args.device)
+    stripe_len = k * args.chunk_size
+    rng = np.random.default_rng(11)
+    payloads = [rng.integers(0, 256, stripe_len, dtype=np.uint8).tobytes()
+                for _ in range(4)]
+    inode = 0xEC0
+    total = args.stripes * stripe_len
+
+    # --- write ---
+    t0 = time.perf_counter()
+    for s0 in range(0, args.stripes, args.concurrency):
+        batch = range(s0, min(s0 + args.concurrency, args.stripes))
+        res = await asyncio.gather(*(
+            ec.write_stripe(lay, inode, s, payloads[s % len(payloads)])
+            for s in batch))
+        for rs_ in res:
+            assert all(r.status.code == int(StatusCode.OK) for r in rs_)
+    t_write = time.perf_counter() - t0
+
+    # --- fail-stop one node; wait for chains to notice ---
+    victim = args.nodes  # last node
+    lost_chains = [c.chain_id for c in
+                   cluster.mgmtd.state.routing().chains.values()
+                   if any(t.node_id == victim for t in c.targets)]
+    await cluster.kill_storage_node(victim)
+    for _ in range(200):
+        routing = cluster.mgmtd.state.routing()
+        if all(routing.chains[c].chain_ver >= 2 for c in lost_chains):
+            break
+        await asyncio.sleep(0.05)
+    await cluster.mgmtd_client.refresh()
+
+    # --- degraded reads (reconstruction masks the dead node's shards) ---
+    t0 = time.perf_counter()
+    for s0 in range(0, args.stripes, args.concurrency):
+        batch = range(s0, min(s0 + args.concurrency, args.stripes))
+        datas = await asyncio.gather(*(
+            ec.read_stripe(lay, inode, s, stripe_len) for s in batch))
+        for s, d in zip(batch, datas):
+            assert d == payloads[s % len(payloads)], f"stripe {s} corrupt"
+    t_degraded = time.perf_counter() - t0
+
+    # --- repair: rebuild the dead node's shards onto the (restarted)
+    # chains.  Restart the node empty: chains walk back to SERVING and the
+    # repair writes land on the fresh target — simulated chunk loss. ---
+    import shutil
+    shutil.rmtree(cluster.node_root(victim), ignore_errors=True)
+    await cluster.start_storage_node(victim)
+    for _ in range(300):
+        routing = cluster.mgmtd.state.routing()
+        if all(routing.chains[c].head() is not None for c in lost_chains):
+            break
+        await asyncio.sleep(0.05)
+    await cluster.mgmtd_client.refresh()
+
+    stripe_losses = {
+        s: tuple(j for j in range(k + m)
+                 if lay.shard_chain(s, j) in lost_chains)
+        for s in range(args.stripes)}
+    n_shards = sum(len(v) for v in stripe_losses.values())
+    t0 = time.perf_counter()
+    sem = asyncio.Semaphore(args.concurrency)
+
+    async def repair(s: int, shards: tuple[int, ...]) -> None:
+        async with sem:
+            res = await ec.repair_stripe(lay, inode, s, shards,
+                                         stripe_len=stripe_len)
+            assert all(r.status.code == int(StatusCode.OK) for r in res)
+    await asyncio.gather(*(repair(s, v) for s, v in stripe_losses.items()
+                           if v))
+    t_repair = time.perf_counter() - t0
+    repaired_bytes = n_shards * args.chunk_size
+
+    # --- full (non-degraded) read-back proves the repair ---
+    for s in range(args.stripes):
+        d = await ec.read_stripe(lay, inode, s, stripe_len)
+        assert d == payloads[s % len(payloads)], f"post-repair stripe {s}"
+
+    return {
+        "k": k, "m": m, "chunk_size": args.chunk_size,
+        "stripes": args.stripes, "bytes": total,
+        "codec": "device" if args.device else "numpy",
+        "write_MB_s": round(total / t_write / 1e6, 2),
+        "degraded_read_MB_s": round(total / t_degraded / 1e6, 2),
+        "repaired_shards": n_shards,
+        "repair_MB_s": round(repaired_bytes / t_repair / 1e6, 2),
+        "verified": True,
+    }
+
+
+def parse_args(argv=None):
+    ap = argparse.ArgumentParser(prog="ec_recovery_bench")
+    ap.add_argument("--nodes", type=int, default=5)
+    ap.add_argument("--k", type=int, default=8)
+    ap.add_argument("--m", type=int, default=2)
+    ap.add_argument("--chunk-size", type=int, default=256 << 10)
+    ap.add_argument("--stripes", type=int, default=16)
+    ap.add_argument("--concurrency", type=int, default=4)
+    ap.add_argument("--device", action="store_true",
+                    help="RS encode/decode on the accelerator")
+    ap.add_argument("--json", action="store_true")
+    return ap.parse_args(argv)
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+    result = asyncio.run(run_bench(args))
+    if args.json:
+        print(json.dumps(result))
+    else:
+        for kk, v in result.items():
+            print(f"{kk:>20}: {v}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

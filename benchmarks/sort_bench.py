@@ -77,8 +77,8 @@ async def _cleanup_job_files(args, sc: StorageClient,
               + [RUN_INODE + (w << 16 | p) for w in range(args.workers)
                  for p in range(args.partitions)]
               + [OUT_INODE + p for p in range(args.partitions)])
-    for inode in inodes:
-        await sc.remove_file_chunks(lay, inode)
+    await asyncio.gather(*(sc.remove_file_chunks(lay, inode)
+                           for inode in inodes))
 
 
 async def _run_job(args, sc: StorageClient, chains: list[int]) -> dict:

@@ -65,10 +65,11 @@ def args_(*specs):
 
 class AdminContext:
     def __init__(self, mgmtd: str, meta: str = "", monitor: str = "",
-                 token: str = ""):
+                 token: str = "", migration: str = ""):
         self.mgmtd_address = mgmtd
         self.meta_address = meta
         self.monitor_address = monitor
+        self.migration_address = migration
         self.token = token
         self.cli = Client()
         self._mgmtd_client: MgmtdClient | None = None
@@ -197,6 +198,42 @@ async def set_preferred_order(ctx: AdminContext, args) -> None:
         ctx.mgmtd_address, "Mgmtd.set_preferred_target_order",
         ChainOpReq(chain_id=args.chain_id, order=list(args.order)))
     _print_chain(rsp.chain)
+
+
+@command("migrate", "move a target to another node (migration service job)")
+@args_(("chain_id", {"type": int}), ("src_target_id", {"type": int}),
+       ("dst_target_id", {"type": int}), ("dst_node_id", {"type": int}),
+       ("dst_root", {}),
+       )
+async def migrate(ctx: AdminContext, args) -> None:
+    if not ctx.migration_address:
+        raise StatusError(StatusCode.INVALID_ARG,
+                          "--migration <addr> required")
+    from t3fs.migration.service import SubmitMigrationReq
+    rsp, _ = await ctx.cli.call(
+        ctx.migration_address, "Migration.submit",
+        SubmitMigrationReq(chain_id=args.chain_id,
+                           src_target_id=args.src_target_id,
+                           dst_target_id=args.dst_target_id,
+                           dst_node_id=args.dst_node_id,
+                           dst_root=args.dst_root))
+    print(f"job {rsp.job_id} submitted")
+
+
+@command("migrate-status", "list migration jobs and their states")
+async def migrate_status(ctx: AdminContext, args) -> None:
+    if not ctx.migration_address:
+        raise StatusError(StatusCode.INVALID_ARG,
+                          "--migration <addr> required")
+    import t3fs.migration.service  # noqa: F401  (registers serde structs)
+    rsp, _ = await ctx.cli.call(ctx.migration_address, "Migration.status",
+                                None)
+    if not rsp.jobs:
+        print("no jobs")
+    for j in rsp.jobs:
+        print(f"job {j.job_id}: chain {j.chain_id} "
+              f"{j.src_target_id}->{j.dst_target_id}@{j.dst_node_id} "
+              f"state={j.state} error={j.error!r}")
 
 
 @command("rotate-preferred", "one rotation step toward the preferred order")
@@ -714,6 +751,8 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--mgmtd", default="127.0.0.1:9000")
     ap.add_argument("--meta", default="")
     ap.add_argument("--monitor", default="")
+    ap.add_argument("--migration", default="",
+                    help="migration service address (migrate commands)")
     ap.add_argument("--token", default="")
     sub = ap.add_subparsers(dest="command")
     for name, (configure, _fn, help_) in sorted(COMMANDS.items()):
@@ -767,7 +806,8 @@ async def repl(ctx: AdminContext, parser: argparse.ArgumentParser) -> None:
 def main(argv: list[str] | None = None) -> int:
     parser = build_parser()
     args = parser.parse_args(argv)
-    ctx = AdminContext(args.mgmtd, args.meta, args.monitor, args.token)
+    ctx = AdminContext(args.mgmtd, args.meta, args.monitor, args.token,
+                       migration=args.migration)
 
     async def run():
         try:

@@ -110,6 +110,15 @@ class ECStorageClient:
         fast.cfg.retry_backoff_s = min(sc.cfg.retry_backoff_s, 0.03)
         return fast
 
+    def _routed_out(self, chain_id: int) -> bool:
+        """True when CURRENT routing shows no serving target for the chain:
+        a read could only burn its whole retry/backoff budget, so degraded
+        paths count the shard as lost immediately.  A stale verdict is safe
+        — the patient wave in _reconstruct_shards re-reads want-shards
+        directly and recovers them without decoding."""
+        chain = self.sc.routing().chain(chain_id)
+        return chain is None or not chain.serving()
+
     # --- codec (TPU path by default; numpy oracle as fallback) ---
 
     async def _encode(self, data_shards: np.ndarray, k: int, m: int) -> np.ndarray:
@@ -184,22 +193,25 @@ class ECStorageClient:
         from surviving shards (the EC-decode recovery path, BASELINE #4)."""
         k, m, cs = layout.k, layout.m, layout.chunk_size
         lens = [max(0, min(cs, stripe_len - j * cs)) for j in range(k)]
-        ios = [ReadIO(chunk_id=layout.data_chunk(inode, stripe, j),
-                      chain_id=layout.shard_chain(stripe, j))
-               for j in range(k) if lens[j]]
-        results, payloads = await self._fast.batch_read(ios)
         chunks: dict[int, bytes] = {}
         missing: list[int] = []
-        pos = 0
+        ios, idxs = [], []
         for j in range(k):
             if not lens[j]:
                 continue
-            r, p = results[pos], payloads[pos]
-            pos += 1
+            if self._routed_out(layout.shard_chain(stripe, j)):
+                missing.append(j)     # fast-fail: no serving target routed
+                continue
+            ios.append(ReadIO(chunk_id=layout.data_chunk(inode, stripe, j),
+                              chain_id=layout.shard_chain(stripe, j)))
+            idxs.append(j)
+        results, payloads = await self._fast.batch_read(ios)
+        for j, r, p in zip(idxs, results, payloads):
             if r.status.code == int(StatusCode.OK):
                 chunks[j] = p
             else:
                 missing.append(j)
+        missing.sort()
         if missing:
             zero_shards = frozenset(j for j in range(k) if lens[j] == 0)
             rec = await self._reconstruct_shards(layout, inode, stripe,
@@ -238,6 +250,8 @@ class ECStorageClient:
             if s in zero_shards:
                 have[s] = np.zeros(cs, dtype=np.uint8)
                 continue
+            if self._routed_out(layout.shard_chain(stripe, s)):
+                continue              # fast-fail; patient wave may still try
             cid = (layout.data_chunk(inode, stripe, s) if s < k
                    else layout.parity_chunk(inode, stripe, s - k))
             ios.append(ReadIO(chunk_id=cid,
@@ -298,14 +312,29 @@ class ECStorageClient:
         (target-resync EC recovery, BASELINE config #4).  stripe_len is the
         stripe's true data length — it determines which shards are legitimate
         zero holes vs genuinely lost."""
+        return (await self.repair_stripe(layout, inode, stripe, (shard,),
+                                         stripe_len))[0]
+
+    async def repair_stripe(self, layout: ECLayout, inode: int, stripe: int,
+                            shards: tuple[int, ...], stripe_len: int
+                            ) -> list[IOResult]:
+        """Repair ALL of a stripe's lost shards in one pass: survivors are
+        read once and one decode produces every wanted shard (repairing a
+        double loss shard-by-shard would read the k survivors twice and
+        decode twice — the per-stripe batch halves recovery traffic, which
+        is the quantity the BIBD placement solver balances)."""
         k, cs = layout.k, layout.chunk_size
         lens = [max(0, min(cs, stripe_len - j * cs)) for j in range(k)]
         zero_shards = frozenset(j for j in range(k) if lens[j] == 0)
-        rec = await self._reconstruct_shards(layout, inode, stripe, (shard,),
-                                             zero_shards)
-        cid = (layout.data_chunk(inode, stripe, shard) if shard < k
-               else layout.parity_chunk(inode, stripe, shard - k))
-        content = rec[0][: lens[shard]] if shard < k else rec[0]
-        return await self.sc.write_chunk(
-            layout.shard_chain(stripe, shard), cid, 0, bytes(content),
-            chunk_size=cs, update_type=UpdateType.REPLACE)
+        rec = await self._reconstruct_shards(layout, inode, stripe,
+                                             tuple(shards), zero_shards)
+        async def write_back(shard: int, content: bytes) -> IOResult:
+            cid = (layout.data_chunk(inode, stripe, shard) if shard < k
+                   else layout.parity_chunk(inode, stripe, shard - k))
+            if shard < k:
+                content = content[: lens[shard]]
+            return await self.sc.write_chunk(
+                layout.shard_chain(stripe, shard), cid, 0, bytes(content),
+                chunk_size=cs, update_type=UpdateType.REPLACE)
+        return list(await asyncio.gather(
+            *(write_back(s, c) for s, c in zip(shards, rec))))

@@ -6,6 +6,17 @@ reference's bounding of the ReliableUpdate channel map via client-session
 expiry (src/mgmtd/background/MgmtdClientSessionsChecker.h).  Round-1 t3fs
 grew both the per-chunk lock dict and the update-channel session map without
 bound (VERDICT weak #6); these two classes are the fix.
+
+Queues/pools decision (src/common/utils/{BoundedQueue,MPSCQueue,
+WorkStealingBlockingQueue,CoroutinesPool,ObjectPool}.h): those exist because
+folly coroutines need explicit executors and hand-built backpressure.  Under
+asyncio the same roles are primitives — asyncio.Queue(maxsize) IS the
+bounded MPSC queue, Semaphore-bounded gather IS the coroutine pool,
+run_in_executor pools ARE the worker pools (see storage/service.py write
+offload), and the registered BufferPool (net/rdma.py) is the one object
+pool whose reuse discipline actually matters.  Re-wrapping the primitives
+would add indirection, not capability; no further queue/pool layer is
+built, deliberately.
 """
 
 from __future__ import annotations

@@ -6,6 +6,9 @@ from its node to a fresh node with zero write-path interruption.
 """
 
 import asyncio
+import os
+import subprocess
+import sys
 
 from t3fs.client.layout import FileLayout
 from t3fs.mgmtd.types import PublicTargetState
@@ -13,6 +16,18 @@ from t3fs.migration.service import MigrationService, SubmitMigrationReq
 from t3fs.net.server import Server
 from t3fs.testing.cluster import LocalCluster
 from t3fs.utils.status import StatusCode
+
+
+def _run_cli_migrate_status(migration_address: str) -> str:
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = subprocess.run(
+        [sys.executable, "-m", "t3fs.cli.admin",
+         "--migration", migration_address, "migrate-status"],
+        capture_output=True, text=True, timeout=60,
+        env={**os.environ, "PYTHONPATH": os.pathsep.join(
+            filter(None, [repo, os.environ.get("PYTHONPATH", "")]))})
+    assert out.returncode == 0, out.stderr
+    return out.stdout
 
 
 def test_live_target_migration():
@@ -64,6 +79,11 @@ def test_live_target_migration():
             # the migrated replica physically holds the chunks
             eng = cluster.storage[4].node.targets[dst_target].engine
             assert len(eng.all_metas()) > 0
+
+            # operator surface: admin CLI lists the finished job
+            out = await asyncio.to_thread(_run_cli_migrate_status,
+                                          srv.address)
+            assert f"job {job_id}" in out and "state=done" in out
 
             await mig.stop()
             await srv.stop()
