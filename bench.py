@@ -19,11 +19,37 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 """
 
 import json
+import os
 import sys
+import threading
 
 import numpy as np
 
 LINE_RATE_GBPS = 50.0  # 2 x 200 Gbps = 50 GB/s per storage node
+
+# The tunneled chip has been observed to wedge so hard that jax.devices()
+# blocks forever (no exception).  The driver needs ONE JSON line no matter
+# what, so a watchdog emits the failure record and hard-exits if the bench
+# hasn't finished in time (normal runs: compile ~40s + 4 sampling groups
+# with 10s sleeps ~= 3-6 min).
+WATCHDOG_S = int(os.environ.get("T3FS_BENCH_WATCHDOG_S", "1500"))
+
+
+def _arm_watchdog() -> None:
+    def fire():
+        print(json.dumps({
+            "metric": "rs8+2_crc32c_stripe_encode",
+            "value": 0.0,
+            "unit": "GB/s/chip",
+            "vs_baseline": 0.0,
+            "error": f"watchdog: no result after {WATCHDOG_S}s "
+                     "(tunneled TPU unreachable/hung; jax.devices() "
+                     "can block indefinitely in this state)",
+        }), flush=True)
+        os._exit(0)
+    t = threading.Timer(WATCHDOG_S, fire)
+    t.daemon = True
+    t.start()
 
 K, M = 8, 2
 CHUNK_LEN = 1 << 20          # 1 MiB shards -> 8 MiB data per stripe
@@ -36,6 +62,7 @@ REPS = 6                      # paired reps per sampling group
 
 
 def main() -> None:
+    _arm_watchdog()
     import jax
     import jax.numpy as jnp
 

@@ -80,6 +80,9 @@ async def _run(args, cluster: LocalCluster, k: int, m: int,
         if all(routing.chains[c].chain_ver >= 2 for c in lost_chains):
             break
         await asyncio.sleep(0.05)
+    else:
+        raise TimeoutError("chains never noticed the node kill — the "
+                           "'degraded' phase would measure stale routing")
     await cluster.mgmtd_client.refresh()
 
     # --- degraded reads (reconstruction masks the dead node's shards) ---
@@ -103,6 +106,9 @@ async def _run(args, cluster: LocalCluster, k: int, m: int,
         if all(routing.chains[c].head() is not None for c in lost_chains):
             break
         await asyncio.sleep(0.05)
+    else:
+        raise TimeoutError("restarted node's chains never returned to "
+                           "service — repair phase has nowhere to write")
     await cluster.mgmtd_client.refresh()
 
     stripe_losses = {

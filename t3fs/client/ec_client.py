@@ -326,8 +326,14 @@ class ECStorageClient:
         k, cs = layout.k, layout.chunk_size
         lens = [max(0, min(cs, stripe_len - j * cs)) for j in range(k)]
         zero_shards = frozenset(j for j in range(k) if lens[j] == 0)
-        rec = await self._reconstruct_shards(layout, inode, stripe,
-                                             tuple(shards), zero_shards)
+        # zero-hole data shards are never materialized — absent == zeros is
+        # the decode contract write_stripe enforces with REMOVE; "repairing"
+        # one means ensuring absence, not REPLACE-writing an empty chunk
+        holes = [s for s in shards if s in zero_shards]
+        lost = tuple(s for s in shards if s not in zero_shards)
+        rec = (await self._reconstruct_shards(layout, inode, stripe, lost,
+                                              zero_shards) if lost else [])
+
         async def write_back(shard: int, content: bytes) -> IOResult:
             cid = (layout.data_chunk(inode, stripe, shard) if shard < k
                    else layout.parity_chunk(inode, stripe, shard - k))
@@ -336,5 +342,15 @@ class ECStorageClient:
             return await self.sc.write_chunk(
                 layout.shard_chain(stripe, shard), cid, 0, bytes(content),
                 chunk_size=cs, update_type=UpdateType.REPLACE)
-        return list(await asyncio.gather(
-            *(write_back(s, c) for s, c in zip(shards, rec))))
+
+        async def remove_hole(shard: int) -> IOResult:
+            return await self.sc.write_chunk(
+                layout.shard_chain(stripe, shard),
+                layout.data_chunk(inode, stripe, shard), 0, b"",
+                chunk_size=cs, update_type=UpdateType.REMOVE)
+
+        done = dict(zip(lost, await asyncio.gather(
+            *(write_back(s, c) for s, c in zip(lost, rec)))))
+        done.update(zip(holes, await asyncio.gather(
+            *(remove_hole(s) for s in holes))))
+        return [done[s] for s in shards]

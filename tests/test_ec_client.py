@@ -225,3 +225,41 @@ def test_ec_repair_stripe_double_loss_one_pass():
         finally:
             await cluster.stop()
     asyncio.run(body())
+
+
+def test_ec_repair_stripe_zero_hole_stays_absent():
+    """Repairing a short stripe's zero-hole data shard must NOT materialize
+    an empty chunk — absent == zeros is the decode contract write_stripe
+    enforces with REMOVE."""
+    async def body():
+        cluster = LocalCluster(num_nodes=3, replicas=1, num_chains=6)
+        await cluster.start()
+        try:
+            lay = ECLayout.create(k=4, m=2, chunk_size=1024,
+                                  chains=[1, 2, 3, 4, 5, 6])
+            ec = ECStorageClient(cluster.sc)
+            data = b"z" * 1500   # shards 0,1 hold data; shards 2,3 are holes
+            await ec.write_stripe(lay, 40, 0, data)
+
+            # "repair" a lost shard set that includes hole shard 3 plus the
+            # real shard 1 (the by-chain selection a recovery driver makes)
+            res = await ec.repair_stripe(lay, 40, 0, (1, 3),
+                                         stripe_len=len(data))
+            assert all(r.status.code == int(StatusCode.OK) for r in res)
+            got = await ec.read_stripe(lay, 40, 0, len(data))
+            assert got == data
+
+            # hole shard 3's chunk must not exist on its chain
+            from t3fs.storage.types import QueryChunkReq
+            cid = lay.data_chunk(40, 0, 3)
+            chain_id = lay.shard_chain(0, 3)
+            routing = cluster.mgmtd.state.routing()
+            head = routing.chains[chain_id].head()
+            rsp, _ = await cluster.admin.call(
+                routing.node_address(head.node_id), "Storage.query_chunk",
+                QueryChunkReq(chain_id=chain_id, chunk_id=cid))
+            assert not rsp.found, "phantom empty chunk materialized for a " \
+                                  "zero-hole shard"
+        finally:
+            await cluster.stop()
+    asyncio.run(body())
