@@ -200,6 +200,77 @@ async def set_preferred_order(ctx: AdminContext, args) -> None:
     _print_chain(rsp.chain)
 
 
+@command("enable-node", "re-enable an administratively disabled node")
+@args_(("node_id", {"type": int}))
+async def enable_node(ctx: AdminContext, args) -> None:
+    from t3fs.mgmtd.service import NodeOpReq
+    rsp, _ = await ctx.cli.call(ctx.mgmtd_address, "Mgmtd.enable_node",
+                                NodeOpReq(node_id=args.node_id))
+    print(f"node {rsp.node.node_id}: {rsp.node.status.name}")
+
+
+@command("disable-node", "administratively drain a node (targets walk out)")
+@args_(("node_id", {"type": int}))
+async def disable_node(ctx: AdminContext, args) -> None:
+    from t3fs.mgmtd.service import NodeOpReq
+    rsp, _ = await ctx.cli.call(ctx.mgmtd_address, "Mgmtd.disable_node",
+                                NodeOpReq(node_id=args.node_id))
+    print(f"node {rsp.node.node_id}: {rsp.node.status.name}")
+
+
+@command("unregister-node", "retire a node record (must be off all chains)")
+@args_(("node_id", {"type": int}))
+async def unregister_node(ctx: AdminContext, args) -> None:
+    from t3fs.mgmtd.service import NodeOpReq
+    await ctx.cli.call(ctx.mgmtd_address, "Mgmtd.unregister_node",
+                       NodeOpReq(node_id=args.node_id))
+    print(f"node {args.node_id} unregistered")
+
+
+@command("node-tags", "set a node's operator tags")
+@args_(("node_id", {"type": int}), ("tags", {"nargs": "*"}))
+async def node_tags(ctx: AdminContext, args) -> None:
+    from t3fs.mgmtd.service import NodeOpReq
+    rsp, _ = await ctx.cli.call(ctx.mgmtd_address, "Mgmtd.set_node_tags",
+                                NodeOpReq(node_id=args.node_id,
+                                          tags=list(args.tags)))
+    print(f"node {rsp.node.node_id} tags: {rsp.node.tags}")
+
+
+@command("universal-tags", "get or set cluster-wide tags")
+@args_(("tags", {"nargs": "*", "help": "omit to get"}),
+       ("--set", {"action": "store_true", "dest": "do_set"}))
+async def universal_tags(ctx: AdminContext, args) -> None:
+    if args.do_set or args.tags:
+        from t3fs.mgmtd.service import UniversalTagsReq
+        await ctx.cli.call(ctx.mgmtd_address, "Mgmtd.set_universal_tags",
+                           UniversalTagsReq(tags=list(args.tags)))
+    rsp, _ = await ctx.cli.call(ctx.mgmtd_address,
+                                "Mgmtd.get_universal_tags", None)
+    print(f"universal tags: {rsp.tags}")
+
+
+@command("orphan-targets", "heartbeated targets referenced by no chain")
+async def orphan_targets(ctx: AdminContext, args) -> None:
+    rsp, _ = await ctx.cli.call(ctx.mgmtd_address,
+                                "Mgmtd.list_orphan_targets", None)
+    if not rsp.targets:
+        print("no orphan targets")
+    for t in rsp.targets:
+        print(f"target {t.target_id} on node {t.node_id} "
+              f"({t.local_state.name})")
+
+
+@command("config-versions", "distributed config template fingerprints")
+async def config_versions(ctx: AdminContext, args) -> None:
+    rsp, _ = await ctx.cli.call(ctx.mgmtd_address,
+                                "Mgmtd.get_config_versions", None)
+    if not rsp.versions:
+        print("no templates")
+    for ntype, ver in sorted(rsp.versions.items()):
+        print(f"{ntype}: {ver:08x}")
+
+
 @command("migrate", "move a target to another node (migration service job)")
 @args_(("chain_id", {"type": int}), ("src_target_id", {"type": int}),
        ("dst_target_id", {"type": int}), ("dst_node_id", {"type": int}),

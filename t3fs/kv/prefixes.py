@@ -19,6 +19,7 @@ class KeyPrefix(bytes, enum.Enum):
     USER = b"USER"
     CLIENT_SESSION = b"CSES"     # mgmtd client sessions (fbs/mgmtd/ClientSession.h)
     TARGET_INFO = b"TGTI"        # mgmtd per-target info (MgmtdTargetInfoPersister)
+    UNIVERSAL_TAGS = b"UTAG"     # mgmtd cluster-wide tags (setUniversalTags)
 
     def key(self, *parts: bytes) -> bytes:
         return self.value + b"".join(parts)

@@ -24,6 +24,7 @@ from t3fs.mgmtd.types import (
     ChainInfo, ChainTable, ChainTargetInfo, ClientSession, LocalTargetState,
     NodeInfo, PublicTargetState, RoutingInfo,
 )
+from t3fs.mgmtd.types import NodeStatus as NodeStatusEnum
 from t3fs.net.server import rpc_method, service
 from t3fs.utils import serde
 from t3fs.utils.config import ConfigBase, citem
@@ -147,6 +148,9 @@ class MgmtdState:
         # failover can't see the new generation without the demotions
         self.pending_node_saves: dict[int, NodeInfo] = {}
         self._routing_cache: RoutingInfo | None = None
+        # which node last reported each target (live info from heartbeats;
+        # feeds listOrphanTargets — not persisted, best-effort by design)
+        self.target_reporter: dict[int, int] = {}
         # startup grace: a restarted mgmtd has an empty liveness map — treat
         # every node as alive until one full heartbeat window has passed, or
         # the first updater tick would demote the whole healthy cluster
@@ -224,15 +228,36 @@ class MgmtdState:
     def routing(self) -> RoutingInfo:
         return self._routing_cache or RoutingInfo()
 
+    @staticmethod
+    async def _merge_node_write(txn, node: NodeInfo,
+                                admin: bool) -> NodeInfo:
+        """In-txn merge for node-record writes.  status (when DISABLED) and
+        tags are mgmtd-admin-owned: liveness/heartbeat writers must never
+        stomp them, and reading the current record inside the transaction
+        makes a racing admin op an SSI conflict instead of a lost update."""
+        key = KeyPrefix.NODE.key(str(node.node_id).encode())
+        if not admin:
+            raw = await txn.get(key)
+            if raw is not None:
+                cur: NodeInfo = serde.loads(raw)
+                merged = NodeInfo(**{**node.__dict__})
+                merged.tags = list(cur.tags)
+                if cur.status == NodeStatusEnum.DISABLED:
+                    merged.status = cur.status
+                node = merged
+        txn.set(key, serde.dumps(node))
+        return node
+
     async def save_node(self, node: NodeInfo) -> None:
         async def txn_fn(txn):
-            txn.set(KeyPrefix.NODE.key(str(node.node_id).encode()), serde.dumps(node))
+            await self._merge_node_write(txn, node, admin=False)
         await with_transaction(self.kv, txn_fn)
 
     async def save_chains(self, chains: list[ChainInfo],
                           tables: list[ChainTable] = (),
                           nodes: list[NodeInfo] = (),
-                          guard_versions: bool = True) -> list[int]:
+                          guard_versions: bool = True,
+                          admin_nodes: bool = False) -> list[int]:
         """Persist chains (+tables, +node records) in ONE transaction — the
         nodes ride along so e.g. a restart-demotion and the node's new
         generation become durable together.
@@ -274,8 +299,7 @@ class MgmtdState:
                 # landed: persisting a restarted node's generation without
                 # its demotions would lose restart detection on a failover
                 for n in nodes or ():
-                    txn.set(KeyPrefix.NODE.key(str(n.node_id).encode()),
-                            serde.dumps(n))
+                    await self._merge_node_write(txn, n, admin=admin_nodes)
                     any_write = True
             if any_write:
                 raw = await txn.get(KeyPrefix.ROUTING_VER.key())
@@ -291,6 +315,15 @@ class MgmtdState:
         if hb is None:
             return now - self.started_at < self.cfg.heartbeat_timeout_s
         return now - hb < self.cfg.heartbeat_timeout_s
+
+    def node_serviceable(self, node_id: int) -> bool:
+        """Alive AND not administratively disabled: the chains updater
+        drains a DISABLED node's targets exactly like a dead node's
+        (reference disableNode semantics, MgmtdServiceDef.h:10)."""
+        if not self.node_alive(node_id):
+            return False
+        n = self.routing().nodes.get(node_id)
+        return n is None or n.status != NodeStatusEnum.DISABLED
 
 
 def next_chain_state(chain: ChainInfo,
@@ -469,6 +502,68 @@ class ListClientSessionsRsp:
     sessions: list[ClientSession] = field(default_factory=list)
 
 
+@serde_struct
+@dataclass
+class NodeOpReq:
+    """enableNode/disableNode/unregisterNode/setNodeTags carrier."""
+    node_id: int = 0
+    tags: list[str] = field(default_factory=list)
+
+
+@serde_struct
+@dataclass
+class NodeRsp:
+    node: NodeInfo | None = None
+
+
+@serde_struct
+@dataclass
+class GetClientSessionReq:
+    client_id: str = ""
+
+
+@serde_struct
+@dataclass
+class GetClientSessionRsp:
+    session: ClientSession | None = None
+    found: bool = False
+
+
+@serde_struct
+@dataclass
+class UniversalTagsReq:
+    tags: list[str] = field(default_factory=list)
+
+
+@serde_struct
+@dataclass
+class UniversalTagsRsp:
+    tags: list[str] = field(default_factory=list)
+
+
+@serde_struct
+@dataclass
+class ConfigVersionsRsp:
+    """Per-node-type template fingerprints (crc32c of the TOML): the
+    reference's getConfigVersions surface with content hashes as the
+    version — equal hash == identical distributed config."""
+    versions: dict[str, int] = field(default_factory=dict)
+
+
+@serde_struct
+@dataclass
+class OrphanTarget:
+    target_id: int = 0
+    node_id: int = 0                 # reporter (0 if unknown)
+    local_state: LocalTargetState = LocalTargetState.OFFLINE
+
+
+@serde_struct
+@dataclass
+class ListOrphanTargetsRsp:
+    targets: list[OrphanTarget] = field(default_factory=list)
+
+
 @service("Mgmtd")
 class MgmtdService:
     """RPC surface (fbs/mgmtd/MgmtdServiceDef.h:3-26 subset)."""
@@ -501,6 +596,14 @@ class MgmtdService:
         prev_gen = known.generation if known is not None else None
         restarted = (req.node.generation and prev_gen
                      and prev_gen != req.node.generation)
+        # status + tags are MGMTD-owned fields: a node's self-report must
+        # never stomp an admin disable-node or set-node-tags (the node
+        # always reports defaults for them)
+        reported = req.node
+        if known is not None:
+            reported = NodeInfo(**{**req.node.__dict__})
+            reported.status = known.status
+            reported.tags = list(known.tags)
         if restarted:
             # fast restart (within the heartbeat window): every target
             # this node serves must fall back to SYNCING and resync.
@@ -511,13 +614,14 @@ class MgmtdService:
                 for t in chain.targets:
                     if t.node_id == req.node.node_id:
                         st.restarted_targets.add(t.target_id)
-            st.pending_node_saves[req.node.node_id] = req.node
+            st.pending_node_saves[req.node.node_id] = reported
         for tid, ls in req.target_states.items():
             st.local_states[int(tid)] = LocalTargetState(ls)
+            st.target_reporter[int(tid)] = req.node.node_id
         if not restarted and (known is None
                               or known.address != req.node.address
                               or known.generation != req.node.generation):
-            await st.save_node(req.node)
+            await st.save_node(reported)
             await st.load_routing()
         return HeartbeatRsp(routing_version=st.routing().version), b""
 
@@ -692,6 +796,143 @@ class MgmtdService:
         return ListClientSessionsRsp(
             sessions=[serde.loads(v) for _, v in rows]), b""
 
+    # --- node admin ops (MgmtdServiceDef.h:9-16 parity) ---
+
+    async def _node_op(self, node_id: int, mutate) -> NodeInfo:
+        """Load-modify-save a node record + routing version bump."""
+        await self._require_primary()
+        st = self.state
+        n = st.routing().nodes.get(node_id)
+        if n is None:
+            raise make_error(StatusCode.TARGET_NOT_FOUND, f"node {node_id}")
+        updated = NodeInfo(**{**n.__dict__})
+        mutate(updated)
+        await st.save_chains([], nodes=[updated], admin_nodes=True)
+        return updated
+
+    @rpc_method
+    async def enable_node(self, req: NodeOpReq, payload, conn):
+        def mutate(n):
+            n.status = NodeStatusEnum.ACTIVE
+        return NodeRsp(node=await self._node_op(req.node_id, mutate)), b""
+
+    @rpc_method
+    async def disable_node(self, req: NodeOpReq, payload, conn):
+        """Administrative drain: the chains updater treats the node's
+        targets like a dead node's (they walk to chain tail), but the node
+        keeps heartbeating — re-enable restores it without a restart."""
+        def mutate(n):
+            n.status = NodeStatusEnum.DISABLED
+        return NodeRsp(node=await self._node_op(req.node_id, mutate)), b""
+
+    @rpc_method
+    async def set_node_tags(self, req: NodeOpReq, payload, conn):
+        def mutate(n):
+            n.tags = list(req.tags)
+        return NodeRsp(node=await self._node_op(req.node_id, mutate)), b""
+
+    @rpc_method
+    async def unregister_node(self, req: NodeOpReq, payload, conn):
+        """Retire a node record.  Refused while any chain still references
+        the node — silently dropping a referenced node would strand its
+        targets in the chain state machine."""
+        await self._require_primary()
+        st = self.state
+        routing = st.routing()
+        if routing.nodes.get(req.node_id) is None:
+            raise make_error(StatusCode.TARGET_NOT_FOUND,
+                             f"node {req.node_id}")
+        for chain in routing.chains.values():
+            if any(t.node_id == req.node_id for t in chain.targets):
+                raise make_error(
+                    StatusCode.INVALID_ARG,
+                    f"node {req.node_id} still on chain {chain.chain_id}; "
+                    f"update-chain it away first")
+        if st.last_heartbeat.get(req.node_id) is not None \
+                and st.node_alive(req.node_id):
+            # a live node would simply re-register on its next heartbeat,
+            # silently undoing this op seconds later
+            raise make_error(
+                StatusCode.INVALID_ARG,
+                f"node {req.node_id} is still heartbeating; stop it (or "
+                f"disable-node) first")
+
+        async def op(txn):
+            txn.clear(KeyPrefix.NODE.key(str(req.node_id).encode()))
+            raw = await txn.get(KeyPrefix.ROUTING_VER.key())
+            txn.set(KeyPrefix.ROUTING_VER.key(),
+                    str(int(raw or 1) + 1).encode())
+        await with_transaction(st.kv, op)
+        st.last_heartbeat.pop(req.node_id, None)
+        # reap the retired node's reported-target bookkeeping, or its
+        # targets linger in list_orphan_targets forever
+        for tid in [t for t, n in st.target_reporter.items()
+                    if n == req.node_id]:
+            st.target_reporter.pop(tid, None)
+            st.local_states.pop(tid, None)
+        await st.load_routing()
+        return OkRsp(), b""
+
+    @rpc_method
+    async def get_client_session(self, req: GetClientSessionReq, payload,
+                                 conn):
+        async def op(txn):
+            return await txn.get(
+                KeyPrefix.CLIENT_SESSION.key(req.client_id.encode()),
+                snapshot=True)
+        raw = await with_transaction(self.state.kv, op)
+        return GetClientSessionRsp(
+            session=serde.loads(raw) if raw is not None else None,
+            found=raw is not None), b""
+
+    @rpc_method
+    async def set_universal_tags(self, req: UniversalTagsReq, payload, conn):
+        await self._require_primary()
+
+        async def op(txn):
+            txn.set(KeyPrefix.UNIVERSAL_TAGS.key(),
+                    serde.dumps(list(req.tags)))
+        await with_transaction(self.state.kv, op)
+        return OkRsp(), b""
+
+    @rpc_method
+    async def get_universal_tags(self, req, payload, conn):
+        async def op(txn):
+            return await txn.get(KeyPrefix.UNIVERSAL_TAGS.key(),
+                                 snapshot=True)
+        raw = await with_transaction(self.state.kv, op)
+        return UniversalTagsRsp(
+            tags=serde.loads(raw) if raw is not None else []), b""
+
+    @rpc_method
+    async def get_config_versions(self, req, payload, conn):
+        from t3fs.ops.codec import crc32c
+
+        async def op(txn):
+            return await txn.get_range(KeyPrefix.CONFIG.value,
+                                       KeyPrefix.CONFIG.value + b"\xff",
+                                       snapshot=True)
+        rows = await with_transaction(self.state.kv, op)
+        plen = len(KeyPrefix.CONFIG.value)
+        return ConfigVersionsRsp(versions={
+            k[plen:].decode(): crc32c(v) for k, v in rows}), b""
+
+    @rpc_method
+    async def list_orphan_targets(self, req, payload, conn):
+        """Targets reported in heartbeats that no chain references
+        (ListOrphanTargetsOperation analog) — leftovers of chain surgery /
+        aborted migrations an operator should reap."""
+        st = self.state
+        chained = {t.target_id
+                   for c in st.routing().chains.values()
+                   for t in c.targets}
+        out = [OrphanTarget(target_id=tid,
+                            node_id=st.target_reporter.get(tid, 0),
+                            local_state=ls)
+               for tid, ls in sorted(st.local_states.items())
+               if tid not in chained]
+        return ListOrphanTargetsRsp(targets=out), b""
+
     @rpc_method
     async def set_config_template(self, req: SetConfigTemplateReq, payload, conn):
         """Store a per-node-type config template in the KV — the config-
@@ -823,7 +1064,7 @@ class MgmtdServer:
             updated = []
             handled: set[int] = set()
             for chain in routing.chains.values():
-                alive = {t.node_id: st.node_alive(t.node_id)
+                alive = {t.node_id: st.node_serviceable(t.node_id)
                          for t in chain.targets}
                 nxt = next_chain_state(chain, alive, st.local_states,
                                        restarted=st.restarted_targets)
@@ -843,6 +1084,8 @@ class MgmtdServer:
             for n in routing.nodes.values():
                 if n.node_type == "storage":
                     continue
+                if n.status == _NS.DISABLED:
+                    continue  # admin disable is sticky; liveness can't flip it
                 want = _NS.ACTIVE if st.node_alive(n.node_id) else _NS.FAILED
                 if n.status != want \
                         and n.node_id not in st.pending_node_saves:

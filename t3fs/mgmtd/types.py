@@ -92,6 +92,8 @@ class NodeInfo:
     # that happened WITHIN the heartbeat window — the node looks continuously
     # alive but its serving targets may have lost state and need resync
     generation: float = 0.0
+    # operator labels (setNodeTags; placement/ops tooling reads these)
+    tags: list = field(default_factory=list)
 
 
 @serde_struct

@@ -55,6 +55,21 @@ def test_admin_cli_families():
             out = run_cli(cluster, "routing")
             assert "chain-table 1" in out and "SERVING" in out
 
+            # node-admin / tags / audit family (MgmtdServiceDef parity ops)
+            out = run_cli(cluster, "universal-tags", "fleet:dev", "--set")
+            assert "fleet:dev" in out
+            out = run_cli(cluster, "universal-tags")
+            assert "fleet:dev" in out
+            out = run_cli(cluster, "orphan-targets")
+            assert "orphan" in out or "target" in out
+            out = run_cli(cluster, "config-versions")
+            assert out.strip()  # template list (may be empty cluster: msg)
+            nodes_out = run_cli(cluster, "list-nodes")
+            node_id = next(line.split()[0] for line in
+                           nodes_out.splitlines()[1:] if line.strip())
+            out = run_cli(cluster, "node-tags", node_id, "rack:r1")
+            assert "rack:r1" in out
+
             storage_addr = open(os.path.join(d, "storage1.port")).read()
             storage_addr = f"127.0.0.1:{storage_addr.strip()}"
             out = run_cli(cluster, "app-info", storage_addr)

@@ -331,3 +331,133 @@ def test_save_chains_cas_guard():
         info = await st.load_routing()
         assert 9 not in info.nodes
     asyncio.run(body())
+
+
+def test_node_admin_ops_disable_enable_tags_unregister():
+    """enableNode/disableNode/setNodeTags/unregisterNode parity
+    (MgmtdServiceDef.h:9-16): disable drains via the chain state machine,
+    records persist across mgmtd restart, unregister refuses while chained."""
+    from t3fs.mgmtd.service import MgmtdService, NodeOpReq
+    from t3fs.mgmtd.types import NodeInfo, NodeStatus
+    from t3fs.utils.status import StatusError
+
+    async def body():
+        kv = MemKVEngine()
+        srv = MgmtdServer(kv, 1, "")
+        await srv.state.try_acquire_lease()
+        await srv.state.load_routing()
+        await srv.state.save_chains(
+            [chain(S, S)],
+            nodes=[NodeInfo(1, "a:1"), NodeInfo(2, "a:2"),
+                   NodeInfo(3, "a:3")])
+        st = srv.state
+        st.last_heartbeat = {1: __import__("time").time() + 1e6,
+                             2: st.last_heartbeat.get(2, 0) or
+                             __import__("time").time() + 1e6,
+                             3: __import__("time").time() + 1e6}
+        svc = MgmtdService(st)
+
+        # disable node 2 -> updater drains its target to chain tail
+        rsp, _ = await svc.disable_node(NodeOpReq(node_id=2), b"", None)
+        assert rsp.node.status == NodeStatus.DISABLED
+        assert not st.node_serviceable(2) and st.node_alive(2)
+        await srv.update_chains_once()
+        c = st.routing().chains[1]
+        assert [(t.target_id, t.public_state) for t in c.targets] == [
+            (100, S), (101, OFF)]
+
+        # re-enable -> node rejoins (ONLINE local state -> SYNCING)
+        rsp, _ = await svc.enable_node(NodeOpReq(node_id=2), b"", None)
+        assert rsp.node.status == NodeStatus.ACTIVE
+        st.local_states[101] = LocalTargetState.ONLINE
+        await srv.update_chains_once()
+        assert st.routing().chains[1].targets[1].public_state == SY
+
+        # tags persist across a restart (new state over same KV)
+        await svc.set_node_tags(NodeOpReq(node_id=3, tags=["rack:r7"]),
+                                b"", None)
+        st2 = MgmtdState(kv, 9, "x:1", MgmtdConfig())
+        info = await st2.load_routing()
+        assert info.nodes[3].tags == ["rack:r7"]
+        assert info.nodes[2].status == NodeStatus.ACTIVE
+
+        # a node restart (new generation heartbeat) must NOT wipe
+        # admin-owned fields: tags survive, DISABLED stays sticky
+        from t3fs.mgmtd.service import HeartbeatReq
+        await svc.disable_node(NodeOpReq(node_id=3), b"", None)
+        gen = st.routing().nodes[3].generation or 1.0
+        await svc.heartbeat(HeartbeatReq(
+            node=NodeInfo(3, "a:3", generation=gen + 5.0)), b"", None)
+        await srv.update_chains_once()   # flushes pending node saves
+        n3 = st.routing().nodes[3]
+        assert n3.status == NodeStatus.DISABLED, \
+            "node self-report wiped admin disable"
+        assert n3.tags == ["rack:r7"], "node self-report wiped tags"
+
+        # unregister refuses while on a chain or still heartbeating
+        with pytest.raises(StatusError):
+            await svc.unregister_node(NodeOpReq(node_id=1), b"", None)
+        with pytest.raises(StatusError):
+            await svc.unregister_node(NodeOpReq(node_id=3), b"", None)
+        st.last_heartbeat.pop(3, None)
+        st.local_states[391] = LocalTargetState.ONLINE
+        st.target_reporter[391] = 3
+        await svc.unregister_node(NodeOpReq(node_id=3), b"", None)
+        assert 3 not in st.routing().nodes
+        assert 391 not in st.target_reporter and 391 not in st.local_states
+    asyncio.run(body())
+
+
+def test_universal_tags_config_versions_orphans_session_get():
+    from t3fs.mgmtd.service import (
+        GetClientSessionReq, MgmtdService, NodeOpReq, SetConfigTemplateReq,
+        UniversalTagsReq,
+    )
+    from t3fs.mgmtd.types import ClientSession
+
+    async def body():
+        kv = MemKVEngine()
+        srv = MgmtdServer(kv, 1, "")
+        await srv.state.try_acquire_lease()
+        await srv.state.load_routing()
+        st = srv.state
+        svc = MgmtdService(st)
+
+        # universal tags roundtrip + persistence
+        await svc.set_universal_tags(
+            UniversalTagsReq(tags=["fleet:a", "dc:x"]), b"", None)
+        rsp, _ = await svc.get_universal_tags(None, b"", None)
+        assert rsp.tags == ["fleet:a", "dc:x"]
+
+        # config versions = per-type content fingerprints
+        await svc.set_config_template(
+            SetConfigTemplateReq(node_type="storage", toml="a=1"), b"", None)
+        await svc.set_config_template(
+            SetConfigTemplateReq(node_type="meta", toml="b=2"), b"", None)
+        rsp, _ = await svc.get_config_versions(None, b"", None)
+        assert set(rsp.versions) == {"storage", "meta"}
+        v1 = rsp.versions["storage"]
+        await svc.set_config_template(
+            SetConfigTemplateReq(node_type="storage", toml="a=2"), b"", None)
+        rsp, _ = await svc.get_config_versions(None, b"", None)
+        assert rsp.versions["storage"] != v1
+        assert rsp.versions["meta"] == rsp.versions["meta"]
+
+        # orphan targets: heartbeated target not on any chain
+        st.local_states[777] = LocalTargetState.ONLINE
+        st.target_reporter[777] = 4
+        rsp, _ = await svc.list_orphan_targets(None, b"", None)
+        assert [(t.target_id, t.node_id) for t in rsp.targets] == [(777, 4)]
+
+        # get_client_session
+        from t3fs.mgmtd.service import ClientSessionReq
+        await svc.extend_client_session(
+            ClientSessionReq(session=ClientSession(client_id="cl-1")),
+            b"", None)
+        rsp, _ = await svc.get_client_session(
+            GetClientSessionReq(client_id="cl-1"), b"", None)
+        assert rsp.found and rsp.session.client_id == "cl-1"
+        rsp, _ = await svc.get_client_session(
+            GetClientSessionReq(client_id="nope"), b"", None)
+        assert not rsp.found
+    asyncio.run(body())
