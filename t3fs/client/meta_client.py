@@ -13,7 +13,9 @@ import random
 import uuid as uuidlib
 
 from t3fs.meta.schema import DirEntry, Inode
-from t3fs.meta.service import BatchStatReq, EntryReq, InodeReq, PathReq
+from t3fs.meta.service import (
+    BatchStatReq, EntryReq, InodeReq, PathReq, PruneSessionReq,
+)
 from t3fs.net.client import Client
 from t3fs.utils.status import StatusError
 
@@ -190,6 +192,13 @@ class MetaClient:
     async def batch_stat_inodes(self, inode_ids: list[int]) -> list[Inode | None]:
         return (await self._call("batch_stat", BatchStatReq(
             inode_ids=inode_ids))).inodes
+
+    async def prune_sessions(self, session_ids: list[str] = ()) -> None:
+        """Release this client's write sessions eagerly (reference
+        PruneSession): an unmounting daemon calls this instead of leaving
+        its sessions to the dead-client reaper."""
+        await self._call("prune_session", PruneSessionReq(
+            client_id=self.client_id, session_ids=list(session_ids)))
 
     async def close_conn(self) -> None:
         await self.client.close()

@@ -153,6 +153,12 @@ class FuseKernelMount:
             os.close(self.fd)
             self.fd = -1
         self._closed.set()
+        # eager session release (reference PruneSession): don't leave this
+        # mount's write sessions to the dead-client reaper
+        try:
+            await self.mc.prune_sessions()
+        except Exception as e:
+            log.warning("session prune on unmount failed: %s", e)
         log.info("t3fs unmounted from %s", self.mountpoint)
 
     # ---- request pump ----

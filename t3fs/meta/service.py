@@ -18,6 +18,7 @@ from t3fs.meta.events import MetaEventType
 from t3fs.meta.schema import DirEntry, FileSession, Inode, InodeType
 from t3fs.meta.store import ChainAllocator, MetaStore
 from t3fs.net.server import rpc_method, service
+from t3fs.net.wire import OkRsp
 from t3fs.utils.config import ConfigBase as _ConfigBase, citem as _citem
 from t3fs.utils.serde import serde_struct
 from t3fs.utils.status import StatusCode, StatusError, make_error
@@ -93,6 +94,13 @@ class EntryReq:
     request_id: str = ""
     limit: int = 0
     must_dir: int = -1        # unlink_at: -1 any, 0 must be file, 1 must be dir
+
+
+@serde_struct
+@dataclass
+class PruneSessionReq:
+    client_id: str = ""
+    session_ids: list[str] = field(default_factory=list)
 
 
 @serde_struct
@@ -292,6 +300,51 @@ class MetaService:
             inodes = await self.store.batch_stat(req.paths, req.follow)
         return BatchStatRsp(inodes=inodes), b""
 
+    async def reconcile_lengths(self, inode_ids: list[int]) -> int:
+        """Settle precise lengths for files whose writer died without close.
+
+        A crashed writer leaves the inode at its last 5-second
+        report_write_position hint; the reference's Distributor periodically
+        recomputes the true length from storage queryLastChunk
+        (docs/design_notes.md:91-95, meta/components/FileHelper.h).  Runs
+        whenever session pruning evicts dead-writer sessions."""
+        if self.sc is None:
+            return 0
+        fixed = 0
+        for inode_id in set(inode_ids):
+            try:
+                inode = await self.store.stat_inode(inode_id)
+                if inode.itype != InodeType.FILE or inode.layout is None:
+                    continue
+                # skip while other writers hold live sessions — their close
+                # will settle the length with fresher information
+                if await self.store.sessions_of(inode_id):
+                    continue
+                length = await self.sc.query_last_chunk(inode.layout, inode_id)
+                if length != inode.length:
+                    await self.store.set_length(inode_id, length)
+                    fixed += 1
+            except StatusError as e:
+                log.warning("length reconcile of inode %d failed: %s",
+                            inode_id, e)
+        return fixed
+
+    @rpc_method
+    async def prune_session(self, req: PruneSessionReq, payload, conn):
+        """Client-initiated prune of its OWN write sessions (reference
+        PruneSession, fbs/meta/Service.h:734): an unmounting FUSE daemon
+        releases sessions eagerly instead of waiting for the dead-client
+        reaper.  `session_ids` limits the prune; otherwise every session of
+        `client_id` goes.  Lengths reconcile like any reaped writer's."""
+        if not req.client_id:
+            raise make_error(StatusCode.INVALID_ARG, "client_id required")
+        sessions = await self.store.scan_sessions()
+        mine = [s for s in sessions if s.client_id == req.client_id
+                and (not req.session_ids or s.session_id in req.session_ids)]
+        pruned = await self.store.clear_sessions(mine)
+        await self.reconcile_lengths(pruned)
+        return OkRsp(), b""
+
     @rpc_method
     async def list_inodes(self, req: EntryReq, payload, conn):
         """Raw inode-table scan (admin DumpInodes analog): returns inodes
@@ -429,33 +482,7 @@ class MetaServer:
         return await self.store.clear_sessions(list(to_prune.values()))
 
     async def reconcile_lengths(self, inode_ids: list[int]) -> int:
-        """Settle precise lengths for files whose writer died without close.
-
-        A crashed writer leaves the inode at its last 5-second
-        report_write_position hint; the reference's Distributor periodically
-        recomputes the true length from storage queryLastChunk
-        (docs/design_notes.md:91-95, meta/components/FileHelper.h).  Runs
-        whenever session pruning evicts dead-writer sessions."""
-        if self.sc is None:
-            return 0
-        fixed = 0
-        for inode_id in set(inode_ids):
-            try:
-                inode = await self.store.stat_inode(inode_id)
-                if inode.itype != InodeType.FILE or inode.layout is None:
-                    continue
-                # skip while other writers hold live sessions — their close
-                # will settle the length with fresher information
-                if await self.store.sessions_of(inode_id):
-                    continue
-                length = await self.sc.query_last_chunk(inode.layout, inode_id)
-                if length != inode.length:
-                    await self.store.set_length(inode_id, length)
-                    fixed += 1
-            except StatusError as e:
-                log.warning("length reconcile of inode %d failed: %s",
-                            inode_id, e)
-        return fixed
+        return await self.service.reconcile_lengths(inode_ids)
 
     async def gc_once(self) -> int:
         """Reclaim chunks of removed files (GcManager.h:57-118 analog);

@@ -26,6 +26,7 @@ from t3fs.mgmtd.types import (
 )
 from t3fs.mgmtd.types import NodeStatus as NodeStatusEnum
 from t3fs.net.server import rpc_method, service
+from t3fs.net.wire import OkRsp
 from t3fs.utils import serde
 from t3fs.utils.config import ConfigBase, citem
 from t3fs.utils.serde import serde_struct
@@ -66,12 +67,6 @@ class GetRoutingInfoRsp:
 class SetChainsReq:
     chains: list[ChainInfo] = field(default_factory=list)
     tables: list[ChainTable] = field(default_factory=list)
-
-
-@serde_struct
-@dataclass
-class OkRsp:
-    ok: bool = True
 
 
 @serde_struct
@@ -256,8 +251,7 @@ class MgmtdState:
     async def save_chains(self, chains: list[ChainInfo],
                           tables: list[ChainTable] = (),
                           nodes: list[NodeInfo] = (),
-                          guard_versions: bool = True,
-                          admin_nodes: bool = False) -> list[int]:
+                          guard_versions: bool = True) -> list[int]:
         """Persist chains (+tables, +node records) in ONE transaction — the
         nodes ride along so e.g. a restart-demotion and the node's new
         generation become durable together.
@@ -299,7 +293,7 @@ class MgmtdState:
                 # landed: persisting a restarted node's generation without
                 # its demotions would lose restart detection on a failover
                 for n in nodes or ():
-                    await self._merge_node_write(txn, n, admin=admin_nodes)
+                    await self._merge_node_write(txn, n, admin=False)
                     any_write = True
             if any_write:
                 raw = await txn.get(KeyPrefix.ROUTING_VER.key())
@@ -799,16 +793,30 @@ class MgmtdService:
     # --- node admin ops (MgmtdServiceDef.h:9-16 parity) ---
 
     async def _node_op(self, node_id: int, mutate) -> NodeInfo:
-        """Load-modify-save a node record + routing version bump."""
+        """In-txn read-modify-write of a node record + routing version bump.
+        Reading the CURRENT record inside the transaction (not the routing
+        cache) means a concurrent heartbeat's address/generation save can't
+        be reverted — the admin op rebases on whatever committed last."""
         await self._require_primary()
         st = self.state
-        n = st.routing().nodes.get(node_id)
-        if n is None:
-            raise make_error(StatusCode.TARGET_NOT_FOUND, f"node {node_id}")
-        updated = NodeInfo(**{**n.__dict__})
-        mutate(updated)
-        await st.save_chains([], nodes=[updated], admin_nodes=True)
-        return updated
+        key = KeyPrefix.NODE.key(str(node_id).encode())
+        out: list[NodeInfo] = []
+
+        async def txn_fn(txn):
+            raw = await txn.get(key)
+            if raw is None:
+                raise make_error(StatusCode.TARGET_NOT_FOUND,
+                                 f"node {node_id}")
+            updated: NodeInfo = serde.loads(raw)
+            mutate(updated)
+            txn.set(key, serde.dumps(updated))
+            ver = await txn.get(KeyPrefix.ROUTING_VER.key())
+            txn.set(KeyPrefix.ROUTING_VER.key(),
+                    str(int(ver or 1) + 1).encode())
+            out[:] = [updated]
+        await with_transaction(st.kv, txn_fn)
+        await st.load_routing()
+        return out[0]
 
     @rpc_method
     async def enable_node(self, req: NodeOpReq, payload, conn):
@@ -864,6 +872,9 @@ class MgmtdService:
                     str(int(raw or 1) + 1).encode())
         await with_transaction(st.kv, op)
         st.last_heartbeat.pop(req.node_id, None)
+        # a pending restart-save would re-create the record on the next
+        # updater tick
+        st.pending_node_saves.pop(req.node_id, None)
         # reap the retired node's reported-target bookkeeping, or its
         # targets linger in list_orphan_targets forever
         for tid in [t for t, n in st.target_reporter.items()

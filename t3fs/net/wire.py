@@ -38,6 +38,13 @@ class FrameError(Exception):
     pass
 
 
+@serde_struct
+@dataclass
+class OkRsp:
+    """Shared empty-success response for admin/maintenance RPCs."""
+    ok: bool = True
+
+
 def maybe_compress(msg: bytes, payload: bytes, threshold: int,
                    level: int = 1) -> tuple[bytes, bytes, int]:
     """Compress a frame when it pays (MessagePacket UseCompress analog,

@@ -529,3 +529,36 @@ def test_dead_writer_length_reconciliation(store):
         assert await server.reconcile_lengths(pruned) == 1
         assert (await store.stat("/crashed")).length == len(data)
     run(body())
+
+
+def test_prune_session_rpc_client_scoped(store):
+    """Client-initiated session prune (reference PruneSession RPC):
+    removes only the calling client's sessions, reconciles lengths,
+    refuses an empty client_id."""
+    from t3fs.meta.service import MetaServer, MetaService, PruneSessionReq
+
+    async def body():
+        srv = MetaServer(store, StorageClientInMem(), gc_period_s=3600)
+        svc = srv.service
+        await store.mkdirs("/p")
+        _, s1 = await store.create("/p/a", session_client="mount-A")
+        _, s2 = await store.create("/p/b", session_client="mount-B")
+        assert len(await store.scan_sessions()) == 2
+
+        with pytest.raises(StatusError):
+            await svc.prune_session(PruneSessionReq(), b"", None)
+
+        # scoped to one session id of mount-A
+        await svc.prune_session(
+            PruneSessionReq(client_id="mount-A", session_ids=[s1]), b"", None)
+        left = await store.scan_sessions()
+        assert [s.client_id for s in left] == ["mount-B"]
+
+        # whole-client prune doesn't touch other clients
+        await svc.prune_session(
+            PruneSessionReq(client_id="mount-A"), b"", None)
+        assert [s.client_id for s in await store.scan_sessions()] == ["mount-B"]
+        await svc.prune_session(
+            PruneSessionReq(client_id="mount-B"), b"", None)
+        assert await store.scan_sessions() == []
+    run(body())

@@ -437,11 +437,12 @@ def test_universal_tags_config_versions_orphans_session_get():
         rsp, _ = await svc.get_config_versions(None, b"", None)
         assert set(rsp.versions) == {"storage", "meta"}
         v1 = rsp.versions["storage"]
+        v_meta = rsp.versions["meta"]
         await svc.set_config_template(
             SetConfigTemplateReq(node_type="storage", toml="a=2"), b"", None)
         rsp, _ = await svc.get_config_versions(None, b"", None)
         assert rsp.versions["storage"] != v1
-        assert rsp.versions["meta"] == rsp.versions["meta"]
+        assert rsp.versions["meta"] == v_meta  # other types untouched
 
         # orphan targets: heartbeated target not on any chain
         st.local_states[777] = LocalTargetState.ONLINE
