@@ -50,14 +50,11 @@ def make_mesh(n_devices: int | None = None, dp: int | None = None) -> Mesh:
     return Mesh(arr, ("dp", "cp"))
 
 
-def make_sharded_encode_step(mesh: Mesh, chunk_len: int, k: int = 8, m: int = 2,
-                             seg_bytes: int = DEFAULT_SEG_BYTES):
-    """Full sharded encode step: stripes (n, k, chunk_len) uint8, sharded
-    P('dp', None, 'cp') -> (parity (n, m, chunk_len) same sharding,
-                            crcs (n, k+m) uint32 replicated over cp).
-
-    Returns (jitted_fn, in_sharding) — callers place inputs with in_sharding.
-    """
+def _crc_combine_setup(mesh: Mesh, chunk_len: int, seg_bytes: int):
+    """Shared scaffolding for the cp-sharded CRC: local raw-CRC core plus a
+    combine(raw, n, nshards) closure doing the shift-weighted psum over cp.
+    Used by BOTH the encode and the reconstruct steps — the tail-shift
+    exponent/affine math must never diverge between them."""
     cp = mesh.shape["cp"]
     assert chunk_len % cp == 0 and (chunk_len // cp) % seg_bytes == 0, (
         f"chunk_len {chunk_len} must split into {cp} cp shards of whole "
@@ -71,6 +68,26 @@ def make_sharded_encode_step(mesh: Mesh, chunk_len: int, k: int = 8, m: int = 2,
     ]))
     affine = np.uint32(mats.affine_const(chunk_len))
     raw_local = make_crc32c_raw(local_len, seg_bytes)
+
+    def combine(raw: jax.Array, n: int, nshards: int) -> jax.Array:
+        r = jax.lax.axis_index("cp")
+        shifted = _mod2(jnp.einsum("kl,nl->nk", tails[r], raw))
+        total = _mod2(jax.lax.psum(shifted, axis_name="cp"))
+        return pack_bits_u32(total).reshape(n, nshards) ^ affine
+
+    return local_len, raw_local, combine
+
+
+def make_sharded_encode_step(mesh: Mesh, chunk_len: int, k: int = 8, m: int = 2,
+                             seg_bytes: int = DEFAULT_SEG_BYTES):
+    """Full sharded encode step: stripes (n, k, chunk_len) uint8, sharded
+    P('dp', None, 'cp') -> (parity (n, m, chunk_len) same sharding,
+                            crcs (n, k+m) uint32 replicated over cp).
+
+    Returns (jitted_fn, in_sharding) — callers place inputs with in_sharding.
+    """
+    local_len, raw_local, crc_combine = _crc_combine_setup(
+        mesh, chunk_len, seg_bytes)
     # pinned to the matmul encoder: in the FUSED RS+CRC step the matmul
     # folds into the CRC's HBM passes nearly free, while the word-SWAR
     # path mixed with the byte-wise CRC measured 3x slower end to end
@@ -84,11 +101,47 @@ def make_sharded_encode_step(mesh: Mesh, chunk_len: int, k: int = 8, m: int = 2,
         parity = rs_encode(stripes)                              # local: RS is positionwise
         allsh = jnp.concatenate([stripes, parity], axis=1)
         raw = raw_local(allsh.reshape(n * (k + m), local_len))
-        r = jax.lax.axis_index("cp")
-        shifted = _mod2(jnp.einsum("kl,nl->nk", tails[r], raw))
-        total = _mod2(jax.lax.psum(shifted, axis_name="cp"))
-        crcs = pack_bits_u32(total).reshape(n, k + m) ^ affine
+        crcs = crc_combine(raw, n, k + m)
         return parity, crcs
+
+    mapped = jax.shard_map(
+        local_step, mesh=mesh,
+        in_specs=P("dp", None, "cp"),
+        out_specs=(P("dp", None, "cp"), P("dp", None)),
+    )
+    in_sharding = jax.NamedSharding(mesh, P("dp", None, "cp"))
+    return jax.jit(mapped), in_sharding
+
+
+def make_sharded_reconstruct_step(mesh: Mesh, chunk_len: int,
+                                  present: tuple[int, ...],
+                                  want: tuple[int, ...],
+                                  k: int = 8, m: int = 2,
+                                  seg_bytes: int = DEFAULT_SEG_BYTES):
+    """Mesh-sharded RS reconstruct + CRC of the rebuilt shards — the
+    multi-chip recovery path (BASELINE config #4 at pod scale).
+
+    GF(2^8) reconstruction is a per-byte-position linear map over the shard
+    axis, so under cp (chunk-length) sharding it needs ZERO communication —
+    each device decodes its local span.  The only collective is the same
+    shift-weighted CRC psum as the encode step, verifying every rebuilt
+    shard's checksum before it is written back to its chain.
+
+    survivors (n, |present|, chunk_len) uint8 sharded P('dp', None, 'cp')
+      -> (rebuilt (n, |want|, chunk_len) same sharding,
+          crcs (n, |want|) uint32 replicated over cp)
+    """
+    local_len, raw_local, crc_combine = _crc_combine_setup(
+        mesh, chunk_len, seg_bytes)
+    from t3fs.ops.jax_codec import make_rs_reconstruct
+    reconstruct = make_rs_reconstruct(present, want, default_rs(k, m))
+
+    def local_step(survivors: jax.Array):
+        n = survivors.shape[0]
+        rebuilt = reconstruct(survivors)        # local: decode is positionwise
+        raw = raw_local(rebuilt.reshape(n * len(want), local_len))
+        crcs = crc_combine(raw, n, len(want))
+        return rebuilt, crcs
 
     mapped = jax.shard_map(
         local_step, mesh=mesh,

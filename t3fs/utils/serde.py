@@ -69,9 +69,16 @@ def _varint(v: int) -> bytes:
 
 
 class _Plan:
-    """Per-class compiled serde plan (built once, on first encode/decode)."""
+    """Per-class compiled serde plan (built once, on first encode/decode).
 
-    __slots__ = ("cls", "header", "names", "_coercers", "_hint_err")
+    `enc` is a type-specialized encoder generated from the class's hints
+    (the python analog of the reference's compile-time template encoders):
+    each field gets an inline fast path for its hinted type with a
+    byte-identical `_encode` fallback on any runtime type mismatch —
+    tests/test_utils.py fuzzes every registered struct against the generic
+    path to hold that equivalence."""
+
+    __slots__ = ("cls", "header", "names", "enc", "_coercers", "_hint_err")
 
     def __init__(self, cls: type):
         self.cls = cls
@@ -85,6 +92,7 @@ class _Plan:
         # boundary where the old reflective path raised it loudly
         self._coercers: tuple | None = None
         self._hint_err: Exception | None = None
+        hints: dict = {}
         try:
             hints = typing.get_type_hints(cls)
         except Exception as e:
@@ -92,6 +100,15 @@ class _Plan:
         else:
             self._coercers = tuple(_compile_coercer(hints.get(n))
                                    for n in self.names)
+        try:
+            self.enc = _compile_encoder(self, hints)
+        except Exception:          # codegen must never break encoding
+            self.enc = self._generic_enc
+
+    def _generic_enc(self, w: bytearray, obj) -> None:
+        w += self.header
+        for name in self.names:
+            _encode(w, getattr(obj, name))
 
     @property
     def coercers(self) -> tuple:
@@ -100,6 +117,136 @@ class _Plan:
                 f"serde: cannot resolve type hints of "
                 f"{self.cls.__name__}: {self._hint_err}") from self._hint_err
         return self._coercers
+
+
+def _unwrap_optional(hint):
+    """Optional[T] -> (T, True); otherwise (hint, False)."""
+    origin = typing.get_origin(hint)
+    if origin is typing.Union or origin is types.UnionType:
+        args = [a for a in typing.get_args(hint) if a is not type(None)]
+        if len(args) == 1:
+            return args[0], True
+    return hint, False
+
+
+def _emit_varint(lines, ind, v):
+    lines += [f"{ind}while True:",
+              f"{ind}    _b = {v} & 0x7F",
+              f"{ind}    {v} >>= 7",
+              f"{ind}    if {v}:",
+              f"{ind}        w.append(_b | 0x80)",
+              f"{ind}    else:",
+              f"{ind}        w.append(_b)",
+              f"{ind}        break"]
+
+
+def _emit_value(lines, ns, ind, v, hint, depth):
+    """Emit encoding code for one value `v` of hinted type: an inline fast
+    path where a specialization exists, a generic `_encode(w, v)` call
+    otherwise — and ALWAYS a generic fallback branch on runtime type
+    mismatch, so output is byte-identical to the reflective path."""
+    hint, optional = _unwrap_optional(hint)
+    if optional:
+        lines.append(f"{ind}if {v} is None:")
+        lines.append(f"{ind}    w += _B_NONE")
+        lines.append(f"{ind}else:")
+        ind += "    "
+    if isinstance(hint, type) and issubclass(hint, enum.Enum):
+        en = f"_E{len(ns)}"
+        ns[en] = hint
+        lines.append(f"{ind}if isinstance({v}, {en}):")
+        lines.append(f"{ind}    {v} = {v}.value")
+        hint = int if issubclass(hint, int) else (
+            str if issubclass(hint, str) else None)
+        if hint is None:
+            lines.append(f"{ind}_encode(w, {v})")
+            return True
+    if hint is bool:
+        lines += [f"{ind}if {v} is True:",
+                  f"{ind}    w += _B_TRUE",
+                  f"{ind}elif {v} is False:",
+                  f"{ind}    w += _B_FALSE",
+                  f"{ind}else:",
+                  f"{ind}    _encode(w, {v})"]
+        return True
+    if hint is int:
+        lines += [f"{ind}if type({v}) is int:",
+                  f"{ind}    if {v} >= 0:",
+                  f"{ind}        w.append(3)"]
+        _emit_varint(lines, ind + "        ", v)
+        lines += [f"{ind}    else:",
+                  f"{ind}        w.append(4)",
+                  f"{ind}        {v} = -{v} - 1"]
+        _emit_varint(lines, ind + "        ", v)
+        lines += [f"{ind}else:",
+                  f"{ind}    _encode(w, {v})"]
+        return True
+    if hint is float:
+        lines += [f"{ind}if type({v}) is float:",
+                  f"{ind}    w.append(5)",
+                  f"{ind}    w += _pack_d({v})",
+                  f"{ind}else:",
+                  f"{ind}    _encode(w, {v})"]
+        return True
+    if hint is str:
+        lines += [f"{ind}if type({v}) is str:",
+                  f"{ind}    _sb = {v}.encode('utf-8')",
+                  f"{ind}    w.append(7)",
+                  f"{ind}    w += _varint(len(_sb))",
+                  f"{ind}    w += _sb",
+                  f"{ind}else:",
+                  f"{ind}    _encode(w, {v})"]
+        return True
+    if hint is bytes:
+        lines += [f"{ind}if type({v}) is bytes:",
+                  f"{ind}    w.append(6)",
+                  f"{ind}    w += _varint(len({v}))",
+                  f"{ind}    w += {v}",
+                  f"{ind}else:",
+                  f"{ind}    _encode(w, {v})"]
+        return True
+    origin = typing.get_origin(hint)
+    if origin in (list, tuple) and depth < 2:
+        args = typing.get_args(hint)
+        elem_hint = args[0] if args else None
+        x = f"_x{depth}_{len(ns)}"
+        lines.append(f"{ind}if type({v}) is list or type({v}) is tuple:")
+        lines.append(f"{ind}    w.append(8)")
+        lines.append(f"{ind}    _n = len({v})")
+        _emit_varint(lines, ind + "    ", "_n")
+        lines.append(f"{ind}    for {x} in {v}:")
+        if elem_hint is None:
+            lines.append(f"{ind}        _encode(w, {x})")
+        else:
+            _emit_value(lines, ns, ind + "        ", x, elem_hint, depth + 1)
+        lines.append(f"{ind}else:")
+        lines.append(f"{ind}    _encode(w, {v})")
+        return True
+    if isinstance(hint, type) and is_dataclass(hint) \
+            and _registry.get(hint.__name__) is hint:
+        cn = f"_C{len(ns)}"
+        ns[cn] = hint
+        lines += [f"{ind}if type({v}) is {cn}:",
+                  f"{ind}    _plan_of({cn}).enc(w, {v})",
+                  f"{ind}else:",
+                  f"{ind}    _encode(w, {v})"]
+        return True
+    lines.append(f"{ind}_encode(w, {v})")
+    return True
+
+
+def _compile_encoder(plan: "_Plan", hints: dict):
+    """exec-generate enc(w, obj) for one registered dataclass."""
+    ns: dict = {"_encode": _encode, "_varint": _varint, "_pack_d": _pack_d,
+                "_B_NONE": _B_NONE, "_B_TRUE": _B_TRUE, "_B_FALSE": _B_FALSE,
+                "_plan_of": _plan_of, "_HDR": plan.header}
+    lines = ["def enc(w, obj):", "    w += _HDR"]
+    for i, name in enumerate(plan.names):
+        v = f"v{i}"
+        lines.append(f"    {v} = obj.{name}")
+        _emit_value(lines, ns, "    ", v, hints.get(name), 0)
+    exec("\n".join(lines), ns)          # noqa: S102 (trusted codegen)
+    return ns["enc"]
 
 
 def _plan_of(cls: type) -> _Plan:
@@ -209,10 +356,7 @@ def _encode(w: bytearray, obj) -> None:
         if _registry.get(cls.__name__) is None:
             raise TypeError(
                 f"serde: {cls.__name__} not registered (@serde_struct)")
-        plan = _plan_of(cls)
-        w += plan.header
-        for name in plan.names:
-            _encode(w, getattr(obj, name))
+        _plan_of(cls).enc(w, obj)
     else:
         raise TypeError(f"serde: cannot encode {type(obj)}")
 

@@ -394,6 +394,19 @@ def test_node_admin_ops_disable_enable_tags_unregister():
             "node self-report wiped admin disable"
         assert n3.tags == ["rack:r7"], "node self-report wiped tags"
 
+        # enable AFTER a restart heartbeat queued a pending save captured
+        # while DISABLED: the updater flush must not revert to DISABLED
+        await svc.heartbeat(HeartbeatReq(
+            node=NodeInfo(2, "a:2", generation=100.0)), b"", None)
+        await svc.disable_node(NodeOpReq(node_id=2), b"", None)
+        await svc.heartbeat(HeartbeatReq(           # restart: new generation
+            node=NodeInfo(2, "a:2", generation=107.0)), b"", None)
+        assert 2 in st.pending_node_saves
+        await svc.enable_node(NodeOpReq(node_id=2), b"", None)
+        await srv.update_chains_once()
+        assert st.routing().nodes[2].status == NodeStatus.ACTIVE, \
+            "pending restart-save reverted an admin enable"
+
         # unregister refuses while on a chain or still heartbeating
         with pytest.raises(StatusError):
             await svc.unregister_node(NodeOpReq(node_id=1), b"", None)
