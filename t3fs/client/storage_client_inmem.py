@@ -110,6 +110,13 @@ class StorageClientInMem:
                 self.chunks[key] = _Chunk(
                     c.data[:boundary_off].ljust(boundary_off, b"\x00"),
                     c.update_ver + 1)
+        if boundary_off:
+            # the real client TRUNCATE-writes the boundary chunk even when
+            # it doesn't exist (exact-length semantics; the differential
+            # fuzz caught the fake skipping this) — mirror it
+            bkey = (layout.chain_of(boundary), ChunkId(inode, boundary))
+            if bkey not in self.chunks:
+                self.chunks[bkey] = _Chunk(b"\x00" * boundary_off, 1)
 
     async def close(self) -> None:
         pass

@@ -815,15 +815,18 @@ class MgmtdService:
                     str(int(ver or 1) + 1).encode())
             out[:] = [updated]
         await with_transaction(st.kv, txn_fn)
+        await st.load_routing()
         # rebase any pending restart-save on the admin result: the updater
         # flush would otherwise re-persist the PRE-admin status/tags it
         # captured at heartbeat time (keep its generation — that's the
-        # restart-detection payload it exists to deliver)
+        # restart-detection payload it exists to deliver).  AFTER
+        # load_routing: a heartbeat landing during the reload reads the
+        # stale cache and re-captures the pre-admin status; rebasing last
+        # covers that window too.
         pend = st.pending_node_saves.get(node_id)
         if pend is not None:
             pend.status = out[0].status
             pend.tags = list(out[0].tags)
-        await st.load_routing()
         return out[0]
 
     @rpc_method

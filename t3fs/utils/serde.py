@@ -172,10 +172,10 @@ def _emit_value(lines, ns, ind, v, hint, depth):
     if hint is int:
         lines += [f"{ind}if type({v}) is int:",
                   f"{ind}    if {v} >= 0:",
-                  f"{ind}        w.append(3)"]
+                  f"{ind}        w.append({T_INT})"]
         _emit_varint(lines, ind + "        ", v)
         lines += [f"{ind}    else:",
-                  f"{ind}        w.append(4)",
+                  f"{ind}        w.append({T_NEGINT})",
                   f"{ind}        {v} = -{v} - 1"]
         _emit_varint(lines, ind + "        ", v)
         lines += [f"{ind}else:",
@@ -183,7 +183,7 @@ def _emit_value(lines, ns, ind, v, hint, depth):
         return True
     if hint is float:
         lines += [f"{ind}if type({v}) is float:",
-                  f"{ind}    w.append(5)",
+                  f"{ind}    w.append({T_FLOAT})",
                   f"{ind}    w += _pack_d({v})",
                   f"{ind}else:",
                   f"{ind}    _encode(w, {v})"]
@@ -191,7 +191,7 @@ def _emit_value(lines, ns, ind, v, hint, depth):
     if hint is str:
         lines += [f"{ind}if type({v}) is str:",
                   f"{ind}    _sb = {v}.encode('utf-8')",
-                  f"{ind}    w.append(7)",
+                  f"{ind}    w.append({T_STR})",
                   f"{ind}    w += _varint(len(_sb))",
                   f"{ind}    w += _sb",
                   f"{ind}else:",
@@ -199,7 +199,7 @@ def _emit_value(lines, ns, ind, v, hint, depth):
         return True
     if hint is bytes:
         lines += [f"{ind}if type({v}) is bytes:",
-                  f"{ind}    w.append(6)",
+                  f"{ind}    w.append({T_BYTES})",
                   f"{ind}    w += _varint(len({v}))",
                   f"{ind}    w += {v}",
                   f"{ind}else:",
@@ -211,7 +211,7 @@ def _emit_value(lines, ns, ind, v, hint, depth):
         elem_hint = args[0] if args else None
         x = f"_x{depth}_{len(ns)}"
         lines.append(f"{ind}if type({v}) is list or type({v}) is tuple:")
-        lines.append(f"{ind}    w.append(8)")
+        lines.append(f"{ind}    w.append({T_LIST})")
         lines.append(f"{ind}    _n = len({v})")
         _emit_varint(lines, ind + "    ", "_n")
         lines.append(f"{ind}    for {x} in {v}:")
@@ -408,16 +408,25 @@ def _decode(r: _Reader):
             raise ValueError(f"serde: unknown struct {name!r}")
         plan = _plan_of(cls)
         nfields = r.varint()
-        names, coercers = plan.names, plan.coercers
-        nown = len(names)
-        # forward/backward compat: extra fields dropped, missing use defaults
-        kwargs = {}
+        coercers = plan.coercers
+        nown = len(coercers)
+        # forward/backward compat: extra fields dropped, missing use
+        # defaults.  Positional construction (fields in declaration order)
+        # skips a kwargs dict per struct on the hot path.
+        if nfields <= nown:
+            args = []
+            for i in range(nfields):
+                v = _decode(r)
+                c = coercers[i]
+                args.append(v if c is None else c(v))
+            return cls(*args)
+        args = []
         for i in range(nfields):
             v = _decode(r)
             if i < nown:
                 c = coercers[i]
-                kwargs[names[i]] = v if c is None else c(v)
-        return cls(**kwargs)
+                args.append(v if c is None else c(v))
+        return cls(*args)
     if tag == T_BYTES:
         return r.exact(r.varint())
     if tag == T_STR:
