@@ -483,6 +483,26 @@ async def ls(ctx: AdminContext, args) -> None:
     print(_fmt_table(rows, ["name", "type", "inode"]))
 
 
+@command("chmod", "change a path's permissions")
+@args_(("path", {}), ("mode", {"help": "octal, e.g. 640"}))
+async def chmod(ctx: AdminContext, args) -> None:
+    fs = await ctx.fs()
+    ino = await fs.stat(args.path)
+    ino = await fs.meta.set_attr_inode(ino.inode_id,
+                                       perm=int(args.mode, 8))
+    print(f"{args.path}: perm={oct(ino.perm)}")
+
+
+@command("chown", "change a path's owner/group")
+@args_(("path", {}), ("uid", {"type": int}), ("gid", {"type": int}))
+async def chown(ctx: AdminContext, args) -> None:
+    fs = await ctx.fs()
+    ino = await fs.stat(args.path)
+    ino = await fs.meta.set_attr_inode(ino.inode_id,
+                                       uid=args.uid, gid=args.gid)
+    print(f"{args.path}: uid={ino.uid} gid={ino.gid}")
+
+
 @command("stat", "stat a path")
 @args_(("path", {}))
 async def stat(ctx: AdminContext, args) -> None:

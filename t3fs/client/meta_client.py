@@ -14,7 +14,7 @@ import uuid as uuidlib
 
 from t3fs.meta.schema import DirEntry, Inode
 from t3fs.meta.service import (
-    BatchStatReq, EntryReq, InodeReq, PathReq, PruneSessionReq,
+    BatchStatReq, EntryReq, InodeReq, PathReq, PruneSessionReq, SetAttrReq,
 )
 from t3fs.net.client import Client
 from t3fs.utils.status import StatusError
@@ -192,6 +192,15 @@ class MetaClient:
     async def batch_stat_inodes(self, inode_ids: list[int]) -> list[Inode | None]:
         return (await self._call("batch_stat", BatchStatReq(
             inode_ids=inode_ids))).inodes
+
+    async def set_attr_inode(self, inode_id: int, *, perm: int = -1,
+                             uid: int = -1, gid: int = -1,
+                             atime: float = -1.0,
+                             mtime: float = -1.0) -> Inode:
+        """chmod/chown/utimens by nodeid (-1 = leave unchanged)."""
+        return (await self._call("set_attr_inode", SetAttrReq(
+            inode_id=inode_id, perm=perm, uid=uid, gid=gid,
+            atime=atime, mtime=mtime))).inode
 
     async def prune_sessions(self, session_ids: list[str] = ()) -> None:
         """Release this client's write sessions eagerly (reference

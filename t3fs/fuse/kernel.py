@@ -26,6 +26,7 @@ import logging
 import os
 import stat as statmod
 import struct
+import time as _time
 
 from t3fs.fuse.user_config import (
     VIRT_NAME, MountUserConfig, UserConfig, VirtualTree,
@@ -63,6 +64,8 @@ _MKDIR_IN = struct.Struct("<II")              # mode umask
 _RENAME2_IN = struct.Struct("<QII")           # newdir flags pad
 
 FATTR_MODE, FATTR_UID, FATTR_GID, FATTR_SIZE = 1, 2, 4, 8
+FATTR_ATIME, FATTR_MTIME = 16, 32
+FATTR_ATIME_NOW, FATTR_MTIME_NOW = 128, 256
 MS_NOSUID, MS_NODEV = 2, 4
 MNT_DETACH = 2
 O_ACCMODE = 0o3
@@ -228,8 +231,13 @@ class FuseKernelMount:
             length = len(inode.symlink_target)
         blocks = (length + 511) // 512
         t = int(inode.mtime)
+        # atime/ctime are initialized at first touch (schema.touch), so a
+        # zero here is either a legacy record (fall back to mtime) or a
+        # deliberate utimens(0) on a live record — which also set ctime,
+        # letting the two cases be told apart
+        atime = int(inode.atime) if (inode.atime or inode.ctime) else t
         return _ATTR.pack(inode.inode_id, length, blocks,
-                          int(inode.atime) or t, t, int(inode.ctime) or t,
+                          atime, t, int(inode.ctime) or t,
                           0, 0, 0, _mode_of(inode), max(1, inode.nlink),
                           inode.uid, inode.gid, 0, 4096, 0)
 
@@ -409,13 +417,30 @@ class FuseKernelMount:
             return b""
         if opcode == SETATTR:
             (valid, _p, fh, size, _lock, _at, _mt, _ct,
-             *_rest) = _SETATTR_IN.unpack_from(body)
+             atns, mtns, _ctns, mode, _u4, uid_, gid_, _u5
+             ) = _SETATTR_IN.unpack_from(body)
+            inode = None
             if valid & FATTR_SIZE:
                 inode = await self.mc.truncate(nodeid, size)
                 if nodeid in self._open_len:
                     self._open_len[nodeid] = size
-            else:
-                # mode/uid/gid/time updates are accepted and ignored (v1)
+            now = _time.time()
+            attrs = {}
+            if valid & FATTR_MODE:
+                attrs["perm"] = mode & 0o7777
+            if valid & FATTR_UID:
+                attrs["uid"] = uid_
+            if valid & FATTR_GID:
+                attrs["gid"] = gid_
+            if valid & FATTR_ATIME:
+                attrs["atime"] = (now if valid & FATTR_ATIME_NOW
+                                  else _at + atns / 1e9)
+            if valid & FATTR_MTIME:
+                attrs["mtime"] = (now if valid & FATTR_MTIME_NOW
+                                  else _mt + mtns / 1e9)
+            if attrs:
+                inode = await self.mc.set_attr_inode(nodeid, **attrs)
+            if inode is None:
                 inode = await self.mc.stat_inode(nodeid)
             return self._attr_out(inode, ucfg)
         if opcode == STATFS:

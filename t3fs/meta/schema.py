@@ -56,6 +56,10 @@ class Inode:
 
     def touch(self) -> "Inode":
         self.mtime = self.ctime = time.time()
+        if not self.atime:
+            self.atime = self.mtime   # initialize on first mutation so the
+                                      # FUSE attr never needs a falsy-zero
+                                      # fallback (user-set atime=0 stays 0)
         return self
 
 

@@ -105,6 +105,18 @@ class PruneSessionReq:
 
 @serde_struct
 @dataclass
+class SetAttrReq:
+    """Inode-addressed setattr; -1 / NaN-free sentinel = unchanged."""
+    inode_id: int = 0
+    perm: int = -1
+    uid: int = -1
+    gid: int = -1
+    atime: float = -1.0
+    mtime: float = -1.0
+
+
+@serde_struct
+@dataclass
 class BatchStatReq:
     paths: list[str] = field(default_factory=list)
     inode_ids: list[int] = field(default_factory=list)
@@ -213,6 +225,18 @@ class MetaService:
     async def set_attr(self, req: PathReq, payload, conn):
         return InodeRsp(inode=await self.store.set_attr(
             req.path, perm=req.perm)), b""
+
+    @rpc_method
+    async def set_attr_inode(self, req: SetAttrReq, payload, conn):
+        """chmod/chown/utimens by nodeid (FUSE lowlevel setattr)."""
+        inode = await self.store.set_attr_inode(
+            req.inode_id,
+            perm=None if req.perm < 0 else req.perm,
+            uid=None if req.uid < 0 else req.uid,
+            gid=None if req.gid < 0 else req.gid,
+            atime=None if req.atime < 0 else req.atime,
+            mtime=None if req.mtime < 0 else req.mtime)
+        return InodeRsp(inode=inode), b""
 
     @rpc_method
     async def truncate(self, req: InodeReq, payload, conn):

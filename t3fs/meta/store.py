@@ -800,6 +800,24 @@ class MetaStore:
                 txn.clear(DirEntry.key(child.parent, child.name))
         await self._unlink_entry(txn, dent)
 
+    @staticmethod
+    def _apply_attrs(inode: Inode, *, perm=None, uid=None, gid=None,
+                     atime=None, mtime=None) -> Inode:
+        """The single attr-mutation rule (POSIX: attribute changes bump
+        ctime only; an explicit utimens mtime is user data, not a bump)."""
+        if perm is not None:
+            inode.perm = perm & 0o7777
+        if uid is not None:
+            inode.uid = uid
+        if gid is not None:
+            inode.gid = gid
+        if atime is not None:
+            inode.atime = atime
+        if mtime is not None:
+            inode.mtime = mtime
+        inode.ctime = time.time()
+        return inode
+
     async def set_attr(self, path: str, *, perm: int | None = None,
                        uid: int | None = None, gid: int | None = None) -> Inode:
         async def fn(txn: Transaction):
@@ -807,14 +825,24 @@ class MetaStore:
             if dent is None:
                 raise make_error(StatusCode.META_NOT_FOUND, path)
             inode = await self._require_inode(txn, dent.inode_id)
-            if perm is not None:
-                inode.perm = perm
-            if uid is not None:
-                inode.uid = uid
-            if gid is not None:
-                inode.gid = gid
-            inode.touch()
+            self._apply_attrs(inode, perm=perm, uid=uid, gid=gid)
             txn.set(Inode.key(inode.inode_id), serde.dumps(inode))
+            return inode
+        return await self._txn(fn)
+
+    async def set_attr_inode(self, inode_id: int, *,
+                             perm: int | None = None,
+                             uid: int | None = None,
+                             gid: int | None = None,
+                             atime: float | None = None,
+                             mtime: float | None = None) -> Inode:
+        """Inode-addressed setattr (the FUSE lowlevel surface: chmod/chown/
+        utimens arrive by nodeid, not path — reference FuseOps setattr)."""
+        async def fn(txn: Transaction):
+            inode = await self._require_inode(txn, inode_id)
+            self._apply_attrs(inode, perm=perm, uid=uid, gid=gid,
+                              atime=atime, mtime=mtime)
+            txn.set(Inode.key(inode_id), serde.dumps(inode))
             return inode
         return await self._txn(fn)
 

@@ -98,6 +98,10 @@ def test_admin_cli_families():
             assert "blob" in out
             out = run_cli(cluster, "stat", "/cli/blob")
             assert "length=200000" in out
+            out = run_cli(cluster, "chmod", "/cli/blob", "640")
+            assert "perm=0o640" in out
+            out = run_cli(cluster, "chown", "/cli/blob", "7", "8")
+            assert "uid=7 gid=8" in out
             fetched = os.path.join(d, "fetched.bin")
             run_cli(cluster, "get", "/cli/blob", fetched)
             assert open(fetched, "rb").read() == open(local, "rb").read()

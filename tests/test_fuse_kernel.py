@@ -174,3 +174,39 @@ def test_user_config_per_uid_isolation():
             raise AssertionError(f"accepted {bad}")
         except ValueError:
             pass
+
+
+def test_mount_setattr_chmod_chown_utimens():
+    """SETATTR beyond size: chmod/chown/utimens persist through meta and
+    read back via stat (reference FuseOps setattr)."""
+    async def body():
+        tmp = tempfile.mkdtemp(prefix="t3fs-fuse-")
+        cluster, fuse, mnt = await _mounted(tmp)
+        try:
+            def posix_ops():
+                p = f"{mnt}/attrs.txt"
+                with open(p, "wb") as f:
+                    f.write(b"abc")
+                os.chmod(p, 0o640)
+                st = os.stat(p)
+                assert st.st_mode & 0o7777 == 0o640, oct(st.st_mode)
+                os.chown(p, 1234, 5678)
+                st = os.stat(p)
+                assert (st.st_uid, st.st_gid) == (1234, 5678)
+                os.utime(p, (1_600_000_000, 1_600_000_100))
+                st = os.stat(p)
+                assert int(st.st_atime) == 1_600_000_000
+                assert int(st.st_mtime) == 1_600_000_100
+                # utimensat with UTIME_NOW via os.utime(None)
+                os.utime(p)
+                assert abs(os.stat(p).st_mtime - __import__("time").time()) < 60
+            await asyncio.to_thread(posix_ops)
+            # survives cache: the attrs came back from meta, not the kernel
+            inode = await cluster.mc.stat("/attrs.txt")
+            assert inode.perm == 0o640
+            assert (inode.uid, inode.gid) == (1234, 5678)
+            await fuse.unmount()
+        finally:
+            await cluster.stop()
+            shutil.rmtree(tmp, ignore_errors=True)
+    run(body())
