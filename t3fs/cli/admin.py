@@ -484,12 +484,12 @@ async def ls(ctx: AdminContext, args) -> None:
 
 
 @command("chmod", "change a path's permissions")
-@args_(("path", {}), ("mode", {"help": "octal, e.g. 640"}))
+@args_(("path", {}), ("mode", {"type": lambda s: int(s, 8),
+                               "help": "octal, e.g. 640"}))
 async def chmod(ctx: AdminContext, args) -> None:
     fs = await ctx.fs()
     ino = await fs.stat(args.path)
-    ino = await fs.meta.set_attr_inode(ino.inode_id,
-                                       perm=int(args.mode, 8))
+    ino = await fs.meta.set_attr_inode(ino.inode_id, perm=args.mode)
     print(f"{args.path}: perm={oct(ino.perm)}")
 
 

@@ -66,6 +66,7 @@ _RENAME2_IN = struct.Struct("<QII")           # newdir flags pad
 FATTR_MODE, FATTR_UID, FATTR_GID, FATTR_SIZE = 1, 2, 4, 8
 FATTR_ATIME, FATTR_MTIME = 16, 32
 FATTR_ATIME_NOW, FATTR_MTIME_NOW = 128, 256
+FUSE_DO_READDIRPLUS, FUSE_READDIRPLUS_AUTO = 1 << 13, 1 << 14
 MS_NOSUID, MS_NODEV = 2, 4
 MNT_DETACH = 2
 O_ACCMODE = 0o3
@@ -97,13 +98,17 @@ def _mode_of(inode) -> int:
 
 
 class _Handle:
-    __slots__ = ("inode", "session", "writable", "entries")
+    __slots__ = ("inode", "session", "writable", "entries", "plus",
+                 "virtual")
 
-    def __init__(self, inode, session="", writable=False, entries=None):
+    def __init__(self, inode, session="", writable=False, entries=None,
+                 virtual=False):
         self.inode = inode
         self.session = session
         self.writable = writable
         self.entries = entries            # dir handles: snapshot listing
+        self.plus = None                  # readdirplus: inode_id -> Inode
+        self.virtual = virtual            # /t3fs-virt ids: never meta-stat
 
 
 class FuseKernelMount:
@@ -231,13 +236,11 @@ class FuseKernelMount:
             length = len(inode.symlink_target)
         blocks = (length + 511) // 512
         t = int(inode.mtime)
-        # atime/ctime are initialized at first touch (schema.touch), so a
-        # zero here is either a legacy record (fall back to mtime) or a
-        # deliberate utimens(0) on a live record — which also set ctime,
-        # letting the two cases be told apart
-        atime = int(inode.atime) if (inode.atime or inode.ctime) else t
+        # zero atime/ctime = legacy/unset record: display mtime.  Epoch-0
+        # and pre-1970 timestamps are OUT OF CONTRACT (SETATTR clamps
+        # negatives to 0) — they display as mtime, never as garbage.
         return _ATTR.pack(inode.inode_id, length, blocks,
-                          atime, t, int(inode.ctime) or t,
+                          int(inode.atime) or t, t, int(inode.ctime) or t,
                           0, 0, 0, _mode_of(inode), max(1, inode.nlink),
                           inode.uid, inode.gid, 0, 4096, 0)
 
@@ -278,7 +281,11 @@ class FuseKernelMount:
             if major < 7:
                 return b""                 # unsupportably old; shouldn't happen
             log.info("FUSE INIT kernel %d.%d flags=%#x", major, minor, flags)
-            return _INIT_OUT.pack(7, 31, 1 << 20, 0, 12, 10, self.max_write,
+            # negotiate readdirplus (one batched meta RPC serves a whole
+            # `ls -l` page) when the kernel offers it
+            out_flags = flags & (FUSE_DO_READDIRPLUS | FUSE_READDIRPLUS_AUTO)
+            return _INIT_OUT.pack(7, 31, 1 << 20, out_flags, 12, 10,
+                                  self.max_write,
                                   1, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0)
         if opcode == GETATTR:
             if ucfg.sync_on_stat:
@@ -316,6 +323,48 @@ class FuseKernelMount:
                     break
                 out += struct.pack("<QQII", ino, idx + 1, len(nb), _DT[itype])
                 out += nb + b"\0" * (rec - 24 - len(nb))
+                idx += 1
+            return bytes(out)
+        if opcode == READDIRPLUS:
+            # entries + attrs in one page (FuseOps readdirplus): the whole
+            # listing's attrs come from ONE batched meta RPC, cached on the
+            # dir handle — `ls -l` stops being one GETATTR per entry
+            fh, off, size, *_ = _READ_IN.unpack_from(body)
+            h = self._handles.get(fh)
+            if h is None or h.entries is None:
+                raise OSError(errno.EBADF, "bad dir handle")
+            if off == 0:
+                h.plus = None     # rewinddir(): re-fetch, don't re-prime
+                                  # the kernel attr cache with stale values
+            if h.plus is None:
+                if h.virtual:
+                    h.plus = {}       # virtual ids: kernel LOOKUPs on demand
+                else:
+                    ids = [ino for ino, name, _t in h.entries
+                           if name not in (".", "..")]
+                    inodes = (await self.mc.batch_stat_inodes(ids)
+                              if ids else [])
+                    h.plus = {i.inode_id: i for i in inodes
+                              if i is not None}
+            out = bytearray()
+            idx = off
+            while idx < len(h.entries):
+                ino, name, itype = h.entries[idx]
+                nb = name.encode()
+                rec = (152 + len(nb) + 7) & ~7
+                if out and len(out) + rec > size:
+                    break
+                inode = None if name in (".", "..") else h.plus.get(ino)
+                if inode is not None:
+                    entry = self._entry_out(inode, ucfg)
+                else:
+                    # nodeid 0: no lookup-count side effect; kernel will
+                    # LOOKUP on demand ('.'/'..'/raced-away entries)
+                    entry = b"\0" * 128
+                out += entry
+                out += struct.pack("<QQII", ino, idx + 1, len(nb),
+                                   _DT[itype])
+                out += nb + b"\0" * (rec - 152 - len(nb))
                 idx += 1
             return bytes(out)
         if opcode in (RELEASEDIR, RELEASE):
@@ -432,12 +481,17 @@ class FuseKernelMount:
                 attrs["uid"] = uid_
             if valid & FATTR_GID:
                 attrs["gid"] = gid_
+            # tv_sec arrives as u64; a pre-epoch time is two's-complement
+            # negative — clamp to 0 (out of contract) instead of storing a
+            # ~1.8e19 garbage date
+            def tsec(v, ns):
+                return 0.0 if v >= 1 << 62 else v + ns / 1e9
             if valid & FATTR_ATIME:
                 attrs["atime"] = (now if valid & FATTR_ATIME_NOW
-                                  else _at + atns / 1e9)
+                                  else tsec(_at, atns))
             if valid & FATTR_MTIME:
                 attrs["mtime"] = (now if valid & FATTR_MTIME_NOW
-                                  else _mt + mtns / 1e9)
+                                  else tsec(_mt, mtns))
             if attrs:
                 inode = await self.mc.set_attr_inode(nodeid, **attrs)
             if inode is None:
@@ -483,7 +537,7 @@ class FuseKernelMount:
             listing = v.listing(nodeid, uid)
             return _OPEN_OUT.pack(
                 self._new_fh(_Handle(v.getattr(nodeid, uid),
-                                     entries=listing)), 0, 0)
+                                     entries=listing, virtual=True)), 0, 0)
         if opcode == SYMLINK:
             name_b, target_b = body.split(b"\0", 2)[:2]
             from t3fs.fuse.user_config import RMRF_DIR
@@ -497,8 +551,8 @@ class FuseKernelMount:
             # LOOKUP fresh (a cached positive dentry would EEXIST it)
             return self._entry_out(ino, MountUserConfig(attr_timeout=0,
                                                         entry_timeout=0))
-        if opcode in (READDIR, RELEASEDIR, RELEASE, ACCESS, STATFS,
-                      FSYNCDIR):
+        if opcode in (READDIR, READDIRPLUS, RELEASEDIR, RELEASE, ACCESS,
+                      STATFS, FSYNCDIR):
             return NotImplemented          # generic handlers work as-is
         raise OSError(errno.EACCES, "virtual tree is config-only")
 

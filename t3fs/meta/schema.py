@@ -57,9 +57,10 @@ class Inode:
     def touch(self) -> "Inode":
         self.mtime = self.ctime = time.time()
         if not self.atime:
-            self.atime = self.mtime   # initialize on first mutation so the
-                                      # FUSE attr never needs a falsy-zero
-                                      # fallback (user-set atime=0 stays 0)
+            # initialize unset atime on first mutation.  Epoch-0 atime is
+            # out of contract (indistinguishable from unset; the FUSE attr
+            # displays mtime for it and SETATTR clamps negatives to 0)
+            self.atime = self.mtime
         return self
 
 

@@ -210,3 +210,46 @@ def test_mount_setattr_chmod_chown_utimens():
             await cluster.stop()
             shutil.rmtree(tmp, ignore_errors=True)
     run(body())
+
+
+def test_readdirplus_batched_attrs():
+    """`ls -l` served by READDIRPLUS: per-entry attrs arrive with the
+    listing from ONE batched meta RPC (reference FuseOps readdirplus),
+    not a GETATTR per entry."""
+    async def body():
+        tmp = tempfile.mkdtemp(prefix="t3fs-fuse-")
+        cluster, fuse, mnt = await _mounted(tmp)
+        try:
+            calls = {"batch": 0}
+            orig = fuse.mc.batch_stat_inodes
+
+            async def counting(ids):
+                calls["batch"] += 1
+                return await orig(ids)
+            fuse.mc.batch_stat_inodes = counting
+
+            def posix_ops():
+                os.mkdir(f"{mnt}/d")
+                for i in range(12):
+                    p = f"{mnt}/d/f{i:02d}"
+                    with open(p, "wb") as f:
+                        f.write(b"y" * (10 + i))
+                    os.chmod(p, 0o600 + i)
+                out = {}
+                with os.scandir(f"{mnt}/d") as it:
+                    for e in it:
+                        st = e.stat()          # served from the plus page
+                        out[e.name] = (st.st_size, st.st_mode & 0o7777)
+                return out
+            out = await asyncio.to_thread(posix_ops)
+            assert len(out) == 12
+            for i in range(12):
+                assert out[f"f{i:02d}"] == (10 + i, 0o600 + i), i
+            # one OPENDIR -> one batched stat (the kernel may re-list;
+            # allow a small number, never one-per-entry)
+            assert 1 <= calls["batch"] <= 3, calls
+            await fuse.unmount()
+        finally:
+            await cluster.stop()
+            shutil.rmtree(tmp, ignore_errors=True)
+    run(body())
