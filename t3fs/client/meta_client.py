@@ -174,6 +174,11 @@ class MetaClient:
             parent=sparent, name=sname, dparent=dparent, dname=dname,
             client_id=self.client_id, request_id=self._rid()))
 
+    async def link_at(self, inode_id: int, parent: int, name: str) -> Inode:
+        return (await self._call("link_at", EntryReq(
+            inode_id=inode_id, parent=parent, name=name,
+            client_id=self.client_id, request_id=self._rid()))).inode
+
     async def open_inode(self, inode_id: int,
                          write: bool = False) -> tuple[Inode, str]:
         rsp = await self._call("open_inode", EntryReq(

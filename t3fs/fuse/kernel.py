@@ -337,8 +337,12 @@ class FuseKernelMount:
                 h.plus = None     # rewinddir(): re-fetch, don't re-prime
                                   # the kernel attr cache with stale values
             if h.plus is None:
-                if h.virtual:
-                    h.plus = {}       # virtual ids: kernel LOOKUPs on demand
+                if h.virtual or (ucfg and ucfg.sync_on_stat):
+                    # virtual ids have no meta records; sync_on_stat mounts
+                    # must NOT prime the attr cache with un-synced lengths
+                    # (the GETATTR sync path is the whole point) — zeroed
+                    # entries make the kernel LOOKUP/GETATTR per file
+                    h.plus = {}
                 else:
                     ids = [ino for ino, name, _t in h.entries
                            if name not in (".", "..")]
@@ -419,6 +423,12 @@ class FuseKernelMount:
             await self.mc.unlink_at(nodeid, name,
                                     must_dir=(opcode == RMDIR))
             return b""
+        if opcode == LINK:
+            # fuse_link_in { u64 oldnodeid } + newname
+            (old_nodeid,) = struct.unpack_from("<Q", body)
+            name = body[8:].split(b"\0", 1)[0].decode()
+            return self._entry_out(
+                await self.mc.link_at(old_nodeid, nodeid, name), ucfg)
         if opcode in (RENAME, RENAME2):
             if opcode == RENAME:
                 newdir = struct.unpack_from("<Q", body)[0]

@@ -304,6 +304,14 @@ class MetaService:
         return InodeRsp(), b""
 
     @rpc_method
+    async def link_at(self, req: EntryReq, payload, conn):
+        """Entry-level hardlink (FUSE LINK): inode_id -> (parent, name)."""
+        inode = await self.store.link_at(
+            req.inode_id, req.parent, req.name,
+            client_id=req.client_id, request_id=req.request_id)
+        return InodeRsp(inode=inode), b""
+
+    @rpc_method
     async def open_inode(self, req: EntryReq, payload, conn):
         inode, session = await self.store.open_inode(
             req.inode_id, req.write, req.client_id)

@@ -689,27 +689,49 @@ class MetaStore:
         dropped, self._idem_cursor = await self._txn(fn)
         return dropped
 
+    async def _link_body(self, txn: Transaction, src_inode_id: int,
+                         parent: int, name: str, client_id: str) -> Inode:
+        """The single hardlink mutation rule, shared by the path op and the
+        entry op.  POSIX: link() bumps the file's ctime ONLY (the data did
+        not change — backup tools key on mtime)."""
+        inode = await self._require_inode(txn, src_inode_id)
+        if inode.itype == InodeType.DIRECTORY:
+            raise make_error(StatusCode.META_IS_DIR, str(src_inode_id))
+        if await self._get_dent(txn, parent, name) is not None:
+            raise make_error(StatusCode.META_EXISTS, name)
+        await self._require_unlocked_dir(txn, parent, client_id, name)
+        inode.nlink += 1
+        inode.ctime = time.time()
+        txn.set(Inode.key(src_inode_id), serde.dumps(inode))
+        txn.set(DirEntry.key(parent, name), serde.dumps(
+            DirEntry(parent, name, src_inode_id, inode.itype)))
+        return inode
+
     async def hardlink(self, existing: str, new_path: str,
                        client_id: str = "", request_id: str = "") -> Inode:
         async def fn(txn: Transaction):
             _, _, src = await self.resolve(txn, existing)
             if src is None:
                 raise make_error(StatusCode.META_NOT_FOUND, existing)
-            if src.itype == InodeType.DIRECTORY:
-                raise make_error(StatusCode.META_IS_DIR, existing)
             parent, name, dent = await self.resolve(txn, new_path, follow_last=False)
             if dent is not None:
                 raise make_error(StatusCode.META_EXISTS, new_path)
-            await self._require_unlocked_dir(txn, parent, client_id, new_path)
-            inode = await self._require_inode(txn, src.inode_id)
-            inode.nlink += 1
-            inode.touch()
-            txn.set(Inode.key(inode.inode_id), serde.dumps(inode))
-            txn.set(DirEntry.key(parent, name), serde.dumps(
-                DirEntry(parent, name, inode.inode_id, src.itype)))
-            return inode
+            return await self._link_body(txn, src.inode_id, parent, name,
+                                         client_id)
         inode = await self._txn_idem(fn, "hardlink", client_id, request_id)
         self._emit(Ev.HARDLINK, inode_id=inode.inode_id, entry_name=new_path,
+                   nlink=inode.nlink, client_id=client_id)
+        return inode
+
+    async def link_at(self, inode_id: int, parent: int, name: str,
+                      client_id: str = "", request_id: str = "") -> Inode:
+        """Entry-level hardlink (FUSE LINK: existing nodeid -> (parent,
+        name)); shares the mutation rule with the path op."""
+        async def fn(txn: Transaction):
+            return await self._link_body(txn, inode_id, parent, name,
+                                         client_id)
+        inode = await self._txn_idem(fn, "link_at", client_id, request_id)
+        self._emit(Ev.HARDLINK, inode_id=inode.inode_id, entry_name=name,
                    nlink=inode.nlink, client_id=client_id)
         return inode
 

@@ -253,3 +253,36 @@ def test_readdirplus_batched_attrs():
             await cluster.stop()
             shutil.rmtree(tmp, ignore_errors=True)
     run(body())
+
+
+def test_mount_hardlink():
+    """`ln` on the mount (FUSE LINK): nlink bumps, data is shared, unlink
+    of one name keeps the other."""
+    async def body():
+        tmp = tempfile.mkdtemp(prefix="t3fs-fuse-")
+        cluster, fuse, mnt = await _mounted(tmp)
+        try:
+            def posix_ops():
+                a, b = f"{mnt}/a", f"{mnt}/b"
+                with open(a, "wb") as f:
+                    f.write(b"linked-data")
+                os.link(a, b)
+                assert os.stat(a).st_nlink == 2
+                assert os.stat(b).st_ino == os.stat(a).st_ino
+                assert open(b, "rb").read() == b"linked-data"
+                os.unlink(a)
+                assert open(b, "rb").read() == b"linked-data"
+                assert os.stat(b).st_nlink == 1
+                # hardlinking a directory is refused
+                os.mkdir(f"{mnt}/dir2")
+                try:
+                    os.link(f"{mnt}/dir2", f"{mnt}/dir2ln")
+                    raise AssertionError("dir hardlink accepted")
+                except (PermissionError, OSError):
+                    pass
+            await asyncio.to_thread(posix_ops)
+            await fuse.unmount()
+        finally:
+            await cluster.stop()
+            shutil.rmtree(tmp, ignore_errors=True)
+    run(body())

@@ -562,3 +562,19 @@ def test_prune_session_rpc_client_scoped(store):
             PruneSessionReq(client_id="mount-B"), b"", None)
         assert await store.scan_sessions() == []
     run(body())
+
+
+def test_hardlink_bumps_ctime_not_mtime(store):
+    """POSIX link(): the linked file's mtime must NOT change (backup tools
+    key on it); only ctime bumps.  Covers both the path op and link_at."""
+    async def body():
+        inode, _ = await store.create("/orig")
+        before = await store.stat("/orig")
+        await asyncio.sleep(0.01)
+        linked = await store.hardlink("/orig", "/via-path")
+        assert linked.mtime == before.mtime
+        assert linked.ctime > before.ctime
+        linked2 = await store.link_at(inode.inode_id, 1, "via-entry")
+        assert linked2.mtime == before.mtime
+        assert linked2.nlink == 3
+    run(body())
