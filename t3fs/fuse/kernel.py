@@ -248,6 +248,16 @@ class FuseKernelMount:
     def _split_s(t: float) -> tuple[int, int]:
         return int(t), int((t - int(t)) * 1e9)
 
+    @staticmethod
+    def _attr_cache_cfg(ucfg: MountUserConfig | None):
+        """sync_on_stat mounts must not let non-sync paths (LOOKUP,
+        READDIRPLUS) prime the kernel attr cache — zero attr_timeout there
+        forces stat() through GETATTR, the only op that settles lengths."""
+        if ucfg is not None and ucfg.sync_on_stat and ucfg.attr_timeout:
+            import dataclasses
+            return dataclasses.replace(ucfg, attr_timeout=0.0)
+        return ucfg
+
     def _entry_out(self, inode, ucfg: MountUserConfig | None = None) -> bytes:
         at, an = self._split_s(ucfg.attr_timeout if ucfg else 1.0)
         et, en = self._split_s(ucfg.entry_timeout if ucfg else 1.0)
@@ -298,7 +308,8 @@ class FuseKernelMount:
             return self._attr_out(await self.mc.stat_inode(nodeid), ucfg)
         if opcode == LOOKUP:
             name = body.split(b"\0", 1)[0].decode()
-            return self._entry_out(await self.mc.lookup(nodeid, name), ucfg)
+            return self._entry_out(await self.mc.lookup(nodeid, name),
+                                   self._attr_cache_cfg(ucfg))
         if opcode == OPENDIR:
             entries, inode = await asyncio.gather(
                 self.mc.readdir_inode(nodeid), self.mc.stat_inode(nodeid))
@@ -337,12 +348,8 @@ class FuseKernelMount:
                 h.plus = None     # rewinddir(): re-fetch, don't re-prime
                                   # the kernel attr cache with stale values
             if h.plus is None:
-                if h.virtual or (ucfg and ucfg.sync_on_stat):
-                    # virtual ids have no meta records; sync_on_stat mounts
-                    # must NOT prime the attr cache with un-synced lengths
-                    # (the GETATTR sync path is the whole point) — zeroed
-                    # entries make the kernel LOOKUP/GETATTR per file
-                    h.plus = {}
+                if h.virtual:
+                    h.plus = {}       # virtual ids: kernel LOOKUPs on demand
                 else:
                     ids = [ino for ino, name, _t in h.entries
                            if name not in (".", "..")]
@@ -360,7 +367,10 @@ class FuseKernelMount:
                     break
                 inode = None if name in (".", "..") else h.plus.get(ino)
                 if inode is not None:
-                    entry = self._entry_out(inode, ucfg)
+                    # sync_on_stat: attrs ride along but with zero validity,
+                    # so stat() still goes through the GETATTR sync path
+                    entry = self._entry_out(inode,
+                                            self._attr_cache_cfg(ucfg))
                 else:
                     # nodeid 0: no lookup-count side effect; kernel will
                     # LOOKUP on demand ('.'/'..'/raced-away entries)
@@ -427,8 +437,14 @@ class FuseKernelMount:
             # fuse_link_in { u64 oldnodeid } + newname
             (old_nodeid,) = struct.unpack_from("<Q", body)
             name = body[8:].split(b"\0", 1)[0].decode()
-            return self._entry_out(
-                await self.mc.link_at(old_nodeid, nodeid, name), ucfg)
+            try:
+                return self._entry_out(
+                    await self.mc.link_at(old_nodeid, nodeid, name), ucfg)
+            except StatusError as e:
+                if e.code == StatusCode.META_IS_DIR:
+                    # POSIX link(2): directory oldpath is EPERM, not EISDIR
+                    raise OSError(errno.EPERM, "hardlink of a directory")
+                raise
         if opcode in (RENAME, RENAME2):
             if opcode == RENAME:
                 newdir = struct.unpack_from("<Q", body)[0]

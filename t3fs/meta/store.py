@@ -731,8 +731,8 @@ class MetaStore:
             return await self._link_body(txn, inode_id, parent, name,
                                          client_id)
         inode = await self._txn_idem(fn, "link_at", client_id, request_id)
-        self._emit(Ev.HARDLINK, inode_id=inode.inode_id, entry_name=name,
-                   nlink=inode.nlink, client_id=client_id)
+        self._emit(Ev.HARDLINK, inode_id=inode.inode_id, parent_id=parent,
+                   entry_name=name, nlink=inode.nlink, client_id=client_id)
         return inode
 
     async def _rename_body(self, txn: Transaction, sparent: int, sname: str,
