@@ -21,6 +21,7 @@ from __future__ import annotations
 import asyncio
 import ctypes
 import ctypes.util
+import dataclasses
 import errno
 import logging
 import os
@@ -250,11 +251,10 @@ class FuseKernelMount:
 
     @staticmethod
     def _attr_cache_cfg(ucfg: MountUserConfig | None):
-        """sync_on_stat mounts must not let non-sync paths (LOOKUP,
+        """sync_on_stat mounts must not let non-sync paths (LOOKUP, LINK,
         READDIRPLUS) prime the kernel attr cache — zero attr_timeout there
         forces stat() through GETATTR, the only op that settles lengths."""
         if ucfg is not None and ucfg.sync_on_stat and ucfg.attr_timeout:
-            import dataclasses
             return dataclasses.replace(ucfg, attr_timeout=0.0)
         return ucfg
 
@@ -359,6 +359,9 @@ class FuseKernelMount:
                               if i is not None}
             out = bytearray()
             idx = off
+            # sync_on_stat: attrs ride along but with zero validity, so
+            # stat() still goes through the GETATTR sync path
+            ecfg = self._attr_cache_cfg(ucfg)
             while idx < len(h.entries):
                 ino, name, itype = h.entries[idx]
                 nb = name.encode()
@@ -367,10 +370,7 @@ class FuseKernelMount:
                     break
                 inode = None if name in (".", "..") else h.plus.get(ino)
                 if inode is not None:
-                    # sync_on_stat: attrs ride along but with zero validity,
-                    # so stat() still goes through the GETATTR sync path
-                    entry = self._entry_out(inode,
-                                            self._attr_cache_cfg(ucfg))
+                    entry = self._entry_out(inode, ecfg)
                 else:
                     # nodeid 0: no lookup-count side effect; kernel will
                     # LOOKUP on demand ('.'/'..'/raced-away entries)
@@ -438,8 +438,11 @@ class FuseKernelMount:
             (old_nodeid,) = struct.unpack_from("<Q", body)
             name = body[8:].split(b"\0", 1)[0].decode()
             try:
+                # LINK returns an EXISTING inode (like LOOKUP): its length
+                # may be un-synced, so sync_on_stat must not cache it
                 return self._entry_out(
-                    await self.mc.link_at(old_nodeid, nodeid, name), ucfg)
+                    await self.mc.link_at(old_nodeid, nodeid, name),
+                    self._attr_cache_cfg(ucfg))
             except StatusError as e:
                 if e.code == StatusCode.META_IS_DIR:
                     # POSIX link(2): directory oldpath is EPERM, not EISDIR

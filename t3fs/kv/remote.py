@@ -108,13 +108,12 @@ class RemoteTransaction:
 
     # --- commit ---
 
-    async def commit(self) -> None:
-        assert not self._committed, "transaction reused after commit"
-        ver = await self._ver() if (self._read_keys or self._read_ranges
-                                    or self._writes or self._range_clears) \
-            else 0
-        req = KvCommitReq(
-            read_version=ver,
+    def to_commit_req(self) -> KvCommitReq:
+        """The single wire encoding of this txn's read/write sets — used by
+        both the one-shot commit and the sharded 2PC prepare
+        (t3fs/kv/shard.py), so the validations can't drift."""
+        return KvCommitReq(
+            read_version=self.read_version or 0,
             read_keys=sorted(self._read_keys),
             range_begins=[b for b, _ in self._read_ranges],
             range_ends=[e for _, e in self._read_ranges],
@@ -124,6 +123,13 @@ class RemoteTransaction:
             write_deletes=[v is None for v in self._writes.values()],
             clear_begins=[b for b, _ in self._range_clears],
             clear_ends=[e for _, e in self._range_clears])
+
+    async def commit(self) -> None:
+        assert not self._committed, "transaction reused after commit"
+        if (self._read_keys or self._read_ranges
+                or self._writes or self._range_clears):
+            await self._ver()
+        req = self.to_commit_req()
         mutates = bool(self._writes or self._range_clears)
         await self.engine._call("Kv.commit", req, commit_ambiguous=mutates)
         self._committed = True

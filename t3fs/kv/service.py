@@ -114,16 +114,35 @@ class KvOkRsp:
     seq: int = 0
 
 
+@serde_struct
+@dataclass
+class KvPrepareReq:
+    """2PC phase 1: one shard's slice of a cross-shard transaction."""
+    txn_id: str = ""
+    body: KvCommitReq = field(default_factory=KvCommitReq)
+
+
+@serde_struct
+@dataclass
+class KvFinishReq:
+    txn_id: str = ""
+
+
 @service("Kv")
 class KvService:
     def __init__(self, engine: KVEngine, *, primary: bool = True,
-                 followers: list[str] | None = None, client=None):
+                 followers: list[str] | None = None, client=None,
+                 prepare_timeout_s: float = 30.0):
         self.engine = engine
         self.primary = primary
         self.followers = list(followers or [])
         self.client = client            # net Client for follower shipping
         self.seq = 0                    # last shipped/applied batch seq
         self._commit_lock = asyncio.Lock()
+        # 2PC: txn_id -> (validated Transaction, expiry timer); the commit
+        # lock is HELD while anything is prepared
+        self._prepared: dict[str, tuple[Transaction, asyncio.Task]] = {}
+        self.prepare_timeout_s = prepare_timeout_s
         self.replicated = 0             # observability
         self.snapshots_pushed = 0
 
@@ -160,9 +179,7 @@ class KvService:
         return KvRangeRsp(version=ver, keys=[k for k, _ in rows],
                           values=[v for _, v in rows]), b""
 
-    @rpc_method
-    async def commit(self, req: KvCommitReq, payload, conn):
-        self._require_primary()
+    def _txn_from_req(self, req: KvCommitReq) -> Transaction:
         txn = Transaction(self.engine, read_version=req.read_version)
         for k in req.read_keys:
             txn._read_keys.add(k)
@@ -171,6 +188,39 @@ class KvService:
                                 req.write_deletes):
             txn._writes[k] = None if is_del else v
         txn._range_clears = list(zip(req.clear_begins, req.clear_ends))
+        return txn
+
+    async def _replicate_and_apply(self, txn: Transaction) -> None:
+        """Ship to followers, then apply locally.  Caller holds
+        _commit_lock and has already conflict-checked."""
+        if not (txn._writes or txn._range_clears):
+            return
+        self.seq += 1
+        try:
+            await self._replicate(KvReplicateReq(
+                seq=self.seq,
+                version=self.engine.current_version() + 1,
+                write_keys=list(txn._writes.keys()),
+                write_values=[v if v is not None else b""
+                              for v in txn._writes.values()],
+                write_deletes=[v is None for v in txn._writes.values()],
+                clear_begins=[b for b, _ in txn._range_clears],
+                clear_ends=[e for _, e in txn._range_clears]))
+            # the local apply is INSIDE the rollback scope: if the
+            # WAL append fails (OSError: disk full) after followers
+            # applied this seq, rolling seq back makes the next
+            # commit reuse it, the followers answer KV_REPLICA_GAP,
+            # and the snapshot push resets them to the primary's
+            # true (unapplied) state — no silent divergence
+            await self.engine.commit_async(txn)
+        except Exception:
+            self.seq -= 1
+            raise
+
+    @rpc_method
+    async def commit(self, req: KvCommitReq, payload, conn):
+        self._require_primary()
+        txn = self._txn_from_req(req)
         async with self._commit_lock:
             # Order: conflict-check -> replicate -> apply.  Nothing becomes
             # visible on the primary until every follower holds the batch,
@@ -181,29 +231,67 @@ class KvService:
             # the same seq, the stale follower answers KV_REPLICA_GAP, and
             # the snapshot push resets it to the primary's true state.
             self.engine.check_conflicts(txn)
-            if txn._writes or txn._range_clears:
-                self.seq += 1
-                try:
-                    await self._replicate(KvReplicateReq(
-                        seq=self.seq,
-                        version=self.engine.current_version() + 1,
-                        write_keys=list(txn._writes.keys()),
-                        write_values=[v if v is not None else b""
-                                      for v in txn._writes.values()],
-                        write_deletes=[v is None for v in txn._writes.values()],
-                        clear_begins=[b for b, _ in txn._range_clears],
-                        clear_ends=[e for _, e in txn._range_clears]))
-                    # the local apply is INSIDE the rollback scope: if the
-                    # WAL append fails (OSError: disk full) after followers
-                    # applied this seq, rolling seq back makes the next
-                    # commit reuse it, the followers answer KV_REPLICA_GAP,
-                    # and the snapshot push resets them to the primary's
-                    # true (unapplied) state — no silent divergence
-                    await self.engine.commit_async(txn)
-                except Exception:
-                    self.seq -= 1
-                    raise
+            await self._replicate_and_apply(txn)
         return KvCommitRsp(version=self.engine.current_version()), b""
+
+    # ---- 2PC surface (cross-shard transactions; see t3fs/kv/shard.py) ----
+
+    @rpc_method
+    async def prepare(self, req: "KvPrepareReq", payload, conn):
+        """Phase 1: validate this shard's slice of a cross-shard txn and
+        HOLD the commit lock until commit_prepared/abort_prepared (or the
+        prepare timeout).  Holding the lock is what makes the set of
+        prepared shards a consistent cut: nothing else can commit between
+        validation and phase 2."""
+        self._require_primary()
+        if not req.txn_id:
+            raise make_error(StatusCode.INVALID_ARG, "empty txn_id")
+        txn = self._txn_from_req(req.body)
+        await self._commit_lock.acquire()
+        try:
+            self.engine.check_conflicts(txn)
+        except BaseException:
+            self._commit_lock.release()
+            raise
+        timer = asyncio.create_task(self._expire_prepared(req.txn_id))
+        self._prepared[req.txn_id] = (txn, timer)
+        return KvOkRsp(seq=self.seq), b""
+
+    async def _expire_prepared(self, txn_id: str) -> None:
+        await asyncio.sleep(self.prepare_timeout_s)
+        entry = self._prepared.pop(txn_id, None)
+        if entry is not None:
+            log.warning("prepared txn %s expired after %.0fs (coordinator "
+                        "crash?) — aborted", txn_id, self.prepare_timeout_s)
+            self._commit_lock.release()
+
+    @rpc_method
+    async def commit_prepared(self, req: "KvFinishReq", payload, conn):
+        """Phase 2 commit.  KV_TXN_NOT_FOUND means the prepare expired —
+        the coordinator must surface TXN_MAYBE_COMMITTED if any other
+        shard already committed (in-memory prepare: a coordinator crash
+        between phases can leave a cross-shard txn partially applied; the
+        durable-prepare upgrade is ROADMAP.md work)."""
+        self._require_primary()
+        entry = self._prepared.pop(req.txn_id, None)
+        if entry is None:
+            raise make_error(StatusCode.KV_TXN_NOT_FOUND, req.txn_id)
+        txn, timer = entry
+        timer.cancel()
+        try:
+            await self._replicate_and_apply(txn)
+        finally:
+            self._commit_lock.release()
+        return KvCommitRsp(version=self.engine.current_version()), b""
+
+    @rpc_method
+    async def abort_prepared(self, req: "KvFinishReq", payload, conn):
+        entry = self._prepared.pop(req.txn_id, None)
+        if entry is not None:
+            _txn, timer = entry
+            timer.cancel()
+            self._commit_lock.release()
+        return KvOkRsp(), b""   # idempotent: unknown/expired is fine
 
     # ---- replication ----
 

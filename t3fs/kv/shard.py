@@ -1,0 +1,263 @@
+"""Range-sharded KV: the FoundationDB role at horizontal scale.
+
+Reference analog: FoundationDB's range partitioning behind
+src/fdb/HybridKvEngine.h — the reference outsources sharding to fdb; t3fs
+builds it over its own replicated KV groups (t3fs/kv/service.py): a static
+ShardMap splits the keyspace into contiguous ranges, each served by one
+replicated group, and a client-side router (`ShardedKVEngine`) implements
+the same KVEngine/Transaction interface meta and mgmtd already consume.
+
+Transaction protocol:
+  - reads route to the owning shard at a per-shard read version (pinned on
+    first touch); range reads split at shard boundaries and merge;
+  - a commit touching ONE shard uses that group's plain one-shot commit
+    (no extra round trips vs the unsharded service);
+  - a commit touching SEVERAL shards runs 2PC: prepare on every shard in
+    shard order (each shard validates its slice's conflicts and HOLDS its
+    commit lock), then commit_prepared everywhere.  Prepared locks make
+    the prepare set a consistent cut; ordered acquisition prevents
+    coordinator deadlocks; prepare expiry (server-side timer) bounds a
+    crashed coordinator's lock hold.
+
+Isolation: per-shard SSI.  Every cross-shard read is revalidated by its
+owning shard during prepare while all involved shards are locked, so any
+write that slipped between read and prepare aborts the transaction
+(TXN_CONFLICT -> with_transaction retries) — optimistic serializability,
+the same contract single-shard transactions have.
+
+Known limitation (ROADMAP.md): prepare state is in-memory.  A coordinator
+crash BETWEEN phase 1 and the end of phase 2 can leave a cross-shard
+transaction partially applied once prepares expire; commit_prepared
+answering KV_TXN_NOT_FOUND after another shard committed surfaces as
+TXN_MAYBE_COMMITTED to the caller (meta ops carry idempotency records for
+exactly this).  The durable-prepare upgrade is round-3 work.
+"""
+
+from __future__ import annotations
+
+import logging
+import uuid
+from dataclasses import dataclass, field
+
+from t3fs.kv.engine import KVEngine
+from t3fs.kv.remote import RemoteKVEngine
+from t3fs.kv.service import KvFinishReq, KvPrepareReq
+from t3fs.net.client import Client
+from t3fs.utils.serde import serde_struct
+from t3fs.utils.status import StatusCode, StatusError, make_error
+
+log = logging.getLogger("t3fs.kv.shard")
+
+KEY_MAX = b"\xff" * 17          # beyond any real key (prefix keys are short)
+
+
+@serde_struct
+@dataclass
+class ShardRange:
+    begin: bytes = b""
+    end: bytes = KEY_MAX
+    addresses: list[str] = field(default_factory=list)
+
+
+@serde_struct
+@dataclass
+class ShardMap:
+    """Contiguous, sorted, gap-free ranges covering [b"", KEY_MAX)."""
+    ranges: list[ShardRange] = field(default_factory=list)
+
+    def validate(self) -> "ShardMap":
+        if not self.ranges:
+            raise make_error(StatusCode.INVALID_ARG, "empty shard map")
+        cur = b""
+        for r in self.ranges:
+            if r.begin != cur:
+                raise make_error(
+                    StatusCode.INVALID_ARG,
+                    f"shard map gap/overlap at {r.begin!r} (expected {cur!r})")
+            if r.end <= r.begin:
+                raise make_error(StatusCode.INVALID_ARG,
+                                 f"empty shard range at {r.begin!r}")
+            if not r.addresses:
+                raise make_error(StatusCode.INVALID_ARG,
+                                 f"shard at {r.begin!r} has no addresses")
+            cur = r.end
+        if cur != KEY_MAX:
+            raise make_error(StatusCode.INVALID_ARG,
+                             f"shard map ends at {cur!r}, not KEY_MAX")
+        return self
+
+    def shard_of(self, key: bytes) -> int:
+        for i, r in enumerate(self.ranges):
+            if r.begin <= key < r.end:
+                return i
+        raise make_error(StatusCode.INVALID_ARG, f"key beyond map: {key!r}")
+
+    def shards_overlapping(self, begin: bytes,
+                           end: bytes) -> list[tuple[int, bytes, bytes]]:
+        """(shard_idx, clipped_begin, clipped_end) for every shard the
+        range [begin, end) intersects."""
+        out = []
+        for i, r in enumerate(self.ranges):
+            b, e = max(begin, r.begin), min(end, r.end)
+            if b < e:
+                out.append((i, b, e))
+        return out
+
+
+class ShardedTransaction:
+    """Client-side transaction over several shard groups."""
+
+    def __init__(self, engine: "ShardedKVEngine"):
+        self.engine = engine
+        self._subs: dict[int, object] = {}      # shard -> RemoteTransaction
+        self._committed = False
+
+    def _sub(self, shard: int):
+        sub = self._subs.get(shard)
+        if sub is None:
+            sub = self._subs[shard] = \
+                self.engine.groups[shard].transaction()
+        return sub
+
+    # --- reads ---
+
+    async def get(self, key: bytes, *, snapshot: bool = False):
+        return await self._sub(self.engine.map.shard_of(key)).get(
+            key, snapshot=snapshot)
+
+    async def snapshot_get(self, key: bytes):
+        return await self.get(key, snapshot=True)
+
+    async def get_range(self, begin: bytes, end: bytes, *, limit: int = 0,
+                        snapshot: bool = False):
+        out = []
+        for shard, b, e in self.engine.map.shards_overlapping(begin, end):
+            rows = await self._sub(shard).get_range(
+                b, e, limit=limit, snapshot=snapshot)
+            out.extend(rows)
+            if limit and len(out) >= limit:
+                return out[:limit]   # shards are key-ordered: safe to stop
+        return out
+
+    # --- writes ---
+
+    def set(self, key: bytes, value: bytes) -> None:
+        self._sub(self.engine.map.shard_of(key)).set(key, value)
+
+    def clear(self, key: bytes) -> None:
+        self._sub(self.engine.map.shard_of(key)).clear(key)
+
+    def clear_range(self, begin: bytes, end: bytes) -> None:
+        for shard, b, e in self.engine.map.shards_overlapping(begin, end):
+            self._sub(shard).clear_range(b, e)
+
+    def add_read_conflict_key(self, key: bytes) -> None:
+        self._sub(self.engine.map.shard_of(key)).add_read_conflict_key(key)
+
+    def add_read_conflict_range(self, begin: bytes, end: bytes) -> None:
+        for shard, b, e in self.engine.map.shards_overlapping(begin, end):
+            self._sub(shard).add_read_conflict_range(b, e)
+
+    # --- commit ---
+
+    async def commit(self) -> None:
+        assert not self._committed, "transaction reused after commit"
+        mutating = sorted(
+            s for s, sub in self._subs.items()
+            if sub._writes or sub._range_clears)
+        touched = sorted(self._subs)
+        if not mutating:
+            # read-only: each shard's reads validate against its own
+            # snapshot via the one-shot commit (no lock coupling needed)
+            for s in touched:
+                await self._subs[s].commit()
+            self._committed = True
+            return
+        if len(touched) == 1:
+            await self._subs[touched[0]].commit()
+            self._committed = True
+            return
+        # cross-shard: 2PC over every touched shard (read-only shards
+        # prepare too — their validation must be inside the locked cut)
+        txn_id = uuid.uuid4().hex
+        prepared: list[int] = []
+        try:
+            for s in touched:               # shard order: no lock cycles
+                await self.engine.groups[s]._call(
+                    "Kv.prepare",
+                    KvPrepareReq(txn_id=txn_id,
+                                 body=self._subs[s].to_commit_req()))
+                prepared.append(s)
+        except BaseException:
+            # abort EVERY touched shard incl. the one whose prepare call
+            # failed: a client-side timeout may have landed server-side,
+            # and abort_prepared is idempotent — this bounds the stall
+            # instead of waiting out prepare_timeout_s
+            for s in touched[:len(prepared) + 1]:
+                try:
+                    await self.engine.groups[s]._call(
+                        "Kv.abort_prepared", KvFinishReq(txn_id=txn_id))
+                except Exception:
+                    log.warning("abort_prepared failed on shard %d "
+                                "(prepare will expire)", s)
+            raise
+        committed: list[int] = []
+        failures: list[tuple[int, StatusError]] = []
+        first_err: StatusError | None = None
+        for s in touched:
+            try:
+                await self.engine.groups[s]._call(
+                    "Kv.commit_prepared", KvFinishReq(txn_id=txn_id),
+                    commit_ambiguous=True)
+                committed.append(s)
+            except StatusError as e:
+                # keep driving the REMAINING prepared shards to commit —
+                # abandoning them would tear the txn by expiry even though
+                # the coordinator is alive; confine the damage to shards
+                # that genuinely failed
+                failures.append((s, e))
+                if first_err is None:
+                    first_err = e
+        if failures:
+            if committed or any(
+                    e.code == StatusCode.TXN_MAYBE_COMMITTED
+                    for _, e in failures):
+                raise make_error(
+                    StatusCode.TXN_MAYBE_COMMITTED,
+                    f"cross-shard txn {txn_id}: shards {committed} "
+                    f"committed, failed: "
+                    f"{[(s, str(e)) for s, e in failures]}") from None
+            # nothing applied anywhere and every failure was definitive:
+            # clean abort (prepares are already consumed or expiring)
+            for s in touched:
+                try:
+                    await self.engine.groups[s]._call(
+                        "Kv.abort_prepared", KvFinishReq(txn_id=txn_id))
+                except Exception:
+                    pass
+            raise first_err
+        self._committed = True
+
+
+class ShardedKVEngine(KVEngine):
+    """KVEngine over a range-sharded deployment of replicated KV groups."""
+
+    def __init__(self, shard_map: ShardMap, client: Client | None = None,
+                 timeout_s: float = 15.0):
+        self.map = shard_map.validate()
+        self.client = client or Client()
+        self.groups = [RemoteKVEngine(r.addresses, client=self.client,
+                                      timeout_s=timeout_s)
+                       for r in self.map.ranges]
+
+    def transaction(self) -> ShardedTransaction:
+        return ShardedTransaction(self)
+
+    async def commit_async(self, txn) -> None:  # pragma: no cover
+        raise NotImplementedError("ShardedTransaction commits via RPC")
+
+    def clear_all(self) -> None:
+        raise NotImplementedError("clear_all is a local-engine test helper")
+
+    async def close(self) -> None:
+        await self.client.close()

@@ -284,13 +284,34 @@ class WalKVEngine(MemKVEngine):
 
 def open_kv_engine(spec: str) -> KVEngine:
     """HybridKvEngine-style selector (HybridKvEngine.h:13-31):
-      "mem"                     in-memory SSI engine (tests, single node)
-      "wal:/path[?sync=os]"     durable WAL+snapshot engine at /path
-      "remote:host:p,host:p"    replicated KvService deployment
-                                (CustomKvEngine cluster_endpoints analog)
+      "mem"                       in-memory SSI engine (tests, single node)
+      "wal:/path[?sync=os]"       durable WAL+snapshot engine at /path
+      "remote:host:p,host:p"      replicated KvService deployment
+                                  (CustomKvEngine cluster_endpoints analog)
+      "shards:a:p,a:p;<hexkey>;a:p,..."
+                                  range-sharded deployment: ';'-separated
+                                  alternation of address groups and hex
+                                  split keys, e.g.
+                                  "shards:h1:1,h2:1;494e4f44;h3:1"
+                                  = group1 [b'' .. b'INOD'), group2 rest
     """
     if spec == "mem":
         return MemKVEngine()
+    if spec.startswith("shards:"):
+        from t3fs.kv.shard import (
+            KEY_MAX, ShardMap, ShardRange, ShardedKVEngine,
+        )
+        parts = spec[len("shards:"):].split(";")
+        if len(parts) % 2 != 1:
+            raise ValueError(
+                "shards spec must alternate group;splitkey;group;...")
+        groups = [p.split(",") for p in parts[0::2]]
+        splits = [bytes.fromhex(p) for p in parts[1::2]]
+        bounds = [b""] + splits + [KEY_MAX]
+        return ShardedKVEngine(ShardMap(ranges=[
+            ShardRange(begin=bounds[i], end=bounds[i + 1],
+                       addresses=groups[i])
+            for i in range(len(groups))]))
     if spec.startswith("remote:"):
         from t3fs.kv.remote import RemoteKVEngine
         return RemoteKVEngine(spec[len("remote:"):].split(","))

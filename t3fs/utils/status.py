@@ -72,6 +72,7 @@ class StatusCode(enum.IntEnum):
     KV_NOT_PRIMARY = 7101
     KV_REPLICA_GAP = 7102
     KV_REPLICATION_FAILED = 7103
+    KV_TXN_NOT_FOUND = 7104      # 2PC: prepared txn expired/unknown here
 
     # mgmtd (reference: MgmtdCode)
     MGMTD_NOT_PRIMARY = 7001

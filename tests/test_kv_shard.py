@@ -1,0 +1,222 @@
+"""Range-sharded KV: routing, cross-shard 2PC, conflicts, atomicity, and
+the meta store running over two shard groups (reference: the FoundationDB
+role's range partitioning, fdb/HybridKvEngine.h)."""
+
+import asyncio
+
+import pytest
+
+from t3fs.kv.engine import MemKVEngine, with_transaction
+from t3fs.kv.service import KvService
+from t3fs.kv.shard import (
+    KEY_MAX, ShardMap, ShardRange, ShardedKVEngine,
+)
+from t3fs.net.client import Client
+from t3fs.net.server import Server
+from t3fs.utils.status import StatusCode, StatusError
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def _mk_sharded(split: bytes, replicas_per_shard: int = 1,
+                      prepare_timeout_s: float = 30.0):
+    """Two shard groups split at `split`; each group optionally replicated."""
+    servers, services = [], []
+    ship = Client()
+    shard_addrs: list[list[str]] = []
+    for _shard in range(2):
+        addrs = []
+        group = []
+        for i in range(replicas_per_shard):
+            svc = KvService(MemKVEngine(), primary=(i == 0), client=ship,
+                            prepare_timeout_s=prepare_timeout_s)
+            srv = Server()
+            srv.add_service(svc)
+            await srv.start()
+            servers.append(srv)
+            group.append(svc)
+            addrs.append(srv.address)
+        group[0].followers = addrs[1:]
+        services.append(group)
+        shard_addrs.append(addrs)
+    smap = ShardMap(ranges=[
+        ShardRange(begin=b"", end=split, addresses=shard_addrs[0]),
+        ShardRange(begin=split, end=KEY_MAX, addresses=shard_addrs[1]),
+    ])
+    kv = ShardedKVEngine(smap)
+
+    async def cleanup():
+        await kv.close()
+        await ship.close()
+        for s in servers:
+            await s.stop()
+    return kv, services, cleanup
+
+
+def test_shard_map_validation():
+    with pytest.raises(StatusError):
+        ShardMap(ranges=[]).validate()
+    with pytest.raises(StatusError):   # gap
+        ShardMap(ranges=[
+            ShardRange(b"", b"m", ["a:1"]),
+            ShardRange(b"n", KEY_MAX, ["a:2"])]).validate()
+    with pytest.raises(StatusError):   # doesn't reach KEY_MAX
+        ShardMap(ranges=[ShardRange(b"", b"m", ["a:1"])]).validate()
+    ok = ShardMap(ranges=[ShardRange(b"", b"m", ["a:1"]),
+                          ShardRange(b"m", KEY_MAX, ["a:2"])]).validate()
+    assert ok.shard_of(b"a") == 0 and ok.shard_of(b"m") == 1
+    assert ok.shards_overlapping(b"a", b"z") == [(0, b"a", b"m"),
+                                                 (1, b"m", b"z")]
+
+
+def test_single_shard_and_cross_shard_commits():
+    async def body():
+        kv, _, cleanup = await _mk_sharded(b"m")
+        try:
+            # single-shard txns use the one-shot path
+            async def one(txn):
+                txn.set(b"alpha", b"1")
+            await with_transaction(kv, one)
+
+            # cross-shard txn: both sides land atomically
+            async def both(txn):
+                txn.set(b"beta", b"B")       # shard 0
+                txn.set(b"omega", b"O")      # shard 1
+            await with_transaction(kv, both)
+
+            t = kv.transaction()
+            assert await t.get(b"alpha") == b"1"
+            assert await t.get(b"beta") == b"B"
+            assert await t.get(b"omega") == b"O"
+            # cross-shard range read merges in key order
+            rows = await t.get_range(b"a", b"z")
+            assert rows == [(b"alpha", b"1"), (b"beta", b"B"),
+                            (b"omega", b"O")]
+        finally:
+            await cleanup()
+    run(body())
+
+
+def test_cross_shard_conflict_aborts_whole_txn():
+    """A write racing ANY shard's reads aborts the whole cross-shard txn
+    (per-shard SSI revalidated inside the locked prepare cut)."""
+    async def body():
+        kv, _, cleanup = await _mk_sharded(b"m")
+        try:
+            async def seed(txn):
+                txn.set(b"acct-a", b"100")   # shard 0
+                txn.set(b"zcct-b", b"100")   # shard 1
+            await with_transaction(kv, seed)
+
+            t1 = kv.transaction()            # transfer a -> b
+            a = int(await t1.get(b"acct-a"))
+            b = int(await t1.get(b"zcct-b"))
+            # concurrent writer bumps acct-a before t1 commits
+            t2 = kv.transaction()
+            t2.set(b"acct-a", b"999")
+            await t2.commit()
+
+            t1.set(b"acct-a", str(a - 10).encode())
+            t1.set(b"zcct-b", str(b + 10).encode())
+            with pytest.raises(StatusError) as ei:
+                await t1.commit()
+            assert ei.value.code == StatusCode.TXN_CONFLICT
+            # NOTHING from t1 leaked into either shard
+            t3 = kv.transaction()
+            assert await t3.get(b"acct-a") == b"999"
+            assert await t3.get(b"zcct-b") == b"100"
+        finally:
+            await cleanup()
+    run(body())
+
+
+def test_prepare_expiry_releases_shard():
+    """A crashed coordinator's prepare expires and the shard accepts new
+    commits (the lock is not leaked)."""
+    async def body():
+        kv, services, cleanup = await _mk_sharded(
+            b"m", prepare_timeout_s=0.3)
+        try:
+            from t3fs.kv.service import KvPrepareReq, KvCommitReq
+            # manually prepare on shard 0 and "crash" (never finish)
+            group0 = kv.groups[0]
+            await group0._call("Kv.prepare", KvPrepareReq(
+                txn_id="dead-coordinator",
+                body=KvCommitReq(write_keys=[b"k"], write_values=[b"v"],
+                                 write_deletes=[False])))
+            # a new commit must get through once the prepare expires
+            async def w(txn):
+                txn.set(b"after", b"1")
+            await asyncio.wait_for(with_transaction(kv, w), timeout=5.0)
+            t = kv.transaction()
+            assert await t.get(b"after") == b"1"
+            # the expired txn's write was aborted, never applied
+            assert await t.get(b"k") is None
+        finally:
+            await cleanup()
+    run(body())
+
+
+def test_cross_shard_with_replicated_groups():
+    """2PC over shard groups that are themselves sync-replicated; follower
+    state matches the primary after a cross-shard commit."""
+    async def body():
+        kv, services, cleanup = await _mk_sharded(b"m",
+                                                  replicas_per_shard=2)
+        try:
+            async def both(txn):
+                txn.set(b"left", b"L")
+                txn.set(b"zright", b"R")
+            await with_transaction(kv, both)
+            for group, key, val in ((services[0], b"left", b"L"),
+                                    (services[1], b"zright", b"R")):
+                for svc in group:        # primary AND follower hold it
+                    got = svc.engine.read_at(key,
+                                             svc.engine.current_version())
+                    assert got == val, (key, svc.primary)
+        finally:
+            await cleanup()
+    run(body())
+
+
+def test_meta_store_over_sharded_kv():
+    """The meta store runs unchanged over two shard groups — inode and
+    dirent prefixes land on different shards, so ordinary meta ops are
+    cross-shard transactions."""
+    async def body():
+        # split between DENT and INOD prefixes: creates touch both shards
+        kv, _, cleanup = await _mk_sharded(b"G")
+        try:
+            from t3fs.meta.store import ChainAllocator, MetaStore
+            from tests.test_meta import make_routing
+            routing = make_routing()
+            store = MetaStore(kv, ChainAllocator(lambda: routing,
+                                                 default_chunk_size=4096))
+            await store.mkdirs("/a/b")
+            inode, _ = await store.create("/a/b/f", session_client="c1")
+            got = await store.stat("/a/b/f")
+            assert got.inode_id == inode.inode_id
+            await store.rename("/a/b/f", "/a/g")
+            assert (await store.stat("/a/g")).inode_id == inode.inode_id
+            entries = await store.readdir("/a")
+            assert sorted(e.name for e in entries) == ["b", "g"]
+            await store.remove("/a", recursive=True)
+            with pytest.raises(StatusError):
+                await store.stat("/a")
+        finally:
+            await cleanup()
+    run(body())
+
+
+def test_open_kv_engine_shards_spec():
+    from t3fs.kv.wal_engine import open_kv_engine
+    eng = open_kv_engine("shards:h1:1,h2:1;494e4f44;h3:1")
+    assert len(eng.groups) == 2
+    assert eng.map.ranges[0].end == b"INOD"
+    assert eng.map.shard_of(b"DENT") == 0      # DENT < INOD
+    assert eng.map.shard_of(b"INOD\x00") == 1
+    import pytest as _p
+    with _p.raises(ValueError):
+        open_kv_engine("shards:h1:1;zz")       # bad alternation/hex

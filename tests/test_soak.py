@@ -15,7 +15,6 @@ import pytest
 
 from t3fs.client.layout import FileLayout
 from t3fs.client.storage_client import StorageClient, StorageClientConfig
-from t3fs.mgmtd.types import PublicTargetState
 from t3fs.testing.cluster import LocalCluster
 
 CHUNK = 8192
