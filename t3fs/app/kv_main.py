@@ -53,6 +53,10 @@ async def serve(cfg: KvMainConfig, app: ApplicationBase) -> None:
     rpc.add_service(svc)
 
     async def start():
+        if cfg.role == "primary":
+            # finish any cross-shard txn this node crashed mid-2PC on
+            # (durable prepare records; see t3fs/kv/shard.py)
+            await svc.recover_prepared()
         await rpc.start()
         app.start_metrics(cfg.monitor_address, cfg.node_id,
                           cfg.metrics_period_s)

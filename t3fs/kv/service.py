@@ -117,15 +117,42 @@ class KvOkRsp:
 @serde_struct
 @dataclass
 class KvPrepareReq:
-    """2PC phase 1: one shard's slice of a cross-shard transaction."""
+    """2PC phase 1: one shard's slice of a cross-shard transaction.
+
+    `decider` names the shard group holding the transaction's decision
+    record (the coordinator uses the first touched shard); `is_decider`
+    marks that shard's own prepare.  Presumed-abort: no decision record
+    means aborted."""
     txn_id: str = ""
     body: KvCommitReq = field(default_factory=KvCommitReq)
+    decider: list[str] = field(default_factory=list)
+    is_decider: bool = False
 
 
 @serde_struct
 @dataclass
 class KvFinishReq:
     txn_id: str = ""
+
+
+@serde_struct
+@dataclass
+class KvDecisionReq:
+    txn_id: str = ""
+
+
+@serde_struct
+@dataclass
+class KvDecisionRsp:
+    # "C" committed | "A" aborted (tombstone) | "P" decider's own prepare
+    # still pending | "U" no trace (presumed abort)
+    decision: str = "U"
+
+
+# internal key prefixes for durable 2PC state (outside every user prefix —
+# user keys in t3fs are printable 4-byte tags, KeyPrefix-def analog)
+PREP_PREFIX = b"\x00t3fs2pc\x00p\x00"
+DEC_PREFIX = b"\x00t3fs2pc\x00d\x00"
 
 
 @service("Kv")
@@ -139,9 +166,10 @@ class KvService:
         self.client = client            # net Client for follower shipping
         self.seq = 0                    # last shipped/applied batch seq
         self._commit_lock = asyncio.Lock()
-        # 2PC: txn_id -> (validated Transaction, expiry timer); the commit
-        # lock is HELD while anything is prepared
-        self._prepared: dict[str, tuple[Transaction, asyncio.Task]] = {}
+        # 2PC: txn_id -> (validated Transaction, expiry timer, prepare
+        # req); the commit lock is HELD while anything is prepared
+        self._prepared: dict[str, tuple] = {}
+        self._resolving: set[str] = set()   # mid-resolution txn ids
         self.prepare_timeout_s = prepare_timeout_s
         self.replicated = 0             # observability
         self.snapshots_pushed = 0
@@ -238,11 +266,12 @@ class KvService:
 
     @rpc_method
     async def prepare(self, req: "KvPrepareReq", payload, conn):
-        """Phase 1: validate this shard's slice of a cross-shard txn and
-        HOLD the commit lock until commit_prepared/abort_prepared (or the
-        prepare timeout).  Holding the lock is what makes the set of
-        prepared shards a consistent cut: nothing else can commit between
-        validation and phase 2."""
+        """Phase 1: validate this shard's slice of a cross-shard txn,
+        durably record it, and HOLD the commit lock until phase 2 (or
+        resolution).  Holding the lock makes the set of prepared shards a
+        consistent cut; the durable record (replicated like any write)
+        lets a restarted/failed-over shard finish the txn per the
+        decider's verdict instead of tearing it."""
         self._require_primary()
         if not req.txn_id:
             raise make_error(StatusCode.INVALID_ARG, "empty txn_id")
@@ -250,48 +279,198 @@ class KvService:
         await self._commit_lock.acquire()
         try:
             self.engine.check_conflicts(txn)
+            rec = Transaction(self.engine,
+                              read_version=self.engine.current_version())
+            rec._writes[PREP_PREFIX + req.txn_id.encode()] = \
+                serde.dumps(req)
+            await self._replicate_and_apply(rec)
         except BaseException:
             self._commit_lock.release()
             raise
-        timer = asyncio.create_task(self._expire_prepared(req.txn_id))
-        self._prepared[req.txn_id] = (txn, timer)
+        timer = asyncio.create_task(self._resolve_later(req.txn_id))
+        self._prepared[req.txn_id] = (txn, timer, req)
         return KvOkRsp(seq=self.seq), b""
 
-    async def _expire_prepared(self, txn_id: str) -> None:
-        await asyncio.sleep(self.prepare_timeout_s)
-        entry = self._prepared.pop(txn_id, None)
-        if entry is not None:
-            log.warning("prepared txn %s expired after %.0fs (coordinator "
-                        "crash?) — aborted", txn_id, self.prepare_timeout_s)
+    def _finish_txn(self, txn: Transaction, req: KvPrepareReq,
+                    decision: bytes | None) -> Transaction:
+        """Merge 2PC bookkeeping into the slice: drop the prepare record
+        and, on the decider, persist the decision — one atomic batch."""
+        txn._writes[PREP_PREFIX + req.txn_id.encode()] = None
+        if req.is_decider and decision is not None:
+            txn._writes[DEC_PREFIX + req.txn_id.encode()] = decision
+        return txn
+
+    async def _resolve_later(self, txn_id: str,
+                             initial_delay: float | None = None) -> None:
+        await asyncio.sleep(self.prepare_timeout_s
+                            if initial_delay is None else initial_delay)
+        while txn_id in self._prepared:
+            try:
+                done = await self._resolve_once(txn_id)
+            except Exception:
+                log.exception("2pc resolution of %s failed; retrying", txn_id)
+                done = False
+            if done:
+                return
+            await asyncio.sleep(min(2.0, self.prepare_timeout_s))
+
+    async def _resolve_once(self, txn_id: str) -> bool:
+        """Coordinator went quiet: resolve via the decider (presumed
+        abort).  Returns False when the outcome is still pending.  The
+        entry is popped only AFTER the apply succeeds — a transient
+        replication failure leaves it armed for the next retry — and is
+        flagged `resolving` so a late coordinator phase-2 can't race the
+        apply (it gets KV_TXN_NOT_FOUND; the state still converges on the
+        decider's verdict)."""
+        entry = self._prepared.get(txn_id)
+        if entry is None:
+            return True
+        txn, _timer, req = entry
+        if req.is_decider:
+            # no decision record can exist (commit_prepared would have
+            # consumed this entry): decide ABORT with a tombstone so a
+            # late coordinator commit_prepared cannot resurrect the txn
+            self._resolving.add(txn_id)
+            drop = Transaction(self.engine,
+                               read_version=self.engine.current_version())
+            self._finish_txn(drop, req, b"A")
+            await self._replicate_and_apply(drop)
+            self._prepared.pop(txn_id, None)
+            self._resolving.discard(txn_id)
             self._commit_lock.release()
+            log.warning("2pc %s: decider expired -> ABORT tombstone", txn_id)
+            return True
+        decision = await self._ask_decider(req)
+        if decision == "P":
+            return False                    # decider undecided: retry later
+        self._resolving.add(txn_id)
+        try:
+            if decision == "C":
+                self._finish_txn(txn, req, None)
+                await self._replicate_and_apply(txn)
+                log.warning("2pc %s: decider says COMMITTED -> applied",
+                            txn_id)
+            else:                           # "A" or no trace: abort
+                drop = Transaction(
+                    self.engine,
+                    read_version=self.engine.current_version())
+                self._finish_txn(drop, req, None)
+                await self._replicate_and_apply(drop)
+                log.warning("2pc %s: resolved as aborted (%s)", txn_id,
+                            decision)
+        except BaseException:
+            self._resolving.discard(txn_id)
+            raise                           # entry stays armed; retry later
+        self._prepared.pop(txn_id, None)
+        self._resolving.discard(txn_id)
+        self._commit_lock.release()
+        return True
+
+    async def _ask_decider(self, req: KvPrepareReq) -> str:
+        if self.client is None or not req.decider:
+            return "U"                      # no path to the decider: abort
+        for addr in req.decider:
+            try:
+                rsp, _ = await self.client.call(
+                    addr, "Kv.get_decision",
+                    KvDecisionReq(txn_id=req.txn_id), timeout=5.0)
+                return rsp.decision
+            except StatusError:
+                continue
+        return "P"                          # unreachable: keep waiting
+
+    @rpc_method
+    async def get_decision(self, req: KvDecisionReq, payload, conn):
+        key = req.txn_id.encode()
+        ver = self.engine.current_version()
+        dec = self.engine.read_at(DEC_PREFIX + key, ver)
+        if dec is not None:
+            return KvDecisionRsp(decision=dec.decode()), b""
+        if self.engine.read_at(PREP_PREFIX + key, ver) is not None \
+                or req.txn_id in self._prepared:
+            return KvDecisionRsp(decision="P"), b""
+        return KvDecisionRsp(decision="U"), b""
 
     @rpc_method
     async def commit_prepared(self, req: "KvFinishReq", payload, conn):
-        """Phase 2 commit.  KV_TXN_NOT_FOUND means the prepare expired —
-        the coordinator must surface TXN_MAYBE_COMMITTED if any other
-        shard already committed (in-memory prepare: a coordinator crash
-        between phases can leave a cross-shard txn partially applied; the
-        durable-prepare upgrade is ROADMAP.md work)."""
+        """Phase 2 commit.  On the decider this also persists the COMMIT
+        decision record atomically with the slice; KV_TXN_NOT_FOUND means
+        the prepare was already resolved (expiry/abort) — the coordinator
+        checks the decider before concluding anything tore."""
         self._require_primary()
+        if req.txn_id in self._resolving:
+            # a resolver is mid-apply; the decider's verdict governs
+            raise make_error(StatusCode.KV_TXN_NOT_FOUND, req.txn_id)
         entry = self._prepared.pop(req.txn_id, None)
         if entry is None:
             raise make_error(StatusCode.KV_TXN_NOT_FOUND, req.txn_id)
-        txn, timer = entry
+        txn, timer, preq = entry
         timer.cancel()
+        self._finish_txn(txn, preq, b"C")
         try:
             await self._replicate_and_apply(txn)
+        except BaseException:
+            # the slice did NOT apply; put the entry back so resolution
+            # (or a coordinator retry) can still finish it
+            timer2 = asyncio.create_task(self._resolve_later(req.txn_id))
+            self._prepared[req.txn_id] = (txn, timer2, preq)
+            raise
         finally:
-            self._commit_lock.release()
+            if req.txn_id not in self._prepared:
+                self._commit_lock.release()
         return KvCommitRsp(version=self.engine.current_version()), b""
 
     @rpc_method
     async def abort_prepared(self, req: "KvFinishReq", payload, conn):
+        if req.txn_id in self._resolving:
+            return KvOkRsp(), b""   # resolver owns it now
         entry = self._prepared.pop(req.txn_id, None)
         if entry is not None:
-            _txn, timer = entry
+            txn, timer, preq = entry
             timer.cancel()
-            self._commit_lock.release()
+            drop = Transaction(self.engine,
+                               read_version=self.engine.current_version())
+            self._finish_txn(drop, preq, None)
+            try:
+                await self._replicate_and_apply(drop)
+            finally:
+                self._commit_lock.release()
         return KvOkRsp(), b""   # idempotent: unknown/expired is fine
+
+    async def recover_prepared(self) -> int:
+        """Post-restart/post-promote hook: re-arm durable prepare records
+        so the crash/failover didn't tear any cross-shard txn.  Returns
+        the number of records found.  Arming is NON-BLOCKING — each record
+        gets a task that acquires the commit lock and resolves; the server
+        keeps serving (notably get_decision) meanwhile, or two shards
+        recovering each other's deciders would deadlock at startup."""
+        ver = self.engine.current_version()
+        rows = self.engine.range_at(PREP_PREFIX,
+                                    PREP_PREFIX + b"\xff", ver, 0)
+        n = 0
+        for _k, blob in rows:
+            req: KvPrepareReq = serde.loads(blob)
+            if req.txn_id in self._prepared:
+                continue
+            n += 1
+            asyncio.create_task(self._arm_recovered(req))
+        return n
+
+    async def _arm_recovered(self, req: KvPrepareReq) -> None:
+        await self._commit_lock.acquire()
+        ver = self.engine.current_version()
+        if self.engine.read_at(PREP_PREFIX + req.txn_id.encode(),
+                               ver) is None:
+            self._commit_lock.release()     # resolved while we queued
+            return
+        txn = self._txn_from_req(req.body)
+        # resolve promptly: the crash already consumed wall time, and
+        # the coordinator that would drive phase 2 is likely gone
+        timer = asyncio.create_task(
+            self._resolve_later(req.txn_id, initial_delay=0.5))
+        self._prepared[req.txn_id] = (txn, timer, req)
+        log.warning("2pc: recovered prepared txn %s from durable record",
+                    req.txn_id)
 
     # ---- replication ----
 
@@ -381,9 +560,13 @@ class KvService:
     @rpc_method
     async def promote(self, req, payload, conn):
         """Failover: this follower becomes the primary (operator/lease-
-        driven; the old primary must be fenced off first)."""
+        driven; the old primary must be fenced off first).  Replicated
+        2PC prepare records re-arm so a failover mid-cross-shard-txn
+        still resolves it."""
         self.primary = True
-        log.warning("KV node promoted to primary at seq %d", self.seq)
+        recovered = await self.recover_prepared()
+        log.warning("KV node promoted to primary at seq %d "
+                    "(%d prepared txns re-armed)", self.seq, recovered)
         return KvOkRsp(seq=self.seq), b""
 
     @rpc_method

@@ -25,12 +25,14 @@ write that slipped between read and prepare aborts the transaction
 (TXN_CONFLICT -> with_transaction retries) — optimistic serializability,
 the same contract single-shard transactions have.
 
-Known limitation (ROADMAP.md): prepare state is in-memory.  A coordinator
-crash BETWEEN phase 1 and the end of phase 2 can leave a cross-shard
-transaction partially applied once prepares expire; commit_prepared
-answering KV_TXN_NOT_FOUND after another shard committed surfaces as
-TXN_MAYBE_COMMITTED to the caller (meta ops carry idempotency records for
-exactly this).  The durable-prepare upgrade is round-3 work.
+Crash safety: prepares are DURABLE (replicated records in each shard's
+engine) and the protocol is presumed-abort with a decision record — the
+decider's commit_prepared atomically persists a COMMIT record; resolvers
+on quiet shards consult it and finish (or tombstone-abort) their slice,
+including after a primary restart/failover (recover_prepared).  A caller
+seeing TXN_MAYBE_COMMITTED therefore means "outcome decided by the
+decider, possibly still propagating" — never a permanently torn txn.
+Remaining polish (ROADMAP.md): decision-record GC, push-based resolution.
 """
 
 from __future__ import annotations
@@ -132,8 +134,9 @@ class ShardedTransaction:
                         snapshot: bool = False):
         out = []
         for shard, b, e in self.engine.map.shards_overlapping(begin, end):
+            remaining = limit - len(out) if limit else 0
             rows = await self._sub(shard).get_range(
-                b, e, limit=limit, snapshot=snapshot)
+                b, e, limit=remaining, snapshot=snapshot)
             out.extend(rows)
             if limit and len(out) >= limit:
                 return out[:limit]   # shards are key-ordered: safe to stop
@@ -178,15 +181,29 @@ class ShardedTransaction:
             self._committed = True
             return
         # cross-shard: 2PC over every touched shard (read-only shards
-        # prepare too — their validation must be inside the locked cut)
+        # prepare too — their validation must be inside the locked cut).
+        # The FIRST touched shard is the decider: its commit_prepared
+        # lands the durable COMMIT decision record, and phase 2 drives it
+        # first, so every later shard can recover the verdict.
         txn_id = uuid.uuid4().hex
+        decider_addrs = list(self.engine.map.ranges[touched[0]].addresses)
+        for s in touched:
+            sub = self._subs[s]
+            # pin the read version on subs that registered conflicts
+            # without ever reading (add_read_conflict_*): version 0 would
+            # conflict against ALL history, livelocking the txn
+            if sub.read_version is None and (sub._read_keys
+                                             or sub._read_ranges):
+                await sub._ver()
         prepared: list[int] = []
         try:
             for s in touched:               # shard order: no lock cycles
                 await self.engine.groups[s]._call(
                     "Kv.prepare",
                     KvPrepareReq(txn_id=txn_id,
-                                 body=self._subs[s].to_commit_req()))
+                                 body=self._subs[s].to_commit_req(),
+                                 decider=decider_addrs,
+                                 is_decider=(s == touched[0])))
                 prepared.append(s)
         except BaseException:
             # abort EVERY touched shard incl. the one whose prepare call
@@ -201,41 +218,47 @@ class ShardedTransaction:
                     log.warning("abort_prepared failed on shard %d "
                                 "(prepare will expire)", s)
             raise
-        committed: list[int] = []
-        failures: list[tuple[int, StatusError]] = []
-        first_err: StatusError | None = None
-        for s in touched:
-            try:
-                await self.engine.groups[s]._call(
-                    "Kv.commit_prepared", KvFinishReq(txn_id=txn_id),
-                    commit_ambiguous=True)
-                committed.append(s)
-            except StatusError as e:
-                # keep driving the REMAINING prepared shards to commit —
-                # abandoning them would tear the txn by expiry even though
-                # the coordinator is alive; confine the damage to shards
-                # that genuinely failed
-                failures.append((s, e))
-                if first_err is None:
-                    first_err = e
-        if failures:
-            if committed or any(
-                    e.code == StatusCode.TXN_MAYBE_COMMITTED
-                    for _, e in failures):
+        # phase 2, DECIDER FIRST and alone: until its COMMIT decision
+        # record lands, nothing may be applied anywhere — committing other
+        # shards while the decider's outcome is unknown could tear the
+        # txn against a later ABORT tombstone
+        try:
+            await self.engine.groups[touched[0]]._call(
+                "Kv.commit_prepared", KvFinishReq(txn_id=txn_id),
+                commit_ambiguous=True)
+        except StatusError as e:
+            if e.code == StatusCode.TXN_MAYBE_COMMITTED:
+                # decision unknown: leave every shard to resolve via the
+                # decider (they self-heal to whichever verdict stands)
                 raise make_error(
                     StatusCode.TXN_MAYBE_COMMITTED,
-                    f"cross-shard txn {txn_id}: shards {committed} "
-                    f"committed, failed: "
-                    f"{[(s, str(e)) for s, e in failures]}") from None
-            # nothing applied anywhere and every failure was definitive:
-            # clean abort (prepares are already consumed or expiring)
+                    f"cross-shard txn {txn_id}: decider outcome "
+                    f"unknown: {e}") from None
+            # decider definitively did not commit: clean abort everywhere
             for s in touched:
                 try:
                     await self.engine.groups[s]._call(
                         "Kv.abort_prepared", KvFinishReq(txn_id=txn_id))
                 except Exception:
                     pass
-            raise first_err
+            raise
+        # decision record = COMMITTED.  Drive the rest; any failure here
+        # self-heals to COMMIT via its resolver, but the caller must know
+        # propagation isn't complete yet.
+        failures: list[tuple[int, StatusError]] = []
+        for s in touched[1:]:
+            try:
+                await self.engine.groups[s]._call(
+                    "Kv.commit_prepared", KvFinishReq(txn_id=txn_id),
+                    commit_ambiguous=True)
+            except StatusError as e:
+                failures.append((s, e))
+        if failures:
+            raise make_error(
+                StatusCode.TXN_MAYBE_COMMITTED,
+                f"cross-shard txn {txn_id} COMMITTED (decision record "
+                f"landed) but shards {[(s, str(e)) for s, e in failures]} "
+                f"have not applied yet; they self-heal via the decider")
         self._committed = True
 
 

@@ -220,3 +220,136 @@ def test_open_kv_engine_shards_spec():
     import pytest as _p
     with _p.raises(ValueError):
         open_kv_engine("shards:h1:1;zz")       # bad alternation/hex
+
+
+def test_durable_2pc_laggard_shard_heals_to_commit():
+    """Coordinator dies between phase-2 calls: the decider committed, the
+    laggard shard's resolver asks the decider and APPLIES its slice — no
+    torn transaction."""
+    async def body():
+        kv, services, cleanup = await _mk_sharded(b"m",
+                                                  prepare_timeout_s=0.3)
+        try:
+            from t3fs.kv.service import KvFinishReq, KvPrepareReq, KvCommitReq
+            dec_addrs = kv.map.ranges[0].addresses
+            mk = lambda k, v: KvCommitReq(write_keys=[k], write_values=[v],
+                                          write_deletes=[False])
+            await kv.groups[0]._call("Kv.prepare", KvPrepareReq(
+                txn_id="t-heal", body=mk(b"a", b"1"),
+                decider=dec_addrs, is_decider=True))
+            await kv.groups[1]._call("Kv.prepare", KvPrepareReq(
+                txn_id="t-heal", body=mk(b"z", b"2"),
+                decider=dec_addrs, is_decider=False))
+            # phase 2 reaches ONLY the decider; coordinator "dies"
+            await kv.groups[0]._call("Kv.commit_prepared",
+                                     KvFinishReq(txn_id="t-heal"))
+            # shard 1 must self-heal to COMMIT via the decision record
+            async def committed():
+                t = kv.transaction()
+                return (await t.get(b"a"), await t.get(b"z"))
+            for _ in range(100):
+                a, z = await committed()
+                if a == b"1" and z == b"2":
+                    break
+                await asyncio.sleep(0.1)
+            else:
+                raise AssertionError(f"laggard never healed: {a!r} {z!r}")
+        finally:
+            await cleanup()
+    run(body())
+
+
+def test_durable_2pc_presumed_abort_when_undecided():
+    """Coordinator dies after phase 1: the decider tombstone-aborts on
+    expiry, the other shard follows, and a LATE commit_prepared cannot
+    resurrect the transaction."""
+    async def body():
+        kv, services, cleanup = await _mk_sharded(b"m",
+                                                  prepare_timeout_s=0.3)
+        try:
+            from t3fs.kv.service import KvFinishReq, KvPrepareReq, KvCommitReq
+            dec_addrs = kv.map.ranges[0].addresses
+            mk = lambda k, v: KvCommitReq(write_keys=[k], write_values=[v],
+                                          write_deletes=[False])
+            await kv.groups[0]._call("Kv.prepare", KvPrepareReq(
+                txn_id="t-dead", body=mk(b"a", b"1"),
+                decider=dec_addrs, is_decider=True))
+            await kv.groups[1]._call("Kv.prepare", KvPrepareReq(
+                txn_id="t-dead", body=mk(b"z", b"2"),
+                decider=dec_addrs, is_decider=False))
+            # both must resolve to ABORT; new commits flow again
+            async def w(txn):
+                txn.set(b"after", b"y")
+                txn.set(b"zafter", b"y")
+            await asyncio.wait_for(with_transaction(kv, w), timeout=8.0)
+            t = kv.transaction()
+            assert await t.get(b"a") is None
+            assert await t.get(b"z") is None
+            # a late phase-2 on the decider is refused (tombstone)
+            with pytest.raises(StatusError) as ei:
+                await kv.groups[0]._call("Kv.commit_prepared",
+                                         KvFinishReq(txn_id="t-dead"))
+            assert ei.value.code == StatusCode.KV_TXN_NOT_FOUND
+        finally:
+            await cleanup()
+    run(body())
+
+
+def test_durable_2pc_shard_restart_recovers_prepared():
+    """A shard primary restarts holding a durable prepared record; the
+    recovered service finishes the txn per the decider's verdict."""
+    async def body():
+        from t3fs.kv.service import (
+            KvFinishReq, KvPrepareReq, KvCommitReq, KvService,
+        )
+        from t3fs.kv.engine import MemKVEngine
+        from t3fs.net.client import Client
+        from t3fs.net.server import Server
+
+        ship = Client()
+        # decider shard (group 0)
+        dec_engine = MemKVEngine()
+        dec_svc = KvService(dec_engine, client=ship, prepare_timeout_s=0.3)
+        dec_srv = Server(); dec_srv.add_service(dec_svc)
+        await dec_srv.start()
+        # crashing shard (group 1): engine survives, service restarts
+        eng = MemKVEngine()
+        svc1 = KvService(eng, client=ship, prepare_timeout_s=600.0)
+        srv1 = Server(); srv1.add_service(svc1)
+        await srv1.start()
+        try:
+            mk = lambda k, v: KvCommitReq(write_keys=[k], write_values=[v],
+                                          write_deletes=[False])
+            dec = [dec_srv.address]
+            await ship.call(dec_srv.address, "Kv.prepare", KvPrepareReq(
+                txn_id="t-rec", body=mk(b"a", b"1"),
+                decider=dec, is_decider=True))
+            await ship.call(srv1.address, "Kv.prepare", KvPrepareReq(
+                txn_id="t-rec", body=mk(b"z", b"2"),
+                decider=dec, is_decider=False))
+            await ship.call(dec_srv.address, "Kv.commit_prepared",
+                            KvFinishReq(txn_id="t-rec"))
+            # "crash" shard 1's service (prepared entry lost, engine kept)
+            await srv1.stop()
+            for _t in list(svc1._prepared.values()):
+                _t[1].cancel()
+            # restart over the same engine state
+            svc1b = KvService(eng, client=ship, prepare_timeout_s=0.2)
+            srv1b = Server(); srv1b.add_service(svc1b)
+            await srv1b.start()
+            assert await svc1b.recover_prepared() == 1
+            for _ in range(100):
+                if eng.read_at(b"z", eng.current_version()) == b"2":
+                    break
+                await asyncio.sleep(0.1)
+            else:
+                raise AssertionError("recovered prepare never applied")
+            await srv1b.stop()
+        finally:
+            await dec_srv.stop()
+            try:
+                await srv1.stop()
+            except Exception:
+                pass
+            await ship.close()
+    run(body())
