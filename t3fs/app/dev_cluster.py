@@ -41,7 +41,8 @@ class DevCluster:
                  num_chains: int = 1, with_meta: bool = True,
                  with_monitor: bool = False, durable: bool = True,
                  chunk_size: int = 1 << 20,
-                 heartbeat_timeout_s: float = 2.0):
+                 heartbeat_timeout_s: float = 2.0,
+                 kv_shards: int = 0):
         self.run_dir = os.path.abspath(run_dir)
         self.num_storage = num_storage
         self.replicas = replicas
@@ -51,6 +52,11 @@ class DevCluster:
         self.durable = durable
         self.chunk_size = chunk_size
         self.heartbeat_timeout_s = heartbeat_timeout_s
+        # >0: run meta over a range-sharded KV deployment of this many
+        # standalone kv_main processes (split evenly across the INOD
+        # prefix space; see t3fs/kv/shard.py)
+        self.kv_shards = kv_shards
+        self.kv_addresses: list[str] = []
         self.procs: dict[str, subprocess.Popen] = {}
         self.mgmtd_address = ""
         self.meta_address = ""
@@ -91,8 +97,10 @@ class DevCluster:
         self.procs[name] = proc
         return proc
 
-    async def _wait_port(self, name: str, timeout_s: float = 20.0) -> str:
-        """Wait for the port file, then for Core.getAppInfo to answer."""
+    async def _wait_port(self, name: str, timeout_s: float = 20.0,
+                         probe: str = "Core.getAppInfo") -> str:
+        """Wait for the port file, then for the probe RPC to answer
+        (kv_main hosts only the Kv service -> probe="Kv.status")."""
         port_path = self._path(f"{name}.port")
         deadline = time.time() + timeout_s
         while not os.path.exists(port_path) or not open(port_path).read():
@@ -106,8 +114,7 @@ class DevCluster:
         address = f"127.0.0.1:{open(port_path).read().strip()}"
         while True:
             try:
-                await self.admin.call(address, "Core.getAppInfo", None,
-                                      timeout=2.0)
+                await self.admin.call(address, probe, None, timeout=2.0)
                 return address
             except Exception:
                 if time.time() > deadline:
@@ -146,10 +153,39 @@ class DevCluster:
 
         await self._install_chains()
 
+        meta_kv = self._kv_spec("meta")
+        if self.kv_shards > 0 and self.with_meta:
+            from t3fs.app.kv_main import KvMainConfig
+            for i in range(1, self.kv_shards + 1):
+                self._spawn(f"kv{i}", "t3fs.app.kv_main", KvMainConfig(
+                    node_id=200 + i, kv=self._kv_spec(f"kv{i}"),
+                    port_file=self._path(f"kv{i}.port"),
+                    monitor_address=self.monitor_address,
+                    metrics_period_s=2.0,
+                    log=LogConfig(file=self._path(f"kv{i}.log"))))
+            self.kv_addresses = [await self._wait_port(f"kv{i}", probe="Kv.status")
+                                 for i in range(1, self.kv_shards + 1)]
+            # split at KeyPrefix boundaries (all user keys carry 4-byte
+            # printable prefixes — an even byte-split would land everything
+            # in one shard): N groups get N contiguous runs of prefixes
+            from t3fs.kv.prefixes import KeyPrefix
+            prefixes = sorted(p.value for p in KeyPrefix)
+            if self.kv_shards > len(prefixes):
+                raise ValueError(
+                    f"kv_shards={self.kv_shards} exceeds the "
+                    f"{len(prefixes)} KeyPrefix split points")
+            parts = []
+            for i, addr in enumerate(self.kv_addresses):
+                if i:
+                    split = prefixes[len(prefixes) * i // self.kv_shards]
+                    parts.append(split.hex())
+                parts.append(addr)
+            meta_kv = "shards:" + ";".join(parts)
+
         if self.with_meta:
             self._spawn("meta", "t3fs.app.meta_main", MetaMainConfig(
                 node_id=100, mgmtd_address=self.mgmtd_address,
-                kv=self._kv_spec("meta"),
+                kv=meta_kv,
                 default_chunk_size=self.chunk_size,
                 port_file=self._path("meta.port"),
                 event_trace_path=self._path("meta_events.parquet"),

@@ -331,21 +331,35 @@ class KvService:
             # consumed this entry): decide ABORT with a tombstone so a
             # late coordinator commit_prepared cannot resurrect the txn
             self._resolving.add(txn_id)
-            drop = Transaction(self.engine,
-                               read_version=self.engine.current_version())
-            self._finish_txn(drop, req, b"A")
-            await self._replicate_and_apply(drop)
+            try:
+                drop = Transaction(
+                    self.engine,
+                    read_version=self.engine.current_version())
+                self._finish_txn(drop, req, b"A")
+                await self._replicate_and_apply(drop)
+            finally:
+                self._resolving.discard(txn_id)
             self._prepared.pop(txn_id, None)
-            self._resolving.discard(txn_id)
             self._commit_lock.release()
             log.warning("2pc %s: decider expired -> ABORT tombstone", txn_id)
             return True
-        decision = await self._ask_decider(req)
-        if decision == "P":
-            return False                    # decider undecided: retry later
+        # flag BEFORE the decider RPC: a phase-2 call landing during that
+        # await must be refused (KV_TXN_NOT_FOUND), or it would pop+apply
+        # concurrently with this resolver — double apply + a release() of
+        # a lock the resolver no longer owns
         self._resolving.add(txn_id)
         try:
+            decision = await self._ask_decider(req)
+            if decision == "P":
+                return False                # decider undecided: retry later
+            if self._prepared.get(txn_id) is not entry:
+                return True                 # consumed while asking (defense)
             if decision == "C":
+                # a decided COMMIT applies UNCONDITIONALLY: conflict
+                # re-checking against the (now old) read version could
+                # veto the decider's global verdict and wedge the shard
+                txn._read_keys.clear()
+                txn._read_ranges.clear()
                 self._finish_txn(txn, req, None)
                 await self._replicate_and_apply(txn)
                 log.warning("2pc %s: decider says COMMITTED -> applied",
@@ -358,11 +372,10 @@ class KvService:
                 await self._replicate_and_apply(drop)
                 log.warning("2pc %s: resolved as aborted (%s)", txn_id,
                             decision)
-        except BaseException:
+        finally:
             self._resolving.discard(txn_id)
-            raise                           # entry stays armed; retry later
+        # on apply failure the exception escapes above: entry stays armed
         self._prepared.pop(txn_id, None)
-        self._resolving.discard(txn_id)
         self._commit_lock.release()
         return True
 
@@ -433,8 +446,17 @@ class KvService:
             self._finish_txn(drop, preq, None)
             try:
                 await self._replicate_and_apply(drop)
+            except BaseException:
+                # the PREP record still exists: re-arm so a resolver
+                # retires it (mirrors commit_prepared), or every other
+                # participant polls "P" forever against an orphan record
+                timer2 = asyncio.create_task(
+                    self._resolve_later(req.txn_id, initial_delay=1.0))
+                self._prepared[req.txn_id] = (txn, timer2, preq)
+                raise
             finally:
-                self._commit_lock.release()
+                if req.txn_id not in self._prepared:
+                    self._commit_lock.release()
         return KvOkRsp(), b""   # idempotent: unknown/expired is fine
 
     async def recover_prepared(self) -> int:

@@ -353,3 +353,47 @@ def test_durable_2pc_shard_restart_recovers_prepared():
                 pass
             await ship.close()
     run(body())
+
+
+@pytest.mark.slow
+def test_meta_over_sharded_kv_multiprocess():
+    """Full deployment shape: meta_main running over TWO standalone
+    kv_main shard processes (shards: spec), driven through MetaClient
+    over real sockets — meta ops are cross-process cross-shard 2PC."""
+    import tempfile
+
+    from t3fs.app.dev_cluster import DevCluster
+    from t3fs.client.meta_client import MetaClient
+
+    async def body():
+        with tempfile.TemporaryDirectory(prefix="t3fs-shardmp-") as d:
+            cluster = DevCluster(d, num_storage=2, replicas=2,
+                                 num_chains=1, with_meta=True,
+                                 durable=True, kv_shards=2,
+                                 chunk_size=64 * 1024)
+            await cluster.start()
+            try:
+                assert len(cluster.kv_addresses) == 2
+                mc = MetaClient([cluster.meta_address])
+                await mc.mkdirs("/shard/deep", recursive=True)
+                inode, sess = await mc.create("/shard/deep/f",
+                                              chunk_size=64 * 1024)
+                await mc.close(inode.inode_id, sess, length=0)
+                got = await mc.stat("/shard/deep/f")
+                assert got.inode_id == inode.inode_id
+                await mc.rename("/shard/deep/f", "/shard/g")
+                names = [e.name for e in await mc.readdir("/shard")]
+                assert sorted(names) == ["deep", "g"]
+                # both kv shard processes actually hold state
+                from t3fs.kv.service import KvRangeReq
+                counts = []
+                for addr in cluster.kv_addresses:
+                    rsp, _ = await cluster.admin.call(
+                        addr, "Kv.read_range",
+                        KvRangeReq(begin=b"", end=b"\xff" * 17))
+                    counts.append(len(rsp.keys))
+                assert all(c > 0 for c in counts), counts
+                await mc.close_conn()
+            finally:
+                await cluster.stop()
+    run(body())
