@@ -55,8 +55,10 @@ async def serve(cfg: KvMainConfig, app: ApplicationBase) -> None:
     async def start():
         if cfg.role == "primary":
             # finish any cross-shard txn this node crashed mid-2PC on
-            # (durable prepare records; see t3fs/kv/shard.py)
+            # (durable prepare records; see t3fs/kv/shard.py); a follower
+            # gets both via Kv.promote
             await svc.recover_prepared()
+            svc.ensure_decision_gc()
         await rpc.start()
         app.start_metrics(cfg.monitor_address, cfg.node_id,
                           cfg.metrics_period_s)
@@ -65,6 +67,7 @@ async def serve(cfg: KvMainConfig, app: ApplicationBase) -> None:
                 f.write(str(rpc.port))
 
     async def stop():
+        svc.stop_decision_gc()
         await rpc.stop()
         await client.close()
         if hasattr(engine, "close"):

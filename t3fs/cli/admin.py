@@ -200,6 +200,19 @@ async def set_preferred_order(ctx: AdminContext, args) -> None:
     _print_chain(rsp.chain)
 
 
+@command("kv-status", "probe KV service nodes (role, replication seq)")
+@args_(("addresses", {"nargs": "+", "help": "kv node addresses"}))
+async def kv_status(ctx: AdminContext, args) -> None:
+    import t3fs.kv.service  # noqa: F401  (registers serde structs)
+    for addr in args.addresses:
+        try:
+            rsp, _ = await ctx.cli.call(addr, "Kv.status", None, timeout=5.0)
+            role = "primary" if rsp.ok else "follower"
+            print(f"{addr}: {role} seq={rsp.seq}")
+        except StatusError as e:
+            print(f"{addr}: unreachable ({e.code.name})")
+
+
 @command("enable-node", "re-enable an administratively disabled node")
 @args_(("node_id", {"type": int}))
 async def enable_node(ctx: AdminContext, args) -> None:

@@ -127,6 +127,9 @@ class KvPrepareReq:
     body: KvCommitReq = field(default_factory=KvCommitReq)
     decider: list[str] = field(default_factory=list)
     is_decider: bool = False
+    # decider-only: every participant group's addresses — COMMIT-record GC
+    # must confirm each group resolved before deleting the verdict
+    participants: list[list[str]] = field(default_factory=list)
 
 
 @serde_struct
@@ -171,8 +174,33 @@ class KvService:
         self._prepared: dict[str, tuple] = {}
         self._resolving: set[str] = set()   # mid-resolution txn ids
         self.prepare_timeout_s = prepare_timeout_s
+        self.decision_gc_ttl_s = 3600.0
+        self.decision_gc_period_s = 300.0
+        self._gc_task: asyncio.Task | None = None
         self.replicated = 0             # observability
         self.snapshots_pushed = 0
+
+    def ensure_decision_gc(self) -> None:
+        """Start the decision-record GC loop (primary-only duty); called at
+        boot for a born-primary and again on promote — a promoted follower
+        is a decider too."""
+        if self._gc_task is None or self._gc_task.done():
+            self._gc_task = asyncio.create_task(self._gc_loop())
+
+    def stop_decision_gc(self) -> None:
+        if self._gc_task is not None:
+            self._gc_task.cancel()
+            self._gc_task = None
+
+    async def _gc_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.decision_gc_period_s)
+            try:
+                n = await self.gc_decisions(self.decision_gc_ttl_s)
+                if n:
+                    log.info("gc'd %d 2pc decision records", n)
+            except Exception:
+                log.exception("2pc decision GC failed; will retry")
 
     # ---- client-facing transactional API ----
 
@@ -294,11 +322,81 @@ class KvService:
     def _finish_txn(self, txn: Transaction, req: KvPrepareReq,
                     decision: bytes | None) -> Transaction:
         """Merge 2PC bookkeeping into the slice: drop the prepare record
-        and, on the decider, persist the decision — one atomic batch."""
+        and, on the decider, persist the decision — one atomic batch.
+        Decision records carry a timestamp so gc_decisions can expire
+        them once every participant has surely resolved."""
+        import struct as _struct
+        import time as _time
         txn._writes[PREP_PREFIX + req.txn_id.encode()] = None
         if req.is_decider and decision is not None:
-            txn._writes[DEC_PREFIX + req.txn_id.encode()] = decision
+            payload = decision + _struct.pack("<d", _time.time())
+            if decision == b"C":
+                # the COMMIT verdict embeds the participant groups so GC
+                # can confirm everyone resolved before deleting it
+                payload += serde.dumps(list(req.participants))
+            txn._writes[DEC_PREFIX + req.txn_id.encode()] = payload
         return txn
+
+    async def gc_decisions(self, ttl_s: float = 3600.0) -> int:
+        """Expire decision records.  ABORT tombstones go by TTL alone —
+        losing one degrades to "U", which resolves to the SAME abort
+        verdict.  COMMIT records are load-bearing for participants that
+        are still down, so they are deleted only once every embedded
+        participant group answers get_decision != "P" (an unreachable
+        group keeps the record).  Returns removals."""
+        import struct as _struct
+        import time as _time
+        now = _time.time()
+        ver = self.engine.current_version()
+        rows = self.engine.range_at(DEC_PREFIX, DEC_PREFIX + b"\xff",
+                                    ver, 0)
+        stale = []
+        for k, v in rows:
+            ts = _struct.unpack("<d", v[1:9])[0] if len(v) >= 9 else 0.0
+            if now - ts <= ttl_s:
+                continue
+            if v[:1] == b"C":
+                try:
+                    participants = serde.loads(v[9:]) if len(v) > 9 else None
+                except Exception:
+                    participants = None
+                if participants is None or not await self._all_resolved(
+                        k[len(DEC_PREFIX):].decode(), participants):
+                    continue        # legacy/unconfirmed: keep the verdict
+            stale.append(k)
+        if not stale:
+            return 0
+        async with self._commit_lock:
+            drop = Transaction(self.engine,
+                               read_version=self.engine.current_version())
+            for k in stale:
+                drop._writes[k] = None
+            await self._replicate_and_apply(drop)
+        return len(stale)
+
+    async def _all_resolved(self, txn_id: str,
+                            participants: list[list[str]]) -> bool:
+        """True iff every participant group confirms it no longer holds a
+        PREP record for txn_id (any address per group may answer; a fully
+        unreachable group vetoes GC)."""
+        if self.client is None:
+            return False
+        for group in participants:
+            ok = False
+            for addr in group:
+                try:
+                    rsp, _ = await self.client.call(
+                        addr, "Kv.get_decision",
+                        KvDecisionReq(txn_id=txn_id), timeout=5.0)
+                    if rsp.decision == "P":
+                        return False
+                    ok = True
+                    break
+                except StatusError:
+                    continue
+            if not ok:
+                return False
+        return True
 
     async def _resolve_later(self, txn_id: str,
                              initial_delay: float | None = None) -> None:
@@ -382,11 +480,12 @@ class KvService:
     async def _ask_decider(self, req: KvPrepareReq) -> str:
         if self.client is None or not req.decider:
             return "U"                      # no path to the decider: abort
+        timeout = min(5.0, max(0.5, self.prepare_timeout_s))
         for addr in req.decider:
             try:
                 rsp, _ = await self.client.call(
                     addr, "Kv.get_decision",
-                    KvDecisionReq(txn_id=req.txn_id), timeout=5.0)
+                    KvDecisionReq(txn_id=req.txn_id), timeout=timeout)
                 return rsp.decision
             except StatusError:
                 continue
@@ -398,7 +497,7 @@ class KvService:
         ver = self.engine.current_version()
         dec = self.engine.read_at(DEC_PREFIX + key, ver)
         if dec is not None:
-            return KvDecisionRsp(decision=dec.decode()), b""
+            return KvDecisionRsp(decision=chr(dec[0])), b""
         if self.engine.read_at(PREP_PREFIX + key, ver) is not None \
                 or req.txn_id in self._prepared:
             return KvDecisionRsp(decision="P"), b""
@@ -587,6 +686,7 @@ class KvService:
         still resolves it."""
         self.primary = True
         recovered = await self.recover_prepared()
+        self.ensure_decision_gc()
         log.warning("KV node promoted to primary at seq %d "
                     "(%d prepared txns re-armed)", self.seq, recovered)
         return KvOkRsp(seq=self.seq), b""

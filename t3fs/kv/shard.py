@@ -187,6 +187,8 @@ class ShardedTransaction:
         # first, so every later shard can recover the verdict.
         txn_id = uuid.uuid4().hex
         decider_addrs = list(self.engine.map.ranges[touched[0]].addresses)
+        participant_groups = [list(self.engine.map.ranges[s].addresses)
+                              for s in touched]
         for s in touched:
             sub = self._subs[s]
             # pin the read version on subs that registered conflicts
@@ -203,7 +205,9 @@ class ShardedTransaction:
                     KvPrepareReq(txn_id=txn_id,
                                  body=self._subs[s].to_commit_req(),
                                  decider=decider_addrs,
-                                 is_decider=(s == touched[0])))
+                                 is_decider=(s == touched[0]),
+                                 participants=(participant_groups
+                                               if s == touched[0] else [])))
                 prepared.append(s)
         except BaseException:
             # abort EVERY touched shard incl. the one whose prepare call

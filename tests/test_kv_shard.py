@@ -397,3 +397,176 @@ def test_meta_over_sharded_kv_multiprocess():
             finally:
                 await cluster.stop()
     run(body())
+
+
+@pytest.mark.slow
+def test_2pc_chaos_convergence():
+    """Randomized 2PC chaos: cross-shard txns driven to random phase
+    points, services crash-restarted (engine survives, memory lost) at
+    random, resolution left to the protocol.  Invariant: for every txn,
+    the final state matches the decider's verdict on BOTH shards — all
+    applied or none, never torn."""
+    async def body():
+        from t3fs.kv.engine import MemKVEngine
+        from t3fs.kv.service import (
+            KvCommitReq, KvFinishReq, KvPrepareReq, KvService,
+        )
+        from t3fs.net.client import Client
+        from t3fs.net.server import Server
+        import random
+
+        rng = random.Random(20260731)
+        ship = Client()
+        engines = [MemKVEngine(), MemKVEngine()]
+        servers: list = [None, None]
+        services: list = [None, None]
+
+        ports = [0, 0]
+
+        async def boot(i, recover=True):
+            if servers[i] is not None:
+                await servers[i].stop()
+                for e in list(services[i]._prepared.values()):
+                    e[1].cancel()
+            svc = KvService(engines[i], client=ship,
+                            prepare_timeout_s=0.25)
+            # restarts KEEP the address (as production does): a changed
+            # port would orphan every resolver polling the old decider
+            srv = Server(port=ports[i])
+            srv.add_service(svc)
+            await srv.start()
+            ports[i] = srv.port
+            servers[i], services[i] = srv, svc
+            if recover:
+                await svc.recover_prepared()
+            return srv.address
+
+        addrs = [await boot(0, recover=False), await boot(1, recover=False)]
+        try:
+            for it in range(12):
+                txn_id = f"chaos-{it}"
+                ka = f"a{it}".encode()
+                kz = f"z{it}".encode()
+                mk = lambda k: KvCommitReq(write_keys=[k],
+                                           write_values=[b"v"],
+                                           write_deletes=[False])
+                dec = [addrs[0]]
+                try:
+                    await ship.call(addrs[0], "Kv.prepare", KvPrepareReq(
+                        txn_id=txn_id, body=mk(ka), decider=dec,
+                        is_decider=True))
+                    await ship.call(addrs[1], "Kv.prepare", KvPrepareReq(
+                        txn_id=txn_id, body=mk(kz), decider=dec,
+                        is_decider=False))
+                except Exception:
+                    continue
+                phase = rng.randrange(4)
+                try:
+                    if phase >= 1:      # commit decider
+                        await ship.call(addrs[0], "Kv.commit_prepared",
+                                        KvFinishReq(txn_id=txn_id))
+                    if phase >= 2:      # commit laggard too
+                        await ship.call(addrs[1], "Kv.commit_prepared",
+                                        KvFinishReq(txn_id=txn_id))
+                    if phase == 3 and rng.random() < 0.5:
+                        await ship.call(addrs[rng.randrange(2)],
+                                        "Kv.abort_prepared",
+                                        KvFinishReq(txn_id=txn_id))
+                except Exception:
+                    pass
+                # random crash-restart of either service (address kept)
+                if rng.random() < 0.5:
+                    i = rng.randrange(2)
+                    addrs[i] = await boot(i)
+                await asyncio.sleep(0)
+
+            # let resolution settle: every durable PREP record must retire
+            from t3fs.kv.service import DEC_PREFIX, PREP_PREFIX
+            deadline = asyncio.get_event_loop().time() + 12.0
+            while asyncio.get_event_loop().time() < deadline:
+                pending = sum(
+                    len(engines[i].range_at(PREP_PREFIX,
+                                            PREP_PREFIX + b"\xff",
+                                            engines[i].current_version(),
+                                            0))
+                    for i in range(2))
+                if pending == 0:
+                    break
+                await asyncio.sleep(0.2)
+
+            # invariant: per txn, laggard state matches decider verdict
+            torn = []
+            ver0 = engines[0].current_version()
+            ver1 = engines[1].current_version()
+            for it in range(12):
+                txn_id = f"chaos-{it}".encode()
+                dec = engines[0].read_at(DEC_PREFIX + txn_id, ver0)
+                a = engines[0].read_at(f"a{it}".encode(), ver0)
+                z = engines[1].read_at(f"z{it}".encode(), ver1)
+                prep0 = engines[0].read_at(PREP_PREFIX + txn_id, ver0)
+                prep1 = engines[1].read_at(PREP_PREFIX + txn_id, ver1)
+                if prep0 or prep1:
+                    continue   # still unresolved (decider unreachable) —
+                               # not torn, just pending
+                verdict = (dec or b"?")[:1]
+                if verdict == b"C":
+                    if not (a == b"v" and z == b"v"):
+                        torn.append((it, "C", a, z))
+                else:
+                    # aborted or never decided: neither side may hold it...
+                    # EXCEPT phase>=2 txns whose decider record was lost is
+                    # impossible (decision is durable+replicated)
+                    if a == b"v" or z == b"v":
+                        torn.append((it, verdict, a, z))
+            assert not torn, torn
+        finally:
+            for s in servers:
+                if s is not None:
+                    await s.stop()
+            await ship.close()
+    run(body())
+
+
+def test_decision_record_gc():
+    """ABORT tombstones expire by TTL (losing one degrades to the same
+    abort verdict); COMMIT records expire only when every embedded
+    participant group confirms resolution — a down/unconfirmed
+    participant keeps the verdict alive (no TTL-induced torn txns)."""
+    async def body():
+        import struct
+        import time as _time
+        from t3fs.kv.engine import MemKVEngine, Transaction
+        from t3fs.kv.service import DEC_PREFIX, KvService
+        from t3fs.utils import serde as _serde
+
+        svc = KvService(MemKVEngine(), client=Client())
+        eng = svc.engine
+        drop = Transaction(eng, read_version=eng.current_version())
+        old_ts = struct.pack("<d", _time.time() - 7200)
+        new_ts = struct.pack("<d", _time.time())
+        # old C with NO participant info (legacy): must be kept forever
+        drop._writes[DEC_PREFIX + b"old-c"] = b"C" + old_ts
+        # old C whose only participant group is UNREACHABLE: kept
+        drop._writes[DEC_PREFIX + b"down-c"] = \
+            b"C" + old_ts + _serde.dumps([["127.0.0.1:1"]])
+        # old C with an EMPTY participant list: trivially confirmed -> gc
+        drop._writes[DEC_PREFIX + b"done-c"] = \
+            b"C" + old_ts + _serde.dumps([])
+        drop._writes[DEC_PREFIX + b"old-a"] = b"A" + old_ts
+        drop._writes[DEC_PREFIX + b"legacy"] = b"A"       # pre-ts format
+        drop._writes[DEC_PREFIX + b"new"] = b"C" + new_ts
+        await eng.commit_async(drop)
+
+        assert await svc.gc_decisions(ttl_s=3600.0) == 3  # done-c, old-a, legacy
+        ver = eng.current_version()
+        assert eng.read_at(DEC_PREFIX + b"old-c", ver) is not None
+        assert eng.read_at(DEC_PREFIX + b"down-c", ver) is not None
+        assert eng.read_at(DEC_PREFIX + b"done-c", ver) is None
+        assert eng.read_at(DEC_PREFIX + b"old-a", ver) is None
+        assert eng.read_at(DEC_PREFIX + b"legacy", ver) is None
+        assert eng.read_at(DEC_PREFIX + b"new", ver) is not None
+        # decision still readable through the RPC after format change
+        from t3fs.kv.service import KvDecisionReq
+        rsp, _ = await svc.get_decision(KvDecisionReq(txn_id="new"), b"", None)
+        assert rsp.decision == "C"
+    run(body())
