@@ -117,15 +117,15 @@ async def _run(args, cluster: LocalCluster, k: int, m: int,
         for s in range(args.stripes)}
     n_shards = sum(len(v) for v in stripe_losses.values())
     t0 = time.perf_counter()
-    sem = asyncio.Semaphore(args.concurrency)
-
-    async def repair(s: int, shards: tuple[int, ...]) -> None:
-        async with sem:
-            res = await ec.repair_stripe(lay, inode, s, shards,
-                                         stripe_len=stripe_len)
-            assert all(r.status.code == int(StatusCode.OK) for r in res)
-    await asyncio.gather(*(repair(s, v) for s, v in stripe_losses.items()
-                           if v))
+    # survivor-read-balanced scheduling (the BIBD objective, online)
+    from t3fs.client.repair import RepairDriver, RepairJob
+    driver = RepairDriver(ec, concurrency=args.concurrency)
+    report = await driver.run([RepairJob(
+        layout=lay, inode=inode,
+        stripe_len_of={s: stripe_len for s in range(args.stripes)},
+        losses=stripe_losses)])
+    assert not report.failed, report.failed
+    assert report.repaired_shards == n_shards
     t_repair = time.perf_counter() - t0
     repaired_bytes = n_shards * args.chunk_size
 

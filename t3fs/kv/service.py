@@ -150,6 +150,9 @@ class KvDecisionRsp:
     # "C" committed | "A" aborted (tombstone) | "P" decider's own prepare
     # still pending | "U" no trace (presumed abort)
     decision: str = "U"
+    # answered by the group's primary?  A follower's "U" may just be a
+    # stale/replaced replica — GC must not treat it as proof of resolution
+    authoritative: bool = False
 
 
 # internal key prefixes for durable 2PC state (outside every user prefix —
@@ -360,7 +363,10 @@ class KvService:
                     participants = serde.loads(v[9:]) if len(v) > 9 else None
                 except Exception:
                     participants = None
-                if participants is None or not await self._all_resolved(
+                # an EMPTY list is indistinguishable from "coordinator
+                # didn't populate the field" (serde default) — keep those
+                # forever too, like legacy records
+                if not participants or not await self._all_resolved(
                         k[len(DEC_PREFIX):].decode(), participants):
                     continue        # legacy/unconfirmed: keep the verdict
             stale.append(k)
@@ -376,13 +382,15 @@ class KvService:
 
     async def _all_resolved(self, txn_id: str,
                             participants: list[list[str]]) -> bool:
-        """True iff every participant group confirms it no longer holds a
-        PREP record for txn_id (any address per group may answer; a fully
-        unreachable group vetoes GC)."""
+        """True iff every participant group AUTHORITATIVELY confirms it no
+        longer holds a PREP record for txn_id.  A "P" from anyone vetoes;
+        a resolved answer counts only from the group's PRIMARY (a stale
+        follower's "U" proves nothing); an unreachable-or-primaryless
+        group vetoes."""
         if self.client is None:
             return False
         for group in participants:
-            ok = False
+            confirmed = False
             for addr in group:
                 try:
                     rsp, _ = await self.client.call(
@@ -390,11 +398,12 @@ class KvService:
                         KvDecisionReq(txn_id=txn_id), timeout=5.0)
                     if rsp.decision == "P":
                         return False
-                    ok = True
-                    break
+                    if getattr(rsp, "authoritative", False):
+                        confirmed = True
+                        break
                 except StatusError:
                     continue
-            if not ok:
+            if not confirmed:
                 return False
         return True
 
@@ -497,11 +506,14 @@ class KvService:
         ver = self.engine.current_version()
         dec = self.engine.read_at(DEC_PREFIX + key, ver)
         if dec is not None:
-            return KvDecisionRsp(decision=chr(dec[0])), b""
+            return KvDecisionRsp(decision=chr(dec[0]),
+                                 authoritative=self.primary), b""
         if self.engine.read_at(PREP_PREFIX + key, ver) is not None \
                 or req.txn_id in self._prepared:
-            return KvDecisionRsp(decision="P"), b""
-        return KvDecisionRsp(decision="U"), b""
+            return KvDecisionRsp(decision="P",
+                                 authoritative=self.primary), b""
+        return KvDecisionRsp(decision="U",
+                             authoritative=self.primary), b""
 
     @rpc_method
     async def commit_prepared(self, req: "KvFinishReq", payload, conn):

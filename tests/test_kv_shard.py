@@ -540,6 +540,10 @@ def test_decision_record_gc():
         from t3fs.utils import serde as _serde
 
         svc = KvService(MemKVEngine(), client=Client())
+        # a live, authoritative, fully-resolved participant group
+        peer = KvService(MemKVEngine())
+        peer_srv = Server(); peer_srv.add_service(peer)
+        await peer_srv.start()
         eng = svc.engine
         drop = Transaction(eng, read_version=eng.current_version())
         old_ts = struct.pack("<d", _time.time() - 7200)
@@ -549,9 +553,13 @@ def test_decision_record_gc():
         # old C whose only participant group is UNREACHABLE: kept
         drop._writes[DEC_PREFIX + b"down-c"] = \
             b"C" + old_ts + _serde.dumps([["127.0.0.1:1"]])
-        # old C with an EMPTY participant list: trivially confirmed -> gc
-        drop._writes[DEC_PREFIX + b"done-c"] = \
+        # old C with an EMPTY participant list: indistinguishable from an
+        # unpopulated field -> kept forever like legacy
+        drop._writes[DEC_PREFIX + b"empty-c"] = \
             b"C" + old_ts + _serde.dumps([])
+        # old C whose participant (a live PRIMARY) confirms resolution: gc
+        drop._writes[DEC_PREFIX + b"done-c"] = \
+            b"C" + old_ts + _serde.dumps([[peer_srv.address]])
         drop._writes[DEC_PREFIX + b"old-a"] = b"A" + old_ts
         drop._writes[DEC_PREFIX + b"legacy"] = b"A"       # pre-ts format
         drop._writes[DEC_PREFIX + b"new"] = b"C" + new_ts
@@ -561,7 +569,9 @@ def test_decision_record_gc():
         ver = eng.current_version()
         assert eng.read_at(DEC_PREFIX + b"old-c", ver) is not None
         assert eng.read_at(DEC_PREFIX + b"down-c", ver) is not None
+        assert eng.read_at(DEC_PREFIX + b"empty-c", ver) is not None
         assert eng.read_at(DEC_PREFIX + b"done-c", ver) is None
+        await peer_srv.stop()
         assert eng.read_at(DEC_PREFIX + b"old-a", ver) is None
         assert eng.read_at(DEC_PREFIX + b"legacy", ver) is None
         assert eng.read_at(DEC_PREFIX + b"new", ver) is not None
