@@ -320,21 +320,45 @@ class StorageClient:
 
     async def read_file_range(self, layout: FileLayout, inode: int,
                               offset: int, length: int) -> tuple[bytes, list[IOResult]]:
-        pieces = layout.chunk_span(offset, length)
-        ios = [ReadIO(chunk_id=ChunkId(inode, idx), chain_id=layout.chain_of(idx),
-                      offset=coff, length=span,
-                      verify_checksum=self.cfg.verify_checksums)
-               for idx, coff, span in pieces]
+        out = await self.read_file_ranges(layout, [(inode, offset, length)])
+        return out[0]
+
+    async def read_file_ranges(
+            self, layout: FileLayout,
+            ranges: list[tuple[int, int, int]],
+    ) -> list[tuple[bytes, list[IOResult]]]:
+        """Many (inode, offset, length) ranges in ONE batch_read fan-out —
+        the coalescing the reference gets from PioV gathering a ring's
+        sqes into one StorageClient batch op (src/fuse/PioV.h:14-37).
+        Holes and short chunks zero-fill, same contract as
+        read_file_range."""
+        all_pieces: list[list[tuple[int, int, int]]] = []
+        ios: list[ReadIO] = []
+        bounds: list[tuple[int, int]] = []
+        for inode, offset, length in ranges:
+            pieces = layout.chunk_span(offset, length)
+            all_pieces.append(pieces)
+            start = len(ios)
+            ios.extend(ReadIO(chunk_id=ChunkId(inode, idx),
+                              chain_id=layout.chain_of(idx),
+                              offset=coff, length=span,
+                              verify_checksum=self.cfg.verify_checksums)
+                       for idx, coff, span in pieces)
+            bounds.append((start, len(ios)))
         results, payloads = await self.batch_read(ios)
-        data = bytearray()
-        for (idx, coff, span), r, p in zip(pieces, results, payloads):
-            if r.status.code == int(StatusCode.CHUNK_NOT_FOUND):
-                data += b"\x00" * span  # hole
-            else:
-                data += p
-                if len(p) < span:
-                    data += b"\x00" * (span - len(p))  # short chunk tail
-        return bytes(data), results
+        out: list[tuple[bytes, list[IOResult]]] = []
+        for pieces, (lo, hi) in zip(all_pieces, bounds):
+            data = bytearray()
+            for (idx, coff, span), r, p in zip(pieces, results[lo:hi],
+                                               payloads[lo:hi]):
+                if r.status.code == int(StatusCode.CHUNK_NOT_FOUND):
+                    data += b"\x00" * span  # hole
+                else:
+                    data += p
+                    if len(p) < span:
+                        data += b"\x00" * (span - len(p))  # short tail
+            out.append((bytes(data), results[lo:hi]))
+        return out
 
     async def query_last_chunk(self, layout: FileLayout, inode: int) -> int:
         """File length via per-chain last-chunk queries (FileOperation analog)."""

@@ -4,9 +4,10 @@ storage/meta clients, push completions.
 Reference analog: FuseClients::ioRingWorker coroutines (src/fuse/
 FuseClients.h:189) + IoRing::process + PioV execute (src/fuse/IoRing.h:121,
 PioV.h:35-37).  A dedicated thread blocks in t3fs_ior_pop_sqe (GIL released
-inside ctypes), feeds the asyncio loop, and ops run concurrently through the
-StorageClient batch path — so many in-flight sqes coalesce exactly like the
-reference's ring batches.
+inside ctypes) and feeds an asyncio queue; a drainer coroutine COALESCES
+whatever reads are queued into one `read_file_ranges` batch per wave (the
+PioV gather — one RPC per storage node per wave, not one per sqe), while
+writes run concurrently as before.
 """
 
 from __future__ import annotations
@@ -38,32 +39,97 @@ class RingWorker:
         self._thread: threading.Thread | None = None
         self._loop: asyncio.AbstractEventLoop | None = None
         self._sem: asyncio.Semaphore | None = None
+        self._queue: asyncio.Queue | None = None
+        self._drainer: asyncio.Task | None = None
+        self._tasks: set[asyncio.Task] = set()
 
     async def start(self) -> None:
         self._loop = asyncio.get_running_loop()
+        # one permit per in-flight SQE (not per wave): the cap the old
+        # per-sqe dispatch enforced, kept under coalescing
         self._sem = asyncio.Semaphore(MAX_INFLIGHT)
+        self._queue: asyncio.Queue = asyncio.Queue()
+        self._tasks: set[asyncio.Task] = set()
+        self._drainer = asyncio.create_task(self._drain_loop())
         self._thread = threading.Thread(target=self._pump, daemon=True,
                                         name=f"t3fs-ring-{self.ring.name}")
         self._thread.start()
 
     def _pump(self) -> None:
-        """Blocking sqe drain on a plain thread; hops to the loop per sqe."""
+        """Blocking sqe drain on a plain thread; hops to the loop queue."""
         while not self._stop.is_set():
             sqe = self.ring.pop_sqe(timeout_ms=100)
             if sqe is None:
                 continue
-            asyncio.run_coroutine_threadsafe(self._dispatch(sqe), self._loop)
+            self._loop.call_soon_threadsafe(self._queue.put_nowait, sqe)
 
-    async def _dispatch(self, sqe: CSqe) -> None:
-        async with self._sem:
-            try:
-                n = await self._execute(sqe)
-                self.ring.complete(sqe.userdata, n, 0)
-            except StatusError as e:
-                self.ring.complete(sqe.userdata, -1, e.code)
-            except Exception:
-                self.ring.complete(sqe.userdata, -1,
-                                   int(StatusCode.INTERNAL))
+    def _complete(self, sqe: CSqe, result: int, status: int) -> None:
+        self.ring.complete(sqe.userdata, result, status)
+        self._sem.release()                  # one permit per sqe
+
+    def _spawn(self, coro) -> None:
+        # the loop only weak-refs tasks: keep a hard reference until done
+        t = asyncio.create_task(coro)
+        self._tasks.add(t)
+        t.add_done_callback(self._tasks.discard)
+
+    async def _drain_loop(self) -> None:
+        """Gather queued sqes into waves: all reads of a wave coalesce
+        into read_file_ranges batches (the PioV gather); writes dispatch
+        concurrently.  Gathering stops when the per-sqe inflight budget
+        is spent — backpressure instead of unbounded fan-out."""
+        while True:
+            sqe = await self._queue.get()
+            await self._sem.acquire()
+            wave = [sqe]
+            while len(wave) < MAX_INFLIGHT and not self._sem.locked():
+                try:
+                    nxt = self._queue.get_nowait()
+                except asyncio.QueueEmpty:
+                    break
+                await self._sem.acquire()
+                wave.append(nxt)
+            reads = [s for s in wave if s.op == OP_READ]
+            writes = [s for s in wave if s.op != OP_READ]
+            # fire the wave without awaiting it: the next wave may start
+            # gathering immediately (completion order is the ring's own
+            # business — userdata matching, like the reference)
+            if reads:
+                by_ident: dict[int, list[CSqe]] = {}
+                for s in reads:
+                    by_ident.setdefault(s.ident, []).append(s)
+                for group in by_ident.values():
+                    self._spawn(self._dispatch_reads(group))
+            for s in writes:
+                self._spawn(self._dispatch_write(s))
+
+    async def _dispatch_reads(self, group: list[CSqe]) -> None:
+        """One ident's reads of a wave -> ONE read_file_ranges batch.
+        Error isolation is per group; each sqe completes exactly once."""
+        done = 0
+        try:
+            lay = await self._layout(group[0].ident)
+            outs = await self.storage.read_file_ranges(
+                lay, [(s.ident, s.file_off, s.len) for s in group])
+            for s, (data, _results) in zip(group, outs):
+                self.iov.write_at(s.iov_off, data)
+                self._complete(s, len(data), 0)
+                done += 1
+        except StatusError as e:
+            for s in group[done:]:
+                self._complete(s, -1, e.code)
+        except Exception:
+            for s in group[done:]:
+                self._complete(s, -1, int(StatusCode.INTERNAL))
+
+    async def _dispatch_write(self, sqe: CSqe) -> None:
+        try:
+            n = await self._execute_write(sqe)
+            self._complete(sqe, n, 0)
+        except StatusError as e:
+            self._complete(sqe, -1, e.code)
+        except Exception:
+            self._complete(sqe, -1, int(StatusCode.INTERNAL))
 
     async def _layout(self, ident: int):
         lay = self._layouts.get(ident)
@@ -72,13 +138,8 @@ class RingWorker:
             lay = self._layouts[ident] = ino.layout
         return lay
 
-    async def _execute(self, sqe: CSqe) -> int:
+    async def _execute_write(self, sqe: CSqe) -> int:
         lay = await self._layout(sqe.ident)
-        if sqe.op == OP_READ:
-            data, _ = await self.storage.read_file_range(
-                lay, sqe.ident, sqe.file_off, sqe.len)
-            self.iov.write_at(sqe.iov_off, data)
-            return len(data)
         payload = self.iov.read_at(sqe.iov_off, sqe.len)
         results = await self.storage.write_file_range(
             lay, sqe.ident, sqe.file_off, payload)
@@ -94,5 +155,19 @@ class RingWorker:
         if self._thread:
             await asyncio.get_running_loop().run_in_executor(
                 None, self._thread.join)
+        if self._drainer is not None:
+            self._drainer.cancel()
+        # sqes already popped from the shm ring but still queued would
+        # otherwise vanish without a cqe — error-complete them
+        if self._queue is not None:
+            while True:
+                try:
+                    sqe = self._queue.get_nowait()
+                except asyncio.QueueEmpty:
+                    break
+                self.ring.complete(sqe.userdata, -1,
+                                   int(StatusCode.CANCELLED))
+        for t in list(self._tasks):
+            t.cancel()
         self.ring.close()
         self.iov.close(unlink=False)
