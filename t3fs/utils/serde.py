@@ -78,7 +78,8 @@ class _Plan:
     tests/test_utils.py fuzzes every registered struct against the generic
     path to hold that equivalence."""
 
-    __slots__ = ("cls", "header", "names", "enc", "_coercers", "_hint_err")
+    __slots__ = ("cls", "header", "names", "enc", "dec", "_coercers",
+                 "_hint_err")
 
     def __init__(self, cls: type):
         self.cls = cls
@@ -104,11 +105,20 @@ class _Plan:
             self.enc = _compile_encoder(self, hints)
         except Exception:          # codegen must never break encoding
             self.enc = self._generic_enc
+        try:
+            if self._coercers is None:
+                raise ValueError("hints unresolved")
+            self.dec = _compile_decoder(self, hints)
+        except Exception:          # codegen must never break decoding
+            self.dec = self._generic_dec
 
     def _generic_enc(self, w: bytearray, obj) -> None:
         w += self.header
         for name in self.names:
             _encode(w, getattr(obj, name))
+
+    def _generic_dec(self, r: "_Reader"):
+        return _decode_struct_body(r, self.cls, self)
 
     @property
     def coercers(self) -> tuple:
@@ -233,6 +243,134 @@ def _emit_value(lines, ns, ind, v, hint, depth):
         return True
     lines.append(f"{ind}_encode(w, {v})")
     return True
+
+
+def _emit_read(lines, ns, ind, v, hint):
+    """Emit a tag-checked fast read into variable `v` for the hinted type,
+    falling back to `_decode_with_tag` (+ the compiled coercer where one
+    exists) on any tag mismatch — outcome-identical to the generic path."""
+    hint, optional = _unwrap_optional(hint)
+    lines.append(f"{ind}_t = r.tag()")
+    if optional:
+        lines.append(f"{ind}if _t == {T_NONE}:")
+        lines.append(f"{ind}    {v} = None")
+        lines.append(f"{ind}else:")
+        ind += "    "
+    enum_name = None
+    if isinstance(hint, type) and issubclass(hint, enum.Enum):
+        enum_name = f"_E{len(ns)}"
+        ns[enum_name] = hint
+        hint = int if issubclass(hint, int) else (
+            str if issubclass(hint, str) else None)
+        if hint is None:
+            # plain/bytes-based enum: generic read, epilogue coerces
+            lines.append(f"{ind}{v} = _decode_with_tag(r, _t)")
+            lines.append(f"{ind}if {v} is not None "
+                         f"and not isinstance({v}, {enum_name}):")
+            lines.append(f"{ind}    {v} = {enum_name}({v})")
+            return
+    if hint is bool:
+        lines += [f"{ind}if _t == {T_TRUE}:",
+                  f"{ind}    {v} = True",
+                  f"{ind}elif _t == {T_FALSE}:",
+                  f"{ind}    {v} = False",
+                  f"{ind}else:",
+                  f"{ind}    {v} = _decode_with_tag(r, _t)"]
+    elif hint is int:
+        lines += [f"{ind}if _t == {T_INT}:",
+                  f"{ind}    {v} = r.varint()",
+                  f"{ind}elif _t == {T_NEGINT}:",
+                  f"{ind}    {v} = -r.varint() - 1",
+                  f"{ind}else:",
+                  f"{ind}    {v} = _decode_with_tag(r, _t)"]
+    elif hint is float:
+        lines += [f"{ind}if _t == {T_FLOAT}:",
+                  f"{ind}    {v} = _unpack_d(r.exact(8))[0]",
+                  f"{ind}else:",
+                  f"{ind}    {v} = _decode_with_tag(r, _t)"]
+    elif hint is str:
+        lines += [f"{ind}if _t == {T_STR}:",
+                  f"{ind}    {v} = r.exact(r.varint()).decode('utf-8')",
+                  f"{ind}else:",
+                  f"{ind}    {v} = _decode_with_tag(r, _t)"]
+    elif hint is bytes:
+        lines += [f"{ind}if _t == {T_BYTES}:",
+                  f"{ind}    {v} = r.exact(r.varint())",
+                  f"{ind}else:",
+                  f"{ind}    {v} = _decode_with_tag(r, _t)"]
+    elif isinstance(hint, type) and is_dataclass(hint) \
+            and _registry.get(hint.__name__) is hint:
+        cn = f"_C{len(ns)}"
+        nb = f"_N{len(ns)}"
+        ns[cn] = hint
+        ns[nb] = hint.__name__.encode()
+        lines += [f"{ind}if _t == {T_STRUCT}:",
+                  f"{ind}    _nm = r.exact(r.varint())",
+                  f"{ind}    if _nm == {nb}:",
+                  f"{ind}        {v} = _plan_of({cn}).dec(r)",
+                  f"{ind}    else:",
+                  f"{ind}        {v} = _struct_by_name(r, _nm)",
+                  f"{ind}else:",
+                  f"{ind}    {v} = _decode_with_tag(r, _t)"]
+    elif typing.get_origin(hint) is list and typing.get_args(hint) \
+            and typing.get_args(hint)[0] in (int, str, bytes):
+        elem = typing.get_args(hint)[0]
+        inner = {int: f"(r.varint() if _et == {T_INT} else "
+                      f"(-r.varint() - 1 if _et == {T_NEGINT} else "
+                      f"_decode_with_tag(r, _et)))",
+                 str: f"(r.exact(r.varint()).decode('utf-8') "
+                      f"if _et == {T_STR} else _decode_with_tag(r, _et))",
+                 bytes: f"(r.exact(r.varint()) if _et == {T_BYTES} "
+                        f"else _decode_with_tag(r, _et))"}[elem]
+        lines += [f"{ind}if _t == {T_LIST}:",
+                  f"{ind}    {v} = []",
+                  f"{ind}    for _ in range(r.varint()):",
+                  f"{ind}        _et = r.tag()",
+                  f"{ind}        {v}.append({inner})",
+                  f"{ind}else:",
+                  f"{ind}    {v} = _decode_with_tag(r, _t)"]
+    else:
+        # no fast path: generic decode + the compiled coercer (if any)
+        lines.append(f"{ind}{v} = _decode_with_tag(r, _t)")
+        coercer = _compile_coercer(hint)
+        if coercer is not None:
+            cc = f"_c{len(ns)}"
+            ns[cc] = coercer
+            lines.append(f"{ind}{v} = {cc}({v})")
+        return
+    if enum_name is not None:
+        lines.append(f"{ind}if {v} is not None "
+                     f"and not isinstance({v}, {enum_name}):")
+        lines.append(f"{ind}    {v} = {enum_name}({v})")
+
+
+def _struct_by_name(r: "_Reader", name_b: bytes):
+    cls = _registry.get(name_b.decode())
+    if cls is None:
+        raise ValueError(f"serde: unknown struct {name_b!r}")
+    return _plan_of(cls).dec(r)
+
+
+def _compile_decoder(plan: "_Plan", hints: dict):
+    """exec-generate dec(r) for one registered dataclass: tag-checked
+    inline reads per field in declaration order, bailing to the generic
+    loop when the wire field count differs (cross-version compat)."""
+    ns: dict = {"_decode_with_tag": _decode_with_tag,
+                "_decode_struct_body": _decode_struct_body,
+                "_unpack_d": _unpack_d, "_plan_of": _plan_of,
+                "_struct_by_name": _struct_by_name,
+                "_CLS": plan.cls, "_PLAN": plan}
+    n = len(plan.names)
+    lines = ["def dec(r):",
+             "    nfields = r.varint()",
+             f"    if nfields != {n}:",
+             "        return _decode_struct_body(r, _CLS, _PLAN, nfields)"]
+    for i, name in enumerate(plan.names):
+        _emit_read(lines, ns, "    ", f"v{i}", hints.get(name))
+    args = ", ".join(f"v{i}" for i in range(n))
+    lines.append(f"    return _CLS({args})")
+    exec("\n".join(lines), ns)          # noqa: S102 (trusted codegen)
+    return ns["dec"]
 
 
 def _compile_encoder(plan: "_Plan", hints: dict):
@@ -384,6 +522,13 @@ class _Reader:
         except IndexError:
             raise ValueError("serde: truncated varint") from None
 
+    def tag(self) -> int:
+        pos = self.pos
+        if pos >= len(self.buf):
+            raise ValueError("serde: truncated input")
+        self.pos = pos + 1
+        return self.buf[pos]
+
     def exact(self, n: int) -> bytes:
         b = self.buf[self.pos:self.pos + n]
         if len(b) != n:
@@ -393,40 +538,38 @@ class _Reader:
         return b
 
 
+def _decode_struct_body(r: _Reader, cls, plan, nfields=None) -> object:
+    """Generic field loop for a struct whose header+name are consumed.
+    Forward/backward compat: extra fields dropped, missing use defaults.
+    Positional construction (fields in declaration order) skips a kwargs
+    dict per struct."""
+    if nfields is None:
+        nfields = r.varint()
+    coercers = plan.coercers
+    nown = len(coercers)
+    args = []
+    for i in range(nfields):
+        v = _decode(r)
+        if i < nown:
+            c = coercers[i]
+            args.append(v if c is None else c(v))
+    return cls(*args)
+
+
 def _decode(r: _Reader):
     buf, pos = r.buf, r.pos
     if pos >= len(buf):
         raise ValueError("serde: truncated input")
     tag = buf[pos]
     r.pos = pos + 1
+    return _decode_with_tag(r, tag)
+
+
+def _decode_with_tag(r: _Reader, tag: int):
     if tag == T_INT:
         return r.varint()
     if tag == T_STRUCT:
-        name = r.exact(r.varint()).decode()
-        cls = _registry.get(name)
-        if cls is None:
-            raise ValueError(f"serde: unknown struct {name!r}")
-        plan = _plan_of(cls)
-        nfields = r.varint()
-        coercers = plan.coercers
-        nown = len(coercers)
-        # forward/backward compat: extra fields dropped, missing use
-        # defaults.  Positional construction (fields in declaration order)
-        # skips a kwargs dict per struct on the hot path.
-        if nfields <= nown:
-            args = []
-            for i in range(nfields):
-                v = _decode(r)
-                c = coercers[i]
-                args.append(v if c is None else c(v))
-            return cls(*args)
-        args = []
-        for i in range(nfields):
-            v = _decode(r)
-            if i < nown:
-                c = coercers[i]
-                args.append(v if c is None else c(v))
-        return cls(*args)
+        return _struct_by_name(r, r.exact(r.varint()))
     if tag == T_BYTES:
         return r.exact(r.varint())
     if tag == T_STR:

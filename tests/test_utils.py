@@ -303,9 +303,20 @@ def test_serde_fuzz_every_registered_struct():
             # the generated fast encoder must be BYTE-identical to the
             # generic reflective path
             w = bytearray()
-            serde._plan_of(cls)._generic_enc(w, obj)
+            plan = serde._plan_of(cls)
+            plan._generic_enc(w, obj)
             assert blob == bytes(w), (name, "codegen != generic")
             back = serde.loads(blob)
+            # ...and the generated decoder outcome-identical to the
+            # generic struct-body loop on the same bytes
+            hdr = len(plan.header) - len(serde._varint(len(plan.names)))
+            r = serde._Reader(blob)
+            r.pos = hdr   # skip tag+name; generic body reads nfields
+            gen = serde._decode_struct_body(r, cls, plan)
+            for f in _fields(cls):
+                a, b = getattr(back, f.name), getattr(gen, f.name)
+                assert type(a) is type(b) and (a == b or a != a), \
+                    (name, f.name, a, b)
             # compare field-by-field (some classes define no __eq__ quirks)
             for f in _fields(cls):
                 a, b = getattr(obj, f.name), getattr(back, f.name)
