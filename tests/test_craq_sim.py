@@ -62,3 +62,22 @@ def test_disk_failure_schedules():
     acked writes must survive throughout."""
     assert run_schedules(60, crashes=0, disk_fails=1) == {}
     assert run_schedules(40, crashes=1, disk_fails=1) == {}
+
+
+def test_wide_sweep_regression_seeds():
+    """Seeds the 10k-schedule sweep caught in round 2: abandoned-update
+    DIRTY wedge (fixed by the replica ADVANCE rule), vacuous ack in a
+    zero-membership window (sim fix), dead-disk LASTSRV wedge (chain
+    state-machine fix), authority-loss accounting."""
+    from t3fs.testing.craq_sim import CraqSim
+    for seed, kw in ((100862, dict(crashes=2)),
+                     (101070, dict(crashes=2)),
+                     (101149, dict(crashes=2)),
+                     (300586, dict(crashes=1, mgmtd_restarts=1)),
+                     (400006, dict(crashes=2, disk_fails=1)),
+                     (400014, dict(crashes=2, disk_fails=1)),
+                     (400024, dict(crashes=2, disk_fails=1)),
+                     (400025, dict(crashes=2, disk_fails=1))):
+        sim = CraqSim(seed, **kw)
+        sim.run()
+        assert not sim.violations, (seed, sim.violations)

@@ -475,3 +475,54 @@ def test_universal_tags_config_versions_orphans_session_get():
             GetClientSessionReq(client_id="nope"), b"", None)
         assert not rsp.found
     asyncio.run(body())
+
+
+def test_lastsrv_with_dead_disk_demotes_once_others_serve():
+    """Wide-sweep find (craq_sim seed 400014): a LASTSRV whose disk dies
+    AFTER other members resynced to SERVING must demote to OFFLINE (its
+    copy is no longer unique) — or it can never be disk-replaced and the
+    chain wedges below full strength."""
+    c = chain(S, S)
+    c.targets[1].public_state = LAST
+    c.targets[0].public_state = S
+    # lastsrv's node alive but its disk reports OFFLINE
+    nxt = next_chain_state(c, {1: True, 2: True},
+                           {101: LocalTargetState.OFFLINE})
+    t = next(t for t in nxt.targets if t.target_id == 101)
+    assert t.public_state == OFF
+    # with NO other serving member it must keep LASTSRV (sole authority;
+    # operator rotate-lastsrv is the escape hatch)
+    c2 = chain(LAST)
+    nxt2 = next_chain_state(c2, {1: True},
+                            {100: LocalTargetState.OFFLINE})
+    assert nxt2 is None or nxt2.targets[0].public_state == LAST
+
+
+def test_survivor_exemption_skips_disk_dead_member():
+    """Review-found: when every serving member restarted and one also lost
+    its disk, the exemption must keep the DATA-BEARING one serving."""
+    c = chain(S, S)
+    nxt = next_chain_state(c, {1: True, 2: True},
+                           {100: LocalTargetState.OFFLINE,
+                            101: LocalTargetState.ONLINE},
+                           restarted={100, 101})
+    states = {t.target_id: t.public_state for t in nxt.targets}
+    assert states[101] == S                      # survivor has a disk
+    assert states[100] in (SY, OFF)
+    # converges: the disk-dead one settles OFFLINE next tick
+    nxt2 = next_chain_state(nxt, {1: True, 2: True},
+                            {100: LocalTargetState.OFFLINE,
+                             101: LocalTargetState.ONLINE})
+    assert {t.target_id: t.public_state
+            for t in nxt2.targets}[100] == OFF
+
+
+def test_no_double_lastsrv():
+    """Review-found: the serving head dying while an OLD lastsrv exists
+    must not mint a second LASTSRV — on return both would reseat SERVING
+    with no resync between them (divergence)."""
+    c = ChainInfo(1, 1, [ChainTargetInfo(102, 2, S),
+                         ChainTargetInfo(101, 1, LAST)])
+    nxt = next_chain_state(c, {1: False, 2: False}, {})
+    states = {t.target_id: t.public_state for t in nxt.targets}
+    assert states[102] == LAST and states[101] == OFF
