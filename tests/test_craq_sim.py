@@ -81,3 +81,13 @@ def test_wide_sweep_regression_seeds():
         sim = CraqSim(seed, **kw)
         sim.run()
         assert not sim.violations, (seed, sim.violations)
+
+
+def test_mixed_failure_schedules():
+    """Harshest mix the wide sweeps ran clean: disk failures combined with
+    wipes, mgmtd restarts, and thin 2-replica chains."""
+    assert run_schedules(40, seed0=600000, crashes=2, disk_fails=1,
+                         wipe_on_crash=True) == {}
+    assert run_schedules(40, seed0=900000, crashes=1, disk_fails=1,
+                         mgmtd_restarts=1) == {}
+    assert run_schedules(30, seed0=800000, crashes=2, replicas=2) == {}
