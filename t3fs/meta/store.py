@@ -741,6 +741,19 @@ class MetaStore:
         await self._require_unlocked_dir(txn, sparent, client_id, sname)
         if dparent != sparent:
             await self._require_unlocked_dir(txn, dparent, client_id, dname)
+        if sdent.itype == InodeType.DIRECTORY:
+            # POSIX rename(2) EINVAL: a directory may not move into its own
+            # subtree — the model fuzz review caught this silently
+            # orphaning (and leaking) the whole subtree.  Walk the dest
+            # parent's ancestry; hitting the source means dst is inside it.
+            cur = dparent
+            while cur != ROOT_INODE_ID:
+                if cur == sdent.inode_id:
+                    raise make_error(
+                        StatusCode.INVALID_ARG,
+                        f"cannot move directory {sname!r} into its own "
+                        f"subtree")
+                cur = (await self._require_inode(txn, cur)).parent
         ddent = await self._get_dent(txn, dparent, dname)
         if ddent is not None:
             if ddent.inode_id == sdent.inode_id:
@@ -749,12 +762,19 @@ class MetaStore:
                 # the last link and dangle the new entry
                 return
             if ddent.itype == InodeType.DIRECTORY:
+                if sdent.itype != InodeType.DIRECTORY:
+                    # POSIX rename(2): non-dir over dir is EISDIR (the
+                    # meta model-fuzz caught the store allowing it)
+                    raise make_error(StatusCode.META_IS_DIR, dname)
                 # overwriting a locked (even empty) directory destroys it
                 await self._require_unlocked_dir(txn, ddent.inode_id,
                                                  client_id, dname)
                 pre = DirEntry.prefix(ddent.inode_id)
                 if await txn.get_range(pre, pre + b"\xff", limit=1):
                     raise make_error(StatusCode.META_NOT_EMPTY, dname)
+            elif sdent.itype == InodeType.DIRECTORY:
+                # POSIX: dir over non-dir is ENOTDIR
+                raise make_error(StatusCode.META_NOT_DIR, dname)
             # overwrite: unlink destination
             await self._unlink_entry(txn, ddent)
         txn.clear(DirEntry.key(sparent, sname))

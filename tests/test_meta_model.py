@@ -1,0 +1,237 @@
+"""Model-based differential fuzz of the meta store.
+
+A plain-dict filesystem model (inodes as objects, dirents as dicts) defines
+the intended POSIX-ish semantics; random op sequences run against BOTH the
+model and the real MetaStore (over MemKV) and every outcome — success
+payloads AND error codes — must agree.  Reference analog: the per-op
+tests/meta/store/ops/Test*.cc suite, scaled by randomization the way the
+engine/client differentials are.
+"""
+
+import asyncio
+import random
+
+import pytest
+
+from t3fs.kv.engine import MemKVEngine
+from t3fs.meta.schema import InodeType, ROOT_INODE_ID
+from t3fs.meta.store import ChainAllocator, MetaStore
+from t3fs.utils.status import StatusCode, StatusError
+from tests.test_meta import make_routing
+
+
+class _MNode:
+    __slots__ = ("itype", "children", "target", "nlink")
+
+    def __init__(self, itype, target=""):
+        self.itype = itype
+        self.children = {} if itype == "dir" else None
+        self.target = target
+        self.nlink = 1
+
+
+class FsModel:
+    """Minimal-correct FS semantics for the ops the fuzz drives."""
+
+    def __init__(self):
+        self.root = _MNode("dir")
+
+    def _walk(self, path, parent=False):
+        parts = [p for p in path.split("/") if p]
+        node = self.root
+        upto = parts[:-1] if parent else parts
+        for p in upto:
+            if node.itype != "dir":
+                raise KeyError("notdir")
+            node = node.children.get(p)
+            if node is None:
+                raise KeyError("missing")
+            if node.itype == "sym":
+                raise KeyError("sym")   # fuzz avoids symlink traversal
+        return (node, parts[-1] if parts else "") if parent else node
+
+    def mkdir(self, path):
+        parent, name = self._walk(path, parent=True)
+        if parent.itype != "dir":
+            raise KeyError("notdir")
+        if name in parent.children:
+            raise KeyError("exists")
+        parent.children[name] = _MNode("dir")
+
+    def create(self, path):
+        parent, name = self._walk(path, parent=True)
+        if parent.itype != "dir":
+            raise KeyError("notdir")
+        if name in parent.children:
+            raise KeyError("exists")
+        parent.children[name] = _MNode("file")
+
+    def remove(self, path, recursive=False):
+        parent, name = self._walk(path, parent=True)
+        if parent.itype != "dir":
+            raise KeyError("notdir")
+        node = parent.children.get(name)
+        if node is None:
+            raise KeyError("missing")
+        if node.itype == "dir" and node.children and not recursive:
+            raise KeyError("notempty")
+        del parent.children[name]
+        def unlink_tree(n):
+            n.nlink -= 1
+            if n.itype == "dir":
+                for ch in n.children.values():
+                    unlink_tree(ch)
+                n.children.clear()
+        unlink_tree(node)
+
+    def rename(self, src, dst):
+        sp, sn = self._walk(src, parent=True)
+        if sp.itype != "dir":
+            raise KeyError("notdir")
+        node = sp.children.get(sn)
+        if node is None:
+            raise KeyError("missing")
+        dp, dn = self._walk(dst, parent=True)
+        if dp.itype != "dir":
+            raise KeyError("notdir")
+        if node.itype == "dir":
+            # POSIX EINVAL: a dir cannot move into its own subtree.
+            # Checked BEFORE dst-entry handling, matching the store's
+            # precedence (ancestry walk precedes ddent inspection).
+            def contains(haystack, needle):
+                if haystack is needle:
+                    return True
+                if haystack.itype != "dir":
+                    return False
+                return any(contains(ch, needle)
+                           for ch in haystack.children.values())
+            if contains(node, dp) or node is dp:
+                raise KeyError("intoself")
+        existing = dp.children.get(dn)
+        if existing is not None:
+            if existing is node:
+                return                      # same inode: POSIX no-op
+            if existing.itype == "dir":
+                if node.itype != "dir":
+                    raise KeyError("isdir")     # POSIX EISDIR
+                if existing.children:
+                    raise KeyError("notempty")
+            elif node.itype == "dir":
+                raise KeyError("notdir")        # POSIX ENOTDIR
+            else:
+                existing.nlink -= 1
+        del sp.children[sn]
+        dp.children[dn] = node
+
+    def hardlink(self, existing, new):
+        # store precedence: source exists -> dest parent resolves -> dest
+        # free -> source not a dir (the type check lives in _link_body,
+        # after resolution)
+        node = self._walk(existing)
+        dp, dn = self._walk(new, parent=True)
+        if dp.itype != "dir":
+            raise KeyError("notdir")
+        if dn in dp.children:
+            raise KeyError("exists")
+        if node.itype == "dir":
+            raise KeyError("isdir")
+        dp.children[dn] = node
+        node.nlink += 1
+
+    def stat(self, path):
+        node = self._walk(path)
+        return (node.itype,
+                node.nlink if node.itype == "file" else None)
+
+    def readdir(self, path):
+        node = self._walk(path)
+        if node.itype != "dir":
+            raise KeyError("notdir")
+        return sorted(node.children)
+
+
+_ERRMAP = {
+    "intoself": StatusCode.INVALID_ARG,
+    "missing": StatusCode.META_NOT_FOUND,
+    "exists": StatusCode.META_EXISTS,
+    "notdir": StatusCode.META_NOT_DIR,
+    "notempty": StatusCode.META_NOT_EMPTY,
+    "isdir": StatusCode.META_IS_DIR,
+}
+
+
+def _paths(rng):
+    names = ["a", "b", "c", "d"]
+    depth = rng.randrange(1, 4)
+    return "/" + "/".join(rng.choice(names) for _ in range(depth))
+
+
+@pytest.mark.parametrize("seed", [1, 2, 3, 4, 5, 6])
+def test_meta_store_matches_model(seed):
+    async def body():
+        rng = random.Random(seed)
+        routing = make_routing()
+        store = MetaStore(MemKVEngine(),
+                          ChainAllocator(lambda: routing,
+                                         default_chunk_size=4096))
+        model = FsModel()
+
+        async def drive(op, *args):
+            """Run on both; outcomes (payload or error class) must match."""
+            merr = mres = None
+            try:
+                mres = getattr(model, op)(*args)
+            except KeyError as e:
+                merr = e.args[0]
+            serr = sres = None
+            try:
+                if op == "mkdir":
+                    await store.mkdirs(args[0], recursive=False)
+                elif op == "create":
+                    await store.create(args[0])
+                elif op == "remove":
+                    await store.remove(args[0], recursive=args[1])
+                elif op == "rename":
+                    await store.rename(args[0], args[1])
+                elif op == "hardlink":
+                    await store.hardlink(args[0], args[1])
+                elif op == "stat":
+                    ino = await store.stat(args[0])
+                    kind = ("dir" if ino.itype == InodeType.DIRECTORY
+                            else "file" if ino.itype == InodeType.FILE
+                            else "sym")
+                    # dir nlink is a convention (2 + subdirs), not modeled;
+                    # file nlink is real hardlink accounting — compare it
+                    sres = (kind, ino.nlink if kind == "file" else None)
+                elif op == "readdir":
+                    sres = sorted(e.name for e in
+                                  await store.readdir(args[0]))
+            except StatusError as e:
+                serr = e.code
+            if merr is not None:
+                assert serr is not None, (op, args, "store succeeded, "
+                                          f"model failed {merr}; got {sres}")
+                assert serr == _ERRMAP[merr], (op, args, merr, serr)
+            else:
+                assert serr is None, (op, args, "model succeeded, "
+                                      f"store failed {serr}")
+                if op in ("stat", "readdir"):
+                    assert sres == mres, (op, args, sres, mres)
+
+        for _ in range(120):
+            k = rng.random()
+            if k < 0.2:
+                await drive("mkdir", _paths(rng))
+            elif k < 0.4:
+                await drive("create", _paths(rng))
+            elif k < 0.5:
+                await drive("remove", _paths(rng), rng.random() < 0.5)
+            elif k < 0.62:
+                await drive("rename", _paths(rng), _paths(rng))
+            elif k < 0.72:
+                await drive("hardlink", _paths(rng), _paths(rng))
+            elif k < 0.86:
+                await drive("stat", _paths(rng))
+            else:
+                await drive("readdir", _paths(rng))
+    asyncio.run(body())
