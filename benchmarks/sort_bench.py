@@ -33,7 +33,6 @@ import numpy as np
 from t3fs.client.layout import FileLayout
 from t3fs.client.storage_client import StorageClient
 from t3fs.ops.device_sort import REC_LEN, lexsort_rows
-from t3fs.utils.status import StatusCode
 
 # inode-space convention for the job's files (disjoint from meta's growing
 # ids and from kvcache's (1<<63)|hash space)

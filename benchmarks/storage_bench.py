@@ -20,7 +20,7 @@ import json
 import os
 import time
 
-from t3fs.client.storage_client import StorageClient, StorageClientConfig
+from t3fs.client.storage_client import StorageClientConfig
 from t3fs.storage.types import ChunkId
 from t3fs.utils.metrics import LatencyRecorder
 

@@ -27,7 +27,6 @@ import random
 from dataclasses import dataclass, field
 
 from t3fs.net.conn import Connection
-from t3fs.net.wire import HEADER_SIZE
 from t3fs.utils.status import StatusError
 
 

@@ -7,7 +7,6 @@ replica count / node count (SystemSetupConfig, :86-163).
 
 from __future__ import annotations
 
-import asyncio
 import tempfile
 
 from t3fs.mgmtd.types import (

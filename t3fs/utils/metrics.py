@@ -14,7 +14,6 @@ import math
 import random
 import threading
 import time
-from collections import defaultdict
 from typing import Any, Callable
 
 log = logging.getLogger("t3fs.metrics")

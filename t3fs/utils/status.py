@@ -9,7 +9,7 @@ Status so services convert at the boundary.
 from __future__ import annotations
 
 import enum
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 
 class StatusCode(enum.IntEnum):
