@@ -78,8 +78,17 @@ class StorageTarget:
 
     async def run_update(self, fn, *args):
         """Run a replica/engine mutation on this target's update worker."""
-        return await asyncio.get_running_loop().run_in_executor(
-            self.update_executor, fn, *args)
+        try:
+            return await asyncio.get_running_loop().run_in_executor(
+                self.update_executor, fn, *args)
+        except RuntimeError as e:
+            if "after shutdown" in str(e):
+                # an in-flight RPC raced the node's stop(): answer with a
+                # RETRYABLE code so the client fails over to the reshaped
+                # chain instead of surfacing an opaque INTERNAL error
+                raise make_error(StatusCode.TARGET_OFFLINE,
+                                 "target shutting down") from None
+            raise
 
     def close(self) -> None:
         self.update_executor.shutdown(wait=True)
