@@ -89,6 +89,11 @@ class Connection:
         for fut in self._waiters.values():
             if not fut.done():
                 fut.set_exception(err)
+                # the awaiting call() may itself have been cancelled (loop
+                # teardown): mark the exception retrieved so asyncio doesn't
+                # log "Future exception was never retrieved"; a live awaiter
+                # still receives it normally
+                fut.exception()
         self._waiters.clear()
 
     # frames past this size compress/decompress in a worker thread so a

@@ -121,18 +121,36 @@ class StorageServer:
         log.info("storage node %d up at %s", self.node_id, self.server.address)
 
     async def stop(self) -> None:
-        await self.maintenance.stop()
-        await self.check.stop()
-        await self.resync.stop()
+        # best-effort through EVERY stage: a failure in one (e.g. an mgmtd
+        # goodbye racing a dead conn) must not leave the listener bound or
+        # the engines open — callers rely on stop() releasing the dirs even
+        # when it raises.  First error re-raised after all stages ran.
+        first: Exception | None = None
+
+        async def _stage(coro) -> None:
+            # Exception only: a CancelledError mid-stage must propagate
+            # immediately (it is the caller breaking a hung shutdown)
+            nonlocal first
+            try:
+                await coro
+            except Exception as e:
+                first = first or e
+
+        await _stage(self.maintenance.stop())
+        await _stage(self.check.stop())
+        await _stage(self.resync.stop())
         if self.mgmtd:
-            await self.mgmtd.stop()
-        await self.node.client.close()
-        await self.node.codec.close()
-        await self.server.stop()
+            await _stage(self.mgmtd.stop())
+        await _stage(self.node.client.close())
+        await _stage(self.node.codec.close())
+        await _stage(self.server.stop())
         # only after the RPC server stops: in-flight batch_reads may hold
         # node.aio, and closing the ring under them is a use-after-free
         if self.node.aio is not None:
-            await self.node.aio.close()
+            await _stage(self.node.aio.close())
             self.node.aio = None
         for t in self.node.targets.values():
-            t.close()
+            # close() joins the update worker — never on the event loop
+            await _stage(asyncio.to_thread(t.close))
+        if first is not None:
+            raise first

@@ -115,18 +115,33 @@ class LocalCluster:
     async def start_storage_node(self, node_id: int) -> StorageServer:
         ss = StorageServer(node_id, self.mgmtd_rpc.address,
                            heartbeat_period_s=0.15, resync_period_s=0.1)
-        for c in range(self.num_chains):
-            # every node pre-creates targets for chains it may serve
-            ss.add_target(self.target_id(node_id, c),
-                          f"{self.node_root(node_id)}/t{c}")
-        await ss.start()
+        try:
+            for c in range(self.num_chains):
+                # every node pre-creates targets for chains it may serve
+                ss.add_target(self.target_id(node_id, c),
+                              f"{self.node_root(node_id)}/t{c}")
+            await ss.start()
+        except BaseException:
+            # a partial start (bound listener, open engines) must not leak:
+            # a caller retry would otherwise double-open the chunk dirs
+            try:
+                await ss.stop()
+            except Exception:
+                pass
+            raise
         self.storage[node_id] = ss
         return ss
 
     async def kill_storage_node(self, node_id: int) -> None:
         """Fail-stop: the node vanishes (no clean goodbye)."""
         ss = self.storage.pop(node_id)
-        await ss.stop()
+        try:
+            await ss.stop()
+        except BaseException:
+            # keep tracking a half-stopped server so teardown still stops
+            # it (and its root dirs aren't deleted under a live engine)
+            self.storage[node_id] = ss
+            raise
 
     def chain(self, chain_id: int = 1) -> ChainInfo:
         return self.mgmtd.state.routing().chains[chain_id]
@@ -144,7 +159,12 @@ class LocalCluster:
             await self.mgmtd_client.stop()
         await self.admin.close()
         for node_id in list(self.storage):
-            await self.kill_storage_node(node_id)
+            try:
+                await self.kill_storage_node(node_id)
+            except Exception:
+                # best-effort teardown: a node wedged by an earlier failed
+                # stop must not abort the rest of the cluster's shutdown
+                self.storage.pop(node_id, None)
         if self.mgmtd:
             await self.mgmtd.stop()
         await self.mgmtd_rpc.stop()
