@@ -14,7 +14,8 @@ import uuid as uuidlib
 
 from t3fs.meta.schema import DirEntry, Inode
 from t3fs.meta.service import (
-    BatchStatReq, EntryReq, InodeReq, PathReq, PruneSessionReq, SetAttrReq,
+    BatchStatReq, EntryReq, InodeReq, LockDirReq, PathReq, PruneSessionReq,
+    SetAttrReq,
 )
 from t3fs.net.client import Client
 from t3fs.utils.status import StatusError
@@ -188,6 +189,14 @@ class MetaClient:
     async def lock_directory(self, path: str, unlock: bool = False) -> Inode:
         return (await self._call("lock_directory", PathReq(
             path=path, client_id=self.client_id, unlock=unlock))).inode
+
+    async def lock_directory_inode(self, inode_id: int,
+                                   action: str) -> Inode:
+        """try_lock | preempt_lock | unlock | clear on a directory nodeid
+        (LockDirectory.cc:32-56); owner is this client's identity."""
+        return (await self._call("lock_directory_inode", LockDirReq(
+            inode_id=inode_id, client_id=self.client_id,
+            action=action))).inode
 
     async def batch_stat(self, paths: list[str],
                          follow: bool = True) -> list[Inode | None]:

@@ -23,6 +23,7 @@ import ctypes
 import ctypes.util
 import dataclasses
 import errno
+import json
 import logging
 import os
 import stat as statmod
@@ -41,7 +42,8 @@ log = logging.getLogger("t3fs.fuse.kernel")
 LOOKUP, FORGET, GETATTR, SETATTR, READLINK, SYMLINK = 1, 2, 3, 4, 5, 6
 MKNOD, MKDIR, UNLINK, RMDIR, RENAME, LINK = 8, 9, 10, 11, 12, 13
 OPEN, READ, WRITE, STATFS, RELEASE, FSYNC = 14, 15, 16, 17, 18, 20
-GETXATTR, LISTXATTR, FLUSH, INIT, OPENDIR, READDIR = 22, 23, 25, 26, 27, 28
+SETXATTR, GETXATTR, LISTXATTR, REMOVEXATTR = 21, 22, 23, 24
+FLUSH, INIT, OPENDIR, READDIR = 25, 26, 27, 28
 RELEASEDIR, FSYNCDIR, ACCESS, CREATE, INTERRUPT = 29, 30, 34, 35, 36
 DESTROY, BATCH_FORGET, READDIRPLUS, RENAME2 = 38, 42, 44, 45
 
@@ -55,6 +57,8 @@ _ATTR_OUT_HEAD = struct.Struct("<QII")        # attr_valid nsec dummy
 _OPEN_OUT = struct.Struct("<QII")             # fh open_flags pad
 _WRITE_OUT = struct.Struct("<II")             # size pad
 _STATFS_OUT = struct.Struct("<5Q4I6I")        # kstatfs, 80 bytes
+_GETXATTR_IN = struct.Struct("<II")           # size padding (also _out)
+_SETXATTR_IN = struct.Struct("<II")           # size flags (legacy, no EXT)
 _READ_IN = struct.Struct("<QQIIQII")          # fh off size rflags lock_owner flags pad
 _WRITE_IN = struct.Struct("<QQIIQII")         # fh off size wflags lock_owner flags pad
 _SETATTR_IN = struct.Struct("<II6Q8I")        # valid pad fh size lock atime mtime ctime + 8I
@@ -284,7 +288,7 @@ class FuseKernelMount:
             return virt
         if ucfg.readonly and opcode in (WRITE, CREATE, MKNOD, MKDIR, SYMLINK,
                                         UNLINK, RMDIR, RENAME, RENAME2, LINK,
-                                        SETATTR):
+                                        SETATTR, SETXATTR, REMOVEXATTR):
             raise OSError(errno.EROFS, "readonly mount (user config)")
         if opcode == INIT:
             major, minor, _ra, flags = _INIT_IN.unpack_from(body)
@@ -532,8 +536,8 @@ class FuseKernelMount:
                                     0, 0, 0, 0, 0, 0)
         if opcode == ACCESS:
             return b""                     # permissive (no default_permissions)
-        if opcode in (GETXATTR, LISTXATTR):
-            raise OSError(errno.ENODATA, "no xattrs")
+        if opcode in (SETXATTR, GETXATTR, LISTXATTR, REMOVEXATTR):
+            return await self._handle_xattr(opcode, nodeid, body)
         if opcode == INTERRUPT:
             return None                    # best-effort: ops are short
         if opcode in (FSYNCDIR, DESTROY):
@@ -583,7 +587,84 @@ class FuseKernelMount:
         if opcode in (READDIR, READDIRPLUS, RELEASEDIR, RELEASE, ACCESS,
                       STATFS, FSYNCDIR):
             return NotImplemented          # generic handlers work as-is
+        if opcode in (SETXATTR, GETXATTR, LISTXATTR):
+            raise OSError(errno.ENOTSUP, "virtual tree")   # FuseOps.cc:2390
+        if opcode == REMOVEXATTR:
+            raise OSError(errno.EPERM, "virtual tree")     # FuseOps.cc:2550
         raise OSError(errno.EACCES, "virtual tree is config-only")
+
+    # ---- xattrs: the virtual t3fs.lock name drives directory locks ----
+
+    XATTR_LOCK = b"t3fs.lock"
+    _LOCK_ACTIONS = (b"try_lock", b"preempt_lock", b"unlock", b"clear")
+
+    async def _handle_xattr(self, opcode: int, nodeid: int,
+                            body: bytes) -> bytes:
+        """The reference exposes exactly ONE xattr, ``hf3fs.lock``
+        (FuseOps.cc:2376-2577): setting it to try_lock / preempt_lock /
+        unlock / clear runs the meta LockDirectory action; getting it
+        returns the holder as JSON (ENODATA while unlocked); listxattr
+        advertises the name only while locked; removexattr clears.
+        Other names: ENOTSUP on set, ENODATA on get, EPERM on remove."""
+        if opcode == SETXATTR:
+            size, _flags = _SETXATTR_IN.unpack_from(body)
+            name, _, tail = body[_SETXATTR_IN.size:].partition(b"\0")
+            value = tail[:size]
+            if name != self.XATTR_LOCK:
+                raise OSError(errno.ENOTSUP, "only t3fs.lock is settable")
+            if value not in self._LOCK_ACTIONS:
+                raise OSError(
+                    errno.EINVAL,
+                    "t3fs.lock takes try_lock|preempt_lock|unlock|clear")
+            await self._lock_action(nodeid, value.decode())
+            return b""
+        if opcode == REMOVEXATTR:
+            name = body.split(b"\0", 1)[0]
+            if name != self.XATTR_LOCK:
+                raise OSError(errno.EPERM, "only t3fs.lock is removable")
+            # ENOTDIR (not ENOTSUP) for files, per FuseOps.cc:2559-2562
+            await self._lock_action(nodeid, "clear",
+                                    not_dir_errno=errno.ENOTDIR)
+            return b""
+        size, _pad = _GETXATTR_IN.unpack_from(body)
+        if opcode == GETXATTR:
+            name = body[_GETXATTR_IN.size:].split(b"\0", 1)[0]
+            value = None
+            if name == self.XATTR_LOCK:
+                inode = await self.mc.stat_inode(nodeid)
+                if inode.itype == InodeType.DIRECTORY and inode.dir_lock:
+                    value = json.dumps(
+                        {"client": inode.dir_lock}).encode()
+            if value is None:
+                raise OSError(errno.ENODATA, "")
+            return self._xattr_reply(size, value)
+        # LISTXATTR
+        inode = await self.mc.stat_inode(nodeid)
+        names = b""
+        if inode.itype == InodeType.DIRECTORY and inode.dir_lock:
+            names = self.XATTR_LOCK + b"\0"
+        return self._xattr_reply(size, names)
+
+    async def _lock_action(self, nodeid: int, action: str,
+                           not_dir_errno: int = errno.ENOTSUP) -> None:
+        try:
+            await self.mc.lock_directory_inode(nodeid, action)
+        except StatusError as e:
+            if e.code == StatusCode.META_NOT_DIR:
+                # setxattr on a non-directory replies ENOTSUP
+                # (FuseOps.cc:2406-2409); removexattr replies ENOTDIR
+                raise OSError(not_dir_errno, "not a directory") from None
+            raise
+
+    @staticmethod
+    def _xattr_reply(size: int, data: bytes) -> bytes:
+        """FUSE xattr size protocol: size==0 probes the length
+        (fuse_getxattr_out), short buffers get ERANGE."""
+        if size == 0:
+            return _GETXATTR_IN.pack(len(data), 0)
+        if size < len(data):
+            raise OSError(errno.ERANGE, "")
+        return data
 
     async def _rmrf(self, target: str, uid: int) -> None:
         """`ln -s <path> /t3fs-virt/rm-rf/x`: recursive server-side remove

@@ -46,6 +46,15 @@ class PathReq:
 
 @serde_struct
 @dataclass
+class LockDirReq:
+    """LockDirectory by nodeid (fbs/meta/Service.h LockDirectoryReq)."""
+    inode_id: int = 0
+    client_id: str = ""
+    action: str = "try_lock"  # try_lock | preempt_lock | unlock | clear
+
+
+@serde_struct
+@dataclass
 class InodeReq:
     inode_id: int = 0
     session_id: str = ""
@@ -323,6 +332,13 @@ class MetaService:
         against entry mutations by other clients."""
         return InodeRsp(inode=await self.store.lock_directory(
             req.path, req.client_id, unlock=req.unlock)), b""
+
+    @rpc_method
+    async def lock_directory_inode(self, req: LockDirReq, payload, conn):
+        """LockDirectory by nodeid with the reference's four actions
+        (LockDirectory.cc:32-56) — the FUSE t3fs.lock xattr surface."""
+        return InodeRsp(inode=await self.store.lock_directory_inode(
+            req.inode_id, req.client_id, req.action)), b""
 
     @rpc_method
     async def batch_stat(self, req: BatchStatReq, payload, conn):

@@ -432,18 +432,59 @@ class MetaStore:
                 inode = await self._require_inode(txn, dent.inode_id)
             if inode.itype != InodeType.DIRECTORY:
                 raise make_error(StatusCode.META_NOT_DIR, path)
-            if unlock:
-                if inode.dir_lock and inode.dir_lock != owner:
+            if self._apply_lock_action(inode, owner,
+                                       "unlock" if unlock else "try_lock"):
+                inode.touch()
+                txn.set(Inode.key(inode.inode_id), serde.dumps(inode))
+            return inode
+        return await self._txn(fn)
+
+    @staticmethod
+    def _apply_lock_action(inode: Inode, owner: str, action: str) -> bool:
+        """Shared LockDirectory action semantics
+        (src/meta/store/ops/LockDirectory.cc:32-56): ``try_lock`` fails
+        when held by another owner then locks, ``preempt_lock`` steals
+        unconditionally, ``unlock`` requires the holder then clears,
+        ``clear`` force-clears.  Returns True when the inode changed
+        (caller persists it)."""
+        if action in ("try_lock", "preempt_lock"):
+            if action == "try_lock" and inode.dir_lock \
+                    and inode.dir_lock != owner:
+                raise make_error(StatusCode.META_DIR_LOCKED,
+                                 f"locked by {inode.dir_lock!r}")
+            if inode.dir_lock == owner:
+                return False               # idempotent re-lock: no write
+            inode.dir_lock = owner
+            return True
+        if action in ("unlock", "clear"):
+            if action == "unlock":
+                if not inode.dir_lock:
                     raise make_error(StatusCode.META_DIR_LOCKED,
-                                     f"{path}: locked by {inode.dir_lock!r}")
-                inode.dir_lock = ""
-            else:
-                if inode.dir_lock and inode.dir_lock != owner:
+                                     "not locked")
+                if inode.dir_lock != owner:
                     raise make_error(StatusCode.META_DIR_LOCKED,
-                                     f"{path}: locked by {inode.dir_lock!r}")
-                inode.dir_lock = owner
-            inode.touch()
-            txn.set(Inode.key(inode.inode_id), serde.dumps(inode))
+                                     f"locked by {inode.dir_lock!r}")
+            if not inode.dir_lock:
+                return False               # already clear: no write
+            inode.dir_lock = ""
+            return True
+        raise make_error(StatusCode.INVALID_ARG,
+                         f"bad lock action {action!r}")
+
+    async def lock_directory_inode(self, inode_id: int, owner: str,
+                                   action: str) -> Inode:
+        """LockDirectory actions over a nodeid (the FUSE ``t3fs.lock``
+        xattr surface; src/meta/store/ops/LockDirectory.cc:32-56):
+        ``try_lock`` fails when held by another owner then locks,
+        ``preempt_lock`` steals unconditionally, ``unlock`` requires the
+        holder then clears, ``clear`` force-clears."""
+        async def fn(txn: Transaction):
+            inode = await self._require_inode(txn, inode_id)
+            if inode.itype != InodeType.DIRECTORY:
+                raise make_error(StatusCode.META_NOT_DIR, str(inode_id))
+            if self._apply_lock_action(inode, owner, action):
+                inode.touch()
+                txn.set(Inode.key(inode.inode_id), serde.dumps(inode))
             return inode
         return await self._txn(fn)
 

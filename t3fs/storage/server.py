@@ -124,7 +124,10 @@ class StorageServer:
         # best-effort through EVERY stage: a failure in one (e.g. an mgmtd
         # goodbye racing a dead conn) must not leave the listener bound or
         # the engines open — callers rely on stop() releasing the dirs even
-        # when it raises.  First error re-raised after all stages ran.
+        # when it raises.  First error re-raised at the end.  The one
+        # exception is server.stop() itself failing: handler tasks may
+        # still hold the aio ring/engines, so those are leaked (never
+        # closed under in-flight reads) and the node is treated as wedged.
         first: Exception | None = None
 
         async def _stage(coro) -> None:
@@ -143,7 +146,14 @@ class StorageServer:
             await _stage(self.mgmtd.stop())
         await _stage(self.node.client.close())
         await _stage(self.node.codec.close())
-        await _stage(self.server.stop())
+        try:
+            await self.server.stop()
+        except Exception as e:
+            # handler tasks may still be running with batch_reads holding
+            # node.aio / the engines: closing either under them is a
+            # use-after-free, so leak them rather than crash — the first
+            # error propagates and the caller treats the node as wedged
+            raise first or e
         # only after the RPC server stops: in-flight batch_reads may hold
         # node.aio, and closing the ring under them is a use-after-free
         if self.node.aio is not None:

@@ -286,3 +286,95 @@ def test_mount_hardlink():
             await cluster.stop()
             shutil.rmtree(tmp, ignore_errors=True)
     run(body())
+
+
+def test_mount_xattr_directory_lock():
+    """The t3fs.lock virtual xattr (reference hf3fs.lock,
+    FuseOps.cc:2376-2577): set runs a LockDirectory action, get returns
+    the holder, list advertises it only while locked, remove clears;
+    a lock held by this mount blocks OTHER clients' entry mutations."""
+    import errno
+    import json
+
+    from t3fs.client.meta_client import MetaClient
+    from t3fs.utils.status import StatusCode, StatusError
+
+    async def body():
+        tmp = tempfile.mkdtemp(prefix="t3fs-fuse-")
+        cluster, fuse, mnt = await _mounted(tmp)
+        try:
+            def lock_ops():
+                os.mkdir(f"{mnt}/locked")
+                # unknown names behave like the reference
+                try:
+                    os.setxattr(f"{mnt}/locked", "user.foo", b"x")
+                    raise AssertionError("foreign setxattr accepted")
+                except OSError as e:
+                    assert e.errno == errno.ENOTSUP, e
+                try:
+                    os.getxattr(f"{mnt}/locked", "user.foo")
+                    raise AssertionError("foreign getxattr answered")
+                except OSError as e:
+                    assert e.errno == errno.ENODATA, e
+                assert os.listxattr(f"{mnt}/locked") == []
+                # take the lock; it becomes visible via get/list
+                os.setxattr(f"{mnt}/locked", "t3fs.lock", b"try_lock")
+                assert os.listxattr(f"{mnt}/locked") == ["t3fs.lock"]
+                holder = json.loads(
+                    os.getxattr(f"{mnt}/locked", "t3fs.lock"))
+                assert holder["client"]
+                # the lock owner itself may still create entries
+                open(f"{mnt}/locked/mine.txt", "wb").close()
+                # invalid action value
+                try:
+                    os.setxattr(f"{mnt}/locked", "t3fs.lock", b"bogus")
+                    raise AssertionError("bogus action accepted")
+                except OSError as e:
+                    assert e.errno == errno.EINVAL, e
+                # lock xattr on a file: ENOTSUP (FuseOps.cc:2406-2409)
+                try:
+                    os.setxattr(f"{mnt}/locked/mine.txt", "t3fs.lock",
+                                b"try_lock")
+                    raise AssertionError("file lock accepted")
+                except OSError as e:
+                    assert e.errno == errno.ENOTSUP, e
+                return holder["client"]
+            holder = await asyncio.to_thread(lock_ops)
+            assert holder == cluster.mc.client_id
+
+            # a DIFFERENT meta client: blocked, try_lock refused,
+            # preempt steals
+            other = MetaClient([cluster.meta_rpc.address])
+            locked = await other.stat("/locked")
+            try:
+                await other.create("/locked/theirs.txt")
+                raise AssertionError("foreign create in locked dir")
+            except StatusError as e:
+                assert e.code == StatusCode.META_DIR_LOCKED
+            try:
+                await other.lock_directory_inode(
+                    locked.inode_id, "try_lock")
+                raise AssertionError("try_lock stole a held lock")
+            except StatusError as e:
+                assert e.code == StatusCode.META_DIR_LOCKED
+            await other.lock_directory_inode(locked.inode_id,
+                                             "preempt_lock")
+
+            def after_steal():
+                # the mount (old owner) is now the foreign client
+                try:
+                    open(f"{mnt}/locked/blocked.txt", "wb").close()
+                    raise AssertionError("create under stolen lock")
+                except OSError as e:
+                    assert e.errno == errno.EACCES, e
+                # removexattr == Clear: force-clears ANY holder
+                os.removexattr(f"{mnt}/locked", "t3fs.lock")
+                assert os.listxattr(f"{mnt}/locked") == []
+                open(f"{mnt}/locked/now-ok.txt", "wb").close()
+            await asyncio.to_thread(after_steal)
+            await other.close_conn()
+            await fuse.unmount()
+        finally:
+            await cluster.stop()
+            shutil.rmtree(tmp, ignore_errors=True)
+    run(body())

@@ -578,3 +578,55 @@ def test_hardlink_bumps_ctime_not_mtime(store):
         assert linked2.mtime == before.mtime
         assert linked2.nlink == 3
     run(body())
+
+
+def test_lock_directory_inode_actions():
+    """The four LockDirectory actions (LockDirectory.cc:32-56):
+    try_lock refuses a held lock, preempt_lock steals, unlock needs the
+    holder, clear force-clears; non-dirs and bad actions are rejected."""
+    async def body():
+        from t3fs.kv.engine import MemKVEngine
+        from t3fs.utils.status import StatusCode
+        kv = MemKVEngine()
+        st = _mk_store(kv)
+        d = await st.mkdirs("/d")
+        f, _ = await st.create("/f", session_client="x")
+
+        # try_lock: idempotent for the owner, refused for others
+        assert (await st.lock_directory_inode(
+            d.inode_id, "a", "try_lock")).dir_lock == "a"
+        assert (await st.lock_directory_inode(
+            d.inode_id, "a", "try_lock")).dir_lock == "a"
+        with pytest.raises(StatusError) as ei:
+            await st.lock_directory_inode(d.inode_id, "b", "try_lock")
+        assert ei.value.code == StatusCode.META_DIR_LOCKED
+
+        # preempt_lock steals unconditionally
+        assert (await st.lock_directory_inode(
+            d.inode_id, "b", "preempt_lock")).dir_lock == "b"
+
+        # unlock: wrong owner refused, holder succeeds, empty refused
+        with pytest.raises(StatusError):
+            await st.lock_directory_inode(d.inode_id, "a", "unlock")
+        assert (await st.lock_directory_inode(
+            d.inode_id, "b", "unlock")).dir_lock == ""
+        with pytest.raises(StatusError):
+            await st.lock_directory_inode(d.inode_id, "b", "unlock")
+
+        # clear: force-clears any holder, idempotent on unlocked
+        await st.lock_directory_inode(d.inode_id, "a", "try_lock")
+        assert (await st.lock_directory_inode(
+            d.inode_id, "zzz", "clear")).dir_lock == ""
+        assert (await st.lock_directory_inode(
+            d.inode_id, "zzz", "clear")).dir_lock == ""
+
+        # non-directory / bad action / missing inode
+        with pytest.raises(StatusError) as ei:
+            await st.lock_directory_inode(f.inode_id, "a", "try_lock")
+        assert ei.value.code == StatusCode.META_NOT_DIR
+        with pytest.raises(StatusError) as ei:
+            await st.lock_directory_inode(d.inode_id, "a", "lock")
+        assert ei.value.code == StatusCode.INVALID_ARG
+        with pytest.raises(StatusError):
+            await st.lock_directory_inode(999999, "a", "try_lock")
+    asyncio.run(body())
