@@ -170,10 +170,12 @@ class MetaClient:
             must_dir=-1 if must_dir is None else int(must_dir)))
 
     async def rename_at(self, sparent: int, sname: str, dparent: int,
-                        dname: str) -> None:
+                        dname: str, flags: int = 0) -> None:
+        """flags: renameat2(2) RENAME_NOREPLACE=1 / RENAME_EXCHANGE=2."""
         await self._call("rename_at", EntryReq(
             parent=sparent, name=sname, dparent=dparent, dname=dname,
-            client_id=self.client_id, request_id=self._rid()))
+            client_id=self.client_id, request_id=self._rid(),
+            flags=flags))
 
     async def link_at(self, inode_id: int, parent: int, name: str) -> Inode:
         return (await self._call("link_at", EntryReq(

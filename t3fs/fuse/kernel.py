@@ -86,6 +86,7 @@ _ERRNO = {
     StatusCode.META_TOO_MANY_SYMLINKS: errno.ELOOP,
     StatusCode.META_NO_PERMISSION: errno.EACCES,
     StatusCode.CHUNK_NOT_FOUND: errno.ENOENT,
+    StatusCode.INVALID_ARG: errno.EINVAL,
 }
 
 _DT = {InodeType.FILE: statmod.S_IFREG >> 12,
@@ -453,17 +454,18 @@ class FuseKernelMount:
                     raise OSError(errno.EPERM, "hardlink of a directory")
                 raise
         if opcode in (RENAME, RENAME2):
+            flags = 0
             if opcode == RENAME:
                 newdir = struct.unpack_from("<Q", body)[0]
                 rest = body[8:]
             else:
                 newdir, flags, _ = _RENAME2_IN.unpack_from(body)
-                if flags:                  # RENAME_NOREPLACE/EXCHANGE
-                    raise NotImplementedError
+                if flags not in (0, 1, 2):  # NOREPLACE=1 EXCHANGE=2 only
+                    raise OSError(errno.EINVAL, "unsupported rename flags")
                 rest = body[_RENAME2_IN.size:]
             oldname_b, newname_b = rest.split(b"\0", 2)[:2]
             await self.mc.rename_at(nodeid, oldname_b.decode(),
-                                    newdir, newname_b.decode())
+                                    newdir, newname_b.decode(), flags=flags)
             return b""
         if opcode == READ:
             fh, off, size, *_ = _READ_IN.unpack_from(body)

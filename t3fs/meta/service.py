@@ -103,6 +103,8 @@ class EntryReq:
     request_id: str = ""
     limit: int = 0
     must_dir: int = -1        # unlink_at: -1 any, 0 must be file, 1 must be dir
+    # append-only (serde positional wire compat): new fields go LAST
+    flags: int = 0            # rename: renameat2 NOREPLACE=1 / EXCHANGE=2
 
 
 @serde_struct
@@ -309,7 +311,8 @@ class MetaService:
     async def rename_at(self, req: EntryReq, payload, conn):
         await self.store.rename_at(
             req.parent, req.name, req.dparent, req.dname,
-            client_id=req.client_id, request_id=req.request_id)
+            client_id=req.client_id, request_id=req.request_id,
+            flags=req.flags)
         return InodeRsp(), b""
 
     @rpc_method

@@ -616,13 +616,24 @@ class MetaStore:
 
     async def rename_at(self, sparent: int, sname: str, dparent: int,
                         dname: str, client_id: str = "",
-                        request_id: str = "") -> None:
+                        request_id: str = "", flags: int = 0) -> None:
+        """Entry-level rename; flags use the renameat2(2)/FUSE values
+        (1 = RENAME_NOREPLACE: fail with EEXIST when dst exists;
+        2 = RENAME_EXCHANGE: atomically swap the two entries)."""
+        if flags not in (0, 1, 2):
+            raise make_error(StatusCode.INVALID_ARG,
+                             f"bad rename flags {flags:#x}")
         async def fn(txn: Transaction):
             sdent = await self._get_dent(txn, sparent, sname)
             if sdent is None:
                 raise make_error(StatusCode.META_NOT_FOUND, sname)
-            await self._rename_body(txn, sparent, sname, sdent,
-                                    dparent, dname, client_id)
+            if flags == 2:
+                await self._exchange_body(txn, sparent, sname, sdent,
+                                          dparent, dname, client_id)
+            else:
+                await self._rename_body(txn, sparent, sname, sdent,
+                                        dparent, dname, client_id,
+                                        no_replace=flags == 1)
         result = await self._txn_idem(fn, "rename", client_id, request_id)
         self._emit(Ev.RENAME, parent_id=sparent, entry_name=sname,
                    dst_parent_id=dparent, dst_entry_name=dname,
@@ -778,7 +789,7 @@ class MetaStore:
 
     async def _rename_body(self, txn: Transaction, sparent: int, sname: str,
                            sdent: DirEntry, dparent: int, dname: str,
-                           client_id: str) -> None:
+                           client_id: str, no_replace: bool = False) -> None:
         await self._require_unlocked_dir(txn, sparent, client_id, sname)
         if dparent != sparent:
             await self._require_unlocked_dir(txn, dparent, client_id, dname)
@@ -797,6 +808,10 @@ class MetaStore:
                 cur = (await self._require_inode(txn, cur)).parent
         ddent = await self._get_dent(txn, dparent, dname)
         if ddent is not None:
+            if no_replace:
+                # RENAME_NOREPLACE: any existing dst (even a hardlink
+                # alias of src) is EEXIST, before the same-inode no-op
+                raise make_error(StatusCode.META_EXISTS, dname)
             if ddent.inode_id == sdent.inode_id:
                 # POSIX: src and dst resolve to the same file (same entry or
                 # hardlink alias) -> no-op; unlink-then-relink would destroy
@@ -825,6 +840,42 @@ class MetaStore:
             inode = await self._require_inode(txn, sdent.inode_id)
             inode.parent = dparent
             txn.set(Inode.key(inode.inode_id), serde.dumps(inode))
+
+    async def _exchange_body(self, txn: Transaction, sparent: int,
+                             sname: str, sdent: DirEntry, dparent: int,
+                             dname: str, client_id: str) -> None:
+        """RENAME_EXCHANGE: atomically swap two existing entries (types may
+        differ).  The VFS blocks ancestor/descendant exchanges on a real
+        mount; the same EINVAL is enforced here for direct API callers."""
+        await self._require_unlocked_dir(txn, sparent, client_id, sname)
+        if dparent != sparent:
+            await self._require_unlocked_dir(txn, dparent, client_id, dname)
+        ddent = await self._get_dent(txn, dparent, dname)
+        if ddent is None:
+            raise make_error(StatusCode.META_NOT_FOUND, dname)
+        if ddent.inode_id == sdent.inode_id:
+            return                         # aliases of one inode: no-op
+        for moved, new_parent in ((sdent, dparent), (ddent, sparent)):
+            if moved.itype != InodeType.DIRECTORY:
+                continue
+            cur = new_parent
+            while cur != ROOT_INODE_ID:
+                if cur == moved.inode_id:
+                    raise make_error(
+                        StatusCode.INVALID_ARG,
+                        f"exchange of {sname!r} and {dname!r} would "
+                        f"create a cycle")
+                cur = (await self._require_inode(txn, cur)).parent
+        txn.set(DirEntry.key(sparent, sname), serde.dumps(
+            DirEntry(sparent, sname, ddent.inode_id, ddent.itype)))
+        txn.set(DirEntry.key(dparent, dname), serde.dumps(
+            DirEntry(dparent, dname, sdent.inode_id, sdent.itype)))
+        if sparent != dparent:
+            for dent, new_parent in ((sdent, dparent), (ddent, sparent)):
+                if dent.itype == InodeType.DIRECTORY:
+                    inode = await self._require_inode(txn, dent.inode_id)
+                    inode.parent = new_parent
+                    txn.set(Inode.key(inode.inode_id), serde.dumps(inode))
 
     async def rename(self, src: str, dst: str,
                      client_id: str = "", request_id: str = "") -> None:

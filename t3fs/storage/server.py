@@ -152,8 +152,9 @@ class StorageServer:
             # handler tasks may still be running with batch_reads holding
             # node.aio / the engines: closing either under them is a
             # use-after-free, so leak them rather than crash — the first
-            # error propagates and the caller treats the node as wedged
-            raise first or e
+            # error propagates (chained so the leak's trigger is recorded)
+            # and the caller treats the node as wedged
+            raise (first or e) from e
         # only after the RPC server stops: in-flight batch_reads may hold
         # node.aio, and closing the ring under them is a use-after-free
         if self.node.aio is not None:

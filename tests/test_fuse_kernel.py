@@ -378,3 +378,49 @@ def test_mount_xattr_directory_lock():
             await cluster.stop()
             shutil.rmtree(tmp, ignore_errors=True)
     run(body())
+
+
+def test_mount_renameat2_flags():
+    """renameat2(2) NOREPLACE/EXCHANGE through the kernel RENAME2 op."""
+    import ctypes
+    import ctypes.util
+    import errno
+
+    libc = ctypes.CDLL(ctypes.util.find_library("c"), use_errno=True)
+    AT_FDCWD = -100
+
+    def renameat2(old, new, flags):
+        r = libc.renameat2(AT_FDCWD, old.encode(), AT_FDCWD,
+                           new.encode(), flags)
+        return 0 if r == 0 else ctypes.get_errno()
+
+    async def body():
+        tmp = tempfile.mkdtemp(prefix="t3fs-fuse-")
+        cluster, fuse, mnt = await _mounted(tmp)
+        try:
+            def ops():
+                with open(f"{mnt}/a", "wb") as f:
+                    f.write(b"A")
+                with open(f"{mnt}/b", "wb") as f:
+                    f.write(b"B")
+                # NOREPLACE: occupied dst -> EEXIST, free dst -> ok
+                assert renameat2(f"{mnt}/a", f"{mnt}/b", 1) == errno.EEXIST
+                assert renameat2(f"{mnt}/a", f"{mnt}/c", 1) == 0
+                assert sorted(os.listdir(mnt)) == ["b", "c"]
+                # EXCHANGE: contents swap
+                assert renameat2(f"{mnt}/b", f"{mnt}/c", 2) == 0
+                assert open(f"{mnt}/b", "rb").read() == b"A"
+                assert open(f"{mnt}/c", "rb").read() == b"B"
+                # EXCHANGE with missing dst -> ENOENT
+                assert renameat2(f"{mnt}/b", f"{mnt}/zz", 2) == errno.ENOENT
+                # dir <-> file exchange
+                os.mkdir(f"{mnt}/d")
+                assert renameat2(f"{mnt}/d", f"{mnt}/b", 2) == 0
+                assert os.path.isdir(f"{mnt}/b")
+                assert open(f"{mnt}/d", "rb").read() == b"A"
+            await asyncio.to_thread(ops)
+            await fuse.unmount()
+        finally:
+            await cluster.stop()
+            shutil.rmtree(tmp, ignore_errors=True)
+    run(body())

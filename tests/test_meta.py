@@ -630,3 +630,66 @@ def test_lock_directory_inode_actions():
         with pytest.raises(StatusError):
             await st.lock_directory_inode(999999, "a", "try_lock")
     asyncio.run(body())
+
+
+def test_rename_at_noreplace_and_exchange():
+    """renameat2 flag semantics (rename_at flags: NOREPLACE=1,
+    EXCHANGE=2): NOREPLACE gives EEXIST on any existing dst (even a
+    hardlink alias of src); EXCHANGE atomically swaps entries of any
+    types, updates dir parent pointers, and refuses cycles."""
+    async def body():
+        from t3fs.kv.engine import MemKVEngine
+        from t3fs.utils.status import StatusCode
+        kv = MemKVEngine()
+        st = _mk_store(kv)
+        root = 1
+        a, _ = await st.create("/a", session_client="x")
+        b, _ = await st.create("/b", session_client="x")
+        d1 = await st.mkdirs("/d1")
+        d2 = await st.mkdirs("/d2")
+        sub = await st.mkdirs("/d1/sub")
+
+        # NOREPLACE: free dst works, occupied dst is EEXIST
+        await st.rename_at(root, "a", root, "a2", flags=1)
+        with pytest.raises(StatusError) as ei:
+            await st.rename_at(root, "a2", root, "b", flags=1)
+        assert ei.value.code == StatusCode.META_EXISTS
+        # hardlink alias of src is still EEXIST
+        await st.link_at(a.inode_id, root, "alias")
+        with pytest.raises(StatusError) as ei:
+            await st.rename_at(root, "a2", root, "alias", flags=1)
+        assert ei.value.code == StatusCode.META_EXISTS
+
+        # EXCHANGE file<->file: contents swap places
+        await st.rename_at(root, "a2", root, "b", flags=2)
+        assert (await st.lookup(root, "b")).inode_id == a.inode_id
+        assert (await st.lookup(root, "a2")).inode_id == b.inode_id
+
+        # EXCHANGE dir<->file across parents: parent pointers follow
+        await st.rename_at(root, "b", d2.inode_id, "sub?", flags=1)  # move
+        f_in_d2 = await st.lookup(d2.inode_id, "sub?")
+        await st.rename_at(d1.inode_id, "sub", d2.inode_id, "sub?",
+                           flags=2)
+        moved_dir = await st.lookup(d2.inode_id, "sub?")
+        assert moved_dir.inode_id == sub.inode_id
+        assert moved_dir.parent == d2.inode_id
+        assert (await st.lookup(d1.inode_id, "sub")).inode_id \
+            == f_in_d2.inode_id
+
+        # EXCHANGE with missing dst: ENOENT (plain rename would create)
+        with pytest.raises(StatusError) as ei:
+            await st.rename_at(root, "d1", root, "nope", flags=2)
+        assert ei.value.code == StatusCode.META_NOT_FOUND
+
+        # EXCHANGE that would cycle (dir with entry under itself): EINVAL
+        deep = await st.mkdirs("/d1/x/y")
+        with pytest.raises(StatusError) as ei:
+            await st.rename_at(root, "d1", (await st.stat("/d1/x")).inode_id,
+                               "y", flags=2)
+        assert ei.value.code == StatusCode.INVALID_ARG
+
+        # bad flags
+        with pytest.raises(StatusError) as ei:
+            await st.rename_at(root, "d1", root, "z", flags=3)
+        assert ei.value.code == StatusCode.INVALID_ARG
+    asyncio.run(body())
