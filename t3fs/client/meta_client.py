@@ -107,9 +107,11 @@ class MetaClient:
             path=path, recursive=recursive, client_id=self.client_id,
             request_id=self._rid()))
 
-    async def rename(self, src: str, dst: str) -> None:
-        await self._call("rename", PathReq(
-            path=src, target=dst, client_id=self.client_id,
+    async def rename(self, src: str, dst: str, flags: int = 0) -> None:
+        # flags route to a separate method so an old server can never
+        # mis-run a flagged rename as a plain destructive one
+        await self._call("rename2" if flags else "rename", PathReq(
+            path=src, target=dst, flags=flags, client_id=self.client_id,
             request_id=self._rid()))
 
     async def symlink(self, path: str, target: str) -> Inode:
@@ -171,8 +173,9 @@ class MetaClient:
 
     async def rename_at(self, sparent: int, sname: str, dparent: int,
                         dname: str, flags: int = 0) -> None:
-        """flags: renameat2(2) RENAME_NOREPLACE=1 / RENAME_EXCHANGE=2."""
-        await self._call("rename_at", EntryReq(
+        """flags: renameat2(2) RENAME_NOREPLACE=1 / RENAME_EXCHANGE=2
+        (flagged calls use their own method — see rename)."""
+        await self._call("rename2_at" if flags else "rename_at", EntryReq(
             parent=sparent, name=sname, dparent=dparent, dname=dname,
             client_id=self.client_id, request_id=self._rid(),
             flags=flags))

@@ -42,6 +42,8 @@ class PathReq:
     write: bool = False
     target: str = ""          # symlink target / rename dst / hardlink new path
     unlock: bool = False      # lock_directory
+    # append-only (serde positional wire compat): new fields go LAST
+    flags: int = 0            # rename: renameat2 NOREPLACE=1 / EXCHANGE=2
 
 
 @serde_struct
@@ -221,6 +223,18 @@ class MetaService:
         return InodeRsp(), b""
 
     @rpc_method
+    async def rename2(self, req: PathReq, payload, conn):
+        """Flagged rename lives under its OWN method so a mixed-version
+        cluster fails with RPC_METHOD_NOT_FOUND instead of an old server
+        silently dropping the trailing flags field and running a plain
+        (destructive) rename."""
+        await self.store.rename(req.path, req.target,
+                                client_id=req.client_id,
+                                request_id=req.request_id,
+                                flags=req.flags)
+        return InodeRsp(), b""
+
+    @rpc_method
     async def symlink(self, req: PathReq, payload, conn):
         return InodeRsp(inode=await self.store.symlink(
             req.path, req.target, client_id=req.client_id,
@@ -309,6 +323,15 @@ class MetaService:
 
     @rpc_method
     async def rename_at(self, req: EntryReq, payload, conn):
+        await self.store.rename_at(
+            req.parent, req.name, req.dparent, req.dname,
+            client_id=req.client_id, request_id=req.request_id)
+        return InodeRsp(), b""
+
+    @rpc_method
+    async def rename2_at(self, req: EntryReq, payload, conn):
+        """Entry-level flagged rename; own method name for the same
+        mixed-version reason as rename2."""
         await self.store.rename_at(
             req.parent, req.name, req.dparent, req.dname,
             client_id=req.client_id, request_id=req.request_id,

@@ -620,20 +620,12 @@ class MetaStore:
         """Entry-level rename; flags use the renameat2(2)/FUSE values
         (1 = RENAME_NOREPLACE: fail with EEXIST when dst exists;
         2 = RENAME_EXCHANGE: atomically swap the two entries)."""
-        if flags not in (0, 1, 2):
-            raise make_error(StatusCode.INVALID_ARG,
-                             f"bad rename flags {flags:#x}")
         async def fn(txn: Transaction):
             sdent = await self._get_dent(txn, sparent, sname)
             if sdent is None:
                 raise make_error(StatusCode.META_NOT_FOUND, sname)
-            if flags == 2:
-                await self._exchange_body(txn, sparent, sname, sdent,
-                                          dparent, dname, client_id)
-            else:
-                await self._rename_body(txn, sparent, sname, sdent,
-                                        dparent, dname, client_id,
-                                        no_replace=flags == 1)
+            await self._rename_dispatch(txn, sparent, sname, sdent,
+                                        dparent, dname, client_id, flags)
         result = await self._txn_idem(fn, "rename", client_id, request_id)
         self._emit(Ev.RENAME, parent_id=sparent, entry_name=sname,
                    dst_parent_id=dparent, dst_entry_name=dname,
@@ -787,25 +779,48 @@ class MetaStore:
                    entry_name=name, nlink=inode.nlink, client_id=client_id)
         return inode
 
+    async def _rename_dispatch(self, txn: Transaction, sparent: int,
+                               sname: str, sdent: DirEntry, dparent: int,
+                               dname: str, client_id: str,
+                               flags: int) -> None:
+        """Shared renameat2 flag dispatch for the path- and entry-level
+        ops (one implementation owns the semantics)."""
+        if flags == 2:
+            await self._exchange_body(txn, sparent, sname, sdent,
+                                      dparent, dname, client_id)
+        elif flags in (0, 1):
+            await self._rename_body(txn, sparent, sname, sdent,
+                                    dparent, dname, client_id,
+                                    no_replace=flags == 1)
+        else:
+            raise make_error(StatusCode.INVALID_ARG,
+                             f"bad rename flags {flags:#x}")
+
+    async def _require_no_cycle(self, txn: Transaction, moved: DirEntry,
+                                new_parent: int, what: str) -> None:
+        """POSIX rename(2)/renameat2 EINVAL: a directory may not move (or
+        be exchanged) into its own subtree.  Walk the new parent's
+        ancestry; hitting the moved directory means the destination is
+        inside it."""
+        if moved.itype != InodeType.DIRECTORY:
+            return
+        cur = new_parent
+        while cur != ROOT_INODE_ID:
+            if cur == moved.inode_id:
+                raise make_error(StatusCode.INVALID_ARG, what)
+            cur = (await self._require_inode(txn, cur)).parent
+
     async def _rename_body(self, txn: Transaction, sparent: int, sname: str,
                            sdent: DirEntry, dparent: int, dname: str,
                            client_id: str, no_replace: bool = False) -> None:
         await self._require_unlocked_dir(txn, sparent, client_id, sname)
         if dparent != sparent:
             await self._require_unlocked_dir(txn, dparent, client_id, dname)
-        if sdent.itype == InodeType.DIRECTORY:
-            # POSIX rename(2) EINVAL: a directory may not move into its own
-            # subtree — the model fuzz review caught this silently
-            # orphaning (and leaking) the whole subtree.  Walk the dest
-            # parent's ancestry; hitting the source means dst is inside it.
-            cur = dparent
-            while cur != ROOT_INODE_ID:
-                if cur == sdent.inode_id:
-                    raise make_error(
-                        StatusCode.INVALID_ARG,
-                        f"cannot move directory {sname!r} into its own "
-                        f"subtree")
-                cur = (await self._require_inode(txn, cur)).parent
+        # the model fuzz review caught the missing walk silently orphaning
+        # (and leaking) the whole subtree
+        await self._require_no_cycle(
+            txn, sdent, dparent,
+            f"cannot move directory {sname!r} into its own subtree")
         ddent = await self._get_dent(txn, dparent, dname)
         if ddent is not None:
             if no_replace:
@@ -856,16 +871,10 @@ class MetaStore:
         if ddent.inode_id == sdent.inode_id:
             return                         # aliases of one inode: no-op
         for moved, new_parent in ((sdent, dparent), (ddent, sparent)):
-            if moved.itype != InodeType.DIRECTORY:
-                continue
-            cur = new_parent
-            while cur != ROOT_INODE_ID:
-                if cur == moved.inode_id:
-                    raise make_error(
-                        StatusCode.INVALID_ARG,
-                        f"exchange of {sname!r} and {dname!r} would "
-                        f"create a cycle")
-                cur = (await self._require_inode(txn, cur)).parent
+            await self._require_no_cycle(
+                txn, moved, new_parent,
+                f"exchange of {sname!r} and {dname!r} would create a "
+                f"cycle")
         txn.set(DirEntry.key(sparent, sname), serde.dumps(
             DirEntry(sparent, sname, ddent.inode_id, ddent.itype)))
         txn.set(DirEntry.key(dparent, dname), serde.dumps(
@@ -878,14 +887,17 @@ class MetaStore:
                     txn.set(Inode.key(inode.inode_id), serde.dumps(inode))
 
     async def rename(self, src: str, dst: str,
-                     client_id: str = "", request_id: str = "") -> None:
+                     client_id: str = "", request_id: str = "",
+                     flags: int = 0) -> None:
+        """Path-level rename; flags as in rename_at (renameat2 values:
+        1 = NOREPLACE, 2 = EXCHANGE)."""
         async def fn(txn: Transaction):
             sparent, sname, sdent = await self.resolve(txn, src, follow_last=False)
             if sdent is None:
                 raise make_error(StatusCode.META_NOT_FOUND, src)
             dparent, dname, _ = await self.resolve(txn, dst, follow_last=False)
-            await self._rename_body(txn, sparent, sname, sdent,
-                                    dparent, dname, client_id)
+            await self._rename_dispatch(txn, sparent, sname, sdent,
+                                        dparent, dname, client_id, flags)
         result = await self._txn_idem(fn, "rename", client_id, request_id)
         self._emit(Ev.RENAME, entry_name=src, dst_entry_name=dst,
                    client_id=client_id)

@@ -98,14 +98,7 @@ class FsModel:
             # POSIX EINVAL: a dir cannot move into its own subtree.
             # Checked BEFORE dst-entry handling, matching the store's
             # precedence (ancestry walk precedes ddent inspection).
-            def contains(haystack, needle):
-                if haystack is needle:
-                    return True
-                if haystack.itype != "dir":
-                    return False
-                return any(contains(ch, needle)
-                           for ch in haystack.children.values())
-            if contains(node, dp) or node is dp:
+            if self._contains(node, dp):
                 raise KeyError("intoself")
         existing = dp.children.get(dn)
         if existing is not None:
@@ -122,6 +115,59 @@ class FsModel:
                 existing.nlink -= 1
         del sp.children[sn]
         dp.children[dn] = node
+
+    def rename_nr(self, src, dst):
+        """RENAME_NOREPLACE: like rename, but ANY existing dst (same
+        inode included) is EEXIST — checked after the intoself walk,
+        matching the store's precedence."""
+        sp, sn = self._walk(src, parent=True)
+        if sp.itype != "dir":
+            raise KeyError("notdir")
+        node = sp.children.get(sn)
+        if node is None:
+            raise KeyError("missing")
+        dp, dn = self._walk(dst, parent=True)
+        if dp.itype != "dir":
+            raise KeyError("notdir")
+        if node.itype == "dir":
+            if self._contains(node, dp):
+                raise KeyError("intoself")
+        if dn in dp.children:
+            raise KeyError("exists")
+        del sp.children[sn]
+        dp.children[dn] = node
+
+    def exchange(self, src, dst):
+        """RENAME_EXCHANGE: both entries must exist; same inode is a
+        no-op; swapping a dir with a new parent inside itself is EINVAL
+        (either direction)."""
+        sp, sn = self._walk(src, parent=True)
+        if sp.itype != "dir":
+            raise KeyError("notdir")
+        snode = sp.children.get(sn)
+        if snode is None:
+            raise KeyError("missing")
+        dp, dn = self._walk(dst, parent=True)
+        if dp.itype != "dir":
+            raise KeyError("notdir")
+        dnode = dp.children.get(dn)
+        if dnode is None:
+            raise KeyError("missing")
+        if snode is dnode:
+            return
+        for moved, new_parent in ((snode, dp), (dnode, sp)):
+            if moved.itype == "dir" and self._contains(moved, new_parent):
+                raise KeyError("intoself")
+        sp.children[sn], dp.children[dn] = dnode, snode
+
+    @staticmethod
+    def _contains(haystack, needle):
+        if haystack is needle:
+            return True
+        if haystack.itype != "dir":
+            return False
+        return any(FsModel._contains(ch, needle)
+                   for ch in haystack.children.values())
 
     def hardlink(self, existing, new):
         # store precedence: source exists -> dest parent resolves -> dest
@@ -193,6 +239,10 @@ def test_meta_store_matches_model(seed):
                     await store.remove(args[0], recursive=args[1])
                 elif op == "rename":
                     await store.rename(args[0], args[1])
+                elif op == "rename_nr":
+                    await store.rename(args[0], args[1], flags=1)
+                elif op == "exchange":
+                    await store.rename(args[0], args[1], flags=2)
                 elif op == "hardlink":
                     await store.hardlink(args[0], args[1])
                 elif op == "stat":
@@ -226,8 +276,12 @@ def test_meta_store_matches_model(seed):
                 await drive("create", _paths(rng))
             elif k < 0.5:
                 await drive("remove", _paths(rng), rng.random() < 0.5)
-            elif k < 0.62:
+            elif k < 0.56:
                 await drive("rename", _paths(rng), _paths(rng))
+            elif k < 0.60:
+                await drive("rename_nr", _paths(rng), _paths(rng))
+            elif k < 0.64:
+                await drive("exchange", _paths(rng), _paths(rng))
             elif k < 0.72:
                 await drive("hardlink", _paths(rng), _paths(rng))
             elif k < 0.86:
