@@ -176,6 +176,7 @@ class KvService:
         # req); the commit lock is HELD while anything is prepared
         self._prepared: dict[str, tuple] = {}
         self._resolving: set[str] = set()   # mid-resolution txn ids
+        self._push_tasks: set[asyncio.Task] = set()  # in-flight pushes
         self.prepare_timeout_s = prepare_timeout_s
         self.decision_gc_ttl_s = 3600.0
         self.decision_gc_period_s = 300.0
@@ -194,6 +195,9 @@ class KvService:
         if self._gc_task is not None:
             self._gc_task.cancel()
             self._gc_task = None
+        for t in list(self._push_tasks):
+            t.cancel()
+        self._push_tasks.clear()
 
     async def _gc_loop(self) -> None:
         while True:
@@ -407,6 +411,36 @@ class KvService:
                 return False
         return True
 
+    def _spawn_push(self, preq: "KvPrepareReq", commit: bool) -> None:
+        """Decider-side push notification (ROADMAP item 3): once this
+        shard's verdict is durable, nudge every other participant group
+        with phase 2 immediately instead of leaving laggards that missed
+        the coordinator's phase 2 to poll get_decision on a timer.  The
+        poll path stays as the fallback (a push lost to a partition
+        changes nothing — the timer still fires)."""
+        if self.client is None or not preq.is_decider \
+                or not preq.participants:
+            return
+        task = asyncio.create_task(self._push_decision(preq, commit))
+        self._push_tasks.add(task)
+        task.add_done_callback(self._push_tasks.discard)
+
+    async def _push_decision(self, preq: "KvPrepareReq",
+                             commit: bool) -> None:
+        method = "Kv.commit_prepared" if commit else "Kv.abort_prepared"
+        req = KvFinishReq(txn_id=preq.txn_id)
+        for group in preq.participants:
+            if list(group) == list(preq.decider):
+                continue                   # own group: verdict already local
+            for addr in group:
+                try:
+                    await self.client.call(addr, method, req, timeout=5.0)
+                    break                  # group handled
+                except StatusError as e:
+                    if e.code == StatusCode.KV_TXN_NOT_FOUND:
+                        break              # already resolved there
+                    continue               # follower/unreachable: next addr
+
     async def _resolve_later(self, txn_id: str,
                              initial_delay: float | None = None) -> None:
         await asyncio.sleep(self.prepare_timeout_s
@@ -449,6 +483,7 @@ class KvService:
             self._prepared.pop(txn_id, None)
             self._commit_lock.release()
             log.warning("2pc %s: decider expired -> ABORT tombstone", txn_id)
+            self._spawn_push(req, commit=False)
             return True
         # flag BEFORE the decider RPC: a phase-2 call landing during that
         # await must be refused (KV_TXN_NOT_FOUND), or it would pop+apply
@@ -542,10 +577,15 @@ class KvService:
         finally:
             if req.txn_id not in self._prepared:
                 self._commit_lock.release()
+        self._spawn_push(preq, commit=True)
         return KvCommitRsp(version=self.engine.current_version()), b""
 
     @rpc_method
     async def abort_prepared(self, req: "KvFinishReq", payload, conn):
+        # primaries only: a follower answering OK for a txn it doesn't
+        # hold would make a pusher/coordinator believe the group's
+        # primary was notified
+        self._require_primary()
         if req.txn_id in self._resolving:
             return KvOkRsp(), b""   # resolver owns it now
         entry = self._prepared.pop(req.txn_id, None)

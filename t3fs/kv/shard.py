@@ -256,6 +256,13 @@ class ShardedTransaction:
                     "Kv.commit_prepared", KvFinishReq(txn_id=txn_id),
                     commit_ambiguous=True)
             except StatusError as e:
+                if e.code == StatusCode.KV_TXN_NOT_FOUND:
+                    # the decider's COMMIT record is durable, so a shard
+                    # with no prepare entry has ALREADY applied commit —
+                    # typically via the decider's push racing this loop.
+                    # (Abort is impossible here: resolvers only abort on
+                    # a decider verdict, and the verdict is COMMIT.)
+                    continue
                 failures.append((s, e))
         if failures:
             raise make_error(

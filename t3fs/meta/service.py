@@ -217,6 +217,12 @@ class MetaService:
 
     @rpc_method
     async def rename(self, req: PathReq, payload, conn):
+        if req.flags:
+            # a flagged request must NEVER run as a plain destructive
+            # rename — clients route flags to rename2, so this is a
+            # misrouted/mixed-version call: refuse it
+            raise make_error(StatusCode.INVALID_ARG,
+                             "flagged rename must use rename2")
         await self.store.rename(req.path, req.target,
                                 client_id=req.client_id,
                                 request_id=req.request_id)
@@ -323,6 +329,9 @@ class MetaService:
 
     @rpc_method
     async def rename_at(self, req: EntryReq, payload, conn):
+        if req.flags:
+            raise make_error(StatusCode.INVALID_ARG,
+                             "flagged rename must use rename2_at")
         await self.store.rename_at(
             req.parent, req.name, req.dparent, req.dname,
             client_id=req.client_id, request_id=req.request_id)

@@ -580,3 +580,83 @@ def test_decision_record_gc():
         rsp, _ = await svc.get_decision(KvDecisionReq(txn_id="new"), b"", None)
         assert rsp.decision == "C"
     run(body())
+
+
+def test_durable_2pc_push_resolution_beats_poll():
+    """Decider-side push (ROADMAP item 3): when the coordinator dies
+    after phase 2 reached only the decider, the decider PUSHES its
+    verdict to the other participants immediately.  Poll timers are set
+    far too long to matter, so fast convergence proves the push path —
+    for both the COMMIT verdict and the expiry-ABORT verdict."""
+    async def body():
+        from t3fs.kv.service import KvFinishReq, KvPrepareReq, KvCommitReq
+        import time as _t
+
+        # --- COMMIT push: poll timer 60s, must converge in ~2s ---
+        kv, services, cleanup = await _mk_sharded(b"m",
+                                                  prepare_timeout_s=60.0)
+        try:
+            dec_addrs = kv.map.ranges[0].addresses
+            parts = [list(kv.map.ranges[0].addresses),
+                     list(kv.map.ranges[1].addresses)]
+            mk = lambda k, v: KvCommitReq(write_keys=[k], write_values=[v],
+                                          write_deletes=[False])
+            await kv.groups[0]._call("Kv.prepare", KvPrepareReq(
+                txn_id="t-push", body=mk(b"a", b"1"), decider=dec_addrs,
+                is_decider=True, participants=parts))
+            await kv.groups[1]._call("Kv.prepare", KvPrepareReq(
+                txn_id="t-push", body=mk(b"z", b"2"), decider=dec_addrs,
+                is_decider=False, participants=parts))
+            await kv.groups[0]._call("Kv.commit_prepared",
+                                     KvFinishReq(txn_id="t-push"))
+            t0 = _t.perf_counter()
+            while True:
+                t = kv.transaction()
+                a, z = await t.get(b"a"), await t.get(b"z")
+                if a == b"1" and z == b"2":
+                    break
+                assert _t.perf_counter() - t0 < 5.0, \
+                    f"push did not converge ({a!r} {z!r}); poll is 60s"
+                await asyncio.sleep(0.05)
+        finally:
+            await cleanup()
+
+        # --- ABORT push: decider expires fast, laggard polls slow ---
+        from t3fs.kv.engine import MemKVEngine
+        from t3fs.kv.service import KvService
+        from t3fs.net.client import Client
+        from t3fs.net.server import Server
+        ship = Client()
+        dec_svc = KvService(MemKVEngine(), client=ship,
+                            prepare_timeout_s=0.3)
+        lag_svc = KvService(MemKVEngine(), client=ship,
+                            prepare_timeout_s=60.0)
+        srv_d, srv_l = Server(), Server()
+        srv_d.add_service(dec_svc); srv_l.add_service(lag_svc)
+        await srv_d.start(); await srv_l.start()
+        try:
+            parts = [[srv_d.address], [srv_l.address]]
+            mk = lambda k, v: KvCommitReq(write_keys=[k], write_values=[v],
+                                          write_deletes=[False])
+            await ship.call(srv_d.address, "Kv.prepare", KvPrepareReq(
+                txn_id="t-ab", body=mk(b"a", b"1"),
+                decider=[srv_d.address], is_decider=True,
+                participants=parts))
+            await ship.call(srv_l.address, "Kv.prepare", KvPrepareReq(
+                txn_id="t-ab", body=mk(b"z", b"2"),
+                decider=[srv_d.address], is_decider=False,
+                participants=parts))
+            # coordinator vanishes entirely; decider expires -> ABORT,
+            # pushes abort_prepared -> laggard frees its lock quickly
+            t0 = _t.perf_counter()
+            while lag_svc._prepared:
+                assert _t.perf_counter() - t0 < 5.0, \
+                    "abort push did not release the laggard; poll is 60s"
+                await asyncio.sleep(0.05)
+            ver = lag_svc.engine.current_version()
+            assert lag_svc.engine.read_at(b"z", ver) is None
+        finally:
+            await srv_d.stop(); await srv_l.stop()
+            await ship.close()
+            dec_svc.stop_decision_gc(); lag_svc.stop_decision_gc()
+    run(body())
