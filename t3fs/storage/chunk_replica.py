@@ -178,8 +178,20 @@ class ChunkReplica:
         """Flip DIRTY->COMMIT for update_ver (idempotent)."""
         meta = self.engine.get_meta(chunk_id)
         if meta is None:
-            # chunk was removed by a later update in the channel; treat as done
-            return IOResult(WireStatus(), 0, update_ver, update_ver, chain_ver, 0)
+            # REMOVE ops never reach here (the service skips engine commit
+            # for them, service.py:376; the reference threads is_remove to
+            # the same effect, chunk_engine/src/core/engine.rs:376), and
+            # the head's per-chunk lock means no later op can have deleted
+            # the chunk mid-update — so a missing chunk at commit means
+            # THIS REPLICA LOST THE APPLIED DATA (crash between apply and
+            # commit that wiped state).  Acking would erase an acked
+            # write with zero physical copies; fail so the head retries
+            # the whole write (CHUNK_NOT_FOUND is retryable).  Found by a
+            # craq_sim sweep: crash-wipe of the only serving replica
+            # between apply and commit, seed 903689.
+            raise make_error(StatusCode.CHUNK_NOT_FOUND,
+                             f"{chunk_id}: commit v{update_ver} but the "
+                             f"chunk is gone (data lost before commit)")
         if meta.commit_ver >= update_ver:
             if meta.state == ChunkState.DIRTY \
                     and meta.update_ver <= meta.commit_ver:

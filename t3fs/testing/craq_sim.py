@@ -327,6 +327,11 @@ class CraqSim:
                 op.steps.pop(0)
             elif e.code == StatusCode.CHUNK_MISSING_UPDATE:
                 self._retry(op)
+            elif e.code == StatusCode.CHUNK_NOT_FOUND and phase == "commit":
+                # replica lost the applied chunk before commit (crash
+                # wipe): the client retries the whole write, re-applying
+                # the data — never ack over zero copies
+                self._retry(op)
             else:
                 self.violations.append(
                     f"unexpected status in {phase}@t{target_id} "
