@@ -79,16 +79,27 @@ class RingWorker:
         concurrently.  Gathering stops when the per-sqe inflight budget
         is spent — backpressure instead of unbounded fan-out."""
         while True:
-            sqe = await self._queue.get()
-            await self._sem.acquire()
-            wave = [sqe]
-            while len(wave) < MAX_INFLIGHT and not self._sem.locked():
-                try:
-                    nxt = self._queue.get_nowait()
-                except asyncio.QueueEmpty:
-                    break
+            wave: list[CSqe] = []
+            try:
+                sqe = await self._queue.get()
+                wave.append(sqe)
                 await self._sem.acquire()
-                wave.append(nxt)
+                while len(wave) < MAX_INFLIGHT and not self._sem.locked():
+                    try:
+                        nxt = self._queue.get_nowait()
+                    except asyncio.QueueEmpty:
+                        break
+                    wave.append(nxt)
+                    await self._sem.acquire()
+            except asyncio.CancelledError:
+                # stop() cancelled us mid-gather: sqes already popped into
+                # `wave` are no longer in the queue, so stop()'s queue
+                # drain can't see them — error-complete here or the user
+                # blocked on those cqes hangs at unmount
+                for s in wave:
+                    self.ring.complete(s.userdata, -1,
+                                       int(StatusCode.CANCELLED))
+                raise
             reads = [s for s in wave if s.op == OP_READ]
             writes = [s for s in wave if s.op != OP_READ]
             # fire the wave without awaiting it: the next wave may start
@@ -118,6 +129,12 @@ class RingWorker:
         except StatusError as e:
             for s in group[done:]:
                 self._complete(s, -1, e.code)
+        except asyncio.CancelledError:
+            # stop() is tearing us down mid-RPC: the user still needs a
+            # cqe for every sqe or unmount hangs on the missing ones
+            for s in group[done:]:
+                self._complete(s, -1, int(StatusCode.CANCELLED))
+            raise
         except Exception:
             for s in group[done:]:
                 self._complete(s, -1, int(StatusCode.INTERNAL))
@@ -128,6 +145,9 @@ class RingWorker:
             self._complete(sqe, n, 0)
         except StatusError as e:
             self._complete(sqe, -1, e.code)
+        except asyncio.CancelledError:
+            self._complete(sqe, -1, int(StatusCode.CANCELLED))
+            raise
         except Exception:
             self._complete(sqe, -1, int(StatusCode.INTERNAL))
 
@@ -157,6 +177,12 @@ class RingWorker:
                 None, self._thread.join)
         if self._drainer is not None:
             self._drainer.cancel()
+            try:
+                # run its CancelledError handler (which error-completes
+                # any half-gathered wave) BEFORE the ring closes below
+                await self._drainer
+            except (asyncio.CancelledError, Exception):
+                pass
         # sqes already popped from the shm ring but still queued would
         # otherwise vanish without a cqe — error-complete them
         if self._queue is not None:
@@ -167,7 +193,13 @@ class RingWorker:
                     break
                 self.ring.complete(sqe.userdata, -1,
                                    int(StatusCode.CANCELLED))
-        for t in list(self._tasks):
+        # dispatched-but-unfinished sqes: cancel their tasks and WAIT for
+        # the CancelledError handlers to push their cqes before the ring
+        # goes away (cancel alone schedules, it doesn't run them)
+        pending = [t for t in list(self._tasks) if not t.done()]
+        for t in pending:
             t.cancel()
+        if pending:
+            await asyncio.gather(*pending, return_exceptions=True)
         self.ring.close()
         self.iov.close(unlink=False)

@@ -26,6 +26,7 @@ from dataclasses import dataclass, field
 
 from t3fs.kv.engine import KVEngine, Transaction
 from t3fs.net.server import rpc_method, service
+from t3fs.utils.lock_manager import ExpiringMap
 from t3fs.utils import serde
 from t3fs.utils.serde import serde_struct
 from t3fs.utils.status import StatusCode, StatusError, make_error
@@ -176,6 +177,16 @@ class KvService:
         # req); the commit lock is HELD while anything is prepared
         self._prepared: dict[str, tuple] = {}
         self._resolving: set[str] = set()   # mid-resolution txn ids
+        # txn_id -> final verdict ("C"/"A") for txns recently finished on
+        # this shard.  Closes two races around late/duplicate prepares:
+        # an abort_prepared that beats its prepare to the shard (the late
+        # prepare would otherwise register and hold the shard-wide commit
+        # lock until expiry), and a duplicate prepare landing after phase 2
+        # completed (it would re-register and later RE-APPLY the slice on
+        # the decider's durable "C" — a lost update for interleaved
+        # writers).  TTL covers the realistic duplicate-delivery window.
+        self._resolved_tombstones: ExpiringMap = ExpiringMap(
+            ttl_s=2 * prepare_timeout_s + 60.0, capacity=8192)
         self._push_tasks: set[asyncio.Task] = set()  # in-flight pushes
         self.prepare_timeout_s = prepare_timeout_s
         self.decision_gc_ttl_s = 3600.0
@@ -310,9 +321,19 @@ class KvService:
         self._require_primary()
         if not req.txn_id:
             raise make_error(StatusCode.INVALID_ARG, "empty txn_id")
+        if self._refuse_stale_prepare(req.txn_id):
+            return KvOkRsp(seq=self.seq), b""
         txn = self._txn_from_req(req.body)
         await self._commit_lock.acquire()
         try:
+            # re-check under the lock: phase 2 / an abort may have raced
+            # this prepare while it sat queued on the lock — registering
+            # now would stall the shard until expiry (abort case) or
+            # re-apply an already-committed slice via the resolver
+            # (commit case)
+            if self._refuse_stale_prepare(req.txn_id):
+                self._commit_lock.release()
+                return KvOkRsp(seq=self.seq), b""
             self.engine.check_conflicts(txn)
             rec = Transaction(self.engine,
                               read_version=self.engine.current_version())
@@ -325,6 +346,21 @@ class KvService:
         timer = asyncio.create_task(self._resolve_later(req.txn_id))
         self._prepared[req.txn_id] = (txn, timer, req)
         return KvOkRsp(seq=self.seq), b""
+
+    def _refuse_stale_prepare(self, txn_id: str) -> bool:
+        """Duplicate/late-prepare gate (checked both outside AND under the
+        commit lock).  True = ack idempotently without registering: the
+        txn is live here (original prepare's record + lock hold stand) or
+        already committed (a coordinator retry proceeding to phase 2 gets
+        KV_TXN_NOT_FOUND and converges via the decider).  Raises for a
+        txn this shard already aborted — presumed-abort's answer."""
+        if txn_id in self._prepared or txn_id in self._resolving:
+            return True
+        verdict = self._resolved_tombstones.get(txn_id)
+        if verdict == b"A":
+            raise make_error(StatusCode.KV_TXN_NOT_FOUND,
+                             f"{txn_id} already aborted")
+        return verdict == b"C"
 
     def _finish_txn(self, txn: Transaction, req: KvPrepareReq,
                     decision: bytes | None) -> Transaction:
@@ -466,6 +502,11 @@ class KvService:
         entry = self._prepared.get(txn_id)
         if entry is None:
             return True
+        if txn_id in self._resolving:
+            # another resolver (duplicate timer) is mid-apply; let it
+            # finish — proceeding here would double-apply the slice and
+            # double-release the commit lock
+            return False
         txn, _timer, req = entry
         if req.is_decider:
             # no decision record can exist (commit_prepared would have
@@ -478,6 +519,7 @@ class KvService:
                     read_version=self.engine.current_version())
                 self._finish_txn(drop, req, b"A")
                 await self._replicate_and_apply(drop)
+                self._resolved_tombstones.set(txn_id, b"A")
             finally:
                 self._resolving.discard(txn_id)
             self._prepared.pop(txn_id, None)
@@ -504,6 +546,7 @@ class KvService:
                 txn._read_ranges.clear()
                 self._finish_txn(txn, req, None)
                 await self._replicate_and_apply(txn)
+                self._resolved_tombstones.set(txn_id, b"C")
                 log.warning("2pc %s: decider says COMMITTED -> applied",
                             txn_id)
             else:                           # "A" or no trace: abort
@@ -512,6 +555,7 @@ class KvService:
                     read_version=self.engine.current_version())
                 self._finish_txn(drop, req, None)
                 await self._replicate_and_apply(drop)
+                self._resolved_tombstones.set(txn_id, b"A")
                 log.warning("2pc %s: resolved as aborted (%s)", txn_id,
                             decision)
         finally:
@@ -522,6 +566,14 @@ class KvService:
         return True
 
     async def _ask_decider(self, req: KvPrepareReq) -> str:
+        """Resolve via the decider group.  Durable verdicts ("C"/"A") and
+        pending ("P") are trusted from any group member — a follower can
+        hold a replicated decision/PREP record but cannot fabricate one.
+        "U" (no trace = presumed abort) is trusted ONLY from the group's
+        primary: a stale/re-seeded follower answers "U" for a txn whose
+        decider durably COMMITTED, and acting on that tears the txn.  A
+        non-authoritative "U" means "keep polling" (same rule
+        _all_resolved applies on the GC side)."""
         if self.client is None or not req.decider:
             return "U"                      # no path to the decider: abort
         timeout = min(5.0, max(0.5, self.prepare_timeout_s))
@@ -530,7 +582,10 @@ class KvService:
                 rsp, _ = await self.client.call(
                     addr, "Kv.get_decision",
                     KvDecisionReq(txn_id=req.txn_id), timeout=timeout)
-                return rsp.decision
+                if rsp.decision != "U" or getattr(
+                        rsp, "authoritative", False):
+                    return rsp.decision
+                # non-authoritative "U": inconclusive, try the next member
             except StatusError:
                 continue
         return "P"                          # unreachable: keep waiting
@@ -568,6 +623,9 @@ class KvService:
         self._finish_txn(txn, preq, b"C")
         try:
             await self._replicate_and_apply(txn)
+            # set BEFORE the lock releases below so a duplicate prepare
+            # queued on the lock sees the verdict in its under-lock check
+            self._resolved_tombstones.set(req.txn_id, b"C")
         except BaseException:
             # the slice did NOT apply; put the entry back so resolution
             # (or a coordinator retry) can still finish it
@@ -589,6 +647,15 @@ class KvService:
         if req.txn_id in self._resolving:
             return KvOkRsp(), b""   # resolver owns it now
         entry = self._prepared.pop(req.txn_id, None)
+        if entry is None:
+            # the prepare may still be queued on the commit lock (or in
+            # flight); tombstone the id so it is refused on arrival
+            # instead of holding the shard's commit lock until expiry.
+            # Never downgrade a COMMIT verdict: a stray abort push racing
+            # a completed commit must not make later prepare retries
+            # report "already aborted" for a txn this shard committed.
+            if self._resolved_tombstones.get(req.txn_id) != b"C":
+                self._resolved_tombstones.set(req.txn_id, b"A")
         if entry is not None:
             txn, timer, preq = entry
             timer.cancel()
@@ -597,6 +664,7 @@ class KvService:
             self._finish_txn(drop, preq, None)
             try:
                 await self._replicate_and_apply(drop)
+                self._resolved_tombstones.set(req.txn_id, b"A")
             except BaseException:
                 # the PREP record still exists: re-arm so a resolver
                 # retires it (mirrors commit_prepared), or every other

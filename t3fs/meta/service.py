@@ -150,6 +150,17 @@ class MetaService:
 
     # each handler returns (rsp, b"")
 
+    @staticmethod
+    def _bind_conn(conn, client_id: str) -> None:
+        """First-use identity binding: remember the first client_id a
+        connection presents so ops acting on OTHER clients' state
+        (prune_session) can refuse cross-client requests.  Not full
+        authentication — it stops accidental/connection-reuse eviction,
+        the hazard the reference's authenticated UserInfo prevents."""
+        if conn is not None and client_id \
+                and getattr(conn, "client_id", None) is None:
+            conn.client_id = client_id
+
     @rpc_method
     async def stat(self, req: PathReq, payload, conn):
         return InodeRsp(inode=await self.store.stat(req.path, req.follow)), b""
@@ -162,6 +173,7 @@ class MetaService:
     async def create(self, req: PathReq, payload, conn):
         # a write session only when the create is an open-for-write
         # (O_CREAT|O_WRONLY); a bare create (mknod-style) must not pin GC
+        self._bind_conn(conn, req.client_id)
         inode, session = await self.store.create(
             req.path, req.perm, req.chunk_size, req.stripe, req.client_id,
             request_id=req.request_id, want_session=req.write)
@@ -169,6 +181,7 @@ class MetaService:
 
     @rpc_method
     async def open(self, req: PathReq, payload, conn):
+        self._bind_conn(conn, req.client_id)
         inode, session = await self.store.open_file(
             req.path, req.write, req.client_id)
         return InodeRsp(inode=inode, session_id=session), b""
@@ -301,6 +314,7 @@ class MetaService:
 
     @rpc_method
     async def create_at(self, req: EntryReq, payload, conn):
+        self._bind_conn(conn, req.client_id)
         inode, session = await self.store.create_at(
             req.parent, req.name, req.perm, req.chunk_size, req.stripe,
             req.client_id, request_id=req.request_id,
@@ -357,6 +371,7 @@ class MetaService:
 
     @rpc_method
     async def open_inode(self, req: EntryReq, payload, conn):
+        self._bind_conn(conn, req.client_id)
         inode, session = await self.store.open_inode(
             req.inode_id, req.write, req.client_id)
         return InodeRsp(inode=inode, session_id=session), b""
@@ -418,9 +433,22 @@ class MetaService:
         PruneSession, fbs/meta/Service.h:734): an unmounting FUSE daemon
         releases sessions eagerly instead of waiting for the dead-client
         reaper.  `session_ids` limits the prune; otherwise every session of
-        `client_id` goes.  Lengths reconcile like any reaped writer's."""
+        `client_id` goes.  Lengths reconcile like any reaped writer's.
+
+        The prunable set derives from the CONNECTION's bound client id, not
+        the request field alone: a connection is bound to the first
+        client_id it presents (any session-creating op binds it), so one
+        client cannot evict another live client's sessions by naming it."""
         if not req.client_id:
             raise make_error(StatusCode.INVALID_ARG, "client_id required")
+        bound = getattr(conn, "client_id", None) if conn is not None else None
+        if bound is not None and bound != req.client_id:
+            raise make_error(
+                StatusCode.META_NO_PERMISSION,
+                f"connection bound to client {bound!r} cannot prune "
+                f"sessions of {req.client_id!r}")
+        if conn is not None and bound is None:
+            conn.client_id = req.client_id
         sessions = await self.store.scan_sessions()
         mine = [s for s in sessions if s.client_id == req.client_id
                 and (not req.session_ids or s.session_id in req.session_ids)]

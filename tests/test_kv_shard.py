@@ -355,6 +355,135 @@ def test_durable_2pc_shard_restart_recovers_prepared():
     run(body())
 
 
+def test_2pc_stale_follower_u_does_not_tear_committed_txn():
+    """ADVICE r2 (high): a stale/re-seeded decider FOLLOWER answering
+    decision='U' (authoritative=False) must NOT make a participant
+    presume abort when the decider's PRIMARY durably COMMITTED.  The
+    resolver must skip non-authoritative 'U' and keep asking."""
+    async def body():
+        from t3fs.kv.service import (
+            KvFinishReq, KvPrepareReq, KvCommitReq, KvService,
+        )
+        ship = Client()
+        # decider primary: will durably COMMIT the txn
+        dec_svc = KvService(MemKVEngine(), client=ship,
+                            prepare_timeout_s=600.0)
+        dec_srv = Server(); dec_srv.add_service(dec_svc)
+        await dec_srv.start()
+        # stale follower of the decider group: restarted EMPTY (no DEC /
+        # PREP records), answers 'U' non-authoritatively
+        stale_svc = KvService(MemKVEngine(), primary=False, client=ship)
+        stale_srv = Server(); stale_srv.add_service(stale_svc)
+        await stale_srv.start()
+        # participant shard with a short expiry so its resolver runs
+        part_eng = MemKVEngine()
+        part_svc = KvService(part_eng, client=ship, prepare_timeout_s=0.3)
+        part_srv = Server(); part_srv.add_service(part_svc)
+        await part_srv.start()
+        try:
+            mk = lambda k, v: KvCommitReq(write_keys=[k], write_values=[v],
+                                          write_deletes=[False])
+            # the STALE follower is listed FIRST: pre-fix, its 'U' was
+            # taken at face value and the participant tore the txn
+            dec = [stale_srv.address, dec_srv.address]
+            await ship.call(dec_srv.address, "Kv.prepare", KvPrepareReq(
+                txn_id="t-stale", body=mk(b"a", b"1"),
+                decider=dec, is_decider=True))
+            await ship.call(part_srv.address, "Kv.prepare", KvPrepareReq(
+                txn_id="t-stale", body=mk(b"z", b"2"),
+                decider=dec, is_decider=False))
+            # decider COMMITS durably; coordinator "dies" before phase 2
+            # reaches the participant (we just don't send it)
+            await ship.call(dec_srv.address, "Kv.commit_prepared",
+                            KvFinishReq(txn_id="t-stale"))
+            # participant's expiry resolver must land on COMMIT
+            for _ in range(100):
+                if part_eng.read_at(b"z",
+                                    part_eng.current_version()) == b"2":
+                    break
+                await asyncio.sleep(0.1)
+            else:
+                raise AssertionError(
+                    "participant tore a decider-committed txn "
+                    "(or never resolved)")
+        finally:
+            for s in (dec_srv, stale_srv, part_srv):
+                await s.stop()
+            await ship.close()
+    run(body())
+
+
+def test_2pc_late_prepare_after_abort_tombstoned():
+    """ADVICE r2 (medium): a prepare landing AFTER abort_prepared already
+    answered OK (no entry yet) must be refused immediately instead of
+    registering and holding the shard-wide commit lock until expiry."""
+    async def body():
+        from t3fs.kv.service import (
+            KvCommitReq, KvFinishReq, KvPrepareReq, KvService,
+        )
+        ship = Client()
+        svc = KvService(MemKVEngine(), client=ship,
+                        prepare_timeout_s=600.0)
+        srv = Server(); srv.add_service(svc)
+        await srv.start()
+        try:
+            mk = lambda k, v: KvCommitReq(write_keys=[k], write_values=[v],
+                                          write_deletes=[False])
+            # coordinator timed out and aborted BEFORE the prepare landed
+            await ship.call(srv.address, "Kv.abort_prepared",
+                            KvFinishReq(txn_id="t-late"))
+            with pytest.raises(StatusError) as ei:
+                await ship.call(srv.address, "Kv.prepare", KvPrepareReq(
+                    txn_id="t-late", body=mk(b"a", b"1"),
+                    decider=[srv.address], is_decider=False))
+            assert ei.value.code == StatusCode.KV_TXN_NOT_FOUND
+            # the shard's commit lock is FREE: an unrelated commit
+            # completes promptly (pre-fix: stalled prepare_timeout_s)
+            await asyncio.wait_for(
+                ship.call(srv.address, "Kv.commit", mk(b"k", b"v")),
+                timeout=2.0)
+        finally:
+            await srv.stop()
+            await ship.close()
+    run(body())
+
+
+def test_2pc_duplicate_prepare_idempotent():
+    """ADVICE r2 (low): duplicate delivery of a prepare must ack
+    idempotently — not re-register (leaking the first timer) nor
+    deadlock on the commit lock the first prepare holds."""
+    async def body():
+        from t3fs.kv.service import (
+            KvCommitReq, KvFinishReq, KvPrepareReq, KvService,
+        )
+        ship = Client()
+        eng = MemKVEngine()
+        svc = KvService(eng, client=ship, prepare_timeout_s=600.0)
+        srv = Server(); srv.add_service(svc)
+        await srv.start()
+        try:
+            mk = lambda k, v: KvCommitReq(write_keys=[k], write_values=[v],
+                                          write_deletes=[False])
+            preq = KvPrepareReq(txn_id="t-dup", body=mk(b"a", b"1"),
+                                decider=[srv.address], is_decider=True)
+            await ship.call(srv.address, "Kv.prepare", preq)
+            # duplicate: must return (not deadlock) and keep ONE entry
+            await asyncio.wait_for(
+                ship.call(srv.address, "Kv.prepare", preq), timeout=2.0)
+            assert list(svc._prepared) == ["t-dup"]
+            await ship.call(srv.address, "Kv.commit_prepared",
+                            KvFinishReq(txn_id="t-dup"))
+            assert eng.read_at(b"a", eng.current_version()) == b"1"
+            # lock released exactly once: a follow-up commit flows
+            await asyncio.wait_for(
+                ship.call(srv.address, "Kv.commit", mk(b"k", b"v")),
+                timeout=2.0)
+        finally:
+            await srv.stop()
+            await ship.close()
+    run(body())
+
+
 @pytest.mark.slow
 def test_meta_over_sharded_kv_multiprocess():
     """Full deployment shape: meta_main running over TWO standalone

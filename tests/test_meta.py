@@ -564,6 +564,51 @@ def test_prune_session_rpc_client_scoped(store):
     run(body())
 
 
+def test_prune_session_conn_identity_enforced(store):
+    """A connection bound to client A (by its own open/create) cannot
+    prune client B's sessions by naming B in the request (ADVICE r2:
+    request-supplied client_id was trusted blindly)."""
+    from t3fs.meta.service import MetaServer, PathReq, PruneSessionReq
+
+    class FakeConn:
+        pass
+
+    async def body():
+        srv = MetaServer(store, StorageClientInMem(), gc_period_s=3600)
+        svc = srv.service
+        await store.mkdirs("/p")
+        conn_a = FakeConn()
+        await svc.create(PathReq(path="/p/a", write=True,
+                                 client_id="mount-A"), b"", conn_a)
+        await svc.create(PathReq(path="/p/b", write=True,
+                                 client_id="mount-B"), b"", FakeConn())
+        assert len(await store.scan_sessions()) == 2
+
+        # conn_a bound to mount-A: pruning mount-B is refused
+        with pytest.raises(StatusError) as ei:
+            await svc.prune_session(
+                PruneSessionReq(client_id="mount-B"), b"", conn_a)
+        assert ei.value.code == StatusCode.META_NO_PERMISSION
+        assert len(await store.scan_sessions()) == 2
+
+        # its own sessions prune fine
+        await svc.prune_session(
+            PruneSessionReq(client_id="mount-A"), b"", conn_a)
+        assert [s.client_id for s in await store.scan_sessions()] \
+            == ["mount-B"]
+
+        # an unbound conn binds on first prune, then stays scoped
+        conn_c = FakeConn()
+        await svc.prune_session(
+            PruneSessionReq(client_id="mount-C"), b"", conn_c)
+        with pytest.raises(StatusError):
+            await svc.prune_session(
+                PruneSessionReq(client_id="mount-B"), b"", conn_c)
+        assert [s.client_id for s in await store.scan_sessions()] \
+            == ["mount-B"]
+    run(body())
+
+
 def test_hardlink_bumps_ctime_not_mtime(store):
     """POSIX link(): the linked file's mtime must NOT change (backup tools
     key on it); only ctime bumps.  Covers both the path op and link_at."""
