@@ -41,8 +41,11 @@ DEFAULT_SEG_BYTES = 512
 
 
 def on_tpu() -> bool:
+    """True when the default JAX device is a real accelerator (anything
+    that isn't the CPU backend — the tunneled chip registers under the
+    plugin platform name "axon", not "tpu")."""
     try:
-        return jax.devices()[0].platform == "tpu"
+        return jax.devices()[0].platform != "cpu"
     except Exception:
         return False
 

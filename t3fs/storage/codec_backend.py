@@ -204,7 +204,12 @@ class DeviceChecksumBackend(ChecksumBackend):
 
             _enable_persistent_cache()
             if self._interpret is None:
-                self._interpret = jax.devices()[0].platform != "tpu"
+                # interpret ONLY on the CPU backend: real accelerators may
+                # register under a plugin platform name that isn't "tpu"
+                # (the tunneled chip registers as "axon"), and falling
+                # back to the interpreter there would silently throw away
+                # the Mosaic kernels
+                self._interpret = jax.devices()[0].platform == "cpu"
             fn = jax.jit(make_crc32c_words_raw(
                 chunk_words, interpret=self._interpret))
             self._fns[chunk_words] = fn

@@ -5,14 +5,28 @@ chip and must NOT import this).
 
 The ambient environment pins JAX to the real TPU (JAX_PLATFORMS=axon, set
 again by sitecustomize after env vars), so plain env overrides don't stick —
-jax.config.update is the reliable knob."""
+jax.config.update is the reliable knob.
+
+On-device tier: T3FS_ON_DEVICE=1 keeps the REAL chip as the JAX backend so
+the Pallas kernels compile with interpret=False (Mosaic) instead of the CPU
+interpreter.  Intended for the device-test subset only:
+
+    T3FS_ON_DEVICE=1 python -m pytest tests/test_pallas_codec.py \
+        tests/test_codec_backend.py -q
+
+Running the full suite in this mode is unsupported (most tests need the
+8-device virtual CPU mesh)."""
 
 import os
 
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+ON_DEVICE = bool(os.environ.get("T3FS_ON_DEVICE"))
 
-import jax  # noqa: E402
+if not ON_DEVICE:
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
 
-jax.config.update("jax_platforms", "cpu")
+    import jax  # noqa: E402
+
+    jax.config.update("jax_platforms", "cpu")
