@@ -134,10 +134,22 @@ async def _run(args, cluster: LocalCluster, k: int, m: int,
         d = await ec.read_stripe(lay, inode, s, stripe_len)
         assert d == payloads[s % len(payloads)], f"post-repair stripe {s}"
 
+    # which codec implementation actually served the calls (pallas-words /
+    # pallas-bitmatmul / xla-bitmatmul), plus batching effectiveness
+    codec_stats = None
+    if ec.codec is not None:
+        codec_stats = {
+            "counts": dict(ec.codec.codec_counts),
+            "batches": ec.codec.batches,
+            "batched_items": ec.codec.batched_items,
+        }
+        await ec.close()
+
     return {
         "k": k, "m": m, "chunk_size": args.chunk_size,
         "stripes": args.stripes, "bytes": total,
         "codec": "device" if args.device else "numpy",
+        "codec_stats": codec_stats,
         "write_MB_s": round(total / t_write / 1e6, 2),
         "degraded_read_MB_s": round(total / t_degraded / 1e6, 2),
         "repaired_shards": n_shards,

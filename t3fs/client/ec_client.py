@@ -5,8 +5,9 @@ This is the capability t3fs ADDS over the reference (BASELINE.json configs
 solver (deploy/data_placement/src/model/data_placement.py:484) with no
 encode/decode data path.  Here a stripe of k data chunks gets m parity
 chunks, each of the k+m shards on a different chain (replication factor 1 —
-parity replaces replication), encoded/decoded by the batched GF(2) bit-matmul
-codec (t3fs.ops.jax_codec) that runs on the co-located TPU.
+parity replaces replication), encoded/decoded by the word-packed Pallas
+kernels (t3fs.client.ec_codec — the same configuration bench.py measures)
+on the co-located TPU, with concurrent stripes micro-batched per launch.
 
 Addressing: data chunk j of stripe s  -> ChunkId(inode, s*k + j)
             parity chunk p of stripe s -> ChunkId(inode | PARITY_NS, s*m + p)
@@ -22,7 +23,7 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
-from t3fs.ops import jax_codec
+from t3fs.client.ec_codec import ECCodec
 from t3fs.ops.rs import default_rs
 from t3fs.storage.types import ChunkId, IOResult, ReadIO, UpdateType
 from t3fs.utils.serde import serde_struct
@@ -91,9 +92,12 @@ class ECStorageClient:
     """Stripe-granular EC write/read/repair over a StorageClient."""
 
     def __init__(self, storage_client, use_device_codec: bool = True,
-                 fast_read_retries: int = 4):
+                 fast_read_retries: int = 4, codec: "ECCodec | None" = None):
         self.sc = storage_client
         self.use_device = use_device_codec
+        # device path: the word-packed Pallas kernels (bench.py's measured
+        # configuration) with stripe micro-batching; None = numpy oracle
+        self.codec = (codec or ECCodec()) if use_device_codec else None
         # degraded reads must not wait out long retry tails on dead chains:
         # parity covers a fast-failed shard, so EC reads use a bounded-retry
         # view of the same client (shared sockets + routing), falling back
@@ -119,29 +123,32 @@ class ECStorageClient:
         chain = self.sc.routing().chain(chain_id)
         return chain is None or not chain.serving()
 
-    # --- codec (TPU path by default; numpy oracle as fallback) ---
+    # --- codec (Pallas word kernels by default; numpy oracle fallback) ---
+    # Device calls go through ECCodec: concurrent stripes micro-batch into
+    # one kernel launch on the codec's own thread (XLA compile takes
+    # seconds and compute releases the GIL — nothing blocks the loop).
 
     async def _encode(self, data_shards: np.ndarray, k: int, m: int) -> np.ndarray:
-        # off the event loop: XLA compile takes seconds and device compute
-        # releases the GIL — blocking here would stall heartbeats/leases
-        def run():
-            if self.use_device:
-                out = jax_codec.rs_encode_jit(k, m)(data_shards[None, :, :])
-                return np.asarray(out)[0]
-            return default_rs(k, m).encode_ref(data_shards)
-        return await asyncio.to_thread(run)
+        if self.codec is not None:
+            return await self.codec.encode(data_shards, k, m)
+        return await asyncio.to_thread(default_rs(k, m).encode_ref,
+                                       data_shards)
 
     async def _reconstruct(self, present_rows: np.ndarray,
                            present: tuple[int, ...], want: tuple[int, ...],
                            k: int, m: int) -> np.ndarray:
+        if self.codec is not None:
+            return await self.codec.reconstruct(present_rows, present, want,
+                                                k, m)
+
         def run():
-            if self.use_device:
-                out = jax_codec.rs_reconstruct_jit(present, want, k, m)(
-                    present_rows[None, :, :])
-                return np.asarray(out)[0]
             shards = {idx: present_rows[i] for i, idx in enumerate(present)}
             return default_rs(k, m).decode_ref(shards, list(want))
         return await asyncio.to_thread(run)
+
+    async def close(self) -> None:
+        if self.codec is not None:
+            await self.codec.close()
 
     # --- write ---
 
