@@ -12,6 +12,7 @@ import logging
 import random
 import uuid as uuidlib
 
+from t3fs.meta.acl import UserInfo
 from t3fs.meta.schema import DirEntry, Inode
 from t3fs.meta.service import (
     BatchStatReq, EntryReq, InodeReq, LockDirReq, PathReq, PruneSessionReq,
@@ -26,13 +27,17 @@ log = logging.getLogger("t3fs.client.meta")
 class MetaClient:
     def __init__(self, addresses: list[str], client: Client | None = None,
                  client_id: str = "", strategy: str = "roundrobin",
-                 max_retries: int = 3):
+                 max_retries: int = 3, user: UserInfo | None = None):
         assert addresses
         self.addresses = list(addresses)
         self.client = client or Client()
         self.client_id = client_id or f"mc-{random.getrandbits(40):010x}"
         self.strategy = strategy
         self.max_retries = max_retries
+        # default identity stamped on every request (None = trusted
+        # caller, enforcement off); per-call `user=` overrides it — the
+        # FUSE daemon passes each kernel request's caller this way
+        self.user = user
         self._rr = itertools.count()
 
     def _pick(self, attempt: int) -> str:
@@ -40,7 +45,10 @@ class MetaClient:
             return random.choice(self.addresses)
         return self.addresses[(next(self._rr) + attempt) % len(self.addresses)]
 
-    async def _call(self, method: str, req):
+    async def _call(self, method: str, req, user: UserInfo | None = None):
+        ident = user if user is not None else self.user
+        if ident is not None and hasattr(req, "user"):
+            req.user = ident
         last: StatusError | None = None
         for attempt in range(self.max_retries):
             address = self._pick(attempt)
@@ -55,8 +63,10 @@ class MetaClient:
 
     # --- typed ops ---
 
-    async def stat(self, path: str, follow: bool = True) -> Inode:
-        return (await self._call("stat", PathReq(path=path, follow=follow))).inode
+    async def stat(self, path: str, follow: bool = True,
+                   user: UserInfo | None = None) -> Inode:
+        return (await self._call("stat", PathReq(path=path, follow=follow),
+                                 user=user)).inode
 
     async def stat_inode(self, inode_id: int) -> Inode:
         return (await self._call("stat_inode", InodeReq(inode_id=inode_id))).inode
@@ -67,18 +77,24 @@ class MetaClient:
         return str(uuidlib.uuid4())
 
     async def create(self, path: str, perm: int = 0o644, chunk_size: int = 0,
-                     stripe: int = 0, write: bool = False) -> tuple[Inode, str]:
+                     stripe: int = 0, write: bool = False,
+                     user: UserInfo | None = None) -> tuple[Inode, str]:
         """write=True opens a write session with the create (O_CREAT|O_WRONLY);
         the caller must close(inode_id, session_id) or the session pins GC
         until the dead-client pruner reaps it."""
         rsp = await self._call("create", PathReq(
             path=path, perm=perm, chunk_size=chunk_size, stripe=stripe,
-            write=write, client_id=self.client_id, request_id=self._rid()))
+            write=write, client_id=self.client_id, request_id=self._rid()),
+            user=user)
         return rsp.inode, rsp.session_id
 
-    async def open(self, path: str, write: bool = False) -> tuple[Inode, str]:
+    async def open(self, path: str, write: bool = False,
+                   user: UserInfo | None = None,
+                   rdwr: bool = False) -> tuple[Inode, str]:
         rsp = await self._call("open", PathReq(path=path, write=write,
-                                               client_id=self.client_id))
+                                               client_id=self.client_id,
+                                               rdwr=rdwr),
+                               user=user)
         return rsp.inode, rsp.session_id
 
     async def close(self, inode_id: int, session_id: str = "",
@@ -94,101 +110,127 @@ class MetaClient:
                          InodeReq(inode_id=inode_id, position=position))
 
     async def mkdirs(self, path: str, perm: int = 0o755,
-                     recursive: bool = True) -> Inode:
+                     recursive: bool = True,
+                     user: UserInfo | None = None) -> Inode:
         return (await self._call("mkdirs", PathReq(
             path=path, perm=perm, recursive=recursive,
-            client_id=self.client_id, request_id=self._rid()))).inode
+            client_id=self.client_id, request_id=self._rid()),
+            user=user)).inode
 
-    async def readdir(self, path: str) -> list[DirEntry]:
-        return (await self._call("readdir", PathReq(path=path))).entries
+    async def readdir(self, path: str,
+                      user: UserInfo | None = None) -> list[DirEntry]:
+        return (await self._call("readdir", PathReq(path=path),
+                                 user=user)).entries
 
-    async def remove(self, path: str, recursive: bool = False) -> None:
+    async def remove(self, path: str, recursive: bool = False,
+                     user: UserInfo | None = None) -> None:
         await self._call("remove", PathReq(
             path=path, recursive=recursive, client_id=self.client_id,
-            request_id=self._rid()))
+            request_id=self._rid()), user=user)
 
-    async def rename(self, src: str, dst: str, flags: int = 0) -> None:
+    async def rename(self, src: str, dst: str, flags: int = 0,
+                     user: UserInfo | None = None) -> None:
         # flags route to a separate method so an old server can never
         # mis-run a flagged rename as a plain destructive one
         await self._call("rename2" if flags else "rename", PathReq(
             path=src, target=dst, flags=flags, client_id=self.client_id,
-            request_id=self._rid()))
+            request_id=self._rid()), user=user)
 
-    async def symlink(self, path: str, target: str) -> Inode:
+    async def symlink(self, path: str, target: str,
+                      user: UserInfo | None = None) -> Inode:
         return (await self._call("symlink", PathReq(
             path=path, target=target, client_id=self.client_id,
-            request_id=self._rid()))).inode
+            request_id=self._rid()), user=user)).inode
 
-    async def hardlink(self, existing: str, new_path: str) -> Inode:
+    async def hardlink(self, existing: str, new_path: str,
+                       user: UserInfo | None = None) -> Inode:
         return (await self._call("hardlink", PathReq(
             path=existing, target=new_path, client_id=self.client_id,
-            request_id=self._rid()))).inode
+            request_id=self._rid()), user=user)).inode
 
-    async def set_attr(self, path: str, perm: int) -> Inode:
-        return (await self._call("set_attr", PathReq(path=path, perm=perm))).inode
+    async def set_attr(self, path: str, perm: int,
+                       user: UserInfo | None = None) -> Inode:
+        return (await self._call("set_attr",
+                                 PathReq(path=path, perm=perm),
+                                 user=user)).inode
 
-    async def truncate(self, inode_id: int, length: int) -> Inode:
+    async def truncate(self, inode_id: int, length: int,
+                       user: UserInfo | None = None) -> Inode:
         return (await self._call("truncate", InodeReq(inode_id=inode_id,
-                                                      length=length))).inode
+                                                      length=length),
+                                 user=user)).inode
 
     async def get_real_path(self, inode_id: int) -> str:
         return (await self._call("get_real_path", InodeReq(inode_id=inode_id))).path
 
-    async def lookup(self, parent: int, name: str) -> Inode:
+    async def lookup(self, parent: int, name: str,
+                     user: UserInfo | None = None) -> Inode:
         return (await self._call("lookup", EntryReq(
-            parent=parent, name=name))).inode
+            parent=parent, name=name), user=user)).inode
 
-    async def readdir_inode(self, inode_id: int,
-                            limit: int = 0) -> list[DirEntry]:
+    async def readdir_inode(self, inode_id: int, limit: int = 0,
+                            user: UserInfo | None = None
+                            ) -> list[DirEntry]:
         return (await self._call("readdir_inode", EntryReq(
-            inode_id=inode_id, limit=limit))).entries
+            inode_id=inode_id, limit=limit), user=user)).entries
 
     async def create_at(self, parent: int, name: str, perm: int = 0o644,
                         chunk_size: int = 0, stripe: int = 0,
-                        write: bool = False) -> tuple[Inode, str]:
+                        write: bool = False,
+                        user: UserInfo | None = None) -> tuple[Inode, str]:
         rsp = await self._call("create_at", EntryReq(
             parent=parent, name=name, perm=perm, chunk_size=chunk_size,
             stripe=stripe, write=write, client_id=self.client_id,
-            request_id=self._rid()))
+            request_id=self._rid()), user=user)
         return rsp.inode, rsp.session_id
 
-    async def mkdir_at(self, parent: int, name: str,
-                       perm: int = 0o755) -> Inode:
+    async def mkdir_at(self, parent: int, name: str, perm: int = 0o755,
+                       user: UserInfo | None = None) -> Inode:
         return (await self._call("mkdir_at", EntryReq(
             parent=parent, name=name, perm=perm, client_id=self.client_id,
-            request_id=self._rid()))).inode
+            request_id=self._rid()), user=user)).inode
 
-    async def symlink_at(self, parent: int, name: str, target: str) -> Inode:
+    async def symlink_at(self, parent: int, name: str, target: str,
+                         user: UserInfo | None = None) -> Inode:
         return (await self._call("symlink_at", EntryReq(
             parent=parent, name=name, target=target,
-            client_id=self.client_id, request_id=self._rid()))).inode
+            client_id=self.client_id, request_id=self._rid()),
+            user=user)).inode
 
     async def unlink_at(self, parent: int, name: str,
                         recursive: bool = False,
-                        must_dir: bool | None = None) -> None:
+                        must_dir: bool | None = None,
+                        user: UserInfo | None = None) -> None:
         await self._call("unlink_at", EntryReq(
             parent=parent, name=name, recursive=recursive,
             client_id=self.client_id, request_id=self._rid(),
-            must_dir=-1 if must_dir is None else int(must_dir)))
+            must_dir=-1 if must_dir is None else int(must_dir)),
+            user=user)
 
     async def rename_at(self, sparent: int, sname: str, dparent: int,
-                        dname: str, flags: int = 0) -> None:
+                        dname: str, flags: int = 0,
+                        user: UserInfo | None = None) -> None:
         """flags: renameat2(2) RENAME_NOREPLACE=1 / RENAME_EXCHANGE=2
         (flagged calls use their own method — see rename)."""
         await self._call("rename2_at" if flags else "rename_at", EntryReq(
             parent=sparent, name=sname, dparent=dparent, dname=dname,
             client_id=self.client_id, request_id=self._rid(),
-            flags=flags))
+            flags=flags), user=user)
 
-    async def link_at(self, inode_id: int, parent: int, name: str) -> Inode:
+    async def link_at(self, inode_id: int, parent: int, name: str,
+                      user: UserInfo | None = None) -> Inode:
         return (await self._call("link_at", EntryReq(
             inode_id=inode_id, parent=parent, name=name,
-            client_id=self.client_id, request_id=self._rid()))).inode
+            client_id=self.client_id, request_id=self._rid()),
+            user=user)).inode
 
-    async def open_inode(self, inode_id: int,
-                         write: bool = False) -> tuple[Inode, str]:
+    async def open_inode(self, inode_id: int, write: bool = False,
+                         user: UserInfo | None = None,
+                         rdwr: bool = False) -> tuple[Inode, str]:
         rsp = await self._call("open_inode", EntryReq(
-            inode_id=inode_id, write=write, client_id=self.client_id))
+            inode_id=inode_id, write=write, client_id=self.client_id,
+            rdwr=rdwr),
+            user=user)
         return rsp.inode, rsp.session_id
 
     async def lock_directory(self, path: str, unlock: bool = False) -> Inode:
@@ -203,10 +245,11 @@ class MetaClient:
             inode_id=inode_id, client_id=self.client_id,
             action=action))).inode
 
-    async def batch_stat(self, paths: list[str],
-                         follow: bool = True) -> list[Inode | None]:
+    async def batch_stat(self, paths: list[str], follow: bool = True,
+                         user: UserInfo | None = None
+                         ) -> list[Inode | None]:
         return (await self._call("batch_stat", BatchStatReq(
-            paths=paths, follow=follow))).inodes
+            paths=paths, follow=follow), user=user)).inodes
 
     async def batch_stat_inodes(self, inode_ids: list[int]) -> list[Inode | None]:
         return (await self._call("batch_stat", BatchStatReq(
@@ -215,11 +258,12 @@ class MetaClient:
     async def set_attr_inode(self, inode_id: int, *, perm: int = -1,
                              uid: int = -1, gid: int = -1,
                              atime: float = -1.0,
-                             mtime: float = -1.0) -> Inode:
+                             mtime: float = -1.0,
+                             user: UserInfo | None = None) -> Inode:
         """chmod/chown/utimens by nodeid (-1 = leave unchanged)."""
         return (await self._call("set_attr_inode", SetAttrReq(
             inode_id=inode_id, perm=perm, uid=uid, gid=gid,
-            atime=atime, mtime=mtime))).inode
+            atime=atime, mtime=mtime), user=user)).inode
 
     async def prune_sessions(self, session_ids: list[str] = ()) -> None:
         """Release this client's write sessions eagerly (reference

@@ -33,6 +33,7 @@ import time as _time
 from t3fs.fuse.user_config import (
     VIRT_NAME, MountUserConfig, UserConfig, VirtualTree,
 )
+from t3fs.meta.acl import UserInfo
 from t3fs.meta.schema import InodeType, ROOT_INODE_ID
 from t3fs.utils.status import StatusCode, StatusError
 
@@ -147,7 +148,7 @@ class FuseKernelMount:
     async def mount(self) -> None:
         self.fd = os.open("/dev/fuse", os.O_RDWR | os.O_NONBLOCK)
         opts = (f"fd={self.fd},rootmode=40000,user_id={os.getuid()},"
-                f"group_id={os.getgid()}")
+                f"group_id={os.getgid()},allow_other")
         r = _libc.mount(b"t3fs", self.mountpoint.encode(), b"fuse.t3fs",
                         MS_NOSUID | MS_NODEV, opts.encode())
         if r != 0:
@@ -207,7 +208,7 @@ class FuseKernelMount:
         if opcode in (FORGET, BATCH_FORGET):
             return                         # MUST not reply
         try:
-            data = await self._handle(opcode, nodeid, body, uid)
+            data = await self._handle(opcode, nodeid, body, uid, gid)
             if data is None:
                 return                     # handler already replied / no reply
             self._reply(unique, 0, data)
@@ -282,8 +283,13 @@ class FuseKernelMount:
     # ---- opcode handlers ----
 
     async def _handle(self, opcode: int, nodeid: int, body: bytes,
-                      uid: int = 0):
+                      uid: int = 0, gid: int = 0):
         ucfg = self.user_config.get(uid)
+        # per-request caller identity from the FUSE header: the meta
+        # service enforces POSIX mode bits against it (reference carries
+        # UserInfo on every RPC; supplementary groups are not in the
+        # header, so group checks see the primary gid only)
+        user = UserInfo(uid=uid, gids=[gid])
         virt = await self._handle_virtual(opcode, nodeid, body, uid, ucfg)
         if virt is not NotImplemented:
             return virt
@@ -313,11 +319,13 @@ class FuseKernelMount:
             return self._attr_out(await self.mc.stat_inode(nodeid), ucfg)
         if opcode == LOOKUP:
             name = body.split(b"\0", 1)[0].decode()
-            return self._entry_out(await self.mc.lookup(nodeid, name),
+            return self._entry_out(await self.mc.lookup(nodeid, name,
+                                                        user=user),
                                    self._attr_cache_cfg(ucfg))
         if opcode == OPENDIR:
             entries, inode = await asyncio.gather(
-                self.mc.readdir_inode(nodeid), self.mc.stat_inode(nodeid))
+                self.mc.readdir_inode(nodeid, user=user),
+                self.mc.stat_inode(nodeid))
             listing = [(nodeid, ".", InodeType.DIRECTORY),
                        (inode.parent or nodeid, "..", InodeType.DIRECTORY)]
             listing += [(e.inode_id, e.name, InodeType(e.itype))
@@ -397,7 +405,9 @@ class FuseKernelMount:
             writable = (flags & O_ACCMODE) != os.O_RDONLY
             if writable and ucfg.readonly:
                 raise OSError(errno.EROFS, "readonly mount (user config)")
-            inode, session = await self.mc.open_inode(nodeid, write=writable)
+            inode, session = await self.mc.open_inode(
+                nodeid, write=writable, user=user,
+                rdwr=(flags & O_ACCMODE) == os.O_RDWR)
             if writable:
                 self._track_open(inode)
             return _OPEN_OUT.pack(
@@ -407,7 +417,7 @@ class FuseKernelMount:
             name = body[_CREATE_IN.size:].split(b"\0", 1)[0].decode()
             inode, session = await self.mc.create_at(nodeid, name,
                                                      perm=mode & 0o7777,
-                                                     write=True)
+                                                     write=True, user=user)
             self._track_open(inode)
             fh = self._new_fh(_Handle(inode, session, True))
             return self._entry_out(inode, ucfg) + _OPEN_OUT.pack(fh, 0, 0)
@@ -417,17 +427,19 @@ class FuseKernelMount:
             if not statmod.S_ISREG(mode):
                 raise NotImplementedError
             inode, _ = await self.mc.create_at(nodeid, name,
-                                               perm=mode & 0o7777)
+                                               perm=mode & 0o7777,
+                                               user=user)
             return self._entry_out(inode, ucfg)
         if opcode == MKDIR:
             mode, _umask = _MKDIR_IN.unpack_from(body)
             name = body[_MKDIR_IN.size:].split(b"\0", 1)[0].decode()
             return self._entry_out(await self.mc.mkdir_at(
-                nodeid, name, perm=mode & 0o7777), ucfg)
+                nodeid, name, perm=mode & 0o7777, user=user), ucfg)
         if opcode == SYMLINK:
             name_b, target_b = body.split(b"\0", 2)[:2]
             return self._entry_out(await self.mc.symlink_at(
-                nodeid, name_b.decode(), target_b.decode()), ucfg)
+                nodeid, name_b.decode(), target_b.decode(), user=user),
+                ucfg)
         if opcode == READLINK:
             inode = await self.mc.stat_inode(nodeid)
             return inode.symlink_target.encode()
@@ -436,7 +448,7 @@ class FuseKernelMount:
             # server-side type assertion: the kernel's cached entry type can
             # be stale, and rmdir(file) / unlink(dir) must fail atomically
             await self.mc.unlink_at(nodeid, name,
-                                    must_dir=(opcode == RMDIR))
+                                    must_dir=(opcode == RMDIR), user=user)
             return b""
         if opcode == LINK:
             # fuse_link_in { u64 oldnodeid } + newname
@@ -446,7 +458,8 @@ class FuseKernelMount:
                 # LINK returns an EXISTING inode (like LOOKUP): its length
                 # may be un-synced, so sync_on_stat must not cache it
                 return self._entry_out(
-                    await self.mc.link_at(old_nodeid, nodeid, name),
+                    await self.mc.link_at(old_nodeid, nodeid, name,
+                                          user=user),
                     self._attr_cache_cfg(ucfg))
             except StatusError as e:
                 if e.code == StatusCode.META_IS_DIR:
@@ -465,7 +478,8 @@ class FuseKernelMount:
                 rest = body[_RENAME2_IN.size:]
             oldname_b, newname_b = rest.split(b"\0", 2)[:2]
             await self.mc.rename_at(nodeid, oldname_b.decode(),
-                                    newdir, newname_b.decode(), flags=flags)
+                                    newdir, newname_b.decode(), flags=flags,
+                                    user=user)
             return b""
         if opcode == READ:
             fh, off, size, *_ = _READ_IN.unpack_from(body)
@@ -505,7 +519,7 @@ class FuseKernelMount:
              ) = _SETATTR_IN.unpack_from(body)
             inode = None
             if valid & FATTR_SIZE:
-                inode = await self.mc.truncate(nodeid, size)
+                inode = await self.mc.truncate(nodeid, size, user=user)
                 if nodeid in self._open_len:
                     self._open_len[nodeid] = size
             now = _time.time()
@@ -528,7 +542,8 @@ class FuseKernelMount:
                 attrs["mtime"] = (now if valid & FATTR_MTIME_NOW
                                   else tsec(_mt, mtns))
             if attrs:
-                inode = await self.mc.set_attr_inode(nodeid, **attrs)
+                inode = await self.mc.set_attr_inode(nodeid, user=user,
+                                                     **attrs)
             if inode is None:
                 inode = await self.mc.stat_inode(nodeid)
             return self._attr_out(inode, ucfg)
@@ -537,7 +552,17 @@ class FuseKernelMount:
                                     1 << 19, 4096, 255, 4096, 0,
                                     0, 0, 0, 0, 0, 0)
         if opcode == ACCESS:
-            return b""                     # permissive (no default_permissions)
+            # access(2)/faccessat(2): the kernel asks because the mount
+            # runs without default_permissions — answer from the REAL
+            # mode bits so `test -w` and friends tell the truth
+            from t3fs.meta import acl as _acl
+            if self.virt.is_virtual(nodeid):
+                return b""       # /t3fs-virt ids never exist meta-side
+            (mask,) = struct.unpack_from("<I", body)
+            inode = await self.mc.stat_inode(nodeid)
+            if mask & 7 and not _acl.may(inode, user, mask & 7):
+                raise OSError(errno.EACCES, "access denied")
+            return b""
         if opcode in (SETXATTR, GETXATTR, LISTXATTR, REMOVEXATTR):
             return await self._handle_xattr(opcode, nodeid, body)
         if opcode == INTERRUPT:

@@ -14,6 +14,8 @@ import time
 from dataclasses import dataclass, field
 
 from t3fs.client.layout import FileLayout
+from t3fs.meta import acl
+from t3fs.meta.acl import UserInfo
 from t3fs.meta.events import MetaEventType
 from t3fs.meta.schema import DirEntry, FileSession, Inode, InodeType
 from t3fs.meta.store import ChainAllocator, MetaStore
@@ -44,6 +46,8 @@ class PathReq:
     unlock: bool = False      # lock_directory
     # append-only (serde positional wire compat): new fields go LAST
     flags: int = 0            # rename: renameat2 NOREPLACE=1 / EXCHANGE=2
+    user: UserInfo | None = None   # caller identity (None = trusted)
+    rdwr: bool = False        # open: O_RDWR (needs R in addition to W)
 
 
 @serde_struct
@@ -62,6 +66,7 @@ class InodeReq:
     session_id: str = ""
     length: int = -1          # -1: unknown (server settles via storage)
     position: int = 0
+    user: UserInfo | None = None   # caller identity (None = trusted)
 
 
 @serde_struct
@@ -107,6 +112,8 @@ class EntryReq:
     must_dir: int = -1        # unlink_at: -1 any, 0 must be file, 1 must be dir
     # append-only (serde positional wire compat): new fields go LAST
     flags: int = 0            # rename: renameat2 NOREPLACE=1 / EXCHANGE=2
+    user: UserInfo | None = None   # caller identity (None = trusted)
+    rdwr: bool = False        # open: O_RDWR (needs R in addition to W)
 
 
 @serde_struct
@@ -114,6 +121,7 @@ class EntryReq:
 class PruneSessionReq:
     client_id: str = ""
     session_ids: list[str] = field(default_factory=list)
+    user: UserInfo | None = None   # caller identity (None = trusted)
 
 
 @serde_struct
@@ -126,6 +134,7 @@ class SetAttrReq:
     gid: int = -1
     atime: float = -1.0
     mtime: float = -1.0
+    user: UserInfo | None = None   # caller identity (None = trusted)
 
 
 @serde_struct
@@ -134,6 +143,7 @@ class BatchStatReq:
     paths: list[str] = field(default_factory=list)
     inode_ids: list[int] = field(default_factory=list)
     follow: bool = True
+    user: UserInfo | None = None   # caller identity (None = trusted)
 
 
 @serde_struct
@@ -144,9 +154,31 @@ class BatchStatRsp:
 
 @service("Meta")
 class MetaService:
-    def __init__(self, store: MetaStore, storage_client=None):
+    def __init__(self, store: MetaStore, storage_client=None,
+                 authenticator=None):
         self.store = store
         self.sc = storage_client   # FileHelper / GC path (may be None in tests)
+        # optional async (claimed UserInfo) -> verified UserInfo hook; when
+        # set, the registry's record (not the claim) is what gets enforced
+        self.authenticator = authenticator
+
+    async def _identity(self, req) -> UserInfo | None:
+        """Caller identity for permission checks.  Without an
+        authenticator, None (no user on the request) = trusted caller,
+        enforcement off — matching deployments that run without
+        authentication, like an un-exported local mount.  With an
+        authenticator configured, EVERY request must carry an identity
+        and it must verify (token check against the user registry,
+        reference AuthReq flow) — omitting the field is a refusal, not a
+        bypass, and the VERIFIED record is returned so a forged uid in
+        the claim cannot escalate."""
+        user = getattr(req, "user", None)
+        if self.authenticator is None:
+            return user
+        if user is None:
+            raise make_error(StatusCode.META_NO_PERMISSION,
+                             "identity required (authenticated deployment)")
+        return await self.authenticator(user)
 
     # each handler returns (rsp, b"")
 
@@ -163,7 +195,8 @@ class MetaService:
 
     @rpc_method
     async def stat(self, req: PathReq, payload, conn):
-        return InodeRsp(inode=await self.store.stat(req.path, req.follow)), b""
+        return InodeRsp(inode=await self.store.stat(
+            req.path, req.follow, user=await self._identity(req))), b""
 
     @rpc_method
     async def stat_inode(self, req: InodeReq, payload, conn):
@@ -176,14 +209,16 @@ class MetaService:
         self._bind_conn(conn, req.client_id)
         inode, session = await self.store.create(
             req.path, req.perm, req.chunk_size, req.stripe, req.client_id,
-            request_id=req.request_id, want_session=req.write)
+            request_id=req.request_id, want_session=req.write,
+            user=await self._identity(req))
         return InodeRsp(inode=inode, session_id=session), b""
 
     @rpc_method
     async def open(self, req: PathReq, payload, conn):
         self._bind_conn(conn, req.client_id)
         inode, session = await self.store.open_file(
-            req.path, req.write, req.client_id)
+            req.path, req.write, req.client_id,
+            user=await self._identity(req), rdwr=req.rdwr)
         return InodeRsp(inode=inode, session_id=session), b""
 
     @rpc_method
@@ -215,17 +250,20 @@ class MetaService:
     async def mkdirs(self, req: PathReq, payload, conn):
         return InodeRsp(inode=await self.store.mkdirs(
             req.path, req.perm, req.recursive, client_id=req.client_id,
-            request_id=req.request_id)), b""
+            request_id=req.request_id,
+            user=await self._identity(req))), b""
 
     @rpc_method
     async def readdir(self, req: PathReq, payload, conn):
-        return ReaddirRsp(entries=await self.store.readdir(req.path)), b""
+        return ReaddirRsp(entries=await self.store.readdir(
+            req.path, user=await self._identity(req))), b""
 
     @rpc_method
     async def remove(self, req: PathReq, payload, conn):
         await self.store.remove(req.path, req.recursive,
                                 client_id=req.client_id,
-                                request_id=req.request_id)
+                                request_id=req.request_id,
+                                user=await self._identity(req))
         return InodeRsp(), b""
 
     @rpc_method
@@ -238,7 +276,8 @@ class MetaService:
                              "flagged rename must use rename2")
         await self.store.rename(req.path, req.target,
                                 client_id=req.client_id,
-                                request_id=req.request_id)
+                                request_id=req.request_id,
+                                user=await self._identity(req))
         return InodeRsp(), b""
 
     @rpc_method
@@ -250,25 +289,29 @@ class MetaService:
         await self.store.rename(req.path, req.target,
                                 client_id=req.client_id,
                                 request_id=req.request_id,
-                                flags=req.flags)
+                                flags=req.flags,
+                                user=await self._identity(req))
         return InodeRsp(), b""
 
     @rpc_method
     async def symlink(self, req: PathReq, payload, conn):
         return InodeRsp(inode=await self.store.symlink(
             req.path, req.target, client_id=req.client_id,
-            request_id=req.request_id)), b""
+            request_id=req.request_id,
+            user=await self._identity(req))), b""
 
     @rpc_method
     async def hardlink(self, req: PathReq, payload, conn):
         return InodeRsp(inode=await self.store.hardlink(
             req.path, req.target, client_id=req.client_id,
-            request_id=req.request_id)), b""
+            request_id=req.request_id,
+            user=await self._identity(req))), b""
 
     @rpc_method
     async def set_attr(self, req: PathReq, payload, conn):
         return InodeRsp(inode=await self.store.set_attr(
-            req.path, perm=req.perm)), b""
+            req.path, perm=req.perm,
+            user=await self._identity(req))), b""
 
     @rpc_method
     async def set_attr_inode(self, req: SetAttrReq, payload, conn):
@@ -279,13 +322,18 @@ class MetaService:
             uid=None if req.uid < 0 else req.uid,
             gid=None if req.gid < 0 else req.gid,
             atime=None if req.atime < 0 else req.atime,
-            mtime=None if req.mtime < 0 else req.mtime)
+            mtime=None if req.mtime < 0 else req.mtime,
+            user=await self._identity(req))
         return InodeRsp(inode=inode), b""
 
     @rpc_method
     async def truncate(self, req: InodeReq, payload, conn):
         """Truncate file data (chunks) + settle meta length."""
         inode = await self.store.stat_inode(req.inode_id)
+        user = await self._identity(req)
+        if user is not None:
+            # truncate(2) needs W on the file
+            acl.check(inode, user, acl.W, str(req.inode_id))
         if self.sc is not None and inode.layout is not None:
             await self.sc.truncate_file(inode.layout, req.inode_id,
                                         max(0, req.length))
@@ -305,12 +353,13 @@ class MetaService:
     async def lookup(self, req: EntryReq, payload, conn):
         """FUSE lookup: (parent nodeid, name) -> inode (FuseOps.cc:644)."""
         return InodeRsp(inode=await self.store.lookup(
-            req.parent, req.name)), b""
+            req.parent, req.name, user=await self._identity(req))), b""
 
     @rpc_method
     async def readdir_inode(self, req: EntryReq, payload, conn):
         return ReaddirRsp(entries=await self.store.readdir_inode(
-            req.inode_id, req.limit)), b""
+            req.inode_id, req.limit,
+            user=await self._identity(req))), b""
 
     @rpc_method
     async def create_at(self, req: EntryReq, payload, conn):
@@ -318,27 +367,30 @@ class MetaService:
         inode, session = await self.store.create_at(
             req.parent, req.name, req.perm, req.chunk_size, req.stripe,
             req.client_id, request_id=req.request_id,
-            want_session=req.write)
+            want_session=req.write, user=await self._identity(req))
         return InodeRsp(inode=inode, session_id=session), b""
 
     @rpc_method
     async def mkdir_at(self, req: EntryReq, payload, conn):
         return InodeRsp(inode=await self.store.mkdir_at(
             req.parent, req.name, req.perm, client_id=req.client_id,
-            request_id=req.request_id)), b""
+            request_id=req.request_id,
+            user=await self._identity(req))), b""
 
     @rpc_method
     async def symlink_at(self, req: EntryReq, payload, conn):
         return InodeRsp(inode=await self.store.symlink_at(
             req.parent, req.name, req.target, client_id=req.client_id,
-            request_id=req.request_id)), b""
+            request_id=req.request_id,
+            user=await self._identity(req))), b""
 
     @rpc_method
     async def unlink_at(self, req: EntryReq, payload, conn):
         await self.store.unlink_at(
             req.parent, req.name, req.recursive, client_id=req.client_id,
             request_id=req.request_id,
-            must_dir=None if req.must_dir < 0 else bool(req.must_dir))
+            must_dir=None if req.must_dir < 0 else bool(req.must_dir),
+            user=await self._identity(req))
         return InodeRsp(), b""
 
     @rpc_method
@@ -348,7 +400,8 @@ class MetaService:
                              "flagged rename must use rename2_at")
         await self.store.rename_at(
             req.parent, req.name, req.dparent, req.dname,
-            client_id=req.client_id, request_id=req.request_id)
+            client_id=req.client_id, request_id=req.request_id,
+            user=await self._identity(req))
         return InodeRsp(), b""
 
     @rpc_method
@@ -358,7 +411,7 @@ class MetaService:
         await self.store.rename_at(
             req.parent, req.name, req.dparent, req.dname,
             client_id=req.client_id, request_id=req.request_id,
-            flags=req.flags)
+            flags=req.flags, user=await self._identity(req))
         return InodeRsp(), b""
 
     @rpc_method
@@ -366,14 +419,16 @@ class MetaService:
         """Entry-level hardlink (FUSE LINK): inode_id -> (parent, name)."""
         inode = await self.store.link_at(
             req.inode_id, req.parent, req.name,
-            client_id=req.client_id, request_id=req.request_id)
+            client_id=req.client_id, request_id=req.request_id,
+            user=await self._identity(req))
         return InodeRsp(inode=inode), b""
 
     @rpc_method
     async def open_inode(self, req: EntryReq, payload, conn):
         self._bind_conn(conn, req.client_id)
         inode, session = await self.store.open_inode(
-            req.inode_id, req.write, req.client_id)
+            req.inode_id, req.write, req.client_id,
+            user=await self._identity(req), rdwr=req.rdwr)
         return InodeRsp(inode=inode, session_id=session), b""
 
     @rpc_method
@@ -395,7 +450,8 @@ class MetaService:
         if req.inode_ids:
             inodes = await self.store.batch_stat_inodes(req.inode_ids)
         else:
-            inodes = await self.store.batch_stat(req.paths, req.follow)
+            inodes = await self.store.batch_stat(
+                req.paths, req.follow, user=await self._identity(req))
         return BatchStatRsp(inodes=inodes), b""
 
     async def reconcile_lengths(self, inode_ids: list[int]) -> int:
@@ -437,18 +493,21 @@ class MetaService:
 
         The prunable set derives from the CONNECTION's bound client id, not
         the request field alone: a connection is bound to the first
-        client_id it presents (any session-creating op binds it), so one
-        client cannot evict another live client's sessions by naming it."""
+        client_id it presents (any session-creating op binds it), so a
+        REUSED connection cannot evict another live client's sessions by
+        naming it.  A fresh connection is still trusted for its first
+        claim — full protection needs the authenticated deployment, where
+        _identity refuses unidentified callers outright."""
         if not req.client_id:
             raise make_error(StatusCode.INVALID_ARG, "client_id required")
+        await self._identity(req)   # authenticated deployments: verify
         bound = getattr(conn, "client_id", None) if conn is not None else None
         if bound is not None and bound != req.client_id:
             raise make_error(
                 StatusCode.META_NO_PERMISSION,
                 f"connection bound to client {bound!r} cannot prune "
                 f"sessions of {req.client_id!r}")
-        if conn is not None and bound is None:
-            conn.client_id = req.client_id
+        self._bind_conn(conn, req.client_id)
         sessions = await self.store.scan_sessions()
         mine = [s for s in sessions if s.client_id == req.client_id
                 and (not req.session_ids or s.session_id in req.session_ids)]

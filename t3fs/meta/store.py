@@ -18,6 +18,8 @@ import uuid as uuidlib
 from t3fs.client.layout import FileLayout
 from t3fs.kv.engine import KVEngine, Transaction, with_transaction
 from t3fs.kv.prefixes import KeyPrefix
+from t3fs.meta import acl
+from t3fs.meta.acl import UserInfo
 from t3fs.meta.events import MetaEventType as Ev
 from t3fs.meta.schema import (
     GC_PREFIX, IDEM_PREFIX, DirEntry, FileSession, IdemRecord, Inode,
@@ -197,10 +199,35 @@ class MetaStore:
         raw = await txn.get(DirEntry.key(parent, name))
         return serde.loads(raw) if raw else None
 
+    @staticmethod
+    def _open_bits(write: bool, rdwr: bool) -> int:
+        """open(2) accmode -> required permission bits."""
+        if rdwr:
+            return acl.R | acl.W
+        return acl.W if write else acl.R
+
+    async def _check_access(self, txn: Transaction, inode_or_id,
+                            user: UserInfo | None, bits: int,
+                            path: str = "") -> Inode | None:
+        """Permission gate for one inode (reference: per-op
+        inode.acl.checkPermission, src/meta/store/ops/SetAttr.h:76,99).
+        user=None (trusted caller) skips the inode fetch entirely, so
+        unauthenticated deployments pay nothing.  Returns the inode it
+        checked (None when skipped)."""
+        if user is None or acl.is_root(user):
+            return None
+        inode = inode_or_id if isinstance(inode_or_id, Inode) \
+            else await self._require_inode(txn, inode_or_id)
+        acl.check(inode, user, bits, path)
+        return inode
+
     async def resolve(self, txn: Transaction, path: str,
-                      follow_last: bool = True) -> tuple[int, str, DirEntry | None]:
+                      follow_last: bool = True,
+                      user: UserInfo | None = None
+                      ) -> tuple[int, str, DirEntry | None]:
         """Path -> (parent_inode_id, last_name, existing dent-or-None).
-        Iterative with symlink expansion limits (PathResolve.h:28-113)."""
+        Iterative with symlink expansion limits (PathResolve.h:28-113).
+        With a user, every directory searched needs X (POSIX traversal)."""
         depth = 0
         parts = [p for p in path.split("/") if p]
         parent = ROOT_INODE_ID
@@ -208,6 +235,8 @@ class MetaStore:
         while i < len(parts):
             name = parts[i]
             last = i == len(parts) - 1
+            await self._check_access(txn, parent, user, acl.X,
+                                     "/".join(parts[:i]) or "/")
             dent = await self._get_dent(txn, parent, name)
             if last and (dent is None or not follow_last
                          or dent.itype != InodeType.SYMLINK):
@@ -235,11 +264,14 @@ class MetaStore:
 
     # --- ops (each returns a plain result; run via with_transaction) ---
 
-    async def stat(self, path: str, follow: bool = True) -> Inode:
+    async def stat(self, path: str, follow: bool = True,
+                   user: UserInfo | None = None) -> Inode:
         async def fn(txn: Transaction):
             if path.strip("/") == "":
                 return await self._require_inode(txn, ROOT_INODE_ID)
-            parent, name, dent = await self.resolve(txn, path, follow_last=follow)
+            parent, name, dent = await self.resolve(txn, path,
+                                                    follow_last=follow,
+                                                    user=user)
             if dent is None:
                 raise make_error(StatusCode.META_NOT_FOUND, path)
             return await self._require_inode(txn, dent.inode_id)
@@ -252,7 +284,8 @@ class MetaStore:
 
     async def mkdirs(self, path: str, perm: int = 0o755,
                      recursive: bool = True, client_id: str = "",
-                     request_id: str = "") -> Inode:
+                     request_id: str = "",
+                     user: UserInfo | None = None) -> Inode:
         async def fn(txn: Transaction):
             parts = [p for p in path.split("/") if p]
             if not parts:
@@ -261,6 +294,12 @@ class MetaStore:
             created: Inode | None = None
             lock_checked = False
             for i, name in enumerate(parts):
+                if created is None:
+                    # pre-existing dirs need X to traverse; the deepest
+                    # one (where creation starts) additionally needs W
+                    # below.  Dirs this txn just created are the user's.
+                    await self._check_access(txn, parent, user, acl.X,
+                                             "/".join(parts[:i]) or "/")
                 dent = await self._get_dent(txn, parent, name)
                 last = i == len(parts) - 1
                 if dent is not None:
@@ -277,10 +316,15 @@ class MetaStore:
                     # deeper parents are directories this txn just created
                     await self._require_unlocked_dir(txn, parent, client_id,
                                                      path)
+                    await self._check_access(txn, parent, user, acl.W,
+                                             "/".join(parts[:i]) or "/")
                     lock_checked = True
                 inode_id = await self.ids.allocate()
                 inode = Inode(inode_id=inode_id, itype=InodeType.DIRECTORY,
-                              perm=perm, nlink=2, parent=parent).touch()
+                              perm=perm, nlink=2, parent=parent,
+                              uid=user.uid if user else 0,
+                              gid=acl.primary_gid(user) if user else 0
+                              ).touch()
                 txn.set(Inode.key(inode_id), serde.dumps(inode))
                 txn.set(DirEntry.key(parent, name), serde.dumps(
                     DirEntry(parent, name, inode_id, InodeType.DIRECTORY)))
@@ -297,22 +341,26 @@ class MetaStore:
     async def create(self, path: str, perm: int = 0o644, chunk_size: int = 0,
                      stripe: int = 0, session_client: str = "",
                      request_id: str = "",
-                     want_session: bool = True) -> tuple[Inode, str]:
+                     want_session: bool = True,
+                     user: UserInfo | None = None) -> tuple[Inode, str]:
         """Create a file (+ optional write session). Returns (inode, session_id).
         want_session=False creates without a write session (mknod-style) while
         session_client still keys idempotency."""
         layout = self.chains.allocate_layout(chunk_size, stripe)
 
         async def fn(txn: Transaction):
-            parent, name, dent = await self.resolve(txn, path)
+            parent, name, dent = await self.resolve(txn, path, user=user)
             if dent is not None:
                 raise make_error(StatusCode.META_EXISTS, path)
             if not name:
                 raise make_error(StatusCode.META_INVALID_PATH, path)
             await self._require_unlocked_dir(txn, parent, session_client, path)
+            await self._check_access(txn, parent, user, acl.W, path)
             inode_id = await self.ids.allocate()
             inode = Inode(inode_id=inode_id, itype=InodeType.FILE, perm=perm,
-                          layout=layout).touch()
+                          layout=layout,
+                          uid=user.uid if user else 0,
+                          gid=acl.primary_gid(user) if user else 0).touch()
             txn.set(Inode.key(inode_id), serde.dumps(inode))
             txn.set(DirEntry.key(parent, name), serde.dumps(
                 DirEntry(parent, name, inode_id, InodeType.FILE)))
@@ -330,14 +378,21 @@ class MetaStore:
         return inode, session_id
 
     async def open_file(self, path: str, write: bool = False,
-                        session_client: str = "") -> tuple[Inode, str]:
+                        session_client: str = "",
+                        user: UserInfo | None = None,
+                        rdwr: bool = False) -> tuple[Inode, str]:
         async def fn(txn: Transaction):
-            parent, name, dent = await self.resolve(txn, path)
+            parent, name, dent = await self.resolve(txn, path, user=user)
             if dent is None:
                 raise make_error(StatusCode.META_NOT_FOUND, path)
             inode = await self._require_inode(txn, dent.inode_id)
             if inode.itype == InodeType.DIRECTORY and write:
                 raise make_error(StatusCode.META_IS_DIR, path)
+            # open(2) access check: O_RDONLY needs R, O_WRONLY needs W,
+            # O_RDWR needs BOTH (a 0o200 write-only file must not leak
+            # its contents through an O_RDWR handle)
+            await self._check_access(txn, inode, user,
+                                     self._open_bits(write, rdwr), path)
             session_id = ""
             if write and session_client:
                 session_id = str(uuidlib.uuid4())
@@ -381,32 +436,40 @@ class MetaStore:
                 txn.set(Inode.key(inode_id), serde.dumps(inode))
         await self._txn(fn)
 
-    async def readdir(self, path: str, limit: int = 0) -> list[DirEntry]:
+    async def readdir(self, path: str, limit: int = 0,
+                      user: UserInfo | None = None) -> list[DirEntry]:
         async def fn(txn: Transaction):
             if path.strip("/") == "":
                 dir_id = ROOT_INODE_ID
             else:
-                parent, name, dent = await self.resolve(txn, path)
+                parent, name, dent = await self.resolve(txn, path, user=user)
                 if dent is None:
                     raise make_error(StatusCode.META_NOT_FOUND, path)
                 if dent.itype != InodeType.DIRECTORY:
                     raise make_error(StatusCode.META_NOT_DIR, path)
                 dir_id = dent.inode_id
+            await self._check_access(txn, dir_id, user, acl.R, path)
             pre = DirEntry.prefix(dir_id)
             rows = await txn.get_range(pre, pre + b"\xff", limit=limit)
             return [serde.loads(v) for _, v in rows]
         return await self._txn(fn)
 
     async def symlink(self, path: str, target: str,
-                      client_id: str = "", request_id: str = "") -> Inode:
+                      client_id: str = "", request_id: str = "",
+                      user: UserInfo | None = None) -> Inode:
         async def fn(txn: Transaction):
-            parent, name, dent = await self.resolve(txn, path, follow_last=False)
+            parent, name, dent = await self.resolve(txn, path,
+                                                    follow_last=False,
+                                                    user=user)
             if dent is not None:
                 raise make_error(StatusCode.META_EXISTS, path)
             await self._require_unlocked_dir(txn, parent, client_id, path)
+            await self._check_access(txn, parent, user, acl.W, path)
             inode_id = await self.ids.allocate()
             inode = Inode(inode_id=inode_id, itype=InodeType.SYMLINK,
-                          symlink_target=target).touch()
+                          symlink_target=target,
+                          uid=user.uid if user else 0,
+                          gid=acl.primary_gid(user) if user else 0).touch()
             txn.set(Inode.key(inode_id), serde.dumps(inode))
             txn.set(DirEntry.key(parent, name), serde.dumps(
                 DirEntry(parent, name, inode_id, InodeType.SYMLINK)))
@@ -490,9 +553,11 @@ class MetaStore:
 
     # --- entry-level ops (FUSE lowlevel surface: (parent nodeid, name)) ---
 
-    async def lookup(self, parent: int, name: str) -> Inode:
+    async def lookup(self, parent: int, name: str,
+                     user: UserInfo | None = None) -> Inode:
         """FUSE lookup (FuseOps.cc:644): (parent inode, name) -> child inode."""
         async def fn(txn: Transaction):
+            await self._check_access(txn, parent, user, acl.X, name)
             dent = await self._get_dent(txn, parent, name)
             if dent is None:
                 raise make_error(StatusCode.META_NOT_FOUND,
@@ -500,12 +565,13 @@ class MetaStore:
             return await self._require_inode(txn, dent.inode_id)
         return await self._txn(fn)
 
-    async def readdir_inode(self, inode_id: int,
-                            limit: int = 0) -> list[DirEntry]:
+    async def readdir_inode(self, inode_id: int, limit: int = 0,
+                            user: UserInfo | None = None) -> list[DirEntry]:
         async def fn(txn: Transaction):
             inode = await self._require_inode(txn, inode_id)
             if inode.itype != InodeType.DIRECTORY:
                 raise make_error(StatusCode.META_NOT_DIR, str(inode_id))
+            await self._check_access(txn, inode, user, acl.R, str(inode_id))
             pre = DirEntry.prefix(inode_id)
             rows = await txn.get_range(pre, pre + b"\xff", limit=limit)
             return [serde.loads(v) for _, v in rows]
@@ -514,16 +580,20 @@ class MetaStore:
     async def create_at(self, parent: int, name: str, perm: int = 0o644,
                         chunk_size: int = 0, stripe: int = 0,
                         session_client: str = "", request_id: str = "",
-                        want_session: bool = True) -> tuple[Inode, str]:
+                        want_session: bool = True,
+                        user: UserInfo | None = None) -> tuple[Inode, str]:
         layout = self.chains.allocate_layout(chunk_size, stripe)
 
         async def fn(txn: Transaction):
             if await self._get_dent(txn, parent, name) is not None:
                 raise make_error(StatusCode.META_EXISTS, name)
             await self._require_unlocked_dir(txn, parent, session_client, name)
+            await self._check_access(txn, parent, user, acl.W | acl.X, name)
             inode_id = await self.ids.allocate()
             inode = Inode(inode_id=inode_id, itype=InodeType.FILE, perm=perm,
-                          layout=layout).touch()
+                          layout=layout,
+                          uid=user.uid if user else 0,
+                          gid=acl.primary_gid(user) if user else 0).touch()
             txn.set(Inode.key(inode_id), serde.dumps(inode))
             txn.set(DirEntry.key(parent, name), serde.dumps(
                 DirEntry(parent, name, inode_id, InodeType.FILE)))
@@ -542,14 +612,18 @@ class MetaStore:
         return inode, session_id
 
     async def mkdir_at(self, parent: int, name: str, perm: int = 0o755,
-                       client_id: str = "", request_id: str = "") -> Inode:
+                       client_id: str = "", request_id: str = "",
+                       user: UserInfo | None = None) -> Inode:
         async def fn(txn: Transaction):
             if await self._get_dent(txn, parent, name) is not None:
                 raise make_error(StatusCode.META_EXISTS, name)
             await self._require_unlocked_dir(txn, parent, client_id, name)
+            await self._check_access(txn, parent, user, acl.W | acl.X, name)
             inode_id = await self.ids.allocate()
             inode = Inode(inode_id=inode_id, itype=InodeType.DIRECTORY,
-                          perm=perm, nlink=2, parent=parent).touch()
+                          perm=perm, nlink=2, parent=parent,
+                          uid=user.uid if user else 0,
+                          gid=acl.primary_gid(user) if user else 0).touch()
             txn.set(Inode.key(inode_id), serde.dumps(inode))
             txn.set(DirEntry.key(parent, name), serde.dumps(
                 DirEntry(parent, name, inode_id, InodeType.DIRECTORY)))
@@ -560,14 +634,18 @@ class MetaStore:
         return inode
 
     async def symlink_at(self, parent: int, name: str, target: str,
-                         client_id: str = "", request_id: str = "") -> Inode:
+                         client_id: str = "", request_id: str = "",
+                         user: UserInfo | None = None) -> Inode:
         async def fn(txn: Transaction):
             if await self._get_dent(txn, parent, name) is not None:
                 raise make_error(StatusCode.META_EXISTS, name)
             await self._require_unlocked_dir(txn, parent, client_id, name)
+            await self._check_access(txn, parent, user, acl.W | acl.X, name)
             inode_id = await self.ids.allocate()
             inode = Inode(inode_id=inode_id, itype=InodeType.SYMLINK,
-                          symlink_target=target).touch()
+                          symlink_target=target,
+                          uid=user.uid if user else 0,
+                          gid=acl.primary_gid(user) if user else 0).touch()
             txn.set(Inode.key(inode_id), serde.dumps(inode))
             txn.set(DirEntry.key(parent, name), serde.dumps(
                 DirEntry(parent, name, inode_id, InodeType.SYMLINK)))
@@ -578,10 +656,25 @@ class MetaStore:
                    client_id=client_id)
         return inode
 
+    async def _check_unlink_perm(self, txn: Transaction, parent: int,
+                                 dent: DirEntry, user: UserInfo | None,
+                                 name: str) -> None:
+        """unlink/rmdir/rename-source gate: W+X on the parent plus the
+        sticky-bit restricted-deletion rule."""
+        if user is None or acl.is_root(user):
+            return
+        pinode = await self._require_inode(txn, parent)
+        acl.check(pinode, user, acl.W | acl.X, name)
+        if pinode.perm & acl.S_ISVTX:
+            entry = await self._require_inode(txn, dent.inode_id)
+            acl.check_sticky(pinode, entry, user, name)
+
     async def _unlink_body(self, txn: Transaction, parent: int, name: str,
                            dent: DirEntry, recursive: bool, client_id: str,
-                           must_dir: bool | None = None) -> None:
+                           must_dir: bool | None = None,
+                           user: UserInfo | None = None) -> None:
         await self._require_unlocked_dir(txn, parent, client_id, name)
+        await self._check_unlink_perm(txn, parent, dent, user, name)
         if must_dir is True and dent.itype != InodeType.DIRECTORY:
             raise make_error(StatusCode.META_NOT_DIR, name)   # rmdir(file)
         if must_dir is False and dent.itype == InodeType.DIRECTORY:
@@ -595,20 +688,21 @@ class MetaStore:
                 raise make_error(StatusCode.META_NOT_EMPTY, name)
             for _, raw in children:
                 child: DirEntry = serde.loads(raw)
-                await self._remove_tree(txn, child, client_id)
+                await self._remove_tree(txn, child, client_id, user=user)
                 txn.clear(DirEntry.key(child.parent, child.name))
         await self._unlink_entry(txn, dent)
         txn.clear(DirEntry.key(parent, name))
 
     async def unlink_at(self, parent: int, name: str, recursive: bool = False,
                         client_id: str = "", request_id: str = "",
-                        must_dir: bool | None = None) -> None:
+                        must_dir: bool | None = None,
+                        user: UserInfo | None = None) -> None:
         async def fn(txn: Transaction):
             dent = await self._get_dent(txn, parent, name)
             if dent is None:
                 raise make_error(StatusCode.META_NOT_FOUND, name)
             await self._unlink_body(txn, parent, name, dent, recursive,
-                                    client_id, must_dir)
+                                    client_id, must_dir, user=user)
         result = await self._txn_idem(fn, "remove", client_id, request_id)
         self._emit(Ev.REMOVE, parent_id=parent, entry_name=name,
                    recursive_remove=recursive, client_id=client_id)
@@ -616,7 +710,8 @@ class MetaStore:
 
     async def rename_at(self, sparent: int, sname: str, dparent: int,
                         dname: str, client_id: str = "",
-                        request_id: str = "", flags: int = 0) -> None:
+                        request_id: str = "", flags: int = 0,
+                        user: UserInfo | None = None) -> None:
         """Entry-level rename; flags use the renameat2(2)/FUSE values
         (1 = RENAME_NOREPLACE: fail with EEXIST when dst exists;
         2 = RENAME_EXCHANGE: atomically swap the two entries)."""
@@ -625,7 +720,8 @@ class MetaStore:
             if sdent is None:
                 raise make_error(StatusCode.META_NOT_FOUND, sname)
             await self._rename_dispatch(txn, sparent, sname, sdent,
-                                        dparent, dname, client_id, flags)
+                                        dparent, dname, client_id, flags,
+                                        user=user)
         result = await self._txn_idem(fn, "rename", client_id, request_id)
         self._emit(Ev.RENAME, parent_id=sparent, entry_name=sname,
                    dst_parent_id=dparent, dst_entry_name=dname,
@@ -633,12 +729,17 @@ class MetaStore:
         return result
 
     async def open_inode(self, inode_id: int, write: bool = False,
-                         session_client: str = "") -> tuple[Inode, str]:
+                         session_client: str = "",
+                         user: UserInfo | None = None,
+                         rdwr: bool = False) -> tuple[Inode, str]:
         """FUSE open by nodeid: like open_file but without a path walk."""
         async def fn(txn: Transaction):
             inode = await self._require_inode(txn, inode_id)
             if inode.itype == InodeType.DIRECTORY and write:
                 raise make_error(StatusCode.META_IS_DIR, str(inode_id))
+            await self._check_access(txn, inode, user,
+                                     self._open_bits(write, rdwr),
+                                     str(inode_id))
             session_id = ""
             if write and session_client:
                 session_id = str(uuidlib.uuid4())
@@ -653,9 +754,11 @@ class MetaStore:
         return inode, session_id
 
     async def batch_stat(self, paths: list[str],
-                         follow: bool = True) -> list[Inode | None]:
+                         follow: bool = True,
+                         user: UserInfo | None = None) -> list[Inode | None]:
         """Stat many paths in ONE transaction (batchStatByPath,
-        fbs/meta/Service.h:718-741) — one snapshot, one round trip."""
+        fbs/meta/Service.h:718-741) — one snapshot, one round trip.
+        Permission-denied paths come back None, like not-found ones."""
         async def fn(txn: Transaction):
             out: list[Inode | None] = []
             for path in paths:
@@ -665,7 +768,8 @@ class MetaStore:
                             await self._require_inode(txn, ROOT_INODE_ID))
                         continue
                     _, _, dent = await self.resolve(txn, path,
-                                                    follow_last=follow)
+                                                    follow_last=follow,
+                                                    user=user)
                     out.append(None if dent is None else
                                await self._get_inode(txn, dent.inode_id))
                 except StatusError:
@@ -734,7 +838,8 @@ class MetaStore:
         return dropped
 
     async def _link_body(self, txn: Transaction, src_inode_id: int,
-                         parent: int, name: str, client_id: str) -> Inode:
+                         parent: int, name: str, client_id: str,
+                         user: UserInfo | None = None) -> Inode:
         """The single hardlink mutation rule, shared by the path op and the
         entry op.  POSIX: link() bumps the file's ctime ONLY (the data did
         not change — backup tools key on mtime)."""
@@ -744,6 +849,7 @@ class MetaStore:
         if await self._get_dent(txn, parent, name) is not None:
             raise make_error(StatusCode.META_EXISTS, name)
         await self._require_unlocked_dir(txn, parent, client_id, name)
+        await self._check_access(txn, parent, user, acl.W | acl.X, name)
         inode.nlink += 1
         inode.ctime = time.time()
         txn.set(Inode.key(src_inode_id), serde.dumps(inode))
@@ -752,28 +858,32 @@ class MetaStore:
         return inode
 
     async def hardlink(self, existing: str, new_path: str,
-                       client_id: str = "", request_id: str = "") -> Inode:
+                       client_id: str = "", request_id: str = "",
+                       user: UserInfo | None = None) -> Inode:
         async def fn(txn: Transaction):
-            _, _, src = await self.resolve(txn, existing)
+            _, _, src = await self.resolve(txn, existing, user=user)
             if src is None:
                 raise make_error(StatusCode.META_NOT_FOUND, existing)
-            parent, name, dent = await self.resolve(txn, new_path, follow_last=False)
+            parent, name, dent = await self.resolve(txn, new_path,
+                                                    follow_last=False,
+                                                    user=user)
             if dent is not None:
                 raise make_error(StatusCode.META_EXISTS, new_path)
             return await self._link_body(txn, src.inode_id, parent, name,
-                                         client_id)
+                                         client_id, user=user)
         inode = await self._txn_idem(fn, "hardlink", client_id, request_id)
         self._emit(Ev.HARDLINK, inode_id=inode.inode_id, entry_name=new_path,
                    nlink=inode.nlink, client_id=client_id)
         return inode
 
     async def link_at(self, inode_id: int, parent: int, name: str,
-                      client_id: str = "", request_id: str = "") -> Inode:
+                      client_id: str = "", request_id: str = "",
+                      user: UserInfo | None = None) -> Inode:
         """Entry-level hardlink (FUSE LINK: existing nodeid -> (parent,
         name)); shares the mutation rule with the path op."""
         async def fn(txn: Transaction):
             return await self._link_body(txn, inode_id, parent, name,
-                                         client_id)
+                                         client_id, user=user)
         inode = await self._txn_idem(fn, "link_at", client_id, request_id)
         self._emit(Ev.HARDLINK, inode_id=inode.inode_id, parent_id=parent,
                    entry_name=name, nlink=inode.nlink, client_id=client_id)
@@ -782,16 +892,17 @@ class MetaStore:
     async def _rename_dispatch(self, txn: Transaction, sparent: int,
                                sname: str, sdent: DirEntry, dparent: int,
                                dname: str, client_id: str,
-                               flags: int) -> None:
+                               flags: int,
+                               user: UserInfo | None = None) -> None:
         """Shared renameat2 flag dispatch for the path- and entry-level
         ops (one implementation owns the semantics)."""
         if flags == 2:
             await self._exchange_body(txn, sparent, sname, sdent,
-                                      dparent, dname, client_id)
+                                      dparent, dname, client_id, user=user)
         elif flags in (0, 1):
             await self._rename_body(txn, sparent, sname, sdent,
                                     dparent, dname, client_id,
-                                    no_replace=flags == 1)
+                                    no_replace=flags == 1, user=user)
         else:
             raise make_error(StatusCode.INVALID_ARG,
                              f"bad rename flags {flags:#x}")
@@ -812,10 +923,17 @@ class MetaStore:
 
     async def _rename_body(self, txn: Transaction, sparent: int, sname: str,
                            sdent: DirEntry, dparent: int, dname: str,
-                           client_id: str, no_replace: bool = False) -> None:
+                           client_id: str, no_replace: bool = False,
+                           user: UserInfo | None = None) -> None:
         await self._require_unlocked_dir(txn, sparent, client_id, sname)
         if dparent != sparent:
             await self._require_unlocked_dir(txn, dparent, client_id, dname)
+        # rename(2): removing the src entry needs W+X on its parent (+
+        # sticky); creating/overwriting dst needs W+X on the dst parent
+        await self._check_unlink_perm(txn, sparent, sdent, user, sname)
+        if dparent != sparent:
+            await self._check_access(txn, dparent, user, acl.W | acl.X,
+                                     dname)
         # the model fuzz review caught the missing walk silently orphaning
         # (and leaking) the whole subtree
         await self._require_no_cycle(
@@ -846,7 +964,8 @@ class MetaStore:
             elif sdent.itype == InodeType.DIRECTORY:
                 # POSIX: dir over non-dir is ENOTDIR
                 raise make_error(StatusCode.META_NOT_DIR, dname)
-            # overwrite: unlink destination
+            # overwrite: unlink destination (sticky rule applies to it)
+            await self._check_unlink_perm(txn, dparent, ddent, user, dname)
             await self._unlink_entry(txn, ddent)
         txn.clear(DirEntry.key(sparent, sname))
         txn.set(DirEntry.key(dparent, dname), serde.dumps(
@@ -858,7 +977,8 @@ class MetaStore:
 
     async def _exchange_body(self, txn: Transaction, sparent: int,
                              sname: str, sdent: DirEntry, dparent: int,
-                             dname: str, client_id: str) -> None:
+                             dname: str, client_id: str,
+                             user: UserInfo | None = None) -> None:
         """RENAME_EXCHANGE: atomically swap two existing entries (types may
         differ).  The VFS blocks ancestor/descendant exchanges on a real
         mount; the same EINVAL is enforced here for direct API callers."""
@@ -868,6 +988,9 @@ class MetaStore:
         ddent = await self._get_dent(txn, dparent, dname)
         if ddent is None:
             raise make_error(StatusCode.META_NOT_FOUND, dname)
+        # both entries move: W+X (+ sticky) on both parents
+        await self._check_unlink_perm(txn, sparent, sdent, user, sname)
+        await self._check_unlink_perm(txn, dparent, ddent, user, dname)
         if ddent.inode_id == sdent.inode_id:
             return                         # aliases of one inode: no-op
         for moved, new_parent in ((sdent, dparent), (ddent, sparent)):
@@ -888,16 +1011,21 @@ class MetaStore:
 
     async def rename(self, src: str, dst: str,
                      client_id: str = "", request_id: str = "",
-                     flags: int = 0) -> None:
+                     flags: int = 0, user: UserInfo | None = None) -> None:
         """Path-level rename; flags as in rename_at (renameat2 values:
         1 = NOREPLACE, 2 = EXCHANGE)."""
         async def fn(txn: Transaction):
-            sparent, sname, sdent = await self.resolve(txn, src, follow_last=False)
+            sparent, sname, sdent = await self.resolve(txn, src,
+                                                       follow_last=False,
+                                                       user=user)
             if sdent is None:
                 raise make_error(StatusCode.META_NOT_FOUND, src)
-            dparent, dname, _ = await self.resolve(txn, dst, follow_last=False)
+            dparent, dname, _ = await self.resolve(txn, dst,
+                                                   follow_last=False,
+                                                   user=user)
             await self._rename_dispatch(txn, sparent, sname, sdent,
-                                        dparent, dname, client_id, flags)
+                                        dparent, dname, client_id, flags,
+                                        user=user)
         result = await self._txn_idem(fn, "rename", client_id, request_id)
         self._emit(Ev.RENAME, entry_name=src, dst_entry_name=dst,
                    client_id=client_id)
@@ -920,29 +1048,37 @@ class MetaStore:
             txn.set(Inode.key(inode.inode_id), serde.dumps(inode))
 
     async def remove(self, path: str, recursive: bool = False,
-                     client_id: str = "", request_id: str = "") -> None:
+                     client_id: str = "", request_id: str = "",
+                     user: UserInfo | None = None) -> None:
         # recursive removal runs inside one txn (small trees); big trees
         # should go through trash + async GC
         async def fn(txn: Transaction):
-            parent, name, dent = await self.resolve(txn, path, follow_last=False)
+            parent, name, dent = await self.resolve(txn, path,
+                                                    follow_last=False,
+                                                    user=user)
             if dent is None:
                 raise make_error(StatusCode.META_NOT_FOUND, path)
             await self._unlink_body(txn, parent, name, dent, recursive,
-                                    client_id)
+                                    client_id, user=user)
         result = await self._txn_idem(fn, "remove", client_id, request_id)
         self._emit(Ev.REMOVE, entry_name=path, recursive_remove=recursive,
                    client_id=client_id)
         return result
 
     async def _remove_tree(self, txn: Transaction, dent: DirEntry,
-                           client_id: str = "") -> None:
+                           client_id: str = "",
+                           user: UserInfo | None = None) -> None:
         if dent.itype == InodeType.DIRECTORY:
             await self._require_unlocked_dir(txn, dent.inode_id, client_id,
                                              dent.name)
+            # recursive delete: every directory whose entries go needs W+X
+            # (rm -r semantics — one unwritable subdir fails the txn whole)
+            await self._check_access(txn, dent.inode_id, user,
+                                     acl.W | acl.X, dent.name)
             pre = DirEntry.prefix(dent.inode_id)
             for _, raw in await txn.get_range(pre, pre + b"\xff"):
                 child: DirEntry = serde.loads(raw)
-                await self._remove_tree(txn, child, client_id)
+                await self._remove_tree(txn, child, client_id, user=user)
                 txn.clear(DirEntry.key(child.parent, child.name))
         await self._unlink_entry(txn, dent)
 
@@ -964,13 +1100,32 @@ class MetaStore:
         inode.ctime = time.time()
         return inode
 
+    @staticmethod
+    def _check_setattr_perm(inode: Inode, user: UserInfo | None, *,
+                            perm, uid, gid, atime=None, mtime=None,
+                            path: str = "") -> None:
+        """setattr gate (reference SetAttr.h:76,99): chmod is owner-only;
+        chown follows chown(2) rules; explicit utimes are owner-only
+        unless the caller has W (the touch(1) rule)."""
+        if user is None or acl.is_root(user):
+            return
+        if perm is not None:
+            acl.check_owner(inode, user, "chmod", path)
+        acl.check_chown(inode, user, uid, gid, path)
+        if (atime is not None or mtime is not None) \
+                and user.uid != inode.uid:
+            acl.check(inode, user, acl.W, path)
+
     async def set_attr(self, path: str, *, perm: int | None = None,
-                       uid: int | None = None, gid: int | None = None) -> Inode:
+                       uid: int | None = None, gid: int | None = None,
+                       user: UserInfo | None = None) -> Inode:
         async def fn(txn: Transaction):
-            parent, name, dent = await self.resolve(txn, path)
+            parent, name, dent = await self.resolve(txn, path, user=user)
             if dent is None:
                 raise make_error(StatusCode.META_NOT_FOUND, path)
             inode = await self._require_inode(txn, dent.inode_id)
+            self._check_setattr_perm(inode, user, perm=perm, uid=uid,
+                                     gid=gid, path=path)
             self._apply_attrs(inode, perm=perm, uid=uid, gid=gid)
             txn.set(Inode.key(inode.inode_id), serde.dumps(inode))
             return inode
@@ -981,11 +1136,15 @@ class MetaStore:
                              uid: int | None = None,
                              gid: int | None = None,
                              atime: float | None = None,
-                             mtime: float | None = None) -> Inode:
+                             mtime: float | None = None,
+                             user: UserInfo | None = None) -> Inode:
         """Inode-addressed setattr (the FUSE lowlevel surface: chmod/chown/
         utimens arrive by nodeid, not path — reference FuseOps setattr)."""
         async def fn(txn: Transaction):
             inode = await self._require_inode(txn, inode_id)
+            self._check_setattr_perm(inode, user, perm=perm, uid=uid,
+                                     gid=gid, atime=atime, mtime=mtime,
+                                     path=str(inode_id))
             self._apply_attrs(inode, perm=perm, uid=uid, gid=gid,
                               atime=atime, mtime=mtime)
             txn.set(Inode.key(inode_id), serde.dumps(inode))
