@@ -107,13 +107,35 @@ class MgmtdClientForServer(MgmtdClient):
                  target_states: Callable[[], dict[int, LocalTargetState]],
                  client: Client | None = None,
                  heartbeat_period_s: float = 0.3,
-                 refresh_period_s: float = 0.5):
+                 refresh_period_s: float = 0.5,
+                 default_lease_s: float = 2.0):
         super().__init__(mgmtd_address, client, refresh_period_s)
         self.node = node
         self.target_states = target_states
         self.heartbeat_period_s = heartbeat_period_s
         self._hb_task: asyncio.Task | None = None
         self.last_heartbeat_ok: float = 0.0
+        # self-fencing state (reference: suicide.cc kills the process when
+        # mgmtd is unreachable for lease/2; t3fs demotes instead of dying):
+        # lease_s comes from mgmtd's heartbeat response, the monotonic
+        # stamp survives wall-clock jumps.  default_lease_s covers the
+        # restart-while-partitioned window: a node that has NEVER
+        # completed a heartbeat must still fence, or a head that crashes
+        # and restarts during a partition keeps acking on stale routing
+        # (defaults match mgmtd's heartbeat_timeout_s default of 2.0).
+        self.lease_s: float = 0.0
+        self.default_lease_s = default_lease_s
+        self._last_hb_mono: float = time.monotonic()
+
+    def fenced(self) -> bool:
+        """True when this node must stop serving writes: no successful
+        heartbeat for lease/2, so mgmtd may be about to (or already did)
+        hand our chain roles to someone else.  A node that keeps acking
+        in this state can lose acknowledged data — the chain_ver check
+        alone only protects clients with FRESH routing."""
+        lease = self.lease_s or self.default_lease_s
+        return (lease > 0
+                and time.monotonic() - self._last_hb_mono > lease / 2)
 
     async def heartbeat_once(self) -> bool:
         try:
@@ -123,6 +145,9 @@ class MgmtdClientForServer(MgmtdClient):
                              routing_version=self._routing.version),
                 timeout=5.0)
             self.last_heartbeat_ok = time.time()
+            self._last_hb_mono = time.monotonic()
+            if getattr(rsp, "lease_s", 0.0):
+                self.lease_s = rsp.lease_s
             if rsp.routing_version > self._routing.version:
                 await self.refresh()
             return True

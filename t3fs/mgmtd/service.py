@@ -48,6 +48,12 @@ class HeartbeatReq:
 class HeartbeatRsp:
     routing_version: int = 0
     primary: bool = True
+    # mgmtd's failure-detection window: the node self-fences (stops
+    # serving writes) when it hasn't completed a heartbeat for lease_s/2,
+    # so a partitioned stale head stops acking BEFORE mgmtd promotes a
+    # successor (reference: suicide at lease/2, src/common/utils/
+    # suicide.cc:7, docs/design_notes.md:177)
+    lease_s: float = 0.0
 
 
 @serde_struct
@@ -652,7 +658,8 @@ class MgmtdService:
                               or known.generation != req.node.generation):
             await st.save_node(reported)
             await st.load_routing()
-        return HeartbeatRsp(routing_version=st.routing().version), b""
+        return HeartbeatRsp(routing_version=st.routing().version,
+                            lease_s=st.cfg.heartbeat_timeout_s), b""
 
     @rpc_method
     async def get_routing_info(self, req: GetRoutingInfoReq, payload, conn):

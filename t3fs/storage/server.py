@@ -108,6 +108,9 @@ class StorageServer:
             heartbeat_period_s=self.heartbeat_period_s,
             refresh_period_s=self.heartbeat_period_s)
         await self.mgmtd.start()
+        # self-fencing: refuse writes once the mgmtd lease (reported in
+        # heartbeat responses) has lapsed for lease/2 — see suicide.cc
+        self.node.fence = self.mgmtd.fenced
         await self.resync.start()
         await self.check.start()
         await self.maintenance.start()

@@ -129,6 +129,13 @@ class StorageNode:
         # optional CriticalSectionAuditor (t3fs/testing/race.py §5.2 analog);
         # tests/sims set it to assert per-chunk mutual exclusion live
         self.audit = None
+        # self-fencing hook (() -> bool): wired to the mgmtd client's
+        # lease tracker by StorageServer; True = this node's mgmtd lease
+        # lapsed, refuse writes (reference: suicide.cc at lease/2)
+        self.fence: Callable[[], bool] | None = None
+
+    def fenced(self) -> bool:
+        return self.fence is not None and self.fence()
 
     def routing(self) -> RoutingInfo:
         return self._routing_provider()
@@ -277,6 +284,17 @@ class StorageService:
         trace_add("storage.update.enter", f"chunk={io.chunk_id}")
         if io.debug.server_should_fail():
             raise make_error(StatusCode.INTERNAL, "injected server error")
+        if node.fenced():
+            # self-fencing (reference suicide.cc at lease/2): our mgmtd
+            # lease lapsed, so routing may already name a new head for
+            # this chain — acking any write here could lose acknowledged
+            # data when the promoted chain diverges.  TARGET_OFFLINE is
+            # retryable: the client refreshes routing and lands on the
+            # live chain.  Reads keep serving (a stale read is bounded by
+            # the chain's committed prefix; a stale ACK is not).
+            raise make_error(
+                StatusCode.TARGET_OFFLINE,
+                f"node {node.node_id} self-fenced: mgmtd lease expired")
         chain, target = node._check_chain(io.chain_id, io.chain_ver,
                                           require_head=require_head)
         trace["target_id"] = target.target_id
