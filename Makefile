@@ -1,0 +1,38 @@
+# Developer targets.  `make sanitize` is the reference-parity TSan/ASan
+# pass over the native code (reference: tsan_suppressions.txt + CI TSan
+# suites): builds libt3fs_native with each sanitizer and runs the suites
+# that exercise the three native components (chunk engine WAL/snapshot,
+# usrbio shm rings, io_uring reader) with the sanitizer runtime
+# preloaded into python.
+
+PY ?= python
+TSAN_RT := $(shell g++ -print-file-name=libtsan.so)
+ASAN_RT := $(shell g++ -print-file-name=libasan.so)
+# "device"-codec params lazily import jax, whose nanobind bindings trip
+# the preloaded sanitizer runtimes — the sanitizer pass targets the
+# NATIVE code (engine WAL, usrbio rings, io_uring reader), so those
+# params are excluded (they run in the normal suite).
+SAN_TESTS := tests/test_native_engine.py tests/test_usrbio.py \
+             tests/test_engine_differential.py tests/test_chunk_engine.py \
+             tests/test_storage_service.py
+SAN_FILTER := -k "not device"
+
+.PHONY: test sanitize sanitize-thread sanitize-address
+
+test:
+	$(PY) -m pytest tests/ -x -q
+
+sanitize: sanitize-thread sanitize-address
+	@echo "sanitize: both passes clean"
+
+sanitize-thread:
+	T3FS_SANITIZE=thread $(PY) -m t3fs.native.build
+	T3FS_SANITIZE=thread LD_PRELOAD=$(TSAN_RT) \
+	  TSAN_OPTIONS="suppressions=$(CURDIR)/t3fs/native/tsan_suppressions.txt halt_on_error=1 report_signal_unsafe=0" \
+	  $(PY) -m pytest $(SAN_TESTS) $(SAN_FILTER) -x -q
+
+sanitize-address:
+	T3FS_SANITIZE=address $(PY) -m t3fs.native.build
+	T3FS_SANITIZE=address LD_PRELOAD=$(ASAN_RT) \
+	  ASAN_OPTIONS="detect_leaks=0 verify_asan_link_order=0 halt_on_error=1" \
+	  $(PY) -m pytest $(SAN_TESTS) $(SAN_FILTER) -x -q

@@ -21,6 +21,28 @@ _CXXFLAGS = ["-std=c++20", "-O2", "-g", "-fPIC", "-shared", "-Wall",
 if platform.machine() in ("x86_64", "AMD64"):
     _CXXFLAGS.append("-msse4.2")  # hw CRC32C; other arches use the sw path
 
+# Sanitizer builds (reference runs its suites under TSan —
+# tsan_suppressions.txt): T3FS_SANITIZE=thread|address switches the build
+# and the artifact name.  The sanitized .so needs the matching runtime
+# loaded FIRST in the process (python itself is uninstrumented), so test
+# runs set LD_PRELOAD=$(g++ -print-file-name=lib{tsan,asan}.so) — see
+# `make sanitize`.
+_SANITIZE = os.environ.get("T3FS_SANITIZE", "")
+
+
+def _flags_and_lib() -> tuple[list[str], str]:
+    if _SANITIZE and _SANITIZE not in ("thread", "address"):
+        # an unknown value must not silently build UNinstrumented code
+        # while the test harness believes it is in sanitizer mode
+        raise ValueError(
+            f"T3FS_SANITIZE={_SANITIZE!r}: use 'thread' or 'address'")
+    if _SANITIZE in ("thread", "address"):
+        flags = [f if f != "-O2" else "-O1" for f in _CXXFLAGS]
+        flags.append(f"-fsanitize={_SANITIZE}")
+        flags.append("-fno-omit-frame-pointer")
+        return flags, _LIB.replace(".so", f".{_SANITIZE[0]}san.so")
+    return _CXXFLAGS, _LIB
+
 
 def _sources() -> list[str]:
     return [os.path.join(_DIR, s) for s in _SOURCES
@@ -28,19 +50,20 @@ def _sources() -> list[str]:
 
 
 def build(force: bool = False) -> str:
+    flags, lib = _flags_and_lib()
     srcs = _sources()
-    if not force and os.path.exists(_LIB):
-        lib_mtime = os.path.getmtime(_LIB)
+    if not force and os.path.exists(lib):
+        lib_mtime = os.path.getmtime(lib)
         if all(os.path.getmtime(s) <= lib_mtime for s in srcs):
-            return _LIB
-    tmp = _LIB + f".tmp.{os.getpid()}"
-    cmd = ["g++", *_CXXFLAGS, "-o", tmp, *srcs]
+            return lib
+    tmp = lib + f".tmp.{os.getpid()}"
+    cmd = ["g++", *flags, "-o", tmp, *srcs]
     try:
         subprocess.run(cmd, check=True, capture_output=True, text=True)
     except subprocess.CalledProcessError as e:
         raise RuntimeError(f"native build failed:\n{e.stderr}") from e
-    os.replace(tmp, _LIB)
-    return _LIB
+    os.replace(tmp, lib)
+    return lib
 
 
 def load_library() -> ctypes.CDLL:

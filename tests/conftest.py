@@ -21,7 +21,13 @@ import os
 
 ON_DEVICE = bool(os.environ.get("T3FS_ON_DEVICE"))
 
-if not ON_DEVICE:
+# Sanitizer tier (`make sanitize`): ASan/TSan runtimes are LD_PRELOADed
+# into python, and jaxlib's nanobind bindings trip the interceptors
+# (__cxa_throw CHECK) — so the sanitizer pass, which targets the NATIVE
+# code only, must not initialize jax at all.
+SANITIZE = bool(os.environ.get("T3FS_SANITIZE"))
+
+if not ON_DEVICE and not SANITIZE:
     flags = os.environ.get("XLA_FLAGS", "")
     if "xla_force_host_platform_device_count" not in flags:
         os.environ["XLA_FLAGS"] = (
