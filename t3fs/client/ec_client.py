@@ -232,7 +232,8 @@ class ECStorageClient:
     async def _reconstruct_shards(self, layout: ECLayout, inode: int,
                                   stripe: int, want: tuple[int, ...],
                                   zero_shards: frozenset[int],
-                                  known: dict[int, bytes] | None = None
+                                  known: dict[int, bytes] | None = None,
+                                  prefer: tuple[int, ...] | None = None
                                   ) -> list[bytes]:
         """Fetch enough surviving shards (data we already have + parity +
         other data) and decode the wanted shard indices (0..k+m-1 space).
@@ -241,7 +242,12 @@ class ECStorageClient:
         (short stripe) — only those may be substituted with zeros on
         CHUNK_NOT_FOUND.  Any other missing shard counts as lost; silently
         zero-filling it would decode garbage and, on the repair path, write
-        that garbage back as if it were real (double-loss corruption)."""
+        that garbage back as if it were real (double-loss corruption).
+
+        `prefer` restricts the FAST pass to those survivor shard indices
+        (the repair planner's load-balanced k-pick); the patient retry
+        wave ignores it, so a failed preferred read degrades to extra IO,
+        never to a failed repair."""
         k, m, cs = layout.k, layout.m, layout.chunk_size
         known = dict(known or {})
         have: dict[int, np.ndarray] = {}
@@ -250,8 +256,12 @@ class ECStorageClient:
             buf[: len(content)] = np.frombuffer(content, dtype=np.uint8)
             have[j] = buf
 
+        # zero-hole shards bypass `prefer`: they cost no IO (substituted,
+        # never read) and the patient wave never materializes them
         need_more = [s for s in range(k + m)
-                     if s not in have and s not in want]
+                     if s not in have and s not in want
+                     and (prefer is None or s in prefer
+                          or s in zero_shards)]
         ios, ids = [], []
         for s in need_more:
             if s in zero_shards:
@@ -323,13 +333,19 @@ class ECStorageClient:
                                          stripe_len))[0]
 
     async def repair_stripe(self, layout: ECLayout, inode: int, stripe: int,
-                            shards: tuple[int, ...], stripe_len: int
+                            shards: tuple[int, ...], stripe_len: int,
+                            read_shards: tuple[int, ...] | None = None
                             ) -> list[IOResult]:
         """Repair ALL of a stripe's lost shards in one pass: survivors are
         read once and one decode produces every wanted shard (repairing a
         double loss shard-by-shard would read the k survivors twice and
         decode twice — the per-stripe batch halves recovery traffic, which
-        is the quantity the BIBD placement solver balances)."""
+        is the quantity the BIBD placement solver balances).
+
+        `read_shards` (RepairDriver's balanced pick) restricts the FAST
+        survivor pass to those shard indices — decode needs only k, and
+        which k determines where the read load lands.  Shortfalls still
+        fall through to the unrestricted patient wave."""
         k, cs = layout.k, layout.chunk_size
         lens = [max(0, min(cs, stripe_len - j * cs)) for j in range(k)]
         zero_shards = frozenset(j for j in range(k) if lens[j] == 0)
@@ -339,7 +355,9 @@ class ECStorageClient:
         holes = [s for s in shards if s in zero_shards]
         lost = tuple(s for s in shards if s not in zero_shards)
         rec = (await self._reconstruct_shards(layout, inode, stripe, lost,
-                                              zero_shards) if lost else [])
+                                              zero_shards,
+                                              prefer=read_shards)
+               if lost else [])
 
         async def write_back(shard: int, content: bytes) -> IOResult:
             cid = (layout.data_chunk(inode, stripe, shard) if shard < k

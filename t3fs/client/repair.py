@@ -54,27 +54,35 @@ class RepairDriver:
     """Schedules `ECStorageClient.repair_stripe` calls across many files,
     survivor-read-balanced."""
 
-    def __init__(self, ec: ECStorageClient, concurrency: int = 8):
+    def __init__(self, ec: ECStorageClient, concurrency: int = 8,
+                 initial_load: dict[int, int] | None = None):
         self.ec = ec
         self.concurrency = concurrency
+        # exact placement weights (mgmtd.placement.chain_recovery_weights):
+        # chains the failure already loaded (resync sources, degraded-read
+        # targets) start with their standing weight, so the survivor picks
+        # steer around them instead of discovering the hotspot online
+        self.initial_load = dict(initial_load or {})
 
-    @staticmethod
-    def plan(jobs: list[RepairJob]
-             ) -> tuple[list[tuple[RepairJob, int, list[int]]],
+    def plan(self, jobs: list[RepairJob]
+             ) -> tuple[list[tuple["RepairJob", int, tuple[int, ...]]],
                         list[tuple[int, int]]]:
-        """Order stripes so survivor reads spread evenly; returns
-        (ordered [(job, stripe, survivor_chains)], unrepairable
+        """Choose, per stripe, WHICH k survivors to read and in what
+        order, so survivor-read load stays flat across chains; returns
+        (ordered [(job, stripe, chosen_shard_indices)], unrepairable
         [(inode, stripe)] — stripes with NO surviving shard).
 
-        Greedy with a lazy-reevaluation heap: pop the stripe whose
-        survivor chains carry the least accumulated load (score = max
-        per-chain counter); a popped entry whose score went stale since
-        push is re-scored and re-pushed — O(P log P) typical instead of
+        Decode needs exactly k of the k+m-|lost| survivors — reading all
+        of them both wastes IO and concentrates load.  Each stripe takes
+        the k survivors whose chains carry the least accumulated load
+        (seeded from initial_load, the solver's exact weights).  Ordering
+        uses a lazy-reevaluation heap: a popped entry whose score went
+        stale is re-scored and re-pushed — O(P log P) typical instead of
         the naive O(P^2) scan, which would stall the event loop for
         minutes at cluster scale."""
         import heapq
 
-        pending: list[tuple[RepairJob, int, list[int]]] = []
+        pending: list[tuple[RepairJob, int, list[tuple[int, int]]]] = []
         unrepairable: list[tuple[int, int]] = []
         for job in jobs:
             for stripe, lost in sorted(job.losses.items()):
@@ -82,33 +90,38 @@ class RepairDriver:
                     continue
                 lay = job.layout
                 lost_set = set(lost)
-                # _reconstruct_shards fetches EVERY survivor (decode picks
-                # k of them); read load lands on all of their chains
-                survivors = [lay.shard_chain(stripe, s)
+                survivors = [(s, lay.shard_chain(stripe, s))
                              for s in range(lay.k + lay.m)
                              if s not in lost_set]
                 if not survivors:
                     unrepairable.append((job.inode, stripe))
                     continue
                 pending.append((job, stripe, survivors))
-        load: dict[int, int] = defaultdict(int)
+        load: dict[int, int] = defaultdict(int, self.initial_load)
 
-        def score(entry) -> int:
-            return max(load[c] for c in entry[2])
+        def choose(entry) -> tuple[list[tuple[int, int]], int]:
+            """k least-loaded survivors (all of them when fewer than k
+            survive — the decode needs everything it can get) and the
+            resulting score."""
+            k = entry[0].layout.k
+            ranked = sorted(entry[2], key=lambda sc: (load[sc[1]], sc[1]))
+            chosen = ranked[:k]
+            return chosen, max(load[c] for _s, c in chosen)
 
         heap = [(0, i) for i in range(len(pending))]
         heapq.heapify(heap)
-        ordered: list[tuple[RepairJob, int, list[int]]] = []
+        ordered: list[tuple[RepairJob, int, tuple[int, ...]]] = []
         while heap:
             s, i = heapq.heappop(heap)
-            cur = score(pending[i])
+            chosen, cur = choose(pending[i])
             if cur != s:
                 heapq.heappush(heap, (cur, i))   # stale: re-score
                 continue
-            entry = pending[i]
-            for c in entry[2]:
+            job, stripe, _survivors = pending[i]
+            for _shard, c in chosen:
                 load[c] += 1
-            ordered.append(entry)
+            ordered.append((job, stripe,
+                            tuple(shard for shard, _c in chosen)))
         return ordered, unrepairable
 
     async def run(self, jobs: list[RepairJob]) -> RepairReport:
@@ -118,18 +131,30 @@ class RepairDriver:
         for inode, stripe in unrepairable:
             log.warning("repair inode %d stripe %d: no surviving shards",
                         inode, stripe)
+        # PLANNED survivor reads per chain (a failed preferred read falls
+        # through to the patient wave and may touch other chains; zero-
+        # hole shards substitute for free — the metric reflects the plan,
+        # which is what the balancer controls).  Every candidate survivor
+        # chain starts at 0 so a chain the picker left idle shows up in
+        # min_chain_reads instead of being silently excluded.
         chain_reads: dict[int, int] = defaultdict(int)
+        for job, stripe, _chosen in ordered:
+            lost_set = set(job.losses[stripe])
+            for s in range(job.layout.k + job.layout.m):
+                if s not in lost_set:
+                    chain_reads[job.layout.shard_chain(stripe, s)] += 0
         sem = asyncio.Semaphore(self.concurrency)
 
         async def one(job: RepairJob, stripe: int,
-                      survivors: list[int]) -> None:
+                      read_shards: tuple[int, ...]) -> None:
             lost = job.losses[stripe]
             async with sem:
                 try:
                     results = await self.ec.repair_stripe(
                         job.layout, job.inode, stripe, lost,
                         stripe_len=job.stripe_len_of.get(
-                            stripe, job.layout.k * job.layout.chunk_size))
+                            stripe, job.layout.k * job.layout.chunk_size),
+                        read_shards=read_shards)
                 except Exception as e:
                     log.warning("repair inode %d stripe %d failed: %s",
                                 job.inode, stripe, e)
@@ -139,8 +164,8 @@ class RepairDriver:
                        for r in results):
                     report.repaired_stripes += 1
                     report.repaired_shards += len(lost)
-                    for c in survivors:      # the set the planner balanced
-                        chain_reads[c] += 1
+                    for s in read_shards:    # the set the planner balanced
+                        chain_reads[job.layout.shard_chain(stripe, s)] += 1
                 else:
                     report.failed.append((job.inode, stripe))
 

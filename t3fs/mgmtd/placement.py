@@ -203,6 +203,24 @@ def build_chain_table(num_nodes: int, num_chains: int, replicas: int,
     return assignment
 
 
+def chain_recovery_weights(routing: RoutingInfo,
+                           failed_nodes: set[int]) -> dict[int, int]:
+    """Per-chain STANDING recovery load implied by the placement when
+    `failed_nodes` are down: each chain is weighted by how many of its
+    member targets live on failed nodes (those chains are sourcing
+    resync/degraded traffic already).  The EC repair planner seeds its
+    survivor-pick counters with these exact weights instead of starting
+    from zero, so stripe repairs steer AROUND chains the failure already
+    loaded (the solver's pair-count objective, applied at repair time)."""
+    weights: dict[int, int] = {}
+    for cid, chain in routing.chains.items():
+        w = sum(1 for t in chain.targets
+                if t.node_id in failed_nodes)
+        if w:
+            weights[cid] = w
+    return weights
+
+
 def recovery_imbalance(assignment: list[list[int]], num_nodes: int) -> float:
     """max over failed nodes of (max peer recovery share / mean share);
     1.0 = perfectly balanced reconstruction traffic."""

@@ -113,8 +113,13 @@ class LocalCluster:
             self.mc = MetaClient([self.meta_rpc.address])
 
     async def start_storage_node(self, node_id: int) -> StorageServer:
+        # heartbeat at timeout/6: the lease/2 self-fence then has ~3
+        # heartbeat periods of margin (the production ratio) — one stalled
+        # loop iteration must not spuriously fence every node in a test
         ss = StorageServer(node_id, self.mgmtd_rpc.address,
-                           heartbeat_period_s=0.15, resync_period_s=0.1)
+                           heartbeat_period_s=min(
+                               0.15, self.mgmtd_cfg.heartbeat_timeout_s / 6),
+                           resync_period_s=0.1)
         try:
             for c in range(self.num_chains):
                 # every node pre-creates targets for chains it may serve

@@ -205,14 +205,22 @@ def test_partitioned_head_self_fences():
             # reachable by clients — the dangerous half-partition)
             head = cluster.storage[1]
             assert head.mgmtd.lease_s > 0          # lease learned via hb
+            import time as _t
+            t_cut = _t.monotonic()
             head.mgmtd._hb_task.cancel()
 
-            # within lease/2 (0.6s) the node must fence itself
+            # the node must fence itself BEFORE the failure-detection
+            # window (1.2s) elapses — i.e. before mgmtd could possibly
+            # have promoted a successor.  Measuring wall time (not just
+            # "eventually fenced") is what actually pins the lease/2
+            # property: a regression to lease*2 would fence too late and
+            # fail here.
             await wait_for(lambda: head.node.fenced(), timeout=5.0,
                            desc="head self-fence")
-            # ...and that is BEFORE mgmtd would declare it dead: the
-            # fence window is half the failure-detection window
-            assert head.mgmtd.lease_s / 2 < cluster.mgmtd_cfg.heartbeat_timeout_s
+            fenced_after = _t.monotonic() - t_cut
+            assert fenced_after < cluster.mgmtd_cfg.heartbeat_timeout_s, \
+                f"fenced after {fenced_after:.2f}s — later than the " \
+                f"{cluster.mgmtd_cfg.heartbeat_timeout_s}s promotion window"
 
             # a write sent straight at the stale head is refused
             from t3fs.storage.types import ChunkId, UpdateIO, UpdateType
