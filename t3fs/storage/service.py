@@ -34,7 +34,7 @@ from t3fs.storage.types import (
     QueryChunkReq, QueryChunkRsp, QueryLastChunkReq, QueryLastChunkRsp,
     ReadIO, RemoveChunksReq, SpaceInfoRsp, SyncDoneReq, SyncDoneRsp,
     SyncStartReq, SyncStartRsp, TargetOpReq, TargetOpRsp, TruncateChunkReq,
-    UpdateIO, UpdateType, WriteReq, WriteRsp,
+    UpdateIO, UpdateType, WriteReq, WriteRsp, pack_ioresults, unpack_readios,
 )
 from t3fs.analytics.trace_log import StorageEventTrace
 from t3fs.utils.fault_injection import fault_raise
@@ -457,6 +457,7 @@ class StorageService:
             raise make_error(StatusCode.INTERNAL, "injected server error")
         if node._read_sem is None:
             node._read_sem = asyncio.Semaphore(node.read_concurrency)
+        ios = unpack_readios(req.packed_ios) if req.packed_ios else req.ios
 
         async def one(io: ReadIO) -> tuple[IOResult, bytes | None]:
             node.read_count.add()
@@ -492,9 +493,14 @@ class StorageService:
                 return (IOResult(WireStatus(int(e.code), str(e))),
                         None if io.buf is not None else b"")
 
-        pairs = await asyncio.gather(*(one(io) for io in req.ios))
+        pairs = await asyncio.gather(*(one(io) for io in ios))
         results = [r for r, _ in pairs]
         inline_parts = [d for _, d in pairs if d is not None]
+        if req.want_packed:
+            packed = pack_ioresults(results)
+            if packed is not None:
+                return (BatchReadRsp(packed_results=packed),
+                        b"".join(inline_parts))
         return BatchReadRsp(results=results), b"".join(inline_parts)
 
     # ---- metadata-ish ops ----

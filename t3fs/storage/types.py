@@ -119,6 +119,14 @@ class BatchReadReq:
     ios: list[ReadIO] = field(default_factory=list)
     inline: bool = False
     debug: DebugFlags = field(default_factory=DebugFlags)
+    # packed fast path (append-only fields): the KVCache-style small-IO
+    # batches are IOPS-bound on serde CPU — a 32-IO batch is ~70 nested
+    # structs each way through the tag-walking codec.  packed_ios is the
+    # same list as ONE fixed-stride blob (pack_readios); want_packed asks
+    # the server to answer in kind, so old clients/servers interop: an
+    # old client never sets it, an old server ignores both fields.
+    packed_ios: bytes = b""
+    want_packed: bool = False
 
 
 @serde_struct
@@ -127,6 +135,9 @@ class BatchReadRsp:
     results: list[IOResult] = field(default_factory=list)
     # inline payloads are concatenated in the frame payload, per-IO lengths
     # in results[i].length
+    # packed IOResults (pack_ioresults; only when the request set
+    # want_packed and no result carries an error message)
+    packed_results: bytes = b""
 
 
 @serde_struct
@@ -243,3 +254,59 @@ class SyncDoneReq:
 @dataclass
 class SyncDoneRsp:
     ok: bool = True
+
+
+# ---- packed batch-IO fast path (see BatchReadReq.packed_ios) ----
+
+# inode/index are UNSIGNED 64-bit (KVCache derives inodes from hashes
+# with the top bit set; EC parity uses bit 62)
+_IORESULT_FMT = struct.Struct("<6q")            # code len uv cv ccv crc
+_READIO_FMT = struct.Struct("<2Q3q3B")          # inode idx chain off len +flags
+
+
+def pack_ioresults(results: list[IOResult]) -> bytes | None:
+    """Fixed-stride encoding of a result list; None when any result
+    carries an error message (the detail must survive, so those batches
+    stay on the struct path)."""
+    out = bytearray()
+    pack = _IORESULT_FMT.pack
+    try:
+        for r in results:
+            if r.status.message:
+                return None
+            out += pack(r.status.code, r.length, r.update_ver, r.commit_ver,
+                        r.commit_chain_ver, r.checksum)
+    except struct.error:
+        return None     # out-of-range field: the struct path handles it
+    return bytes(out)
+
+
+def unpack_ioresults(blob: bytes) -> list[IOResult]:
+    return [IOResult(WireStatus(code), length, uv, cv, ccv, crc)
+            for code, length, uv, cv, ccv, crc
+            in _IORESULT_FMT.iter_unpack(blob)]
+
+
+def pack_readios(ios: list[ReadIO]) -> bytes | None:
+    """Fixed-stride encoding of a read batch; None when any IO carries a
+    RemoteBuf (buf-push IOs need the full struct)."""
+    out = bytearray()
+    pack = _READIO_FMT.pack
+    try:
+        for io in ios:
+            if io.buf is not None:
+                return None
+            out += pack(io.chunk_id.inode, io.chunk_id.index, io.chain_id,
+                        io.offset, io.length,
+                        io.verify_checksum, io.allow_uncommitted,
+                        io.no_payload)
+    except struct.error:
+        return None     # out-of-range field: the struct path handles it
+    return bytes(out)
+
+
+def unpack_readios(blob: bytes) -> list[ReadIO]:
+    return [ReadIO(ChunkId(inode, idx), chain, off, length, None,
+                   bool(vc), bool(au), bool(np_))
+            for inode, idx, chain, off, length, vc, au, np_
+            in _READIO_FMT.iter_unpack(blob)]

@@ -167,3 +167,131 @@ def test_remote_buf_pooled_writes():
         finally:
             await fabric.stop()
     run(body())
+
+
+def test_batch_read_packed_fast_path_roundtrip():
+    """The packed batch encoding must be byte-accurate both ways, fall
+    back for RemoteBuf/overflow IOs, and interop with the struct path
+    (r3 perf work — see docs/perf_multiprocess.md)."""
+    from t3fs.storage.types import (
+        ChunkId, IOResult, ReadIO, pack_ioresults, pack_readios,
+        unpack_ioresults, unpack_readios,
+    )
+    from t3fs.net.wire import WireStatus
+
+    ios = [ReadIO(ChunkId((1 << 63) | 7, i), 3, i * 512, 16384,
+                  verify_checksum=(i % 2 == 0), no_payload=(i == 5))
+           for i in range(32)]
+    blob = pack_readios(ios)
+    assert blob is not None and unpack_readios(blob) == ios
+
+    # RemoteBuf forces the struct path
+    from t3fs.net.rdma import RemoteBuf
+    ios2 = list(ios)
+    ios2[3] = ReadIO(ChunkId(1, 1), 1, 0, 16, buf=RemoteBuf())
+    assert pack_readios(ios2) is None
+
+    rs = [IOResult(WireStatus(0), 16384, 2, 2, 1, 0xFFFFFFFF)
+          for _ in range(32)]
+    blob2 = pack_ioresults(rs)
+    assert blob2 is not None and unpack_ioresults(blob2) == rs
+    # an error message must survive -> struct path
+    rs[9] = IOResult(WireStatus(5001, "chunk not found"))
+    assert pack_ioresults(rs) is None
+
+
+def test_batch_read_uses_packed_wire_path():
+    """End-to-end: the client sends packed_ios and the server answers
+    packed_results on a clean batch; a batch with an error message falls
+    back to the struct list transparently."""
+    import asyncio as _a
+
+    from t3fs.storage.types import BatchReadRsp
+    from t3fs.testing.fabric import StorageFabric
+    from t3fs.client.layout import FileLayout
+
+    async def body():
+        fab = StorageFabric(num_nodes=3, replicas=3)
+        await fab.start()
+        try:
+            from t3fs.client.storage_client import StorageClient
+            sc = StorageClient(lambda: fab.routing, client=fab.client)
+            lay = FileLayout(chunk_size=16384, chains=[fab.chain_id])
+            data = bytes(range(256)) * 256          # 4 chunks
+            await sc.write_file_range(lay, 77, 0, data)
+
+            # spy on the RPC client to assert the wire shape
+            seen = {}
+            orig_call = fab.client.call
+
+            async def spy_call(addr, method, req=None, **kw):
+                rsp, payload = await orig_call(addr, method, req, **kw)
+                if method == "Storage.batch_read":
+                    seen["req_packed"] = bool(req.packed_ios)
+                    seen["rsp_packed"] = bool(
+                        isinstance(rsp, BatchReadRsp) and rsp.packed_results)
+                return rsp, payload
+            fab.client.call = spy_call
+
+            got, results = await sc.read_file_range(lay, 77, 0, len(data))
+            assert got == data
+            assert seen == {"req_packed": True, "rsp_packed": True}, seen
+
+            # a read of a missing chunk produces an error message ->
+            # struct-path response; the client still decodes it fine
+            from t3fs.storage.types import ReadIO, ChunkId
+            res, _ = await sc.batch_read(
+                [ReadIO(ChunkId(9999, 0), fab.chain_id, 0, 4096)])
+            assert res[0].status.code != 0
+            assert seen["rsp_packed"] is False
+        finally:
+            await fab.stop()
+    _a.run(body())
+
+
+def test_batch_read_packed_interop_with_old_server():
+    """A server that predates the packed encoding drops the unknown
+    fields and answers an empty batch; the client must detect this,
+    re-send on the struct path, and memoize the address (code-review r3:
+    the first cut silently failed the whole batch)."""
+    import asyncio as _a
+
+    async def body():
+        from t3fs.testing.fabric import StorageFabric
+        from t3fs.client.storage_client import StorageClient
+        from t3fs.client.layout import FileLayout
+        fab = StorageFabric(num_nodes=1, replicas=1)
+        await fab.start()
+        try:
+            sc = StorageClient(lambda: fab.routing, client=fab.client)
+            lay = FileLayout(chunk_size=16384, chains=[fab.chain_id])
+            data = bytes(range(256)) * 128
+            await sc.write_file_range(lay, 5, 0, data)
+
+            # emulate an OLD server: its serde drops the unknown packed
+            # fields, so it sees ios=[] and answers results=[]
+            orig_call = fab.client.call
+            calls = []
+
+            async def old_server_call(addr, method, req=None, **kw):
+                if method == "Storage.batch_read":
+                    calls.append(bool(req.packed_ios))
+                    if req.packed_ios:
+                        req.packed_ios = b""
+                        req.want_packed = False
+                return await orig_call(addr, method, req, **kw)
+            fab.client.call = old_server_call
+
+            got, results = await sc.read_file_range(lay, 5, 0, len(data))
+            assert got == data
+            assert all(r.status.code == 0 for r in results)
+            # first attempt was packed, fallback was struct, and the
+            # address is memoized so later reads skip packing entirely
+            assert calls[0] is True and calls[1] is False
+            n = len(calls)
+            got2, _ = await sc.read_file_range(lay, 5, 0, len(data))
+            assert got2 == data
+            assert all(c is False for c in calls[n:])
+        finally:
+            await fab.stop()
+    _a.run(body())
