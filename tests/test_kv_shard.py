@@ -789,3 +789,60 @@ def test_durable_2pc_push_resolution_beats_poll():
             await ship.close()
             dec_svc.stop_decision_gc(); lag_svc.stop_decision_gc()
     run(body())
+
+
+def test_2pc_slow_coordinator_races_prepare_expiry():
+    """VERDICT r2 weak #7: a SLOW-but-alive coordinator whose phase 2
+    lands after server-side prepare expiry.  Expiry aborts the (still
+    committable) txn by design — what must hold is that the coordinator
+    LEARNS the abort (definitive error, not a silent tear), no shard
+    applied its slice, and both shards converge with free locks."""
+    async def body():
+        kv, services, cleanup = await _mk_sharded(b"m",
+                                                  prepare_timeout_s=0.3)
+        try:
+            from t3fs.kv.service import KvCommitReq, KvFinishReq, KvPrepareReq
+            dec_addrs = kv.map.ranges[0].addresses
+            mk = lambda k, v: KvCommitReq(write_keys=[k], write_values=[v],
+                                          write_deletes=[False])
+            # phase 1 on both shards (decider first, like the coordinator)
+            await kv.groups[0]._call("Kv.prepare", KvPrepareReq(
+                txn_id="t-slow", body=mk(b"a", b"1"),
+                decider=dec_addrs, is_decider=True,
+                participants=[list(kv.map.ranges[i].addresses)
+                              for i in range(2)]))
+            await kv.groups[1]._call("Kv.prepare", KvPrepareReq(
+                txn_id="t-slow", body=mk(b"z", b"2"),
+                decider=dec_addrs, is_decider=False))
+            # the coordinator stalls PAST the server-side expiry
+            await asyncio.sleep(1.0)
+            # late phase 2: the decider already tombstone-aborted — the
+            # coordinator must get a DEFINITIVE refusal
+            with pytest.raises(StatusError) as ei:
+                await kv.groups[0]._call("Kv.commit_prepared",
+                                         KvFinishReq(txn_id="t-slow"))
+            assert ei.value.code == StatusCode.KV_TXN_NOT_FOUND
+            # decider verdict is a durable ABORT tombstone
+            from t3fs.kv.service import KvDecisionReq
+            rsp = await kv.groups[0]._call(
+                "Kv.get_decision", KvDecisionReq(txn_id="t-slow"))
+            assert rsp.decision == "A"
+            # nothing applied anywhere; locks free; new txns flow
+            async def wait_clean():
+                while True:
+                    t = kv.transaction()
+                    if await t.get(b"a") is None and await t.get(b"z") is None:
+                        return
+                    await asyncio.sleep(0.05)
+            await asyncio.wait_for(wait_clean(), timeout=5.0)
+
+            async def w(txn):
+                txn.set(b"after", b"1")
+                txn.set(b"zafter", b"2")
+            await asyncio.wait_for(with_transaction(kv, w), timeout=5.0)
+            t = kv.transaction()
+            assert await t.get(b"after") == b"1"
+            assert await t.get(b"zafter") == b"2"
+        finally:
+            await cleanup()
+    run(body())
