@@ -213,6 +213,79 @@ async def kv_status(ctx: AdminContext, args) -> None:
             print(f"{addr}: unreachable ({e.code.name})")
 
 
+@command("kv-publish-map", "bootstrap the versioned shard map from a "
+                           "shards spec (group;hexsplit;group;...)")
+@args_(("spec", {"help": "same grammar as the 'shards:' engine spec, "
+                         "e.g. 'h1:1,h2:1;494e4f44;h3:1'"}))
+async def kv_publish_map(ctx: AdminContext, args) -> None:
+    from t3fs.kv.shard import KEY_MAX, ShardMap, ShardRange
+    from t3fs.kv.surgery import ShardAdmin
+    parts = args.spec.split(";")
+    if len(parts) % 2 != 1:
+        raise SystemExit("spec must alternate group;splitkey;group;...")
+    groups = [p.split(",") for p in parts[0::2]]
+    splits = [bytes.fromhex(p) for p in parts[1::2]]
+    bounds = [b""] + splits + [KEY_MAX]
+    m = ShardMap(ranges=[ShardRange(bounds[i], bounds[i + 1], groups[i])
+                         for i in range(len(groups))], version=1)
+    admin = ShardAdmin(groups[0], client=ctx.cli)
+    try:
+        cur = await admin.load_map()
+        raise SystemExit(f"map already published (v{cur.version}); "
+                         f"surgery commands evolve it from here")
+    except StatusError as e:
+        if e.code != StatusCode.NOT_FOUND:
+            raise
+    await admin.publish_map(m)
+    print(f"published shard map v1: {len(m.ranges)} ranges "
+          f"(map home {groups[0]})")
+
+
+@command("kv-map", "show the published shard map (map home group)")
+@args_(("map_home", {"nargs": "+", "help": "map-home group addresses"}))
+async def kv_map(ctx: AdminContext, args) -> None:
+    from t3fs.kv.surgery import ShardAdmin
+    admin = ShardAdmin(list(args.map_home), client=ctx.cli)
+    m = await admin.load_map()
+    print(f"shard map v{m.version}: {len(m.ranges)} ranges")
+    for r in m.ranges:
+        print(f"  [{r.begin!r}, {r.end!r}) -> {', '.join(r.addresses)}")
+
+
+@command("kv-split", "split the shard range containing KEY in place")
+@args_(("key", {"help": "split key (becomes a range boundary)"}),
+       ("map_home", {"nargs": "+", "help": "map-home group addresses"}))
+async def kv_split(ctx: AdminContext, args) -> None:
+    from t3fs.kv.surgery import ShardAdmin
+    admin = ShardAdmin(list(args.map_home), client=ctx.cli)
+    m = await admin.split(args.key.encode())
+    print(f"map v{m.version}: {len(m.ranges)} ranges")
+
+
+@command("kv-move", "move the exact shard range [BEGIN,END) to a group")
+@args_(("begin", {"help": "range begin (must be a map boundary)"}),
+       ("end", {"help": "range end ('MAX' for keyspace end)"}),
+       ("to", {"nargs": "+", "help": "target group addresses"}),
+       ("--map-home", {"nargs": "+", "required": True,
+                       "help": "map-home group addresses"}))
+async def kv_move(ctx: AdminContext, args) -> None:
+    from t3fs.kv.shard import KEY_MAX
+    from t3fs.kv.surgery import ShardAdmin
+    admin = ShardAdmin(list(args.map_home), client=ctx.cli)
+    end = KEY_MAX if args.end == "MAX" else args.end.encode()
+    m = await admin.move(args.begin.encode(), end, list(args.to))
+    print(f"moved; map v{m.version}")
+
+
+@command("kv-move-resume", "finish a shard move whose driver died")
+@args_(("map_home", {"nargs": "+", "help": "map-home group addresses"}))
+async def kv_move_resume(ctx: AdminContext, args) -> None:
+    from t3fs.kv.surgery import ShardAdmin
+    admin = ShardAdmin(list(args.map_home), client=ctx.cli)
+    m = await admin.resume()
+    print(f"resumed; map v{m.version}" if m else "no pending move intent")
+
+
 @command("enable-node", "re-enable an administratively disabled node")
 @args_(("node_id", {"type": int}))
 async def enable_node(ctx: AdminContext, args) -> None:

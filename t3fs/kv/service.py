@@ -117,6 +117,35 @@ class KvOkRsp:
 
 @serde_struct
 @dataclass
+class KvShardRangeReq:
+    """Shard-surgery range ops (kv/surgery.py): freeze/unfreeze,
+    delete_range."""
+    begin: bytes = b""
+    end: bytes = b""
+    ttl_s: float = 30.0            # shard_freeze: auto-expiry bound
+
+
+@serde_struct
+@dataclass
+class KvShardOwnedReq:
+    """Replace this group's owned-range list wholesale (idempotent — the
+    mover recomputes the full list from the target map on every run).
+    An EMPTY list means "owns nothing" (fully drained group); a group
+    with NO owned record at all is unrestricted (pre-surgery)."""
+    begins: list[bytes] = field(default_factory=list)
+    ends: list[bytes] = field(default_factory=list)
+
+
+@serde_struct
+@dataclass
+class KvShardLoadReq:
+    """Bulk row ingest during a move (bypasses owned/frozen gates)."""
+    keys: list[bytes] = field(default_factory=list)
+    values: list[bytes] = field(default_factory=list)
+
+
+@serde_struct
+@dataclass
 class KvPrepareReq:
     """2PC phase 1: one shard's slice of a cross-shard transaction.
 
@@ -194,6 +223,14 @@ class KvService:
         self._gc_task: asyncio.Task | None = None
         self.replicated = 0             # observability
         self.snapshots_pushed = 0
+        # shard surgery state (kv/surgery.py): owned ranges + freeze are
+        # DURABLE (replicated records) so a restart/failover mid-move
+        # keeps refusing what it must.  "unloaded" = lazy (from the
+        # engine); None = no record, unrestricted; [] = owns NOTHING
+        # (a fully-drained group) — the two must not be conflated or a
+        # drained source silently reverts to accepting everything.
+        self._owned: list | None | str = "unloaded"
+        self._frozen: tuple[bytes, bytes, float] | None | str = "unloaded"
 
     def ensure_decision_gc(self) -> None:
         """Start the decision-record GC loop (primary-only duty); called at
@@ -235,6 +272,7 @@ class KvService:
     @rpc_method
     async def read(self, req: KvReadReq, payload, conn):
         self._require_primary()
+        self._check_read_owned(req.keys)
         ver = req.version if req.version >= 0 \
             else self.engine.current_version()
         values, found = [], []
@@ -247,11 +285,184 @@ class KvService:
     @rpc_method
     async def read_range(self, req: KvRangeReq, payload, conn):
         self._require_primary()
+        self._check_range_owned(req.begin, req.end)
         ver = req.version if req.version >= 0 \
             else self.engine.current_version()
         rows = self.engine.range_at(req.begin, req.end, ver, req.limit)
         return KvRangeRsp(version=ver, keys=[k for k, _ in rows],
                           values=[v for _, v in rows]), b""
+
+    # ---- shard surgery: durable owned ranges + freeze (kv/surgery.py) ----
+    # A group refuses keys outside its owned ranges (KV_WRONG_SHARD: the
+    # client's shard map is stale) and mutations into a frozen range
+    # (KV_SHARD_FROZEN: a move is copying it).  Both records replicate
+    # like data, so a promoted follower keeps enforcing them — without
+    # that, a failover between a move's snapshot and its map flip would
+    # accept writes the copied snapshot does not contain.
+
+    OWNED_KEY = b"\x00t3fsshard\x00owned"
+    FROZEN_KEY = b"\x00t3fsshard\x00frozen"
+
+    def _shard_state(self) -> None:
+        if self._owned == "unloaded":
+            raw = self.engine.read_at(self.OWNED_KEY,
+                                      self.engine.current_version())
+            self._owned = serde.loads(raw) if raw is not None else None
+        if self._frozen == "unloaded":
+            raw = self.engine.read_at(self.FROZEN_KEY,
+                                      self.engine.current_version())
+            self._frozen = tuple(serde.loads(raw)) if raw else None
+
+    def _owns(self, key: bytes) -> bool:
+        if key.startswith(b"\x00"):
+            return True                    # internal bookkeeping namespace
+        if self._owned is None:
+            return True                    # no restriction recorded
+        return any(b <= key < e for b, e in self._owned)
+
+    def _frozen_hit(self, key: bytes) -> bool:
+        fr = self._frozen
+        if fr is None or key.startswith(b"\x00"):
+            return False
+        b, e, deadline = fr
+        import time as _time
+        if _time.time() > deadline:
+            self._frozen = None            # TTL lapsed (record GC'd lazily)
+            return False
+        return b <= key < e
+
+    def _check_shard_gates(self, txn: Transaction) -> None:
+        """Refuse mutations that a stale shard map or an in-flight move
+        must not accept.  Reads are NOT gated here (they are gated in the
+        read RPCs against owned only — frozen ranges still serve)."""
+        self._shard_state()
+        for k in txn._writes:
+            if not self._owns(k):
+                raise make_error(StatusCode.KV_WRONG_SHARD,
+                                 f"key {k!r} not owned by this group")
+            if self._frozen_hit(k):
+                raise make_error(StatusCode.KV_SHARD_FROZEN,
+                                 f"key {k!r} frozen for an in-flight move")
+        for b, e in txn._range_clears:
+            # a clear must be FULLY owned (checking only its begin would
+            # let a stale client's wide clear half-apply) and must not
+            # OVERLAP a frozen range anywhere (a clear starting before
+            # the frozen begin would delete already-copied rows, which
+            # then resurrect on the move target after the flip)
+            self._check_range_owned(b, e)
+            fr = self._frozen
+            if fr is not None and not b.startswith(b"\x00"):
+                fb, fe, _dl = fr
+                if b < fe and fb < e and self._frozen_hit(fb):
+                    raise make_error(
+                        StatusCode.KV_SHARD_FROZEN,
+                        f"clear [{b!r},{e!r}) overlaps the frozen range")
+
+    def _check_read_owned(self, keys) -> None:
+        self._shard_state()
+        for k in keys:
+            if not self._owns(k):
+                raise make_error(StatusCode.KV_WRONG_SHARD,
+                                 f"key {k!r} not owned by this group")
+
+    def _check_range_owned(self, begin: bytes, end: bytes) -> None:
+        """The whole requested range must sit inside the owned union — a
+        stale client scanning a moved-away slice would silently read
+        stale rows otherwise.  Internal (\\x00-prefixed) scans bypass."""
+        self._shard_state()
+        if self._owned is None or begin.startswith(b"\x00"):
+            return
+        if not self._owned:
+            raise make_error(StatusCode.KV_WRONG_SHARD,
+                             "group owns no ranges (drained by a move)")
+        cur = begin
+        for b, e in sorted(self._owned):
+            if cur >= end:
+                return
+            if b <= cur < e:
+                cur = e
+        if cur < end:
+            raise make_error(
+                StatusCode.KV_WRONG_SHARD,
+                f"range [{begin!r},{end!r}) not fully owned here")
+
+    async def _put_record(self, key: bytes, value: bytes | None) -> None:
+        async with self._commit_lock:
+            rec = Transaction(self.engine,
+                              read_version=self.engine.current_version())
+            rec._writes[key] = value
+            await self._replicate_and_apply(rec)
+
+    @rpc_method
+    async def shard_set_owned(self, req: KvShardOwnedReq, payload, conn):
+        self._require_primary()
+        # an EMPTY list is a real record ("owns nothing"), distinct from
+        # no record at all ("unrestricted")
+        owned = sorted(zip(req.begins, req.ends))
+        await self._put_record(self.OWNED_KEY,
+                               serde.dumps([list(r) for r in owned]))
+        self._owned = [tuple(r) for r in owned]
+        return KvOkRsp(), b""
+
+    @rpc_method
+    async def shard_freeze(self, req: KvShardRangeReq, payload, conn):
+        import time as _time
+        self._require_primary()
+        fr = (req.begin, req.end, _time.time() + req.ttl_s)
+        await self._put_record(self.FROZEN_KEY, serde.dumps(list(fr)))
+        self._frozen = fr
+        return KvOkRsp(), b""
+
+    @rpc_method
+    async def shard_unfreeze(self, req: KvShardRangeReq, payload, conn):
+        self._require_primary()
+        await self._put_record(self.FROZEN_KEY, None)
+        self._frozen = None
+        return KvOkRsp(), b""
+
+    # surgery ops act on USER rows only: the first map range begins at
+    # b"" but the \x00-prefixed internal namespace (2PC records, owned/
+    # frozen state, the map itself) must never be copied to another group
+    # nor deleted by a move's cleanup
+    _USER_FLOOR = b"\x01"
+
+    @rpc_method
+    async def shard_snapshot(self, req: KvRangeReq, payload, conn):
+        """Paginated row dump for a move (freeze first for consistency;
+        cursor = pass last key + b'\\x00' as the next begin)."""
+        self._require_primary()
+        rows = self.engine.range_at(max(req.begin, self._USER_FLOOR),
+                                    req.end,
+                                    self.engine.current_version(),
+                                    req.limit)
+        return KvRangeRsp(version=self.engine.current_version(),
+                          keys=[k for k, _ in rows],
+                          values=[v for _, v in rows]), b""
+
+    @rpc_method
+    async def shard_load(self, req: KvShardLoadReq, payload, conn):
+        """Bulk ingest (move target): replicated like any batch, but
+        bypasses the owned/frozen gates — the target does not own the
+        range until the map flips."""
+        self._require_primary()
+        async with self._commit_lock:
+            rec = Transaction(self.engine,
+                              read_version=self.engine.current_version())
+            for k, v in zip(req.keys, req.values):
+                rec._writes[k] = v
+            await self._replicate_and_apply(rec)
+        return KvOkRsp(), b""
+
+    @rpc_method
+    async def shard_delete_range(self, req: KvShardRangeReq, payload, conn):
+        self._require_primary()
+        async with self._commit_lock:
+            rec = Transaction(self.engine,
+                              read_version=self.engine.current_version())
+            rec._range_clears.append((max(req.begin, self._USER_FLOOR),
+                                      req.end))
+            await self._replicate_and_apply(rec)
+        return KvOkRsp(), b""
 
     def _txn_from_req(self, req: KvCommitReq) -> Transaction:
         txn = Transaction(self.engine, read_version=req.read_version)
@@ -304,6 +515,7 @@ class KvService:
             # follower failed is healed by seq reuse: the next commit ships
             # the same seq, the stale follower answers KV_REPLICA_GAP, and
             # the snapshot push resets it to the primary's true state.
+            self._check_shard_gates(txn)
             self.engine.check_conflicts(txn)
             await self._replicate_and_apply(txn)
         return KvCommitRsp(version=self.engine.current_version()), b""
@@ -334,6 +546,7 @@ class KvService:
             if self._refuse_stale_prepare(req.txn_id):
                 self._commit_lock.release()
                 return KvOkRsp(seq=self.seq), b""
+            self._check_shard_gates(txn)
             self.engine.check_conflicts(txn)
             rec = Transaction(self.engine,
                               read_version=self.engine.current_version())
@@ -805,6 +1018,10 @@ class KvService:
         2PC prepare records re-arm so a failover mid-cross-shard-txn
         still resolves it."""
         self.primary = True
+        # shard-surgery caches reload from the replicated records: the
+        # promoted copy must enforce exactly what the old primary did
+        self._owned = "unloaded"
+        self._frozen = "unloaded"
         recovered = await self.recover_prepared()
         self.ensure_decision_gc()
         log.warning("KV node promoted to primary at seq %d "

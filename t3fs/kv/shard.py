@@ -45,12 +45,18 @@ from t3fs.kv.engine import KVEngine
 from t3fs.kv.remote import RemoteKVEngine
 from t3fs.kv.service import KvFinishReq, KvPrepareReq
 from t3fs.net.client import Client
+from t3fs.utils import serde
 from t3fs.utils.serde import serde_struct
 from t3fs.utils.status import StatusCode, StatusError, make_error
 
 log = logging.getLogger("t3fs.kv.shard")
 
 KEY_MAX = b"\xff" * 17          # beyond any real key (prefix keys are short)
+
+# map-home record: the authoritative versioned ShardMap lives in the KV
+# itself (a designated, never-moving group) — FDB keeps its shard map in
+# system keyspace the same way
+MAP_KEY = b"\x00t3fsshard\x00map"
 
 
 @serde_struct
@@ -66,6 +72,9 @@ class ShardRange:
 class ShardMap:
     """Contiguous, sorted, gap-free ranges covering [b"", KEY_MAX)."""
     ranges: list[ShardRange] = field(default_factory=list)
+    # bumped by shard surgery (kv/surgery.py); clients refresh from the
+    # map-home record when a group answers KV_WRONG_SHARD
+    version: int = 0
 
     def validate(self) -> "ShardMap":
         if not self.ranges:
@@ -121,11 +130,32 @@ class ShardedTransaction:
                 self.engine.groups[shard].transaction()
         return sub
 
+    async def _retag_stale_map(self, coro):
+        """KV_WRONG_SHARD / KV_SHARD_FROZEN mean the map moved under this
+        transaction (or a move is mid-copy): refresh the map and surface
+        TXN_CONFLICT so the with_transaction retry loop re-runs against
+        fresh routing."""
+        try:
+            return await coro
+        except StatusError as e:
+            if e.code in (StatusCode.KV_WRONG_SHARD,
+                          StatusCode.KV_SHARD_FROZEN):
+                try:
+                    await self.engine.refresh_map()
+                except Exception as re:   # map home briefly unreachable:
+                    log.warning("shard map refresh failed: %s", re)
+                    # the retry path still heals once it comes back
+                raise make_error(
+                    StatusCode.TXN_CONFLICT,
+                    f"shard map changed under txn: {e}") from None
+            raise
+
     # --- reads ---
 
     async def get(self, key: bytes, *, snapshot: bool = False):
-        return await self._sub(self.engine.map.shard_of(key)).get(
-            key, snapshot=snapshot)
+        return await self._retag_stale_map(
+            self._sub(self.engine.map.shard_of(key)).get(
+                key, snapshot=snapshot))
 
     async def snapshot_get(self, key: bytes):
         return await self.get(key, snapshot=True)
@@ -135,8 +165,8 @@ class ShardedTransaction:
         out = []
         for shard, b, e in self.engine.map.shards_overlapping(begin, end):
             remaining = limit - len(out) if limit else 0
-            rows = await self._sub(shard).get_range(
-                b, e, limit=remaining, snapshot=snapshot)
+            rows = await self._retag_stale_map(self._sub(shard).get_range(
+                b, e, limit=remaining, snapshot=snapshot))
             out.extend(rows)
             if limit and len(out) >= limit:
                 return out[:limit]   # shards are key-ordered: safe to stop
@@ -164,6 +194,9 @@ class ShardedTransaction:
     # --- commit ---
 
     async def commit(self) -> None:
+        return await self._retag_stale_map(self._commit_inner())
+
+    async def _commit_inner(self) -> None:
         assert not self._committed, "transaction reused after commit"
         mutating = sorted(
             s for s, sub in self._subs.items()
@@ -277,12 +310,42 @@ class ShardedKVEngine(KVEngine):
     """KVEngine over a range-sharded deployment of replicated KV groups."""
 
     def __init__(self, shard_map: ShardMap, client: Client | None = None,
-                 timeout_s: float = 15.0):
+                 timeout_s: float = 15.0,
+                 map_home: list[str] | None = None):
         self.map = shard_map.validate()
         self.client = client or Client()
+        self.timeout_s = timeout_s
+        # map home: addresses of the (never-moving) group holding the
+        # authoritative versioned map record; None = static deployment
+        self.map_home = list(map_home or [])
+        self._map_group = (RemoteKVEngine(self.map_home, client=self.client,
+                                          timeout_s=timeout_s)
+                           if self.map_home else None)
+        self._rebuild_groups()
+
+    def _rebuild_groups(self) -> None:
         self.groups = [RemoteKVEngine(r.addresses, client=self.client,
-                                      timeout_s=timeout_s)
+                                      timeout_s=self.timeout_s)
                        for r in self.map.ranges]
+
+    async def refresh_map(self) -> bool:
+        """Reload the shard map from the map home; True when it changed.
+        Called by transactions that hit KV_WRONG_SHARD/KV_SHARD_FROZEN —
+        the surgery mover bumped the version."""
+        if self._map_group is None:
+            return False
+        txn = self._map_group.transaction()
+        raw = await txn.get(MAP_KEY, snapshot=True)
+        if raw is None:
+            return False
+        new: ShardMap = serde.loads(raw)
+        if new.version <= self.map.version:
+            return False
+        self.map = new.validate()
+        self._rebuild_groups()
+        log.info("shard map refreshed to v%d (%d ranges)",
+                 new.version, len(new.ranges))
+        return True
 
     def transaction(self) -> ShardedTransaction:
         return ShardedTransaction(self)

@@ -308,10 +308,14 @@ def open_kv_engine(spec: str) -> KVEngine:
         groups = [p.split(",") for p in parts[0::2]]
         splits = [bytes.fromhex(p) for p in parts[1::2]]
         bounds = [b""] + splits + [KEY_MAX]
+        # the FIRST group doubles as the map home: when surgery has
+        # published a versioned map there, clients converge to it (the
+        # spec's static layout is just the bootstrap routing)
         return ShardedKVEngine(ShardMap(ranges=[
             ShardRange(begin=bounds[i], end=bounds[i + 1],
                        addresses=groups[i])
-            for i in range(len(groups))]))
+            for i in range(len(groups))]),
+            map_home=groups[0])
     if spec.startswith("remote:"):
         from t3fs.kv.remote import RemoteKVEngine
         return RemoteKVEngine(spec[len("remote:"):].split(","))

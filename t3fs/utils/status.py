@@ -73,6 +73,8 @@ class StatusCode(enum.IntEnum):
     KV_REPLICA_GAP = 7102
     KV_REPLICATION_FAILED = 7103
     KV_TXN_NOT_FOUND = 7104      # 2PC: prepared txn expired/unknown here
+    KV_WRONG_SHARD = 7105        # key outside this group's owned ranges
+    KV_SHARD_FROZEN = 7106       # range frozen for an in-flight move
 
     # mgmtd (reference: MgmtdCode)
     MGMTD_NOT_PRIMARY = 7001

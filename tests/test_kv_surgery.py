@@ -1,0 +1,318 @@
+"""Online shard split/move (VERDICT r2 missing #4: the static ShardMap
+could never rebalance a hot range without downtime).
+
+Reference role: FoundationDB's online range movement behind
+src/fdb/FDBKVEngine.h.
+"""
+
+import asyncio
+
+import pytest
+
+from t3fs.kv.engine import MemKVEngine, with_transaction
+from t3fs.kv.service import KvService
+from t3fs.kv.shard import (
+    KEY_MAX, MAP_KEY, ShardMap, ShardRange, ShardedKVEngine,
+)
+from t3fs.kv.surgery import ShardAdmin
+from t3fs.net.client import Client
+from t3fs.net.server import Server
+from t3fs.utils.status import StatusCode, StatusError
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def _mk_cluster(n_groups: int = 3, split: bytes = b"m"):
+    """Groups 0..n-1 running; group 0 serves [b'', split), group 1 serves
+    [split, MAX); later groups start EMPTY (move targets).  Group 0 is
+    also the map home."""
+    ship = Client()
+    servers, services, addrs = [], [], []
+    for i in range(n_groups):
+        svc = KvService(MemKVEngine(), client=ship, prepare_timeout_s=5.0)
+        srv = Server(); srv.add_service(svc)
+        await srv.start()
+        servers.append(srv); services.append(svc)
+        addrs.append([srv.address])
+    m = ShardMap(ranges=[ShardRange(b"", split, addrs[0]),
+                         ShardRange(split, KEY_MAX, addrs[1])],
+                 version=1)
+    admin = ShardAdmin(addrs[0], client=ship)
+    await admin.publish_map(m)
+    kv = ShardedKVEngine(m, client=ship, map_home=addrs[0])
+
+    async def cleanup():
+        await kv.close()
+        for s in servers:
+            await s.stop()
+    return kv, admin, services, addrs, cleanup
+
+
+def test_split_then_move_live_range():
+    """Split [m,MAX) at 's' and move [s,MAX) to an empty group while a
+    client keeps reading/writing — no lost or stale data."""
+    async def body():
+        kv, admin, services, addrs, cleanup = await _mk_cluster()
+        try:
+            # seed data across the keyspace
+            async def seed(txn):
+                for i in range(40):
+                    txn.set(b"k%02d" % i, b"v%d" % i)
+                    txn.set(b"z%02d" % i, b"zv%d" % i)
+            await with_transaction(kv, seed)
+
+            m = await admin.split(b"s")
+            assert [r.begin for r in m.ranges] == [b"", b"m", b"s"]
+            m = await admin.move(b"s", KEY_MAX, addrs[2])
+            assert [list(r.addresses) for r in m.ranges] == \
+                [addrs[0], addrs[1], addrs[2]]
+
+            # the CLIENT still holds the old map: its next touch of the
+            # moved range must transparently converge via refresh+retry
+            async def rw(txn):
+                assert await txn.get(b"z07") == b"zv7"
+                txn.set(b"z99", b"new")
+            await with_transaction(kv, rw)
+            assert kv.map.version == m.version
+
+            # the moved rows live on group 2 and are GONE from group 1
+            g2 = services[2].engine
+            assert g2.read_at(b"z07", g2.current_version()) == b"zv7"
+            assert g2.read_at(b"z99", g2.current_version()) == b"new"
+            g1 = services[1].engine
+            assert g1.read_at(b"z07", g1.current_version()) is None
+            # unmoved halves untouched
+            t = kv.transaction()
+            assert await t.get(b"k03") == b"v3"
+            # a stale DIRECT write to the old group is refused
+            with pytest.raises(StatusError) as ei:
+                txn = kv.groups[1].transaction()   # group 1 = [m,s) now
+                txn.set(b"z50", b"stale")
+                await txn.commit()
+            assert ei.value.code in (StatusCode.KV_WRONG_SHARD,
+                                     StatusCode.TXN_CONFLICT)
+        finally:
+            await cleanup()
+    run(body())
+
+
+def test_move_killed_mid_copy_converges():
+    """Kill the mover BEFORE the flip: the freeze expires, the source
+    keeps serving, resume() re-copies fresh (including writes that landed
+    between the attempts) and completes."""
+    async def body():
+        kv, admin, services, addrs, cleanup = await _mk_cluster()
+        try:
+            async def seed(txn):
+                for i in range(30):
+                    txn.set(b"z%02d" % i, b"zv%d" % i)
+            await with_transaction(kv, seed)
+
+            # sabotage: the target's load_range dies after the first page
+            admin.page_rows = 8
+            admin.freeze_ttl_s = 0.5
+            orig_drive = admin._drive
+            calls = {"n": 0}
+            real_call = type(kv.groups[0])._call
+
+            async def dying_call(self_, method, req, **kw):
+                if method == "Kv.shard_load":
+                    calls["n"] += 1
+                    if calls["n"] == 2:
+                        raise RuntimeError("mover killed mid-copy")
+                return await real_call(self_, method, req, **kw)
+
+            import t3fs.kv.remote as remote_mod
+            remote_mod.RemoteKVEngine._call = dying_call
+            try:
+                with pytest.raises(RuntimeError):
+                    await admin.move(b"m", KEY_MAX, addrs[2])
+            finally:
+                remote_mod.RemoteKVEngine._call = real_call
+
+            # the durable intent SURVIVES the failure (it clears only
+            # after full success) — that is what resume() keys on
+            assert await admin._load_intent() is not None
+
+            # freeze expires -> source serves again; a write lands
+            await asyncio.sleep(0.6)
+            async def w(txn):
+                txn.set(b"z50", b"landed-between-attempts")
+            await asyncio.wait_for(with_transaction(kv, w), timeout=5.0)
+
+            # resume completes the move and the late write survived
+            m = await admin.resume()
+            assert m is not None
+            g2 = services[2].engine
+            assert g2.read_at(b"z50",
+                              g2.current_version()) == b"landed-between-attempts"
+            assert g2.read_at(b"z07", g2.current_version()) == b"zv7"
+            # client converges
+            async def r(txn):
+                assert await txn.get(b"z50") == b"landed-between-attempts"
+            await asyncio.wait_for(with_transaction(kv, r), timeout=5.0)
+            assert await admin._load_intent() is None
+        finally:
+            await cleanup()
+    run(body())
+
+
+def test_move_killed_after_flip_resume_cleans_up():
+    """Kill the mover AFTER the map flip: clients already route to the
+    target; resume() finishes the source-side cleanup (ownership drop +
+    row deletion + unfreeze)."""
+    async def body():
+        kv, admin, services, addrs, cleanup = await _mk_cluster()
+        try:
+            async def seed(txn):
+                for i in range(10):
+                    txn.set(b"z%02d" % i, b"zv%d" % i)
+            await with_transaction(kv, seed)
+
+            real_call = type(kv.groups[0])._call
+
+            async def dying_call(self_, method, req, **kw):
+                if method == "Kv.shard_set_owned" and \
+                        self_.addresses == addrs[1]:
+                    raise RuntimeError("mover killed after flip")
+                return await real_call(self_, method, req, **kw)
+
+            import t3fs.kv.remote as remote_mod
+            remote_mod.RemoteKVEngine._call = dying_call
+            try:
+                with pytest.raises(RuntimeError):
+                    await admin.move(b"m", KEY_MAX, addrs[2])
+            finally:
+                remote_mod.RemoteKVEngine._call = real_call
+            from t3fs.kv.surgery import MoveIntent
+            await admin._put_intent(MoveIntent(
+                begin=b"m", end=KEY_MAX, src=addrs[1], dst=addrs[2]))
+
+            # map is flipped: clients converge to the target already
+            async def r(txn):
+                assert await txn.get(b"z03") == b"zv3"
+            await asyncio.wait_for(with_transaction(kv, r), timeout=5.0)
+
+            m = await admin.resume()
+            assert m is not None
+            # source dropped the rows and refuses the range
+            g1 = services[1].engine
+            assert g1.read_at(b"z03", g1.current_version()) is None
+            with pytest.raises(StatusError) as ei:
+                txn = ShardedKVEngine(
+                    ShardMap(ranges=[ShardRange(b"", b"m", addrs[0]),
+                                     ShardRange(b"m", KEY_MAX, addrs[1])],
+                             version=1),
+                    client=admin.client).transaction()
+                txn.set(b"z03", b"stale-client-write")
+                await txn.commit()
+            assert ei.value.code in (StatusCode.KV_WRONG_SHARD,
+                                     StatusCode.TXN_CONFLICT)
+        finally:
+            await cleanup()
+    run(body())
+
+
+def test_surgery_cli_commands():
+    """kv-map / kv-split / kv-move / kv-move-resume drive the surgery
+    through the REAL admin CLI entry point."""
+    import subprocess
+    import sys
+
+    async def body():
+        kv, admin, services, addrs, cleanup = await _mk_cluster()
+        try:
+            async def seed(txn):
+                txn.set(b"zkey", b"zval")
+            await with_transaction(kv, seed)
+            home = addrs[0]
+
+            def cli(*argv):
+                out = subprocess.run(
+                    [sys.executable, "-m", "t3fs.cli.admin",
+                     "--mgmtd", "127.0.0.1:1", *argv],
+                    capture_output=True, text=True, timeout=60)
+                assert out.returncode == 0, (argv, out.stdout, out.stderr)
+                return out.stdout
+
+            s = await asyncio.to_thread(cli, "kv-map", *home)
+            assert "shard map v1" in s
+            s = await asyncio.to_thread(cli, "kv-split", "s", *home)
+            assert "3 ranges" in s
+            s = await asyncio.to_thread(
+                cli, "kv-move", "s", "MAX", *addrs[2], "--map-home", *home)
+            assert "map v3" in s
+            s = await asyncio.to_thread(cli, "kv-move-resume", *home)
+            assert "no pending move intent" in s
+            # data still readable through a refreshed client
+            async def r(txn):
+                assert await txn.get(b"zkey") == b"zval"
+            await asyncio.wait_for(with_transaction(kv, r), timeout=5.0)
+        finally:
+            await cleanup()
+    run(body())
+
+
+def test_publish_map_cas_and_pending_intent_guard():
+    """Code-review r3: concurrent surgery must not lose updates (CAS on
+    the map record) and a pending move intent blocks a DIFFERENT move."""
+    async def body():
+        kv, admin, services, addrs, cleanup = await _mk_cluster()
+        try:
+            m = await admin.load_map()
+            # CAS: publishing against a stale base version conflicts
+            stale = ShardMap(ranges=list(m.ranges), version=m.version + 1)
+            await admin.publish_map(stale, base_version=m.version)
+            with pytest.raises(StatusError) as ei:
+                await admin.publish_map(
+                    ShardMap(ranges=list(m.ranges), version=m.version + 1),
+                    base_version=m.version)   # stale base
+            assert ei.value.code == StatusCode.TXN_CONFLICT
+
+            # pending-intent guard
+            from t3fs.kv.surgery import MoveIntent
+            await admin._put_intent(MoveIntent(
+                begin=b"m", end=KEY_MAX, src=addrs[1], dst=addrs[2]))
+            with pytest.raises(StatusError) as ei:
+                await admin.move(b"", b"m", addrs[2])   # DIFFERENT range
+            assert ei.value.code == StatusCode.BUSY
+            await admin._put_intent(None)
+        finally:
+            await cleanup()
+    run(body())
+
+
+def test_clear_range_gated_against_frozen_and_unowned():
+    """Code-review r3: a clear_range must be FULLY owned and must not
+    overlap a frozen range (begin-only checking let wide clears
+    half-apply or delete already-copied rows)."""
+    async def body():
+        kv, admin, services, addrs, cleanup = await _mk_cluster()
+        try:
+            from t3fs.kv.service import KvShardOwnedReq, KvShardRangeReq
+            g1 = kv.groups[1]
+            # group 1 owns [m, s) only
+            await g1._call("Kv.shard_set_owned", KvShardOwnedReq(
+                begins=[b"m"], ends=[b"s"]))
+            txn = g1.transaction()
+            txn.clear_range(b"n", b"z")       # extends past owned end
+            with pytest.raises(StatusError) as ei:
+                await txn.commit()
+            assert ei.value.code == StatusCode.KV_WRONG_SHARD
+
+            # frozen overlap: clear starting BEFORE the frozen begin
+            await g1._call("Kv.shard_set_owned", KvShardOwnedReq(
+                begins=[b"m"], ends=[b"z"]))
+            await g1._call("Kv.shard_freeze", KvShardRangeReq(
+                begin=b"p", end=b"q", ttl_s=30.0))
+            txn = g1.transaction()
+            txn.clear_range(b"m", b"r")
+            with pytest.raises(StatusError) as ei:
+                await txn.commit()
+            assert ei.value.code == StatusCode.KV_SHARD_FROZEN
+            await g1._call("Kv.shard_unfreeze", KvShardRangeReq())
+        finally:
+            await cleanup()
+    run(body())
