@@ -213,6 +213,58 @@ async def kv_status(ctx: AdminContext, args) -> None:
             print(f"{addr}: unreachable ({e.code.name})")
 
 
+@command("trace-read", "print storage trace rows (Parquet event log)")
+@args_(("paths", {"nargs": "+", "help": "trace files/dirs/globs"}),
+       ("--limit", {"type": int, "default": 50}),
+       ("--chain", {"type": int, "default": 0}),
+       ("--node", {"type": int, "default": 0}),
+       ("--errors-only", {"action": "store_true"}))
+async def trace_read(ctx: AdminContext, args) -> None:
+    from t3fs.analytics.trace_query import iter_rows
+    n = 0
+    try:
+        for row in iter_rows(list(args.paths), chain=args.chain,
+                             node=args.node,
+                             errors_only=args.errors_only):
+            print(f"{row['ts']:.6f} node={row['node_id']} "
+                  f"target={row['target_id']} chain={row['chain_id']} "
+                  f"chunk={row['chunk_id']} {row['update_type']} "
+                  f"len={row['length']} status={row['commit_status']} "
+                  f"lat={row['latency_s'] * 1e3:.3f}ms")
+            n += 1
+            if args.limit and n >= args.limit:
+                break
+    except (OSError, FileNotFoundError) as e:
+        print(f"trace read failed: {e}")
+        return
+    print(f"({n} rows)")
+
+
+@command("trace-top", "latency/error breakdown from storage traces "
+                      "(p50/p99 per node/target/chain/type)")
+@args_(("paths", {"nargs": "+", "help": "trace files/dirs/globs"}),
+       ("--by", {"choices": ["node", "target", "chain", "type", "status"],
+                 "default": "target"}),
+       ("--chain", {"type": int, "default": 0}),
+       ("--node", {"type": int, "default": 0}))
+async def trace_top(ctx: AdminContext, args) -> None:
+    from t3fs.analytics.trace_query import top
+    try:
+        stats = top(list(args.paths), by=args.by, chain=args.chain,
+                    node=args.node)
+    except (OSError, FileNotFoundError) as e:
+        print(f"trace read failed: {e}")
+        return
+    if not stats:
+        print("no rows")
+        return
+    rows = [[g.key, g.count, g.errors, f"{g.bytes / 1e6:.2f}",
+             f"{g.p50_ms:.3f}", f"{g.p99_ms:.3f}", f"{g.max_ms:.3f}",
+             f"{g.mean_ms:.3f}"] for g in stats]
+    print(_fmt_table(rows, ["group", "count", "errors", "MB", "p50ms",
+                            "p99ms", "maxms", "meanms"]))
+
+
 @command("kv-publish-map", "bootstrap the versioned shard map from a "
                            "shards spec (group;hexsplit;group;...)")
 @args_(("spec", {"help": "same grammar as the 'shards:' engine spec, "
