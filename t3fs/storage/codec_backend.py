@@ -147,11 +147,31 @@ class DeviceChecksumBackend(ChecksumBackend):
     # --- batching worker ---
 
     async def _worker_loop(self) -> None:
+        """Double-buffered dispatch (docs/codec_economics.md: serial
+        copy-then-compute can NEVER reach line rate; overlap can): batch
+        n+1's host pack + H2D + kernel LAUNCH happens before batch n's
+        results are pulled, so on a real chip the device computes n while
+        the host prepares n+1 (JAX async dispatch makes the launch
+        non-blocking; only the result pull blocks)."""
         loop = asyncio.get_running_loop()
         batch: list[_Pending] = []
+        in_flight: list | None = None       # dispatched, results not pulled
         try:
             while True:
-                batch = [await self._q.get()]
+                try:
+                    if in_flight is None:
+                        first = await self._q.get()
+                    else:
+                        # traffic pause: bound how long the in-flight
+                        # batch's callers wait for their CRCs
+                        first = await asyncio.wait_for(self._q.get(),
+                                                       self.max_wait_s)
+                except asyncio.TimeoutError:
+                    await loop.run_in_executor(self._pool, self._resolve,
+                                               in_flight)
+                    in_flight = None
+                    continue
+                batch = [first]
                 deadline = loop.time() + self.max_wait_s
                 while len(batch) < self.max_batch:
                     timeout = deadline - loop.time()
@@ -168,19 +188,32 @@ class DeviceChecksumBackend(ChecksumBackend):
                 self.batches += len(groups)
                 self.batched_items += len(batch)
                 try:
-                    await loop.run_in_executor(self._pool, self._flush, groups)
+                    dispatched = await loop.run_in_executor(
+                        self._pool, self._dispatch, groups)
                 except Exception as e:  # pragma: no cover - device failure
-                    log.exception("device CRC flush failed; failing batch")
+                    log.exception("device CRC dispatch failed; failing batch")
                     for item in batch:
                         item.loop.call_soon_threadsafe(
                             _set_exception_safe, item.future, e)
+                    dispatched = None
                 batch = []
+                # pull the PREVIOUS batch only now — its kernel ran on the
+                # device while this batch was packed and launched
+                if in_flight is not None:
+                    await loop.run_in_executor(self._pool, self._resolve,
+                                               in_flight)
+                in_flight = dispatched
         except asyncio.CancelledError:
-            # fail whatever was collected but not yet flushed
+            # fail whatever was collected or still in flight
             err = make_closed_error()
             for item in batch:
                 if not item.future.done():
                     item.future.set_exception(err)
+            if in_flight is not None:
+                for items, _res in in_flight:
+                    for item in items:
+                        if not item.future.done():
+                            item.future.set_exception(err)
             raise
 
     @staticmethod
@@ -261,9 +294,10 @@ class DeviceChecksumBackend(ChecksumBackend):
             except CancelledError:
                 return
 
-    def _flush(self, groups: dict[int, list[_Pending]]) -> None:
-        """Runs in the codec thread: one device call per bucket."""
-        mats = default_matrices()
+    def _dispatch(self, groups: dict[int, list[_Pending]]) -> list:
+        """Codec thread, NON-blocking on the device: pack + launch one
+        kernel per bucket and return the lazy device results."""
+        out = []
         for chunk_words, items in groups.items():
             n = self._n_bucket(len(items))
             arr = np.zeros((n, chunk_words * 4), dtype=np.uint8)
@@ -271,8 +305,23 @@ class DeviceChecksumBackend(ChecksumBackend):
                 # FRONT-pad: raw CRC is zero-preserving
                 arr[i, arr.shape[1] - len(item.data):] = np.frombuffer(
                     item.data, dtype=np.uint8)
-            words = arr.view(np.uint32)
-            raw = np.asarray(self._fn(chunk_words)(words))
+            out.append((items, self._fn(chunk_words)(arr.view(np.uint32))))
+        return out
+
+    def _resolve(self, dispatched: list) -> None:
+        """Codec thread: pull device results and deliver CRCs.  Failures
+        are per-bucket — one bucket's device error must not strand the
+        other buckets' callers."""
+        mats = default_matrices()
+        for items, res in dispatched:
+            try:
+                raw = np.asarray(res)
+            except Exception as e:  # pragma: no cover - device failure
+                log.exception("device CRC resolve failed; failing bucket")
+                for item in items:
+                    item.loop.call_soon_threadsafe(
+                        _set_exception_safe, item.future, e)
+                continue
             for i, item in enumerate(items):
                 crc = int(raw[i]) ^ mats.affine_const(len(item.data))
                 item.loop.call_soon_threadsafe(
