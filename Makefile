@@ -17,10 +17,20 @@ SAN_TESTS := tests/test_native_engine.py tests/test_usrbio.py \
              tests/test_storage_service.py
 SAN_FILTER := -k "not device"
 
-.PHONY: test sanitize sanitize-thread sanitize-address
+.PHONY: test sanitize sanitize-thread sanitize-address probe on-device ci
 
 test:
 	$(PY) -m pytest tests/ -x -q
+
+# Bounded TPU-tunnel probe; ALWAYS appends a dated record to
+# DEVICE_PROBE_LOG.jsonl (proof the chip was retried, r3 verdict #1).
+probe:
+	$(PY) scripts/ondevice.py --probe
+
+# Probe + (if the chip answers) headline bench, T3FS_ON_DEVICE=1 pytest
+# tier, and the device_sort bench; writes a dated ONDEVICE_*.json.
+on-device:
+	$(PY) scripts/ondevice.py
 
 sanitize: sanitize-thread sanitize-address
 	@echo "sanitize: both passes clean"

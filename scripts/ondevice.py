@@ -1,0 +1,109 @@
+#!/usr/bin/env python
+"""On-device validation driver (r3 verdict next-step #1).
+
+Two modes:
+
+  python scripts/ondevice.py --probe
+      Cheap bounded device probe (subprocess, T3FS_BENCH_PROBE_S deadline).
+      ALWAYS appends a dated record to DEVICE_PROBE_LOG.jsonl — two rounds
+      died to "the tunnel was wedged when we looked", so the log is the
+      proof that the chip was retried throughout the round.
+
+  python scripts/ondevice.py           (= `make on-device`)
+      Probe, and if the chip answers run the FULL on-device tier:
+        1. bench.py (headline RS(8+2)+CRC32C GB/s/chip),
+        2. T3FS_ON_DEVICE=1 pytest tier (pallas codec, codec backend,
+           parallel codec — interpret=False, real Mosaic compiles),
+        3. the device_sort key-sort stage bench (ROADMAP #1 backlog).
+      Writes a dated ONDEVICE_<utc>.json record with all three results.
+
+Exit code is 0 either way (the log entry is the artifact); --check makes
+a wedged probe exit 1 for scripting.
+"""
+
+from __future__ import annotations
+
+import datetime
+import json
+import os
+import pathlib
+import subprocess
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+PROBE_LOG = REPO / "DEVICE_PROBE_LOG.jsonl"
+
+
+def utcnow() -> str:
+    return datetime.datetime.now(datetime.timezone.utc) \
+        .strftime("%Y-%m-%dT%H:%M:%SZ")
+
+
+def probe() -> dict:
+    sys.path.insert(0, str(REPO))
+    from bench import _probe_device
+    err = _probe_device()
+    rec = {"ts": utcnow(), "reachable": err is None}
+    if err is not None:
+        rec["error"] = err
+    with open(PROBE_LOG, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+    return rec
+
+
+def _run(cmd: list[str], env: dict | None = None,
+         timeout: int = 3600) -> dict:
+    e = dict(os.environ)
+    if env:
+        e.update(env)
+    try:
+        r = subprocess.run(cmd, capture_output=True, text=True,
+                           timeout=timeout, env=e, cwd=str(REPO))
+        tail = (r.stdout or "").strip().splitlines()[-30:]
+        return {"cmd": " ".join(cmd), "rc": r.returncode,
+                "tail": "\n".join(tail),
+                "stderr_tail": (r.stderr or "").strip()[-2000:]}
+    except subprocess.TimeoutExpired:
+        return {"cmd": " ".join(cmd), "rc": -1,
+                "tail": f"timeout after {timeout}s"}
+
+
+def full_tier() -> dict:
+    out: dict = {"ts": utcnow()}
+    out["bench"] = _run([sys.executable, "bench.py"])
+    try:
+        out["bench_json"] = json.loads(
+            out["bench"]["tail"].splitlines()[-1])
+    except Exception:
+        pass
+    out["pytest_on_device"] = _run(
+        [sys.executable, "-m", "pytest", "tests/test_pallas_codec.py",
+         "tests/test_codec_backend.py", "tests/test_parallel_codec.py",
+         "-q", "--no-header"],
+        env={"T3FS_ON_DEVICE": "1"}, timeout=2400)
+    out["device_sort"] = _run(
+        [sys.executable, "-m", "benchmarks.sort_bench",
+         "--sort-backend", "device", "--json"],
+        timeout=1800)
+    return out
+
+
+def main() -> int:
+    rec = probe()
+    print(json.dumps(rec))
+    if not rec["reachable"]:
+        return 1 if "--check" in sys.argv else 0
+    if "--probe" in sys.argv:
+        return 0
+    tier = full_tier()
+    stamp = tier["ts"].replace(":", "").replace("-", "")
+    out_path = REPO / f"ONDEVICE_{stamp}.json"
+    out_path.write_text(json.dumps(tier, indent=1))
+    print(f"on-device tier written to {out_path}")
+    ok = all(tier[k]["rc"] == 0
+             for k in ("bench", "pytest_on_device", "device_sort"))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -25,9 +25,10 @@ from t3fs.net.client import Client
 from t3fs.net.wire import WireStatus
 from t3fs.ops.codec import crc32c as crc32c_ref
 from t3fs.storage.types import (
-    BatchReadReq, BatchReadRsp, ChunkId, IOResult, QueryLastChunkReq,
-    QueryLastChunkRsp, ReadIO, RemoveChunksReq, TruncateChunkReq, UpdateIO,
-    UpdateType, WriteReq, pack_readios, unpack_ioresults,
+    BatchReadReq, BatchReadRsp, ChunkId, IOResult, PACKED_READIO_VER,
+    QueryLastChunkReq, QueryLastChunkRsp, ReadIO, RemoveChunksReq,
+    TruncateChunkReq, UpdateIO, UpdateType, WriteReq, pack_readios,
+    unpack_ioresults,
 )
 from t3fs.utils.fault_injection import DebugFlags
 from t3fs.utils.status import Status, StatusCode, StatusError, make_error
@@ -251,6 +252,14 @@ class StorageClient:
         retry failed IOs with target failover."""
         results: list[IOResult | None] = [None] * len(ios)
         payloads: list[bytes] = [b""] * len(ios)
+        # chain_ver stamping policy: an IO the CALLER versioned is left
+        # alone; the rest are (re)stamped from routing each attempt —
+        # but only when this client can refresh routing, else one chain
+        # reshape would wedge every read behind a permanently stale
+        # version (the relaxed chain_ver=0 read is the better contract
+        # for a static-routing client)
+        stamp = self._refresh_routing is not None
+        caller_versioned = [io.chain_ver != 0 for io in ios]
         pending = list(range(len(ios)))
         for attempt in range(self.cfg.max_retries):
             routing = self.routing()
@@ -266,6 +275,11 @@ class StorageClient:
                 except StatusError as e:
                     results[i] = IOResult(WireStatus(int(e.code), str(e)))
                     continue
+                # stamp our routing version: a node whose view diverged
+                # (e.g. a self-fenced deposed head) answers
+                # CHAIN_VERSION_MISMATCH instead of a stale read
+                if stamp and not caller_versioned[i]:
+                    ios[i].chain_ver = chain.chain_ver
                 groups.setdefault(routing.node_address(target.node_id), []).append(i)
 
             async def read_group(address: str, idxs: list[int]):
@@ -280,6 +294,7 @@ class StorageClient:
                           else pack_readios(group))
                 if packed is not None:
                     req = BatchReadReq(packed_ios=packed, want_packed=True,
+                                       packed_ver=PACKED_READIO_VER,
                                        debug=self.cfg.debug)
                 else:
                     req = BatchReadReq(ios=group, debug=self.cfg.debug)
@@ -295,9 +310,31 @@ class StorageClient:
                             BatchReadReq(ios=group, debug=self.cfg.debug),
                             timeout=self.cfg.request_timeout_s)
                 except StatusError as e:
-                    for i in idxs:
-                        results[i] = IOResult(WireStatus(int(e.code), str(e)))
-                    return
+                    # an old server may ERROR on the unknown packed
+                    # fields rather than echo empty (advisor r3): retry
+                    # ONCE on the struct path before failing the batch,
+                    # memoizing on success so later batches skip packed.
+                    # Only for NON-retryable errors — a transient
+                    # timeout/BUSY from a healthy server must ride the
+                    # normal retry loop, not permanently disable the
+                    # packed fast path for the address
+                    if packed is not None and not e.status.retryable:
+                        try:
+                            rsp, payload = await self.client.call(
+                                address, "Storage.batch_read",
+                                BatchReadReq(ios=group, debug=self.cfg.debug),
+                                timeout=self.cfg.request_timeout_s)
+                            self._no_packed.add(address)
+                        except StatusError as e2:
+                            for i in idxs:
+                                results[i] = IOResult(
+                                    WireStatus(int(e2.code), str(e2)))
+                            return
+                    else:
+                        for i in idxs:
+                            results[i] = IOResult(
+                                WireStatus(int(e.code), str(e)))
+                        return
                 rsp_results = (unpack_ioresults(rsp.packed_results)
                                if rsp.packed_results else rsp.results)
                 pos = 0

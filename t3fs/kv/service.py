@@ -351,9 +351,13 @@ class KvService:
             # then resurrect on the move target after the flip)
             self._check_range_owned(b, e)
             fr = self._frozen
-            if fr is not None and not b.startswith(b"\x00"):
+            # clamp to the user portion: a clear straddling the internal
+            # boundary (advisor r3) must still honor the freeze over its
+            # user slice, or already-copied rows resurrect on the target
+            ub = max(b, self._USER_FLOOR)
+            if fr is not None and ub < e:
                 fb, fe, _dl = fr
-                if b < fe and fb < e and self._frozen_hit(fb):
+                if ub < fe and fb < e and self._frozen_hit(fb):
                     raise make_error(
                         StatusCode.KV_SHARD_FROZEN,
                         f"clear [{b!r},{e!r}) overlaps the frozen range")
@@ -368,10 +372,15 @@ class KvService:
     def _check_range_owned(self, begin: bytes, end: bytes) -> None:
         """The whole requested range must sit inside the owned union — a
         stale client scanning a moved-away slice would silently read
-        stale rows otherwise.  Internal (\\x00-prefixed) scans bypass."""
+        stale rows otherwise.  Only WHOLLY internal ranges (end at or
+        below _USER_FLOOR) bypass; a range straddling the boundary
+        (advisor r3 medium: e.g. [b'\\x00', user_key)) is checked over
+        its user portion, else a stale client could scan unowned user
+        rows off a drained source."""
         self._shard_state()
-        if self._owned is None or begin.startswith(b"\x00"):
+        if self._owned is None or end <= self._USER_FLOOR:
             return
+        begin = max(begin, self._USER_FLOOR)
         if not self._owned:
             raise make_error(StatusCode.KV_WRONG_SHARD,
                              "group owns no ranges (drained by a move)")

@@ -10,17 +10,24 @@ Protocol (move):
   3. clear any partial copy on the target, then snapshot-copy the frozen
      range in pages;
   4. target takes ownership (shard_set_owned with its full new list);
-  5. publish map version+1 — clients start routing to the target;
-  6. source drops ownership (refuses the range with KV_WRONG_SHARD even
-     after the freeze lapses), deletes the moved rows, unfreezes;
-  7. clear the intent.
+  5. source DROPS ownership (refuses the range with KV_WRONG_SHARD even
+     after the freeze lapses) — BEFORE the map flips, so a mover death
+     here costs a bounded unavailability window (stale clients bounce
+     off KV_WRONG_SHARD until resume() republishes) instead of an
+     acked-write-loss window (r3 verdict weak #2: with the old order a
+     mover dead past freeze_ttl_s left the source acking writes that
+     step-6 cleanup then deleted);
+  6. publish map version+1 — clients start routing to the target;
+  7. source deletes the moved rows and unfreezes; clear the intent.
 
 Every step is idempotent and the intent records src/dst, so `resume()`
-finishes a move killed at ANY point: before the flip it re-runs from the
-freeze (fresh snapshot — the TTL'd freeze guarantees no lost writes);
-after the flip it completes the source-side cleanup.  Ownership and
-freeze records replicate inside each group, so a failover mid-move keeps
-refusing exactly what it must (see KvService shard gates).
+finishes a move killed at ANY point: before the source's ownership drop
+it re-runs from the freeze (fresh snapshot — the TTL'd freeze guarantees
+no lost writes); after the drop the source accepts nothing in the range,
+so re-copy and map publish are race-free however long the mover stays
+dead.  Ownership and freeze records replicate inside each group, so a
+failover mid-move keeps refusing exactly what it must (see KvService
+shard gates).
 
 Clients converge lazily: a group answering KV_WRONG_SHARD makes the
 sharded transaction refresh the map and retry (TXN_CONFLICT path).
@@ -141,7 +148,7 @@ class ShardAdmin:
                 StatusCode.INVALID_ARG,
                 f"[{begin!r},{end!r}) is not a map range (split first)")
         src = list(match[0].addresses)
-        if src == list(to_addresses):
+        if sorted(src) == sorted(to_addresses):
             return m                       # already there: idempotent
         pending = await self._load_intent()
         if pending is not None and (pending.begin, pending.end,
@@ -184,7 +191,7 @@ class ShardAdmin:
                 StatusCode.INVALID_ARG,
                 f"[{begin!r},{end!r}) is no longer an exact map range; "
                 f"resolve the intent manually (map v{m.version})")
-        flipped = list(cur[0].addresses) == list(intent.dst)
+        flipped = sorted(cur[0].addresses) == sorted(intent.dst)
         if not flipped:
             # freeze + copy + take ownership + flip.  The freeze is
             # RE-EXTENDED on every copied page: a copy outlasting one
@@ -217,11 +224,19 @@ class ShardAdmin:
                 version=m.version + 1)
             await dst_g._call("Kv.shard_set_owned",
                               self._owned_req(new_map, intent.dst))
+            # source refuses the range BEFORE the flip: dying between
+            # the drop and the publish leaves stale clients bouncing off
+            # KV_WRONG_SHARD (bounded unavailability, resume() heals) —
+            # never an acked write the cleanup below would delete
+            await src_g._call("Kv.shard_set_owned",
+                              self._owned_req(new_map, intent.src))
             await self.publish_map(new_map, base_version=m.version)
             m = new_map
             log.info("moved [%r,%r) to %s (%d rows), map v%d",
                      begin, end, intent.dst, copied, m.version)
-        # source-side cleanup (also the resume-after-flip path)
+        # source-side cleanup (also the resume-after-flip path; the
+        # owned re-assert is idempotent and covers intents written by a
+        # pre-reorder driver that flipped the map first)
         await src_g._call("Kv.shard_set_owned",
                           self._owned_req(m, intent.src))
         await src_g._call("Kv.shard_delete_range",
@@ -232,8 +247,13 @@ class ShardAdmin:
 
     @staticmethod
     def _owned_req(m: ShardMap, addresses: list[str]) -> KvShardOwnedReq:
+        # order-insensitive group identity: an operator listing an
+        # existing group's addresses in a different order must not make
+        # shard_set_owned's wholesale replace omit that group's live
+        # ranges (advisor r3: that outage needed manual repair)
+        want = sorted(addresses)
         ranges = [(r.begin, r.end) for r in m.ranges
-                  if list(r.addresses) == list(addresses)]
+                  if sorted(r.addresses) == want]
         return KvShardOwnedReq(begins=[b for b, _ in ranges],
                                ends=[e for _, e in ranges])
 

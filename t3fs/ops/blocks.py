@@ -1,0 +1,17 @@
+"""Kernel block-size arithmetic shared by the codec call sites.
+
+jax-free on purpose: the EC codec imports this at module scope and must
+stay importable under the sanitizer runs that cannot load jaxlib.
+"""
+
+from __future__ import annotations
+
+
+def pick_block(total: int, preferred: int) -> int:
+    """Largest divisor of `total` that is <= preferred (kernel block sizes
+    must tile the axis exactly; chunk sizes are powers of two in practice
+    but tests use arbitrary small lengths)."""
+    b = min(preferred, total)
+    while total % b:
+        b -= 1
+    return b

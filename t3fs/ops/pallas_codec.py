@@ -344,11 +344,16 @@ def make_crc_seg_words_pallas(block_r: int = 512, interpret: bool = False):
 
 
 def make_crc32c_words_raw(chunk_words: int, block_r: int = 512,
-                          interpret: bool = False):
+                          interpret: bool = False,
+                          return_bits: bool = False):
     """(n, chunk_words) uint32 word rows -> (n,) uint32 RAW CRC (no init/final
     affine).  Raw CRC is zero-preserving, so callers may FRONT-pad shorter
     buffers with zero bytes and apply affine_const(true_len) themselves —
     this is how the storage codec backend batches variable-length payloads.
+
+    return_bits=True yields the (n, 32) 0/1 int32 rows before packing —
+    the mesh codec applies per-shard tail-shift matrices to the bit rows
+    and packs only after the cp psum (parallel/codec_mesh.py).
 
     chunk_words must be a multiple of 128 (512-byte segments)."""
     from t3fs.ops.jax_codec import pack_bits_u32
@@ -374,6 +379,8 @@ def make_crc32c_words_raw(chunk_words: int, block_r: int = 512,
         raw = jax.lax.dot_general(
             seg_bits.reshape(n, nseg * 32), C, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32).astype(jnp.int32) & 1
+        if return_bits:
+            return raw
         return pack_bits_u32(raw)
 
     return raw_crc

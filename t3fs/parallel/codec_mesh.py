@@ -50,24 +50,19 @@ def make_mesh(n_devices: int | None = None, dp: int | None = None) -> Mesh:
     return Mesh(arr, ("dp", "cp"))
 
 
-def _crc_combine_setup(mesh: Mesh, chunk_len: int, seg_bytes: int):
-    """Shared scaffolding for the cp-sharded CRC: local raw-CRC core plus a
-    combine(raw, n, nshards) closure doing the shift-weighted psum over cp.
-    Used by BOTH the encode and the reconstruct steps — the tail-shift
-    exponent/affine math must never diverge between them."""
+def _tail_combine(mesh: Mesh, local_bytes: int, total_bytes: int):
+    """THE shift-weighted cp psum: bit rows (n*nshards, 32) of each
+    device's local raw CRC -> full-chunk CRCs (n, nshards) uint32.  One
+    construction shared by the byte-path AND word-path steps — the
+    tail-shift exponent/affine math must never diverge between codecs."""
     cp = mesh.shape["cp"]
-    assert chunk_len % cp == 0 and (chunk_len // cp) % seg_bytes == 0, (
-        f"chunk_len {chunk_len} must split into {cp} cp shards of whole "
-        f"{seg_bytes}-byte segments")
-    local_len = chunk_len // cp
     mats = default_matrices()
     # tail-shift matrix per cp rank: Mb^(bytes strictly after this shard)
     tails = jnp.asarray(np.stack([
-        mats.shift_matrix(local_len * (cp - 1 - r)).astype(np.int32)
+        mats.shift_matrix(local_bytes * (cp - 1 - r)).astype(np.int32)
         for r in range(cp)
     ]))
-    affine = np.uint32(mats.affine_const(chunk_len))
-    raw_local = make_crc32c_raw(local_len, seg_bytes)
+    affine = np.uint32(mats.affine_const(total_bytes))
 
     def combine(raw: jax.Array, n: int, nshards: int) -> jax.Array:
         r = jax.lax.axis_index("cp")
@@ -75,7 +70,19 @@ def _crc_combine_setup(mesh: Mesh, chunk_len: int, seg_bytes: int):
         total = _mod2(jax.lax.psum(shifted, axis_name="cp"))
         return pack_bits_u32(total).reshape(n, nshards) ^ affine
 
-    return local_len, raw_local, combine
+    return combine
+
+
+def _crc_combine_setup(mesh: Mesh, chunk_len: int, seg_bytes: int):
+    """Byte-path scaffolding for the cp-sharded CRC: local raw-CRC core
+    plus the shared _tail_combine closure."""
+    cp = mesh.shape["cp"]
+    assert chunk_len % cp == 0 and (chunk_len // cp) % seg_bytes == 0, (
+        f"chunk_len {chunk_len} must split into {cp} cp shards of whole "
+        f"{seg_bytes}-byte segments")
+    local_len = chunk_len // cp
+    raw_local = make_crc32c_raw(local_len, seg_bytes)
+    return local_len, raw_local, _tail_combine(mesh, local_len, chunk_len)
 
 
 def make_sharded_encode_step(mesh: Mesh, chunk_len: int, k: int = 8, m: int = 2,
@@ -108,6 +115,119 @@ def make_sharded_encode_step(mesh: Mesh, chunk_len: int, k: int = 8, m: int = 2,
         local_step, mesh=mesh,
         in_specs=P("dp", None, "cp"),
         out_specs=(P("dp", None, "cp"), P("dp", None)),
+    )
+    in_sharding = jax.NamedSharding(mesh, P("dp", None, "cp"))
+    return jax.jit(mapped), in_sharding
+
+
+def _crc_combine_words_setup(mesh: Mesh, chunk_words: int,
+                             interpret: bool):
+    """Word-kernel sibling of _crc_combine_setup: local raw CRC via the
+    Pallas word kernels (returning BIT rows) + the shared _tail_combine
+    psum.  Tail exponents are in BYTES (4x the word span)."""
+    from t3fs.ops.pallas_codec import make_crc32c_words_raw
+
+    cp = mesh.shape["cp"]
+    local_words = chunk_words // cp
+    assert chunk_words % cp == 0 and local_words % 128 == 0, (
+        f"chunk_words {chunk_words} must split into {cp} cp shards of "
+        f"whole 128-word (512-byte) segments")
+    raw_bits = make_crc32c_words_raw(local_words, interpret=interpret,
+                                     return_bits=True)
+    return local_words, raw_bits, _tail_combine(
+        mesh, local_words * 4, chunk_words * 4)
+
+
+def make_sharded_encode_step_words(mesh: Mesh, chunk_words: int,
+                                   k: int = 8, m: int = 2,
+                                   interpret: bool = False):
+    """The SHIPPING word-packed kernels under the mesh (r3 verdict #4:
+    the sharded path previously ran only the XLA bit-matmul codec, so
+    the multi-chip story and bench.py's measured configuration were
+    different programs).  Same kernels as bench.py's
+    make_stripe_encode_step_words, sharded dp over stripes and cp over
+    the word axis:
+
+      words (n, k, chunk_words) uint32, sharded P('dp', None, 'cp')
+        -> parity (n, m, chunk_words) uint32 same sharding,
+           crcs (n, k+m) uint32 replicated over cp.
+
+    The RAID-6 SWAR parity is word-position-local (zero comms under
+    cp); each device CRCs its local word span via the word kernel and
+    the chunk CRC rides the same shift-weighted psum as the byte path.
+    interpret=True runs the kernels under the Pallas interpreter on the
+    CPU mesh (tests/dryrun); on real chips pass False."""
+    from t3fs.ops.pallas_codec import make_rs_encode_words_pallas
+
+    assert m == 2, "word path is RAID-6 (m=2); use make_sharded_encode_step"
+    local_words, raw_bits, crc_combine = _crc_combine_words_setup(
+        mesh, chunk_words, interpret)
+    rs_enc = make_rs_encode_words_pallas(default_rs(k, m),
+                                         interpret=interpret)
+
+    def local_step(words: jax.Array):
+        n = words.shape[0]                  # (n_local, k, local_words)
+        parity = rs_enc(words)
+        dbits = raw_bits(words.reshape(n * k, local_words))
+        pbits = raw_bits(parity.reshape(n * m, local_words))
+        bits = jnp.concatenate(
+            [dbits.reshape(n, k, 32), pbits.reshape(n, m, 32)],
+            axis=1).reshape(n * (k + m), 32)
+        crcs = crc_combine(bits, n, k + m)
+        return parity, crcs
+
+    mapped = jax.shard_map(
+        local_step, mesh=mesh,
+        in_specs=P("dp", None, "cp"),
+        out_specs=(P("dp", None, "cp"), P("dp", None)),
+        check_vma=False,   # pallas_call outputs carry no vma annotation
+    )
+    in_sharding = jax.NamedSharding(mesh, P("dp", None, "cp"))
+    return jax.jit(mapped), in_sharding
+
+
+def make_sharded_reconstruct_step_words(mesh: Mesh, chunk_len: int,
+                                        present: tuple[int, ...],
+                                        want: tuple[int, ...],
+                                        k: int = 8, m: int = 2,
+                                        interpret: bool = False):
+    """Word-kernel recovery path under the mesh: the Pallas bit-matmul
+    reconstruct (same kernel the EC client ships) decodes each device's
+    local span, and the rebuilt shards' CRCs ride the word-kernel CRC +
+    cp psum.
+
+      survivors (n, k, chunk_len) uint8 sharded P('dp', None, 'cp')
+        -> rebuilt (n, |want|, chunk_len) uint8 same sharding,
+           crcs (n, |want|) uint32 replicated over cp.
+    """
+    from t3fs.ops.pallas_codec import make_rs_reconstruct_pallas
+
+    cp = mesh.shape["cp"]
+    assert chunk_len % (4 * cp) == 0, (chunk_len, cp)
+    local_len = chunk_len // cp
+    local_words, raw_bits, crc_combine = _crc_combine_words_setup(
+        mesh, chunk_len // 4, interpret)
+    from t3fs.ops.blocks import pick_block
+    rec = make_rs_reconstruct_pallas(present, want, default_rs(k, m),
+                                     block_t=pick_block(local_len, 32768),
+                                     interpret=interpret)
+    w = len(want)
+
+    def local_step(survivors: jax.Array):
+        n = survivors.shape[0]              # (n_local, k, local_len) uint8
+        rebuilt = rec(survivors)
+        # free little-endian view of the rebuilt bytes as uint32 words
+        # (same layout as numpy .view(np.uint32) on the host)
+        words = jax.lax.bitcast_convert_type(
+            rebuilt.reshape(n * w, local_words, 4), jnp.uint32)
+        crcs = crc_combine(raw_bits(words), n, w)
+        return rebuilt, crcs
+
+    mapped = jax.shard_map(
+        local_step, mesh=mesh,
+        in_specs=P("dp", None, "cp"),
+        out_specs=(P("dp", None, "cp"), P("dp", None)),
+        check_vma=False,   # pallas_call outputs carry no vma annotation
     )
     in_sharding = jax.NamedSharding(mesh, P("dp", None, "cp"))
     return jax.jit(mapped), in_sharding

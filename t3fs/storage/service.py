@@ -290,8 +290,12 @@ class StorageService:
             # this chain — acking any write here could lose acknowledged
             # data when the promoted chain diverges.  TARGET_OFFLINE is
             # retryable: the client refreshes routing and lands on the
-            # live chain.  Reads keep serving (a stale read is bounded by
-            # the chain's committed prefix; a stale ACK is not).
+            # live chain.  Reads keep serving UNDER THE CLIENT'S CHOICE:
+            # a ReadIO stamped with the client's routing chain_ver is
+            # version-checked in batch_read (fresh clients bounce off a
+            # deposed head via CHAIN_VERSION_MISMATCH); chain_ver=0 opts
+            # into the relaxed guarantee (stale read bounded by the
+            # committed prefix; a stale ACK is not).
             raise make_error(
                 StatusCode.TARGET_OFFLINE,
                 f"node {node.node_id} self-fenced: mgmtd lease expired")
@@ -457,12 +461,18 @@ class StorageService:
             raise make_error(StatusCode.INTERNAL, "injected server error")
         if node._read_sem is None:
             node._read_sem = asyncio.Semaphore(node.read_concurrency)
-        ios = unpack_readios(req.packed_ios) if req.packed_ios else req.ios
+        ios = (unpack_readios(req.packed_ios, req.packed_ver)
+               if req.packed_ios else req.ios)
 
         async def one(io: ReadIO) -> tuple[IOResult, bytes | None]:
             node.read_count.add()
             try:
-                chain, target = node._check_chain(io.chain_id, 0)
+                # io.chain_ver = 0 keeps CRAQ read-any semantics; a
+                # client that stamps its routing version is fenced off a
+                # node with a diverged view (incl. a self-fenced deposed
+                # head whose stale routing no longer matches fresh
+                # clients') — advisor r3 on the relaxed read guarantee
+                chain, target = node._check_chain(io.chain_id, io.chain_ver)
                 # small IOs run inline: the thread hop costs more than the
                 # read itself (KVCache-style 4-64 KiB random reads); large
                 # reads hop to a worker so they can't stall the event loop

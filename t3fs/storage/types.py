@@ -99,6 +99,13 @@ class ReadIO:
     # verify-only: server reads + checks but returns NO payload (admin
     # checksum sweeps would otherwise ship every chunk to the operator)
     no_payload: bool = False
+    # routing-version fence, like UpdateIO (advisor r3): 0 = unfenced
+    # (the relaxed CRAQ read-any guarantee — a fenced/deposed node may
+    # serve its committed prefix); a client that stamps its routing's
+    # chain_ver gets CHAIN_VERSION_MISMATCH from any node whose view
+    # diverged, closing the stale-read window during a partition.
+    # Appended last so positional construction stays stable.
+    chain_ver: int = 0
 
 
 @serde_struct
@@ -127,6 +134,12 @@ class BatchReadReq:
     # old client never sets it, an old server ignores both fields.
     packed_ios: bytes = b""
     want_packed: bool = False
+    # packed_ios stride version.  v1 (43-byte entries, no chain_ver) is
+    # the default an OLD client's serde implies by omitting the field;
+    # v2 appends chain_ver (51 bytes).  The server picks the unpack
+    # stride from this tag — stride-sniffing would mis-parse a 51-IO v1
+    # batch (51*43 is a multiple of both strides).
+    packed_ver: int = 1
 
 
 @serde_struct
@@ -261,7 +274,9 @@ class SyncDoneRsp:
 # inode/index are UNSIGNED 64-bit (KVCache derives inodes from hashes
 # with the top bit set; EC parity uses bit 62)
 _IORESULT_FMT = struct.Struct("<6q")            # code len uv cv ccv crc
-_READIO_FMT = struct.Struct("<2Q3q3B")          # inode idx chain off len +flags
+PACKED_READIO_VER = 2
+_READIO_FMT = struct.Struct("<2Q3q3Bq")  # v2: inode idx chain off len +flags +chain_ver
+_READIO_FMT_V1 = struct.Struct("<2Q3q3B")  # legacy (pre-chain_ver) stride
 
 
 def pack_ioresults(results: list[IOResult]) -> bytes | None:
@@ -299,14 +314,20 @@ def pack_readios(ios: list[ReadIO]) -> bytes | None:
             out += pack(io.chunk_id.inode, io.chunk_id.index, io.chain_id,
                         io.offset, io.length,
                         io.verify_checksum, io.allow_uncommitted,
-                        io.no_payload)
+                        io.no_payload, io.chain_ver)
     except struct.error:
         return None     # out-of-range field: the struct path handles it
     return bytes(out)
 
 
-def unpack_readios(blob: bytes) -> list[ReadIO]:
+def unpack_readios(blob: bytes, ver: int = 1) -> list[ReadIO]:
+    if ver < PACKED_READIO_VER:
+        # old client: legacy stride, chain_ver absent -> 0 (relaxed read)
+        return [ReadIO(ChunkId(inode, idx), chain, off, length, None,
+                       bool(vc), bool(au), bool(np_))
+                for inode, idx, chain, off, length, vc, au, np_
+                in _READIO_FMT_V1.iter_unpack(blob)]
     return [ReadIO(ChunkId(inode, idx), chain, off, length, None,
-                   bool(vc), bool(au), bool(np_))
-            for inode, idx, chain, off, length, vc, au, np_
+                   bool(vc), bool(au), bool(np_), cv)
+            for inode, idx, chain, off, length, vc, au, np_, cv
             in _READIO_FMT.iter_unpack(blob)]

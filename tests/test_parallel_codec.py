@@ -63,3 +63,61 @@ def test_sharded_reconstruct_matches_oracle():
         for j, s in enumerate(want):
             np.testing.assert_array_equal(rebuilt[i, j], allsh[i, s], (i, s))
             assert crcs[i, j] == crc32c_ref(allsh[i, s].tobytes()), (i, s)
+
+def test_sharded_word_encode_matches_oracle():
+    """r3 verdict #4: the SHIPPING word-packed kernels under the mesh —
+    previously the sharded path ran only the XLA bit-matmul codec, so
+    bench.py's measured configuration had no multi-chip story."""
+    from t3fs.parallel.codec_mesh import make_sharded_encode_step_words
+
+    mesh = make_mesh(8)
+    cp = mesh.shape["cp"]
+    interpret = jax.devices()[0].platform == "cpu"
+    chunk_words = 128 * cp * 2
+    step, in_sharding = make_sharded_encode_step_words(
+        mesh, chunk_words, interpret=interpret)
+    rng = np.random.default_rng(2)
+    n = mesh.shape["dp"] * 2
+    words = rng.integers(0, 2**32, (n, 8, chunk_words), dtype=np.uint32)
+    parity, crcs = step(jax.device_put(jnp.asarray(words), in_sharding))
+    parity = np.asarray(parity)
+    crcs = np.asarray(crcs)
+
+    rs = default_rs()
+    data_bytes = words.view(np.uint8).reshape(n, 8, chunk_words * 4)
+    for i in range(n):
+        expect_parity = rs.encode_ref(data_bytes[i])
+        np.testing.assert_array_equal(
+            parity[i].view(np.uint8).reshape(2, chunk_words * 4),
+            expect_parity)
+        allsh = np.concatenate([data_bytes[i], expect_parity], axis=0)
+        for s in range(10):
+            assert crcs[i, s] == crc32c_ref(allsh[s].tobytes()), (i, s)
+
+
+def test_sharded_word_reconstruct_matches_oracle():
+    from t3fs.parallel.codec_mesh import make_sharded_reconstruct_step_words
+
+    mesh = make_mesh(8)
+    cp = mesh.shape["cp"]
+    interpret = jax.devices()[0].platform == "cpu"
+    chunk_len = 512 * cp
+    rng = np.random.default_rng(3)
+    n = mesh.shape["dp"] * 2
+    rs = default_rs()
+    data = rng.integers(0, 256, (n, 8, chunk_len), dtype=np.uint8)
+    allsh = np.stack([np.concatenate([data[i], rs.encode_ref(data[i])])
+                      for i in range(n)])
+
+    want = (1, 8)
+    present = tuple(s for s in range(10) if s not in want)[:8]
+    step, in_sharding = make_sharded_reconstruct_step_words(
+        mesh, chunk_len, present, want, interpret=interpret)
+    survivors = allsh[:, list(present), :]
+    rebuilt, crcs = step(jax.device_put(jnp.asarray(survivors), in_sharding))
+    rebuilt = np.asarray(rebuilt)
+    crcs = np.asarray(crcs)
+    for i in range(n):
+        for j, s in enumerate(want):
+            np.testing.assert_array_equal(rebuilt[i, j], allsh[i, s], (i, s))
+            assert crcs[i, j] == crc32c_ref(allsh[i, s].tobytes()), (i, s)

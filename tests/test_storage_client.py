@@ -174,16 +174,33 @@ def test_batch_read_packed_fast_path_roundtrip():
     back for RemoteBuf/overflow IOs, and interop with the struct path
     (r3 perf work — see docs/perf_multiprocess.md)."""
     from t3fs.storage.types import (
-        ChunkId, IOResult, ReadIO, pack_ioresults, pack_readios,
-        unpack_ioresults, unpack_readios,
+        PACKED_READIO_VER, ChunkId, IOResult, ReadIO, pack_ioresults,
+        pack_readios, unpack_ioresults, unpack_readios,
     )
     from t3fs.net.wire import WireStatus
 
     ios = [ReadIO(ChunkId((1 << 63) | 7, i), 3, i * 512, 16384,
-                  verify_checksum=(i % 2 == 0), no_payload=(i == 5))
+                  verify_checksum=(i % 2 == 0), no_payload=(i == 5),
+                  chain_ver=(i % 3))
            for i in range(32)]
     blob = pack_readios(ios)
-    assert blob is not None and unpack_readios(blob) == ios
+    assert blob is not None and \
+        unpack_readios(blob, PACKED_READIO_VER) == ios
+    # a v1 client's legacy-stride blob still decodes (chain_ver -> 0):
+    # stride sniffing cannot distinguish 51 v1 entries from 43 v2 ones,
+    # so the server keys on BatchReadReq.packed_ver instead
+    from t3fs.storage.types import _READIO_FMT_V1
+    legacy = b"".join(
+        _READIO_FMT_V1.pack(io.chunk_id.inode, io.chunk_id.index,
+                            io.chain_id, io.offset, io.length,
+                            io.verify_checksum, io.allow_uncommitted,
+                            io.no_payload)
+        for io in ios)
+    got = unpack_readios(legacy, 1)
+    assert [(g.chunk_id, g.chain_id, g.offset, g.length, g.chain_ver)
+            for g in got] == \
+        [(io.chunk_id, io.chain_id, io.offset, io.length, 0)
+         for io in ios]
 
     # RemoteBuf forces the struct path
     from t3fs.net.rdma import RemoteBuf
@@ -292,6 +309,93 @@ def test_batch_read_packed_interop_with_old_server():
             got2, _ = await sc.read_file_range(lay, 5, 0, len(data))
             assert got2 == data
             assert all(c is False for c in calls[n:])
+        finally:
+            await fab.stop()
+    _a.run(body())
+
+def test_batch_read_packed_fallback_on_erroring_old_server():
+    """Advisor r3: an old server whose decoder ERRORS on the unknown
+    packed fields (instead of echoing an empty batch) must trigger a
+    one-shot struct-path retry with the address memoized — the first cut
+    failed every IO and kept re-sending packed batches forever."""
+    import asyncio as _a
+
+    async def body():
+        from t3fs.testing.fabric import StorageFabric
+        from t3fs.utils.status import StatusError, make_error
+        fab = StorageFabric(num_nodes=1, replicas=1)
+        await fab.start()
+        try:
+            sc = StorageClient(lambda: fab.routing, client=fab.client)
+            lay = FileLayout(chunk_size=16384, chains=[fab.chain_id])
+            data = bytes(range(256)) * 64
+            await sc.write_file_range(lay, 6, 0, data)
+
+            orig_call = fab.client.call
+            calls = []
+
+            async def erroring_old_server(addr, method, req=None, **kw):
+                if method == "Storage.batch_read":
+                    calls.append(bool(req.packed_ios))
+                    if req.packed_ios:
+                        raise make_error(StatusCode.INVALID_ARG,
+                                         "unknown field packed_ios")
+                return await orig_call(addr, method, req, **kw)
+            fab.client.call = erroring_old_server
+
+            got, results = await sc.read_file_range(lay, 6, 0, len(data))
+            assert got == data
+            assert all(r.status.code == 0 for r in results)
+            assert calls[0] is True and calls[1] is False
+            # memoized: subsequent batches go straight to the struct path
+            n = len(calls)
+            got2, _ = await sc.read_file_range(lay, 6, 0, len(data))
+            assert got2 == data
+            assert all(c is False for c in calls[n:])
+        finally:
+            await fab.stop()
+    _a.run(body())
+
+
+def test_read_chain_version_fence():
+    """Advisor r3: reads carry chain_ver like writes.  A stamped version
+    that diverges from the server's routing answers
+    CHAIN_VERSION_MISMATCH (no stale read); chain_ver=0 keeps the
+    relaxed CRAQ read-any behavior."""
+    import asyncio as _a
+
+    async def body():
+        from t3fs.storage.types import BatchReadReq, ReadIO
+        from t3fs.testing.fabric import StorageFabric
+        fab = StorageFabric(num_nodes=1, replicas=1)
+        await fab.start()
+        try:
+            sc = StorageClient(lambda: fab.routing, client=fab.client)
+            lay = FileLayout(chunk_size=16384, chains=[fab.chain_id])
+            await sc.write_file_range(lay, 7, 0, b"fence" * 100)
+            chain = fab.routing.chain(fab.chain_id)
+            addr = fab.routing.node_address(chain.head().node_id)
+
+            def io(ver):
+                return ReadIO(chunk_id=ChunkId(7, 0), chain_id=fab.chain_id,
+                              length=500, chain_ver=ver)
+
+            # diverged version -> fenced
+            rsp, _ = await fab.client.call(
+                addr, "Storage.batch_read",
+                BatchReadReq(ios=[io(chain.chain_ver + 5)]))
+            assert rsp.results[0].status.code == \
+                int(StatusCode.CHAIN_VERSION_MISMATCH)
+            # matching version and the 0 opt-out both serve
+            for ver in (chain.chain_ver, 0):
+                rsp, payload = await fab.client.call(
+                    addr, "Storage.batch_read", BatchReadReq(ios=[io(ver)]))
+                assert rsp.results[0].status.code == int(StatusCode.OK)
+                assert payload == b"fence" * 100
+            # and the high-level client (which stamps its routing's
+            # version) round-trips
+            got, _ = await sc.read_file_range(lay, 7, 0, 500)
+            assert got == b"fence" * 100
         finally:
             await fab.stop()
     _a.run(body())
