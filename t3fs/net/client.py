@@ -27,6 +27,12 @@ class Client:
         self.dispatcher: dict = {}
         self._conns: dict[str, Connection] = {}
         self._locks: dict[str, asyncio.Lock] = {}
+        # bumped on every NEW connection to an address: callers that
+        # memoize per-peer negotiated state (e.g. the storage client's
+        # packed-wire version) scope it to the epoch, so a server
+        # restart — possibly a ROLLBACK to an older binary — forces
+        # re-negotiation instead of mis-parsing (code-review r4)
+        self._epochs: dict[str, int] = {}
 
     def add_service(self, svc: Any) -> None:
         """Expose a local service to servers (reverse-direction RPC)."""
@@ -53,7 +59,20 @@ class Client:
                               compress_threshold=self.compress_threshold)
             conn.start()
             self._conns[address] = conn
+            self._epochs[address] = self._epochs.get(address, 0) + 1
             return conn
+
+    def epoch(self, address: str) -> int:
+        """Connection generation for address (0 = never connected).
+        When the current connection is closed/absent, returns the epoch
+        the NEXT call will establish — so a caller checking its memo
+        BEFORE a call already sees the stale-ness of state negotiated on
+        the dead connection."""
+        n = self._epochs.get(address, 0)
+        conn = self._conns.get(address)
+        if conn is None or conn.closed:
+            return n + 1
+        return n
 
     async def call(self, address: str, method: str, body: object = None,
                    payload: bytes = b"", timeout: float = 30.0) -> tuple[object, bytes]:

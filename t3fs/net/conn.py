@@ -20,6 +20,7 @@ from t3fs.net.wire import (
     WireStatus, check_msg_crc, decompress_frame, maybe_compress, pack_header,
     unpack_header,
 )
+from t3fs.net.rpcstats import RPC_STATS
 from t3fs.ops.codec import crc32c
 from t3fs.utils import serde
 from t3fs.utils.status import Status, StatusCode, StatusError, make_error
@@ -155,6 +156,18 @@ class Connection:
             except asyncio.TimeoutError:
                 raise make_error(StatusCode.RPC_TIMEOUT,
                                  f"{method} timed out after {timeout}s") from None
+            if rsp.ts_server_replied:
+                # latency decomposition (rpcstats module docstring);
+                # squeue/server are same-clock server intervals, network
+                # is the clock-skew-free remainder
+                total = time.time() - packet.ts_client_called
+                server_span = rsp.ts_server_replied - rsp.ts_server_received
+                started = rsp.ts_server_started or rsp.ts_server_received
+                RPC_STATS.record(
+                    method, total,
+                    squeue=started - rsp.ts_server_received,
+                    server=rsp.ts_server_replied - started,
+                    network=max(0.0, total - server_span))
             status = rsp.status.to_status()
             status.raise_if_error()
             return rsp.body, rsp_payload
@@ -182,7 +195,8 @@ class Connection:
                     check_msg_crc(msg, msg_crc)
                 packet = serde.loads(msg)
                 if packet.is_req:
-                    self._spawn(self._handle_request(packet, payload),
+                    self._spawn(self._handle_request(packet, payload,
+                                                     time.time()),
                                 f"req-{packet.method}")
                 else:
                     fut = self._waiters.get(packet.uuid)
@@ -200,9 +214,11 @@ class Connection:
             if not self._closed:
                 self._spawn(self.close(), f"close-{self.name}")
 
-    async def _handle_request(self, packet: MessagePacket, payload: bytes) -> None:
+    async def _handle_request(self, packet: MessagePacket, payload: bytes,
+                              recv_ts: float = 0.0) -> None:
         rsp = MessagePacket(uuid=packet.uuid, method=packet.method, is_req=False)
-        rsp.ts_server_received = time.time()
+        rsp.ts_server_received = recv_ts or time.time()
+        rsp.ts_server_started = time.time()   # gap = server-side queueing
         rsp_payload = b""
         handler = self.dispatcher.get(packet.method)
         try:

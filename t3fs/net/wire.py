@@ -142,6 +142,10 @@ class MessagePacket:
     ts_server_received: float = 0.0
     ts_server_replied: float = 0.0
     body: object = None           # registered serde struct (or None)
+    # when the handler task first ran (vs received = read-loop time):
+    # the gap is server-side queueing.  Appended last (serde add-only);
+    # reference carries 8 such stamps (serde/MessagePacket.h:43-50)
+    ts_server_started: float = 0.0
 
     def stamp_called(self) -> "MessagePacket":
         self.ts_client_called = time.time()

@@ -10,7 +10,7 @@ from __future__ import annotations
 import asyncio
 import logging
 
-from t3fs.storage.types import IOResult, UpdateIO
+from t3fs.storage.types import IOResult, UpdateIO, update_rpc
 from t3fs.net.wire import WireStatus
 from t3fs.utils.status import StatusCode, StatusError, make_error
 
@@ -145,6 +145,17 @@ class ReliableForwarding:
         self.node = node  # StorageNode (provides client + routing)
         self.max_attempts = max_attempts
         self.retry_delay_s = retry_delay_s
+        # successors whose server predates Storage.update_packed
+        # (detected by RPC_METHOD_NOT_FOUND, same negotiation as the
+        # client's packed write path)
+        self._no_packed: set[str] = set()
+
+    async def _call_update(self, address: str, fwd: UpdateIO,
+                           payload: bytes) -> IOResult:
+        return await update_rpc(
+            self.node.client, address, fwd, payload,
+            self.node.forward_timeout_s, self._no_packed,
+            "Storage.update_packed", "Storage.update", fwd)
 
     async def forward(self, target_id: int, io: UpdateIO,
                       payload: bytes) -> IOResult | None:
@@ -182,10 +193,7 @@ class ReliableForwarding:
             fwd.buf = None
             fwd.chain_ver = chain.chain_ver
             try:
-                rsp, _ = await self.node.client.call(
-                    address, "Storage.update", fwd, payload=payload,
-                    timeout=self.node.forward_timeout_s)
-                return rsp.result
+                return await self._call_update(address, fwd, payload)
             except StatusError as e:
                 attempt += 1
                 # retry until mgmtd reshapes the chain past the dead successor

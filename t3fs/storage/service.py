@@ -30,11 +30,13 @@ from t3fs.storage.chunk_engine import ChunkEngine
 from t3fs.storage.chunk_replica import ChunkReplica
 from t3fs.storage.reliable import ReliableForwarding, ReliableUpdate
 from t3fs.storage.types import (
-    BatchReadReq, BatchReadRsp, ChunkId, IOResult,
+    BatchReadReq, BatchReadRsp, ChunkId, IOResult, PACKED_READIO_VER,
+    PackedIOReq, PackedIORsp,
     QueryChunkReq, QueryChunkRsp, QueryLastChunkReq, QueryLastChunkRsp,
     ReadIO, RemoveChunksReq, SpaceInfoRsp, SyncDoneReq, SyncDoneRsp,
     SyncStartReq, SyncStartRsp, TargetOpReq, TargetOpRsp, TruncateChunkReq,
-    UpdateIO, UpdateType, WriteReq, WriteRsp, pack_ioresults, unpack_readios,
+    UpdateIO, UpdateType, WriteReq, WriteRsp, pack_ioresults,
+    unpack_readios, unpack_updateio,
 )
 from t3fs.analytics.trace_log import StorageEventTrace
 from t3fs.utils.fault_injection import fault_raise
@@ -244,6 +246,37 @@ class StorageService:
         result = await self._update_to_result(req, payload, conn,
                                               require_head=False)
         return WriteRsp(result=result), b""
+
+    # -- packed twins (negotiated by method name: an old server answers
+    # RPC_METHOD_NOT_FOUND and the caller falls back to the struct RPC) --
+
+    @staticmethod
+    def _packed_rsp(result: IOResult) -> "PackedIORsp":
+        packed = pack_ioresults([result])
+        if packed is not None:
+            return PackedIORsp(packed=packed)
+        return PackedIORsp(result=result)   # error message must survive
+
+    @rpc_method
+    async def write_packed(self, req: PackedIOReq, payload: bytes,
+                           conn: Connection):
+        """Client entry point, packed-wire twin of write()."""
+        io = unpack_updateio(req.blob)
+        with self.node.write_latency.time():
+            result = await self._update_to_result(io, payload, conn,
+                                                  require_head=True)
+        return self._packed_rsp(result), b""
+
+    @rpc_method
+    async def update_packed(self, req: PackedIOReq, payload: bytes,
+                            conn: Connection):
+        """Chain-internal hop, packed-wire twin of update()."""
+        io = unpack_updateio(req.blob)
+        if not io.from_head:
+            raise make_error(StatusCode.INVALID_ARG, "update must come from chain")
+        result = await self._update_to_result(io, payload, conn,
+                                              require_head=False)
+        return self._packed_rsp(result), b""
 
     async def _handle_update(self, io: UpdateIO, payload: bytes,
                              conn: Connection, require_head: bool) -> IOResult:
@@ -509,7 +542,10 @@ class StorageService:
         if req.want_packed:
             packed = pack_ioresults(results)
             if packed is not None:
-                return (BatchReadRsp(packed_results=packed),
+                # packed_ver advertises OUR request-side decode stride;
+                # the client packs later batches at min(this, its own)
+                return (BatchReadRsp(packed_results=packed,
+                                     packed_ver=PACKED_READIO_VER),
                         b"".join(inline_parts))
         return BatchReadRsp(results=results), b"".join(inline_parts)
 

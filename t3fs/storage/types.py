@@ -151,6 +151,14 @@ class BatchReadRsp:
     # packed IOResults (pack_ioresults; only when the request set
     # want_packed and no result carries an error message)
     packed_results: bytes = b""
+    # HIGHEST packed_ios stride version this server decodes.  A v1-era
+    # server's serde omits the field -> decodes as 1; a pre-packed
+    # server answers no packed_results at all.  The client sends its
+    # FIRST batch per address on the struct path and packs subsequent
+    # batches at the server's advertised version — never above it
+    # (code-review r4: a v2 blob on a v1 server mis-parses, and 43 v2
+    # entries = 51 v1 entries byte-for-byte, silently).
+    packed_ver: int = 1
 
 
 @serde_struct
@@ -302,19 +310,29 @@ def unpack_ioresults(blob: bytes) -> list[IOResult]:
             in _IORESULT_FMT.iter_unpack(blob)]
 
 
-def pack_readios(ios: list[ReadIO]) -> bytes | None:
-    """Fixed-stride encoding of a read batch; None when any IO carries a
-    RemoteBuf (buf-push IOs need the full struct)."""
+def pack_readios(ios: list[ReadIO],
+                 ver: int = PACKED_READIO_VER) -> bytes | None:
+    """Fixed-stride encoding of a read batch at the given protocol
+    version (never above what the server advertised); None when any IO
+    carries a RemoteBuf (buf-push IOs need the full struct)."""
     out = bytearray()
-    pack = _READIO_FMT.pack
+    v1 = ver < PACKED_READIO_VER
+    pack = (_READIO_FMT_V1 if v1 else _READIO_FMT).pack
     try:
         for io in ios:
             if io.buf is not None:
                 return None
-            out += pack(io.chunk_id.inode, io.chunk_id.index, io.chain_id,
-                        io.offset, io.length,
-                        io.verify_checksum, io.allow_uncommitted,
-                        io.no_payload, io.chain_ver)
+            if v1:
+                # a v1 server ignores chain_ver anyway (relaxed reads)
+                out += pack(io.chunk_id.inode, io.chunk_id.index,
+                            io.chain_id, io.offset, io.length,
+                            io.verify_checksum, io.allow_uncommitted,
+                            io.no_payload)
+            else:
+                out += pack(io.chunk_id.inode, io.chunk_id.index,
+                            io.chain_id, io.offset, io.length,
+                            io.verify_checksum, io.allow_uncommitted,
+                            io.no_payload, io.chain_ver)
     except struct.error:
         return None     # out-of-range field: the struct path handles it
     return bytes(out)
@@ -331,3 +349,102 @@ def unpack_readios(blob: bytes, ver: int = 1) -> list[ReadIO]:
                    bool(vc), bool(au), bool(np_), cv)
             for inode, idx, chain, off, length, vc, au, np_, cv
             in _READIO_FMT.iter_unpack(blob)]
+
+# ---- packed UpdateIO fast path (write / chain-forward hop) ----
+# The write path walks ~20 tagged fields per UpdateIO each way through
+# the tag codec — on the 1-CPU multi-process fabric serde IS the write
+# bottleneck (r3 verdict #3; reads got this treatment in r3).  The
+# common-case UpdateIO (no RemoteBuf, no fault injection) packs to one
+# fixed-stride head + the client_id tail.  Negotiation is by METHOD
+# name: Storage.write_packed / Storage.update_packed answer
+# RPC_METHOD_NOT_FOUND on an old server, and the caller memoizes the
+# address and falls back to the struct path.
+
+_UPDATEIO_FMT = struct.Struct("<2Q10q3B")   # inode idx | chain chain_ver off
+# len csize uver cver cksum chan chanseq | type flags cid_len
+
+
+def pack_updateio(io: UpdateIO) -> bytes | None:
+    """None when the IO needs the full struct (RemoteBuf pull, fault
+    injection flags, oversized client_id, out-of-range field)."""
+    d = io.debug
+    if io.buf is not None or d.inject_server_error_prob or \
+            d.inject_client_error_prob or d.num_points_before_fail:
+        return None
+    cid = io.client_id.encode()
+    if len(cid) > 255:
+        return None
+    flags = (io.inline | io.is_sync << 1 | io.from_head << 2
+             | io.commit_only << 3)
+    try:
+        head = _UPDATEIO_FMT.pack(
+            io.chunk_id.inode, io.chunk_id.index, io.chain_id, io.chain_ver,
+            io.offset, io.length, io.chunk_size, io.update_ver,
+            io.commit_ver, io.checksum, io.channel, io.channel_seq,
+            int(io.update_type), flags, len(cid))
+    except struct.error:
+        return None
+    return head + cid
+
+
+def unpack_updateio(blob: bytes) -> UpdateIO:
+    (inode, idx, chain, cver, off, length, csize, uver, commit_ver, cksum,
+     chan, chanseq, utype, flags, cid_len) = _UPDATEIO_FMT.unpack_from(blob)
+    cid = blob[_UPDATEIO_FMT.size:]
+    if len(cid) != cid_len:
+        raise ValueError(f"packed UpdateIO tail {len(cid)} != {cid_len}")
+    return UpdateIO(
+        chunk_id=ChunkId(inode, idx), chain_id=chain, chain_ver=cver,
+        update_type=UpdateType(utype), offset=off, length=length,
+        chunk_size=csize, update_ver=uver, commit_ver=commit_ver,
+        checksum=cksum, channel=chan, channel_seq=chanseq,
+        client_id=cid.decode(), inline=bool(flags & 1),
+        is_sync=bool(flags & 2), from_head=bool(flags & 4),
+        commit_only=bool(flags & 8))
+
+
+@serde_struct
+@dataclass
+class PackedIOReq:
+    """One packed UpdateIO (write_packed / update_packed): a single
+    bytes field instead of a ~20-field nested struct."""
+    blob: bytes = b""
+
+
+@serde_struct
+@dataclass
+class PackedIORsp:
+    """packed = _IORESULT_FMT when the result has no error message;
+    result carries the full struct otherwise."""
+    packed: bytes = b""
+    result: IOResult | None = None
+
+
+async def update_rpc(client, address: str, io: UpdateIO, payload: bytes,
+                     timeout: float, no_packed: set[str],
+                     packed_method: str, struct_method: str,
+                     struct_req: object) -> IOResult:
+    """One update-shaped RPC, packed wire when the server supports it.
+    Shared by the client write path and the CRAQ forward hop (the
+    negotiation protocol must never diverge between them): try the
+    packed method, and on RPC_METHOD_NOT_FOUND memoize the address as
+    pre-packed and fall back to the struct RPC."""
+    from t3fs.utils.status import StatusCode, StatusError
+
+    if address not in no_packed:
+        blob = pack_updateio(io)
+        if blob is not None:
+            try:
+                rsp, _ = await client.call(
+                    address, packed_method, PackedIOReq(blob=blob),
+                    payload=payload, timeout=timeout)
+                if rsp.packed:
+                    return unpack_ioresults(rsp.packed)[0]
+                return rsp.result
+            except StatusError as e:
+                if e.code != StatusCode.RPC_METHOD_NOT_FOUND:
+                    raise
+                no_packed.add(address)      # old server
+    rsp, _ = await client.call(address, struct_method, struct_req,
+                               payload=payload, timeout=timeout)
+    return rsp.result

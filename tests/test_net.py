@@ -259,3 +259,73 @@ def test_compressed_large_frame_offload(loop_run):
             await client.close()
             await server.stop()
     loop_run(body())
+
+def test_rpc_latency_decomposition_and_rpc_top():
+    """r3 verdict #7: the wire timestamps must be CONSUMED — every call
+    records a queue/server/network split per method, dumps to JSON, and
+    the rpc-top CLI renders the table."""
+    import json
+    import subprocess
+    import sys
+
+    from t3fs.net.rpcstats import RPC_STATS, render_top
+
+    async def body():
+        from t3fs.net.client import Client
+        from t3fs.net.server import Server
+        from t3fs.utils.serde import serde_struct
+        from dataclasses import dataclass
+        from t3fs.net.server import service, rpc_method
+
+        @serde_struct
+        @dataclass
+        class PingReq:
+            n: int = 0
+
+        @service("LatPing")
+        class PingSvc:
+            @rpc_method
+            async def ping(self, req: PingReq, payload, conn):
+                await asyncio.sleep(0.01)     # measurable server time
+                return PingReq(n=req.n + 1), b""
+
+        RPC_STATS.clear()
+        srv = Server()
+        srv.add_service(PingSvc())
+        await srv.start()
+        cli = Client()
+        try:
+            for i in range(20):
+                rsp, _ = await cli.call(srv.address, "LatPing.ping",
+                                        PingReq(n=i))
+                assert rsp.n == i + 1
+        finally:
+            await cli.close()
+            await srv.stop()
+
+        snap = RPC_STATS.snapshot()
+        row = snap["LatPing.ping"]
+        assert row["count"] == 20
+        # the 10ms handler sleep must show up in the SERVER component
+        assert row["server_p50_ms"] >= 9.0, row
+        # total >= server, and the network remainder is non-negative
+        assert row["total_p50_ms"] >= row["server_p50_ms"], row
+        assert row["network_p50_ms"] >= 0.0, row
+        return snap
+
+    snap = asyncio.run(body())
+    # render via the CLI entry point
+    import tempfile, os
+    with tempfile.TemporaryDirectory() as td:
+        p = os.path.join(td, "rpc.json")
+        with open(p, "w") as f:
+            json.dump(snap, f)
+        out = subprocess.run(
+            [sys.executable, "-m", "t3fs.cli.admin", "--mgmtd",
+             "127.0.0.1:1", "rpc-top", p],
+            capture_output=True, text=True, timeout=60)
+        assert out.returncode == 0, (out.stdout, out.stderr)
+        assert "LatPing.ping" in out.stdout
+        assert "srv50" in out.stdout
+    # merged render of two snapshots also works
+    assert "LatPing.ping" in render_top([snap, snap])

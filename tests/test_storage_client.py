@@ -218,12 +218,13 @@ def test_batch_read_packed_fast_path_roundtrip():
 
 
 def test_batch_read_uses_packed_wire_path():
-    """End-to-end: the client sends packed_ios and the server answers
-    packed_results on a clean batch; a batch with an error message falls
-    back to the struct list transparently."""
+    """End-to-end negotiation: the FIRST batch per address rides the
+    struct path with want_packed, the server advertises its packed_ver,
+    and subsequent batches ship packed_ios at that version; a batch with
+    an error message falls back to the struct list transparently."""
     import asyncio as _a
 
-    from t3fs.storage.types import BatchReadRsp
+    from t3fs.storage.types import BatchReadRsp, PACKED_READIO_VER
     from t3fs.testing.fabric import StorageFabric
     from t3fs.client.layout import FileLayout
 
@@ -232,27 +233,40 @@ def test_batch_read_uses_packed_wire_path():
         await fab.start()
         try:
             from t3fs.client.storage_client import StorageClient
-            sc = StorageClient(lambda: fab.routing, client=fab.client)
+            # pin reads to one target: the packed_ver advertisement is
+            # learned PER ADDRESS, so round-robin reads would still be on
+            # their first (struct) batch against the other replicas
+            sc = StorageClient(
+                lambda: fab.routing, client=fab.client,
+                config=StorageClientConfig(
+                    read_selection=TargetSelection.HEAD_TARGET))
             lay = FileLayout(chunk_size=16384, chains=[fab.chain_id])
             data = bytes(range(256)) * 256          # 4 chunks
             await sc.write_file_range(lay, 77, 0, data)
 
             # spy on the RPC client to assert the wire shape
-            seen = {}
+            seen = []
             orig_call = fab.client.call
 
             async def spy_call(addr, method, req=None, **kw):
                 rsp, payload = await orig_call(addr, method, req, **kw)
                 if method == "Storage.batch_read":
-                    seen["req_packed"] = bool(req.packed_ios)
-                    seen["rsp_packed"] = bool(
-                        isinstance(rsp, BatchReadRsp) and rsp.packed_results)
+                    seen.append((bool(req.packed_ios), bool(
+                        isinstance(rsp, BatchReadRsp) and rsp.packed_results)))
                 return rsp, payload
             fab.client.call = spy_call
 
             got, results = await sc.read_file_range(lay, 77, 0, len(data))
             assert got == data
-            assert seen == {"req_packed": True, "rsp_packed": True}, seen
+            # first batch: struct request, packed response (advertises)
+            assert seen[0] == (False, True), seen
+            assert {v for v, _ in sc._packed_ver.values()} == \
+                {PACKED_READIO_VER}
+
+            # second batch to the same address: packed request
+            got, results = await sc.read_file_range(lay, 77, 0, len(data))
+            assert got == data
+            assert seen[-1] == (True, True), seen
 
             # a read of a missing chunk produces an error message ->
             # struct-path response; the client still decodes it fine
@@ -260,7 +274,7 @@ def test_batch_read_uses_packed_wire_path():
             res, _ = await sc.batch_read(
                 [ReadIO(ChunkId(9999, 0), fab.chain_id, 0, 4096)])
             assert res[0].status.code != 0
-            assert seen["rsp_packed"] is False
+            assert seen[-1][1] is False
         finally:
             await fab.stop()
     _a.run(body())
@@ -268,9 +282,9 @@ def test_batch_read_uses_packed_wire_path():
 
 def test_batch_read_packed_interop_with_old_server():
     """A server that predates the packed encoding drops the unknown
-    fields and answers an empty batch; the client must detect this,
-    re-send on the struct path, and memoize the address (code-review r3:
-    the first cut silently failed the whole batch)."""
+    want_packed/packed_ver fields and answers struct results; since it
+    never ADVERTISES a packed_ver, the client must keep every batch on
+    the struct path (never a packed blob it could mis-parse)."""
     import asyncio as _a
 
     async def body():
@@ -286,72 +300,72 @@ def test_batch_read_packed_interop_with_old_server():
             await sc.write_file_range(lay, 5, 0, data)
 
             # emulate an OLD server: its serde drops the unknown packed
-            # fields, so it sees ios=[] and answers results=[]
+            # request fields and its responses carry no packed_results
             orig_call = fab.client.call
             calls = []
 
             async def old_server_call(addr, method, req=None, **kw):
                 if method == "Storage.batch_read":
                     calls.append(bool(req.packed_ios))
-                    if req.packed_ios:
-                        req.packed_ios = b""
-                        req.want_packed = False
+                    assert not req.packed_ios, \
+                        "client packed to a server that never advertised"
+                    req.want_packed = False
                 return await orig_call(addr, method, req, **kw)
             fab.client.call = old_server_call
 
-            got, results = await sc.read_file_range(lay, 5, 0, len(data))
-            assert got == data
-            assert all(r.status.code == 0 for r in results)
-            # first attempt was packed, fallback was struct, and the
-            # address is memoized so later reads skip packing entirely
-            assert calls[0] is True and calls[1] is False
-            n = len(calls)
-            got2, _ = await sc.read_file_range(lay, 5, 0, len(data))
-            assert got2 == data
-            assert all(c is False for c in calls[n:])
+            for _ in range(3):
+                got, results = await sc.read_file_range(lay, 5, 0, len(data))
+                assert got == data
+                assert all(r.status.code == 0 for r in results)
+            assert calls and all(c is False for c in calls)
+            assert not sc._packed_ver      # never advertised -> never learned
         finally:
             await fab.stop()
     _a.run(body())
 
-def test_batch_read_packed_fallback_on_erroring_old_server():
-    """Advisor r3: an old server whose decoder ERRORS on the unknown
-    packed fields (instead of echoing an empty batch) must trigger a
-    one-shot struct-path retry with the address memoized — the first cut
-    failed every IO and kept re-sending packed batches forever."""
+
+def test_batch_read_downgrades_to_v1_packed_server():
+    """Version negotiation (code-review r4): a server that advertises
+    packed_ver=1 must receive v1 (43-byte) blobs — a v2 blob would
+    mis-parse there (43 v2 entries == 51 v1 entries byte-for-byte).
+    The real server decodes the v1 blob via req.packed_ver."""
     import asyncio as _a
 
     async def body():
         from t3fs.testing.fabric import StorageFabric
-        from t3fs.utils.status import StatusError, make_error
+        from t3fs.client.storage_client import StorageClient
+        from t3fs.client.layout import FileLayout
+        from t3fs.storage.types import _READIO_FMT_V1
         fab = StorageFabric(num_nodes=1, replicas=1)
         await fab.start()
         try:
             sc = StorageClient(lambda: fab.routing, client=fab.client)
             lay = FileLayout(chunk_size=16384, chains=[fab.chain_id])
-            data = bytes(range(256)) * 64
+            data = bytes(range(256)) * 128
             await sc.write_file_range(lay, 6, 0, data)
 
             orig_call = fab.client.call
-            calls = []
+            packed_lens = []
 
-            async def erroring_old_server(addr, method, req=None, **kw):
+            async def v1_server_call(addr, method, req=None, **kw):
+                rsp, payload = await orig_call(addr, method, req, **kw)
                 if method == "Storage.batch_read":
-                    calls.append(bool(req.packed_ios))
                     if req.packed_ios:
-                        raise make_error(StatusCode.INVALID_ARG,
-                                         "unknown field packed_ios")
-                return await orig_call(addr, method, req, **kw)
-            fab.client.call = erroring_old_server
+                        packed_lens.append(len(req.packed_ios))
+                        assert req.packed_ver == 1
+                    if rsp.packed_results:
+                        rsp.packed_ver = 1      # server speaks v1 only
+                return rsp, payload
+            fab.client.call = v1_server_call
 
-            got, results = await sc.read_file_range(lay, 6, 0, len(data))
-            assert got == data
-            assert all(r.status.code == 0 for r in results)
-            assert calls[0] is True and calls[1] is False
-            # memoized: subsequent batches go straight to the struct path
-            n = len(calls)
-            got2, _ = await sc.read_file_range(lay, 6, 0, len(data))
-            assert got2 == data
-            assert all(c is False for c in calls[n:])
+            got, _ = await sc.read_file_range(lay, 6, 0, len(data))
+            assert got == data                  # struct first batch
+            assert sc._packed_ver and \
+                {v for v, _ in sc._packed_ver.values()} == {1}
+            got, _ = await sc.read_file_range(lay, 6, 0, len(data))
+            assert got == data                  # v1-packed second batch
+            assert packed_lens and all(
+                n % _READIO_FMT_V1.size == 0 for n in packed_lens)
         finally:
             await fab.stop()
     _a.run(body())
@@ -396,6 +410,130 @@ def test_read_chain_version_fence():
             # version) round-trips
             got, _ = await sc.read_file_range(lay, 7, 0, 500)
             assert got == b"fence" * 100
+        finally:
+            await fab.stop()
+    _a.run(body())
+
+def test_packed_updateio_roundtrip():
+    """pack_updateio must be byte-accurate for the common case and
+    refuse RemoteBuf / fault-injection / oversized-id IOs."""
+    from t3fs.net.rdma import RemoteBuf
+    from t3fs.storage.types import (
+        UpdateIO, UpdateType, pack_updateio, unpack_updateio,
+    )
+    from t3fs.utils.fault_injection import DebugFlags
+
+    io = UpdateIO(chunk_id=ChunkId((1 << 63) | 5, 7), chain_id=3,
+                  chain_ver=2, update_type=UpdateType.TRUNCATE, offset=64,
+                  length=4096, chunk_size=1 << 20, update_ver=9,
+                  commit_ver=8, checksum=0xDEADBEEF, channel=4,
+                  channel_seq=17, client_id="sc-0011aabbccdd",
+                  inline=True, is_sync=True, from_head=True,
+                  commit_only=True)
+    blob = pack_updateio(io)
+    assert blob is not None and unpack_updateio(blob) == io
+
+    assert pack_updateio(UpdateIO(buf=RemoteBuf())) is None
+    assert pack_updateio(UpdateIO(
+        debug=DebugFlags(inject_server_error_prob=0.5))) is None
+    assert pack_updateio(UpdateIO(client_id="x" * 300)) is None
+
+
+def test_write_path_uses_packed_wire_and_falls_back():
+    """End-to-end: client writes ride Storage.write_packed and the CRAQ
+    forward hop rides Storage.update_packed; an old server (method
+    missing) triggers a one-shot fallback with the address memoized."""
+    import asyncio as _a
+
+    async def body():
+        from t3fs.testing.fabric import StorageFabric
+        from t3fs.utils.status import make_error
+        fab = StorageFabric(num_nodes=3, replicas=3)
+        await fab.start()
+        try:
+            sc = StorageClient(lambda: fab.routing, client=fab.client)
+            lay = FileLayout(chunk_size=16384, chains=[fab.chain_id])
+            calls = []
+            orig_call = fab.client.call
+
+            async def spying_call(addr, method, req=None, **kw):
+                calls.append(method)
+                return await orig_call(addr, method, req, **kw)
+            fab.client.call = spying_call
+
+            data = bytes(range(256)) * 64
+            await sc.write_file_range(lay, 8, 0, data)
+            got, _ = await sc.read_file_range(lay, 8, 0, len(data))
+            assert got == data
+            assert "Storage.write_packed" in calls
+            assert "Storage.write" not in calls
+
+            # forward hops between replicas also ride the packed method
+            # (they go through each node's own client, not fab.client —
+            # verify via the forwarding memoization being EMPTY and the
+            # replicas having the data)
+            for node in fab.nodes:
+                assert not node.forwarding._no_packed
+
+            # old server: write_packed answers RPC_METHOD_NOT_FOUND
+            sc2 = StorageClient(lambda: fab.routing, client=fab.client)
+            calls2 = []
+
+            async def old_server_call(addr, method, req=None, **kw):
+                calls2.append(method)
+                if method == "Storage.write_packed":
+                    raise make_error(StatusCode.RPC_METHOD_NOT_FOUND, method)
+                return await orig_call(addr, method, req, **kw)
+            fab.client.call = old_server_call
+
+            await sc2.write_file_range(lay, 9, 0, data)
+            got, _ = await sc2.read_file_range(lay, 9, 0, len(data))
+            assert got == data
+            assert calls2.count("Storage.write_packed") == 1  # memoized
+            assert calls2.count("Storage.write") >= 1
+        finally:
+            await fab.stop()
+    _a.run(body())
+
+
+def test_packed_ver_memo_dies_with_the_connection():
+    """code-review r4: a server restart may be a ROLLBACK to an older
+    packed stride, so the advertised-version memo must not outlive the
+    connection — after a reconnect the next batch re-negotiates on the
+    struct path instead of packing at the stale version."""
+    import asyncio as _a
+
+    async def body():
+        from t3fs.testing.fabric import StorageFabric
+        fab = StorageFabric(num_nodes=1, replicas=1)
+        await fab.start()
+        try:
+            sc = StorageClient(lambda: fab.routing, client=fab.client)
+            lay = FileLayout(chunk_size=16384, chains=[fab.chain_id])
+            data = bytes(range(256)) * 64
+            await sc.write_file_range(lay, 11, 0, data)
+
+            packed_seen = []
+            orig_call = fab.client.call
+
+            async def spy(addr, method, req=None, **kw):
+                if method == "Storage.batch_read":
+                    packed_seen.append(bool(req.packed_ios))
+                return await orig_call(addr, method, req, **kw)
+            fab.client.call = spy
+
+            await sc.read_file_range(lay, 11, 0, len(data))   # learn
+            await sc.read_file_range(lay, 11, 0, len(data))   # packed
+            assert packed_seen == [False, True], packed_seen
+
+            # sever every connection (server restart analog): epoch
+            # bumps on reconnect, memo is stale -> struct + re-learn
+            for conn in list(fab.client._conns.values()):
+                await conn.close()
+            await sc.read_file_range(lay, 11, 0, len(data))
+            assert packed_seen[-1] is False, packed_seen
+            await sc.read_file_range(lay, 11, 0, len(data))
+            assert packed_seen[-1] is True, packed_seen
         finally:
             await fab.stop()
     _a.run(body())
