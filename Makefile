@@ -14,7 +14,7 @@ ASAN_RT := $(shell g++ -print-file-name=libasan.so)
 # params are excluded (they run in the normal suite).
 SAN_TESTS := tests/test_native_engine.py tests/test_usrbio.py \
              tests/test_engine_differential.py tests/test_chunk_engine.py \
-             tests/test_storage_service.py
+             tests/test_storage_service.py tests/test_native_net.py
 SAN_FILTER := -k "not device"
 
 .PHONY: test sanitize sanitize-thread sanitize-address probe on-device ci

@@ -330,6 +330,27 @@ class StorageClient:
                         results[i] = IOResult(
                             WireStatus(int(e.code), str(e)))
                     return
+                if packed is not None and \
+                        self.client.epoch(address) != epoch:
+                    # the connection recycled DURING the call (lazy
+                    # reconnect inside client.call): the packed blob may
+                    # have been decoded by a restarted — possibly
+                    # rolled-back — server at the wrong stride, and a
+                    # 43-IO v2 batch parses as 51 v1 entries without
+                    # error.  Distrust the response: re-send this group
+                    # on the struct path (code-review r4).
+                    self._packed_ver.pop(address, None)
+                    try:
+                        rsp, payload = await self.client.call(
+                            address, "Storage.batch_read",
+                            BatchReadReq(ios=group, want_packed=True,
+                                         debug=self.cfg.debug),
+                            timeout=self.cfg.request_timeout_s)
+                    except StatusError as e:
+                        for i in idxs:
+                            results[i] = IOResult(
+                                WireStatus(int(e.code), str(e)))
+                        return
                 if rsp.packed_results and sver == 0:
                     # memoize under the PRE-call epoch: if the conn
                     # recycled mid-call the memo is instantly stale and

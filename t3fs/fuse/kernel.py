@@ -779,16 +779,15 @@ def host_group_resolver():
     """Supplementary groups from the mount host's user database
     (getgrouplist(3)); for deployments where /etc/group on the FUSE host
     is the identity authority."""
-    import grp
     import pwd
 
     async def resolve(uid: int) -> list[int] | None:
         def lookup():
             try:
-                name = pwd.getpwuid(uid).pw_name
+                pw = pwd.getpwuid(uid)
             except KeyError:
                 return None
-            return list(os.getgrouplist(name, pwd.getpwuid(uid).pw_gid))
+            return list(os.getgrouplist(pw.pw_name, pw.pw_gid))
         return await asyncio.to_thread(lookup)
 
     return resolve

@@ -9,7 +9,8 @@ import sysconfig
 import threading
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
-_SOURCES = ["chunk_engine.cpp", "usrbio.cpp", "aio_reader.cpp"]
+_SOURCES = ["chunk_engine.cpp", "usrbio.cpp", "aio_reader.cpp",
+            "net_pump.cpp"]
 _LIB = os.path.join(_DIR, "libt3fs_native.so")
 _lock = threading.Lock()
 _lib: ctypes.CDLL | None = None

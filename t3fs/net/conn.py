@@ -193,15 +193,7 @@ class Connection:
                     await asyncio.to_thread(check_msg_crc, msg, msg_crc)
                 else:
                     check_msg_crc(msg, msg_crc)
-                packet = serde.loads(msg)
-                if packet.is_req:
-                    self._spawn(self._handle_request(packet, payload,
-                                                     time.time()),
-                                f"req-{packet.method}")
-                else:
-                    fut = self._waiters.get(packet.uuid)
-                    if fut is not None and not fut.done():
-                        fut.set_result((packet, payload))
+                self._dispatch_packet(serde.loads(msg), payload)
         except (asyncio.IncompleteReadError, ConnectionResetError, BrokenPipeError):
             pass
         except asyncio.CancelledError:
@@ -213,6 +205,19 @@ class Connection:
         finally:
             if not self._closed:
                 self._spawn(self.close(), f"close-{self.name}")
+
+    def _dispatch_packet(self, packet: MessagePacket,
+                         payload: bytes) -> None:
+        """Post-decode dispatch shared by the asyncio read loop and the
+        native-pump path: spawn the handler for requests (stamping the
+        receive time), wake the waiter for responses."""
+        if packet.is_req:
+            self._spawn(self._handle_request(packet, payload, time.time()),
+                        f"req-{packet.method}")
+        else:
+            fut = self._waiters.get(packet.uuid)
+            if fut is not None and not fut.done():
+                fut.set_result((packet, payload))
 
     async def _handle_request(self, packet: MessagePacket, payload: bytes,
                               recv_ts: float = 0.0) -> None:

@@ -1,0 +1,316 @@
+"""Native socket transport: the io_uring frame pump behind Connection.
+
+ROADMAP #2 / r3 verdict missing #2 — the reference's bulk plane batches
+work-requests onto the NIC (src/common/net/ib/IBSocket.h:81-180) instead
+of paying per-message syscalls.  Here ONE io_uring (t3fs/native/
+net_pump.cpp) drives RECV/SEND for every connection in the process; the
+pump thread parses t3f2 frames and verifies BOTH CRCs in C++, and the
+asyncio loop is woken once per batch of completed frames through an
+eventfd.  Python keeps serde, dispatch, and compression; it no longer
+pays per-frame readexactly/header/CRC work or a send syscall per frame.
+
+Opt-in per process with T3FS_NATIVE_NET=1 (checked per connection, so
+tests can flip it) — the asyncio StreamReader/Writer transport stays the
+default and the two interoperate byte-for-byte (same wire format).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import ctypes
+import logging
+import os
+import socket
+
+from t3fs.net.conn import Connection
+from t3fs.net.wire import (
+    FLAG_COMPRESS, maybe_compress, pack_header,
+)
+from t3fs.ops.codec import crc32c
+from t3fs.utils import serde
+from t3fs.utils.status import StatusCode, StatusError, make_error
+
+log = logging.getLogger("t3fs.net.native")
+
+# pump_send backpressure: mirror asyncio drain()'s role — a frame is
+# queued instantly, but a writer far ahead of the wire briefly yields
+TX_HIGH_WATER = 32 << 20
+
+
+def native_enabled() -> bool:
+    return os.environ.get("T3FS_NATIVE_NET") == "1"
+
+
+class _PumpEvt(ctypes.Structure):
+    _fields_ = [("data", ctypes.c_uint64),
+                ("conn_id", ctypes.c_uint32),
+                ("flags", ctypes.c_uint32),
+                ("msg_len", ctypes.c_uint32),
+                ("payload_len", ctypes.c_uint32),
+                ("kind", ctypes.c_int32),
+                ("_pad", ctypes.c_int32)]
+
+
+class NativePump:
+    """One io_uring frame pump per (process, event loop)."""
+
+    _per_loop: dict[int, "NativePump"] = {}
+
+    @classmethod
+    def get(cls) -> "NativePump":
+        loop = asyncio.get_running_loop()
+        pump = cls._per_loop.get(id(loop))
+        if pump is None or pump.loop is not loop:
+            # evict pumps whose loops are gone (each asyncio.run leaves
+            # one behind otherwise: an io_uring, an eventfd, and a
+            # parked thread per dead loop — code-review r4)
+            for key, old in list(cls._per_loop.items()):
+                if old.loop.is_closed() or old.loop is loop:
+                    old.destroy()
+                    cls._per_loop.pop(key, None)
+            pump = cls(loop)
+            cls._per_loop[id(loop)] = pump
+        return pump
+
+    def __init__(self, loop: asyncio.AbstractEventLoop):
+        from t3fs.native import load_library
+        lib = load_library()
+        lib.t3fs_pump_create.restype = ctypes.c_void_p
+        lib.t3fs_pump_create.argtypes = [ctypes.c_uint]
+        lib.t3fs_pump_eventfd.restype = ctypes.c_int
+        lib.t3fs_pump_eventfd.argtypes = [ctypes.c_void_p]
+        lib.t3fs_pump_add.restype = ctypes.c_int64
+        lib.t3fs_pump_add.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.t3fs_pump_send.restype = ctypes.c_int64
+        lib.t3fs_pump_send.argtypes = [ctypes.c_void_p, ctypes.c_uint32,
+                                       ctypes.c_char_p, ctypes.c_uint64]
+        lib.t3fs_pump_tx_depth.restype = ctypes.c_int64
+        lib.t3fs_pump_tx_depth.argtypes = [ctypes.c_void_p, ctypes.c_uint32]
+        lib.t3fs_pump_poll.restype = ctypes.c_int
+        lib.t3fs_pump_poll.argtypes = [ctypes.c_void_p,
+                                       ctypes.POINTER(_PumpEvt),
+                                       ctypes.c_uint]
+        lib.t3fs_pump_free.argtypes = [ctypes.c_uint64]
+        lib.t3fs_pump_close.argtypes = [ctypes.c_void_p, ctypes.c_uint32]
+        lib.t3fs_pump_destroy.argtypes = [ctypes.c_void_p]
+        self.lib = lib
+        self.h = lib.t3fs_pump_create(1024)
+        if not self.h:
+            raise OSError("t3fs_pump_create failed (io_uring unavailable?)")
+        self.efd = lib.t3fs_pump_eventfd(self.h)
+        self.loop = loop
+        self.conns: dict[int, "NativeConnection"] = {}
+        self._evts = (_PumpEvt * 256)()
+        loop.add_reader(self.efd, self._drain)
+        import atexit
+        atexit.register(self.destroy)
+
+    def attach(self, conn: "NativeConnection") -> int:
+        # the pump owns a DUP of the fd; the Python socket object stays
+        # with the connection (closed on conn.close())
+        fd = os.dup(conn.sock.fileno())
+        cid = self.lib.t3fs_pump_add(self.h, fd)
+        if cid < 0:
+            raise make_error(StatusCode.RPC_CONNECT_FAILED,
+                             f"pump_add: errno {-cid}")
+        self.conns[cid] = conn
+        return int(cid)
+
+    def send(self, conn_id: int, frame: bytes) -> int:
+        depth = self.lib.t3fs_pump_send(self.h, conn_id, frame, len(frame))
+        if depth < 0:
+            raise make_error(StatusCode.RPC_SEND_FAILED,
+                             f"pump_send: errno {-depth}")
+        return int(depth)
+
+    def tx_depth(self, conn_id: int) -> int:
+        return int(self.lib.t3fs_pump_tx_depth(self.h, conn_id))
+
+    def detach(self, conn_id: int) -> None:
+        self.conns.pop(conn_id, None)
+        self.lib.t3fs_pump_close(self.h, conn_id)
+
+    def destroy(self) -> None:
+        if self.h is None:
+            return
+        if not self.loop.is_closed():
+            try:
+                self.loop.remove_reader(self.efd)
+            except (OSError, RuntimeError):
+                pass
+        self.lib.t3fs_pump_destroy(self.h)
+        self.h = None
+        self.conns.clear()
+
+    def _drain(self) -> None:
+        if self.h is None:
+            return               # destroyed; a late callback must not poll
+        try:
+            os.read(self.efd, 8)
+        except BlockingIOError:
+            pass
+        while True:
+            n = self.lib.t3fs_pump_poll(self.h, self._evts, 256)
+            for i in range(n):
+                e = self._evts[i]
+                conn = self.conns.get(e.conn_id)
+                if e.kind == 1:                      # peer closed / error
+                    if conn is not None:
+                        conn._on_pump_closed()
+                    continue
+                msg = ctypes.string_at(e.data, e.msg_len)
+                payload = ctypes.string_at(e.data + e.msg_len,
+                                           e.payload_len)
+                self.lib.t3fs_pump_free(e.data)
+                if conn is not None:
+                    conn._on_frame(e.flags, msg, payload)
+            if n < 256:
+                break
+
+
+class NativeConnection(Connection):
+    """Connection whose wire runs through the native pump.  Reuses the
+    base class's call()/waiter table and request dispatch; overrides the
+    byte-moving halves (read loop and frame send)."""
+
+    def __init__(self, sock: socket.socket, pump: NativePump,
+                 dispatcher=None, name: str = "?", on_close=None,
+                 compress_threshold: int = 0, compress_level: int = 1):
+        super().__init__(None, None, dispatcher, name, on_close,
+                         compress_threshold, compress_level)
+        self.sock = sock
+        self.pump = pump
+        self.conn_id = 0
+
+    def start(self) -> None:
+        self.conn_id = self.pump.attach(self)
+
+    def _close_now(self) -> None:
+        """Synchronous close: unlike the asyncio transport there is
+        nothing to await, and failure paths need the conn marked closed
+        BEFORE the caller's next _get_conn (the pump's eventfd callback
+        may not have run yet when a send hits a dead conn)."""
+        if self._closed:
+            return
+        self._closed = True
+        if self.on_close is not None:
+            try:
+                self.on_close(self)
+            except Exception:
+                pass
+        if self.conn_id:
+            self.pump.detach(self.conn_id)
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+        err = make_error(StatusCode.RPC_SEND_FAILED,
+                         f"connection {self.name} closed")
+        for fut in self._waiters.values():
+            if not fut.done():
+                fut.set_exception(err)
+                fut.exception()    # see Connection.close for why
+        self._waiters.clear()
+
+    async def close(self) -> None:
+        self._close_now()
+
+    # --- TX: assemble the frame in Python, ship it through the pump ---
+
+    async def _send_frame(self, packet, payload: bytes, flags: int) -> None:
+        msg = serde.dumps(packet)
+        if self.compress_threshold > 0:
+            if len(msg) + len(payload) >= self.OFFLOAD_BYTES:
+                msg, payload, zflag = await asyncio.to_thread(
+                    maybe_compress, msg, payload,
+                    self.compress_threshold, self.compress_level)
+            else:
+                msg, payload, zflag = maybe_compress(
+                    msg, payload, self.compress_threshold,
+                    self.compress_level)
+            flags |= zflag
+        if len(msg) >= self.OFFLOAD_BYTES:
+            mcrc = await asyncio.to_thread(crc32c, msg)
+        else:
+            mcrc = crc32c(msg) if msg else 0
+        async with self._send_lock:
+            if self._closed:
+                raise make_error(StatusCode.RPC_SEND_FAILED,
+                                 "connection closed")
+            head = pack_header(len(msg), len(payload), flags, mcrc)
+            try:
+                depth = self.pump.send(self.conn_id, head + msg + payload)
+            except StatusError:
+                # the pump saw the peer die before our eventfd callback
+                # ran: close NOW so the caller's retry reconnects instead
+                # of re-hitting the dead conn (the asyncio path gets the
+                # same effect from its read loop exiting).
+                # NOTE an end-of-tick TX-coalescing variant (batch every
+                # frame of a loop tick into one submission) measured
+                # SLOWER here: the extra payload copy into the staging
+                # buffer and the tick-delayed first byte cost more than
+                # the saved io_uring_enter calls on this box.
+                self._close_now()
+                raise
+        # backpressure outside the lock: other senders may proceed while
+        # this one waits for the pump queue to drain below the high water
+        while depth > TX_HIGH_WATER:
+            await asyncio.sleep(0.002)
+            if self._closed:
+                raise make_error(StatusCode.RPC_SEND_FAILED,
+                                 f"connection {self.name} closed mid-send")
+            depth = max(0, self.pump.tx_depth(self.conn_id))
+
+    # --- RX: the pump already framed and CRC-verified ---
+
+    def _on_frame(self, flags: int, msg: bytes, payload: bytes) -> None:
+        if flags & FLAG_COMPRESS:
+            # rare path: inflate off-loop, then dispatch
+            self._spawn(self._dispatch_compressed(flags, msg, payload),
+                        f"inflate-{self.name}")
+            return
+        self._dispatch(msg, payload)
+
+    async def _dispatch_compressed(self, flags: int, msg: bytes,
+                                   payload: bytes) -> None:
+        from t3fs.net.wire import decompress_frame
+        try:
+            msg, payload = await asyncio.to_thread(
+                decompress_frame, msg, payload, flags)
+        except Exception:
+            log.warning("conn %s: bad compressed frame", self.name)
+            await self.close()
+            return
+        self._dispatch(msg, payload)
+
+    def _dispatch(self, msg: bytes, payload: bytes) -> None:
+        try:
+            packet = serde.loads(msg)
+        except Exception:
+            log.exception("conn %s: undecodable packet", self.name)
+            self._close_now()
+            return
+        self._dispatch_packet(packet, payload)
+
+    def _on_pump_closed(self) -> None:
+        self._close_now()
+
+
+async def native_connect(address: str, dispatcher, name: str,
+                         compress_threshold: int = 0) -> NativeConnection:
+    host, port = address.rsplit(":", 1)
+    loop = asyncio.get_running_loop()
+    sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    sock.setblocking(False)
+    try:
+        await loop.sock_connect(sock, (host, int(port)))
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    except BaseException:
+        # incl. CancelledError from the caller's wait_for timeout — the
+        # asyncio path closes its socket on cancellation too
+        sock.close()
+        raise
+    conn = NativeConnection(sock, NativePump.get(), dispatcher, name=name,
+                            compress_threshold=compress_threshold)
+    conn.start()
+    return conn

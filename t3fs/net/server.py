@@ -54,15 +54,57 @@ class Server:
         self.dispatcher: dict[str, Handler] = {}
         self._server: asyncio.AbstractServer | None = None
         self._conns: set[Connection] = set()
+        self._lsock = None                    # native-transport listener
+        self._accept_task: asyncio.Task | None = None
 
     def add_service(self, svc: Any) -> None:
         self.dispatcher.update(build_dispatcher(svc))
 
     async def start(self) -> None:
+        from t3fs.net.native_conn import native_enabled
+        if native_enabled():
+            await self._start_native()
+            return
         self._server = await asyncio.start_server(self._on_conn, self.host, self.port)
         self.port = self._server.sockets[0].getsockname()[1]
         log.info("server listening on %s:%d (%d methods)",
                  self.host, self.port, len(self.dispatcher))
+
+    async def _start_native(self) -> None:
+        """Accept on a raw socket and hand every connection to the
+        io_uring frame pump (t3fs/net/native_conn.py) — accepting via
+        asyncio streams and stealing the fd would race the transport's
+        first read."""
+        import socket as _socket
+        s = _socket.socket(_socket.AF_INET, _socket.SOCK_STREAM)
+        s.setsockopt(_socket.SOL_SOCKET, _socket.SO_REUSEADDR, 1)
+        s.bind((self.host, self.port))
+        s.listen(256)
+        s.setblocking(False)
+        self._lsock = s
+        self.port = s.getsockname()[1]
+        self._accept_task = asyncio.create_task(
+            self._accept_loop(), name=f"accept-{self.port}")
+        log.info("server (native transport) listening on %s:%d (%d methods)",
+                 self.host, self.port, len(self.dispatcher))
+
+    async def _accept_loop(self) -> None:
+        import socket as _socket
+
+        from t3fs.net.native_conn import NativeConnection, NativePump
+        loop = asyncio.get_running_loop()
+        while True:
+            try:
+                sock, peer = await loop.sock_accept(self._lsock)
+            except (asyncio.CancelledError, OSError):
+                return
+            sock.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
+            conn = NativeConnection(
+                sock, NativePump.get(), self.dispatcher,
+                name=f"srv<-{peer}", on_close=self._conns.discard,
+                compress_threshold=self.compress_threshold)
+            self._conns.add(conn)
+            conn.start()
 
     async def _on_conn(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
         peer = writer.get_extra_info("peername")
@@ -78,6 +120,14 @@ class Server:
         # closed, so the old order deadlocks while clients stay connected
         if self._server:
             self._server.close()
+        if self._accept_task is not None:
+            self._accept_task.cancel()
+            try:
+                await self._accept_task
+            except asyncio.CancelledError:
+                pass
+        if self._lsock is not None:
+            self._lsock.close()
         # drain until empty: a connection accepted during shutdown may be
         # registered after a one-shot snapshot would have been taken
         while self._conns:

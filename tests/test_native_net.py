@@ -1,0 +1,182 @@
+"""Native io_uring socket transport (t3fs/native/net_pump.cpp +
+t3fs/net/native_conn.py) vs the asyncio transport.
+
+ROADMAP #2 / r3 verdict missing #2.  jax-free on purpose: this file is
+part of the sanitizer suite (`make sanitize`), where jaxlib cannot load.
+"""
+
+import asyncio
+import os
+from dataclasses import dataclass
+
+import pytest
+
+from t3fs.net.client import Client
+from t3fs.net.server import Server, rpc_method, service
+from t3fs.utils.serde import serde_struct
+from t3fs.utils.status import StatusCode, StatusError
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+@serde_struct
+@dataclass
+class NEchoReq:
+    n: int = 0
+    tag: str = ""
+
+
+@service("NEcho")
+class EchoSvc:
+    @rpc_method
+    async def echo(self, req: NEchoReq, payload, conn):
+        return NEchoReq(n=req.n + 1, tag=req.tag), payload[::-1]
+
+    @rpc_method
+    async def boom(self, req: NEchoReq, payload, conn):
+        from t3fs.utils.status import make_error
+        raise make_error(StatusCode.INVALID_ARG, "boom")
+
+
+async def _roundtrip(n_calls: int = 50, payload=b"x" * 100_000):
+    srv = Server()
+    srv.add_service(EchoSvc())
+    await srv.start()
+    cli = Client()
+    try:
+        for i in range(n_calls):
+            rsp, p = await cli.call(srv.address, "NEcho.echo",
+                                    NEchoReq(n=i, tag="t" * (i % 7)),
+                                    payload=payload)
+            assert rsp.n == i + 1 and p == payload[::-1]
+        with pytest.raises(StatusError) as ei:
+            await cli.call(srv.address, "NEcho.boom", NEchoReq())
+        assert ei.value.code == StatusCode.INVALID_ARG
+    finally:
+        await cli.close()
+        await srv.stop()
+
+
+def test_native_transport_roundtrip(monkeypatch):
+    monkeypatch.setenv("T3FS_NATIVE_NET", "1")
+    run(_roundtrip())
+
+
+def test_native_transport_concurrent_calls(monkeypatch):
+    monkeypatch.setenv("T3FS_NATIVE_NET", "1")
+
+    async def body():
+        srv = Server()
+        srv.add_service(EchoSvc())
+        await srv.start()
+        cli = Client()
+        try:
+            payload = os.urandom(64 << 10)
+
+            async def one(i):
+                rsp, p = await cli.call(srv.address, "NEcho.echo",
+                                        NEchoReq(n=i), payload=payload)
+                assert rsp.n == i + 1 and p == payload[::-1]
+            await asyncio.gather(*[one(i) for i in range(200)])
+        finally:
+            await cli.close()
+            await srv.stop()
+    run(body())
+
+
+def test_native_server_asyncio_client_interop(monkeypatch):
+    """Same wire format both ways: a native-transport server serves an
+    asyncio-transport client and vice versa."""
+    async def native_server():
+        monkeypatch.setenv("T3FS_NATIVE_NET", "1")
+        srv = Server()
+        srv.add_service(EchoSvc())
+        await srv.start()
+        monkeypatch.setenv("T3FS_NATIVE_NET", "0")   # client side: asyncio
+        cli = Client()
+        try:
+            rsp, p = await cli.call(srv.address, "NEcho.echo",
+                                    NEchoReq(n=41), payload=b"abc")
+            assert rsp.n == 42 and p == b"cba"
+        finally:
+            await cli.close()
+            await srv.stop()
+    run(native_server())
+
+    async def native_client():
+        monkeypatch.setenv("T3FS_NATIVE_NET", "0")
+        srv = Server()
+        srv.add_service(EchoSvc())
+        await srv.start()
+        monkeypatch.setenv("T3FS_NATIVE_NET", "1")
+        cli = Client()
+        try:
+            rsp, p = await cli.call(srv.address, "NEcho.echo",
+                                    NEchoReq(n=1), payload=b"xyz")
+            assert rsp.n == 2 and p == b"zyx"
+        finally:
+            await cli.close()
+            await srv.stop()
+    run(native_client())
+
+
+def test_native_transport_peer_death(monkeypatch):
+    """Server stop must fail in-flight/subsequent calls with a transport
+    status, and the client must reconnect to a revived server."""
+    monkeypatch.setenv("T3FS_NATIVE_NET", "1")
+
+    async def body():
+        srv = Server()
+        srv.add_service(EchoSvc())
+        await srv.start()
+        address = srv.address
+        cli = Client()
+        try:
+            rsp, _ = await cli.call(address, "NEcho.echo", NEchoReq(n=0))
+            assert rsp.n == 1
+            await srv.stop()
+            with pytest.raises(StatusError) as ei:
+                await cli.call(address, "NEcho.echo", NEchoReq(n=0),
+                               timeout=3.0)
+            assert ei.value.code in (StatusCode.RPC_SEND_FAILED,
+                                     StatusCode.RPC_CONNECT_FAILED,
+                                     StatusCode.RPC_TIMEOUT)
+            # revive on the same port; the client's next call reconnects
+            host, port = address.rsplit(":", 1)
+            srv2 = Server(host=host, port=int(port))
+            srv2.add_service(EchoSvc())
+            await srv2.start()
+            rsp, _ = await cli.call(address, "NEcho.echo", NEchoReq(n=7))
+            assert rsp.n == 8
+            await srv2.stop()
+        finally:
+            await cli.close()
+    run(body())
+
+
+def test_native_transport_large_frames(monkeypatch):
+    """Multi-megabyte payloads cross the pump intact (partial sends and
+    recv reassembly across many 256 KiB reads)."""
+    monkeypatch.setenv("T3FS_NATIVE_NET", "1")
+    run(_roundtrip(n_calls=4, payload=os.urandom(8 << 20)))
+
+
+def test_native_transport_compression(monkeypatch):
+    monkeypatch.setenv("T3FS_NATIVE_NET", "1")
+
+    async def body():
+        srv = Server(compress_threshold=1024)
+        srv.add_service(EchoSvc())
+        await srv.start()
+        cli = Client(compress_threshold=1024)
+        try:
+            payload = b"A" * 200_000          # highly compressible
+            rsp, p = await cli.call(srv.address, "NEcho.echo",
+                                    NEchoReq(n=5), payload=payload)
+            assert rsp.n == 6 and p == payload[::-1]
+        finally:
+            await cli.close()
+            await srv.stop()
+    run(body())
