@@ -266,11 +266,16 @@ async def trace_top(ctx: AdminContext, args) -> None:
 
 
 @command("rpc-top", "RPC latency decomposition (queue/server/network "
-                    "split per method, p50/p99) from T3FS_RPC_STATS dumps")
+                    "split per method, p50/p99) from T3FS_RPC_STATS "
+                    "dumps or live nodes (--live)")
 @args_(("paths", {"nargs": "+",
                   "help": "rpc-stats JSON files (one per process; set "
                           "T3FS_RPC_STATS=<path> on a bench/server run "
-                          "to produce them)"}),
+                          "to produce them) — or node addresses with "
+                          "--live"}),
+       ("--live", {"action": "store_true",
+                   "help": "treat arguments as host:port node addresses "
+                           "and pull Core.getRpcStats from each"}),
        ("--sort", {"default": "total_p99_ms",
                    "help": "column to sort by (default total_p99_ms)"}),
        ("--limit", {"type": int, "default": 30}))
@@ -279,13 +284,26 @@ async def rpc_top(ctx: AdminContext, args) -> None:
     import json as _json
     from t3fs.net.rpcstats import render_top
     snaps = []
-    for pat in args.paths:
-        for path in sorted(_glob.glob(pat)) or [pat]:
+    if args.live:
+        for addr in args.paths:
             try:
-                with open(path) as f:
-                    snaps.append(_json.load(f))
-            except (OSError, ValueError) as e:
-                print(f"skipping {path}: {e}")
+                rsp, _ = await ctx.cli.call(addr, "Core.getRpcStats",
+                                            timeout=10.0)
+                snaps.append(_json.loads(rsp.stats_json))
+            except StatusError as e:
+                print(f"{addr}: unreachable ({e.code.name})")
+            except (ValueError, OSError) as e:
+                # bad address / undecodable stats: skip the node, keep
+                # rendering the healthy ones (parity with the file path)
+                print(f"{addr}: skipped ({e})")
+    else:
+        for pat in args.paths:
+            for path in sorted(_glob.glob(pat)) or [pat]:
+                try:
+                    with open(path) as f:
+                        snaps.append(_json.load(f))
+                except (OSError, ValueError) as e:
+                    print(f"skipping {path}: {e}")
     if not any(snaps):
         print("no rpc stats found")
         return

@@ -53,6 +53,12 @@ class ConfigUpdateRecord:
 
 @serde.serde_struct
 @dataclass
+class RpcStatsRsp:
+    stats_json: str = ""       # rpcstats snapshot(), JSON-encoded
+
+
+@serde.serde_struct
+@dataclass
 class EchoReq:
     message: str = ""
 
@@ -182,6 +188,19 @@ class CoreService:
     async def getAppInfo(self, req, payload, conn):
         return GetAppInfoRsp(self.app_info,
                              time.time() - self.app_info.start_time), b""
+
+    @rpc_method
+    async def getRpcStats(self, req, payload, conn):
+        """This process's RPC latency decomposition (queue/server/
+        network split per method; t3fs/net/rpcstats.py) — the live
+        counterpart of the T3FS_RPC_STATS file dump, so `rpc-top --live`
+        can ask any node where its RPCs spend their time (reference
+        carries 8 wire timestamps for exactly this,
+        serde/MessagePacket.h:43-50)."""
+        import json as _json
+
+        from t3fs.net.rpcstats import RPC_STATS
+        return RpcStatsRsp(stats_json=_json.dumps(RPC_STATS.snapshot())), b""
 
     @rpc_method
     async def getConfig(self, req: GetConfigReq, payload, conn):

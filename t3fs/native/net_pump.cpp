@@ -54,6 +54,11 @@ constexpr uint32_t kMagic = 0x74336632;      // "t3f2" (wire.py MAGIC)
 constexpr uint32_t kHeaderSize = 24;
 constexpr uint64_t kMaxFrame = 512ull << 20; // wire.py MAX_FRAME
 constexpr size_t kRecvBuf = 256 << 10;
+// RX flow control: once this many undrained frame bytes sit in the out
+// queue, RECVs stop re-arming (the kernel buffer fills, TCP closes the
+// window — the role asyncio's StreamReader limit plays) until Python's
+// poll drains below it.
+constexpr size_t kRxHighWater = 64ull << 20;
 
 int sys_io_uring_setup(unsigned entries, struct io_uring_params* p) {
   return static_cast<int>(syscall(__NR_io_uring_setup, entries, p));
@@ -120,6 +125,7 @@ struct Pump {
   unsigned queued = 0;  // prepped, unsubmitted SQEs (under mu)
   std::unordered_map<uint32_t, std::unique_ptr<Conn>> conns;
   std::deque<Frame> out;          // completed frames for Python
+  size_t out_bytes = 0;           // undrained frame bytes (RX flow ctl)
   std::deque<uint32_t> closed;    // dead conns to report
 
   ~Pump() {
@@ -165,6 +171,7 @@ int submit_locked(Pump* p) {
 
 bool arm_recv(Pump* p, Conn* c) {
   if (c->dead || c->recv_armed) return true;
+  if (p->out_bytes >= kRxHighWater) return true;  // paused; poll resumes
   io_uring_sqe* sqe = sqe_alloc(p);
   if (sqe == nullptr) return false;
   c->rbuf.resize(kRecvBuf);
@@ -239,6 +246,7 @@ void parse_frames(Pump* p, Conn* c) {
     uint8_t* data = new uint8_t[msg_len + payload_len];
     memcpy(data, body, msg_len + static_cast<size_t>(payload_len));
     p->out.push_back(Frame{c->id, flags, msg_len, payload_len, data});
+    p->out_bytes += msg_len + static_cast<size_t>(payload_len);
     produced = true;
     c->stage_off += need;
   }
@@ -447,11 +455,13 @@ int64_t t3fs_pump_tx_depth(void* h, uint32_t conn_id) {
 int t3fs_pump_poll(void* h, T3fsPumpEvt* out, unsigned max) {
   auto* p = static_cast<Pump*>(h);
   std::lock_guard lk(p->mu);
+  bool was_high = p->out_bytes >= kRxHighWater;
   unsigned n = 0;
   while (n < max && !p->out.empty()) {
     Frame& f = p->out.front();
     out[n] = T3fsPumpEvt{reinterpret_cast<uint64_t>(f.data), f.conn_id,
                          f.flags, f.msg_len, f.payload_len, 0, 0};
+    p->out_bytes -= f.msg_len + static_cast<size_t>(f.payload_len);
     p->out.pop_front();
     n++;
   }
@@ -459,6 +469,11 @@ int t3fs_pump_poll(void* h, T3fsPumpEvt* out, unsigned max) {
     out[n] = T3fsPumpEvt{0, p->closed.front(), 0, 0, 0, 1, 0};
     p->closed.pop_front();
     n++;
+  }
+  if (was_high && p->out_bytes < kRxHighWater) {
+    // drain crossed the high water downward: resume the paused RECVs
+    for (auto& [id, c] : p->conns) arm_recv(p, c.get());
+    submit_locked(p);
   }
   return static_cast<int>(n);
 }

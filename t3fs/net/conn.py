@@ -102,7 +102,12 @@ class Connection:
     # conns); below it the thread hop costs more than the compression
     OFFLOAD_BYTES = 1 << 20
 
-    async def _send_frame(self, packet: MessagePacket, payload: bytes, flags: int) -> None:
+    async def _prep_frame(self, packet: MessagePacket, payload: bytes,
+                          flags: int) -> tuple[bytes, bytes, bytes]:
+        """Serde + (optional) compression + envelope CRC + header —
+        everything byte-identical between the asyncio and native
+        transports, shared so the wire formats can never diverge.
+        Returns (header, msg, payload)."""
         msg = serde.dumps(packet)
         if self.compress_threshold > 0:
             if len(msg) + len(payload) >= self.OFFLOAD_BYTES:
@@ -120,6 +125,10 @@ class Connection:
             mcrc = await asyncio.to_thread(crc32c, msg)
         else:
             mcrc = crc32c(msg) if msg else 0
+        return pack_header(len(msg), len(payload), flags, mcrc), msg, payload
+
+    async def _send_frame(self, packet: MessagePacket, payload: bytes, flags: int) -> None:
+        head, msg, payload = await self._prep_frame(packet, payload, flags)
         async with self._send_lock:
             if self._closed:
                 raise make_error(StatusCode.RPC_SEND_FAILED, "connection closed")
@@ -129,7 +138,6 @@ class Connection:
                 # is empty, tripling the syscall count per frame (profiled
                 # at ~30% of client CPU on the multi-process path).  Big
                 # payloads are worth a copy-free second write.
-                head = pack_header(len(msg), len(payload), flags, mcrc)
                 if payload and len(payload) > 64 << 10:
                     self.writer.write(head + msg)
                     self.writer.write(payload)

@@ -23,10 +23,7 @@ import os
 import socket
 
 from t3fs.net.conn import Connection
-from t3fs.net.wire import (
-    FLAG_COMPRESS, maybe_compress, pack_header,
-)
-from t3fs.ops.codec import crc32c
+from t3fs.net.wire import FLAG_COMPRESS
 from t3fs.utils import serde
 from t3fs.utils.status import StatusCode, StatusError, make_error
 
@@ -218,26 +215,11 @@ class NativeConnection(Connection):
     # --- TX: assemble the frame in Python, ship it through the pump ---
 
     async def _send_frame(self, packet, payload: bytes, flags: int) -> None:
-        msg = serde.dumps(packet)
-        if self.compress_threshold > 0:
-            if len(msg) + len(payload) >= self.OFFLOAD_BYTES:
-                msg, payload, zflag = await asyncio.to_thread(
-                    maybe_compress, msg, payload,
-                    self.compress_threshold, self.compress_level)
-            else:
-                msg, payload, zflag = maybe_compress(
-                    msg, payload, self.compress_threshold,
-                    self.compress_level)
-            flags |= zflag
-        if len(msg) >= self.OFFLOAD_BYTES:
-            mcrc = await asyncio.to_thread(crc32c, msg)
-        else:
-            mcrc = crc32c(msg) if msg else 0
+        head, msg, payload = await self._prep_frame(packet, payload, flags)
         async with self._send_lock:
             if self._closed:
                 raise make_error(StatusCode.RPC_SEND_FAILED,
                                  "connection closed")
-            head = pack_header(len(msg), len(payload), flags, mcrc)
             try:
                 depth = self.pump.send(self.conn_id, head + msg + payload)
             except StatusError:

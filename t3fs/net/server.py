@@ -76,6 +76,12 @@ class Server:
         asyncio streams and stealing the fd would race the transport's
         first read."""
         import socket as _socket
+
+        from t3fs.net.native_conn import NativePump
+        # fail FAST if io_uring is unavailable (e.g. a seccomp profile
+        # blocking it): raising here surfaces at Server.start() instead
+        # of killing the accept loop on the first inbound connection
+        NativePump.get()
         s = _socket.socket(_socket.AF_INET, _socket.SOCK_STREAM)
         s.setsockopt(_socket.SOL_SOCKET, _socket.SO_REUSEADDR, 1)
         s.bind((self.host, self.port))
@@ -98,13 +104,19 @@ class Server:
                 sock, peer = await loop.sock_accept(self._lsock)
             except (asyncio.CancelledError, OSError):
                 return
-            sock.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
-            conn = NativeConnection(
-                sock, NativePump.get(), self.dispatcher,
-                name=f"srv<-{peer}", on_close=self._conns.discard,
-                compress_threshold=self.compress_threshold)
-            self._conns.add(conn)
-            conn.start()
+            try:
+                sock.setsockopt(_socket.IPPROTO_TCP,
+                                _socket.TCP_NODELAY, 1)
+                conn = NativeConnection(
+                    sock, NativePump.get(), self.dispatcher,
+                    name=f"srv<-{peer}", on_close=self._conns.discard,
+                    compress_threshold=self.compress_threshold)
+                self._conns.add(conn)
+                conn.start()
+            except Exception:
+                # a per-connection failure must not kill the listener
+                log.exception("native accept of %s failed", peer)
+                sock.close()
 
     async def _on_conn(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
         peer = writer.get_extra_info("peername")

@@ -329,3 +329,43 @@ def test_rpc_latency_decomposition_and_rpc_top():
         assert "srv50" in out.stdout
     # merged render of two snapshots also works
     assert "LatPing.ping" in render_top([snap, snap])
+
+def test_rpc_top_live_over_core_service():
+    """rpc-top --live pulls Core.getRpcStats from a running node and
+    renders the same table as the file path (the reference's 8 wire
+    timestamps exist for live interrogation, not post-mortems)."""
+    import json
+    import subprocess
+    import sys
+
+    from t3fs.net.rpcstats import RPC_STATS
+
+    # run the server + CLI inside one loop so the CLI subprocess can
+    # reach the live process
+    async def full():
+        from t3fs.core.service import AppInfo, CoreService, EchoReq
+        from t3fs.net.client import Client
+        from t3fs.net.server import Server
+
+        RPC_STATS.clear()
+        srv = Server()
+        srv.add_service(CoreService(AppInfo(3, "demo", "")))
+        await srv.start()
+        cli = Client()
+        try:
+            for _ in range(5):
+                await cli.call(srv.address, "Core.echo",
+                               EchoReq(message="hi"))
+
+            def run_cli():
+                return subprocess.run(
+                    [sys.executable, "-m", "t3fs.cli.admin", "--mgmtd",
+                     "127.0.0.1:1", "rpc-top", "--live", srv.address],
+                    capture_output=True, text=True, timeout=60)
+            out = await asyncio.to_thread(run_cli)
+            assert out.returncode == 0, (out.stdout, out.stderr)
+            assert "Core.echo" in out.stdout, out.stdout
+        finally:
+            await cli.close()
+            await srv.stop()
+    asyncio.run(full())
