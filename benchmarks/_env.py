@@ -48,3 +48,30 @@ async def make_env(args, config: StorageClientConfig | None = None):
     await fab.start()
     sc = StorageClient(lambda: fab.routing, client=fab.client, config=config)
     return fab, sc, [fab.chain_id]
+
+
+async def make_meta_env(mgmtd_address: str):
+    """Meta-client sibling of make_env: discover meta servers from mgmtd
+    routing and return (MetaClient, async stop).  Fails with a clear
+    message (and a clean mgmtd stop) when routing has no meta nodes —
+    an unreachable mgmtd otherwise surfaces as a bare assert deep in
+    MetaClient while the refresh task leaks."""
+    from t3fs.client.meta_client import MetaClient
+    from t3fs.client.mgmtd_client import MgmtdClient
+
+    mg = MgmtdClient(mgmtd_address, refresh_period_s=0.5)
+    await mg.start()
+    meta_addrs = [n.address for n in mg.routing().nodes.values()
+                  if n.node_type == "meta" and n.address]
+    if not meta_addrs:
+        await mg.stop()
+        raise SystemExit(
+            f"no meta nodes in routing from {mgmtd_address} "
+            "(cluster down, wrong address, or meta not started)")
+    mc = MetaClient(meta_addrs)
+
+    async def stop():
+        await mc.close_conn()
+        await mg.stop()
+
+    return mc, stop

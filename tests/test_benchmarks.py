@@ -43,3 +43,15 @@ def test_usrbio_bench_randread():
         ["--seconds", "1", "--depth", "16", "--file-size", "1048576"])))
     assert res["reads"] > 0 and res["errors"] == 0
     assert res["iops"] > 0
+
+
+def test_meta_bench_phases():
+    """mdtest-analog metadata bench end to end on a tiny budget: every
+    phase completes and reports a positive op rate."""
+    from benchmarks.meta_bench import parse_args as mb_args, run_bench as mb_run
+    res = asyncio.run(mb_run(mb_args(
+        ["--dirs", "2", "--files", "8", "--concurrency", "8"])))
+    for phase in ("mkdir", "create", "stat", "batch_stat", "list",
+                  "rename", "remove"):
+        assert res[phase]["ops"] > 0 and res[phase]["ops_s"] > 0, phase
+    assert res["batch_stat"]["inodes_s"] > 0
