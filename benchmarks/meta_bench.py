@@ -44,7 +44,92 @@ async def _run_phase(coros: list, concurrency: int) -> dict:
             "ops_s": round(len(coros) / dt, 1)}
 
 
+async def run_fuse_bench(args) -> dict:
+    """The same phases as POSIX syscalls through a real kernel mount
+    (mdtest proper).  Syscalls run on worker threads — they must never
+    run on the daemon's event loop (fuse/kernel.py module docstring)."""
+    import os
+    import tempfile
+
+    from t3fs.fuse.kernel import FuseKernelMount
+    from t3fs.testing.cluster import LocalCluster
+
+    cluster = LocalCluster(num_nodes=1, replicas=1, with_meta=True)
+    tmp = tempfile.mkdtemp(prefix="t3fs-metabench-")
+    try:
+        await cluster.start()
+        mnt = os.path.join(tmp, "mnt")
+        os.makedirs(mnt)
+        fuse = FuseKernelMount(cluster.mc, cluster.sc, mnt)
+        await fuse.mount()
+    except BaseException:
+        # a failed mount (non-root, no /dev/fuse) must not leak the
+        # started cluster's tasks/sockets or the tmpdir
+        await cluster.stop()
+        import shutil
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
+
+    D, F, C = args.dirs, args.files, args.concurrency
+    out: dict = {"dirs": D, "files_per_dir": F, "concurrency": C,
+                 "total_files": D * F, "path": "fuse-kernel-mount"}
+
+    def _mk(p):
+        os.mkdir(p)
+
+    def _create(p):
+        open(p, "w").close()
+
+    def _stat(p):
+        os.stat(p)
+
+    def _list(p):
+        os.listdir(p)
+
+    def _rename(pair):
+        os.rename(*pair)
+
+    def _rm(p):
+        os.remove(p)
+
+    def _renamed(p):
+        # rename only the BASENAME: a blanket p.replace("/f", "/r")
+        # would also rewrite tmpdir components containing "/f"
+        return os.path.join(os.path.dirname(p),
+                            "r" + os.path.basename(p)[1:])
+
+    def phase(fn, items):
+        return _run_phase([asyncio.to_thread(fn, it) for it in items], C)
+
+    try:
+        out["mkdir"] = await phase(
+            _mk, [f"{mnt}/d{d:03d}" for d in range(D)])
+        files = [f"{mnt}/d{d:03d}/f{f:04d}"
+                 for d in range(D) for f in range(F)]
+        out["create"] = await phase(_create, files)
+        out["stat"] = await phase(_stat, files)
+        out["list"] = await phase(
+            _list, [f"{mnt}/d{d:03d}" for d in range(D)])
+        out["rename"] = await phase(
+            _rename, [(p, _renamed(p)) for p in files])
+        out["remove"] = await phase(_rm, [_renamed(p) for p in files])
+        return out
+    finally:
+        await fuse.unmount()
+        await cluster.stop()
+        import shutil
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 async def run_bench(args) -> dict:
+    if getattr(args, "fuse", False):
+        if args.mgmtd:
+            raise SystemExit(
+                "--fuse benchmarks an in-process cluster's kernel mount; "
+                "combining it with --mgmtd would silently measure the "
+                "wrong cluster (mount against a live cluster with "
+                "t3fs.app.fuse_main and run mdtest on that mountpoint)")
+        return await run_fuse_bench(args)
     if args.mgmtd:
         from benchmarks._env import make_meta_env
         mc, stop = await make_meta_env(args.mgmtd)
@@ -90,6 +175,11 @@ def parse_args(argv=None):
     ap = argparse.ArgumentParser(prog="meta_bench")
     ap.add_argument("--mgmtd", default="",
                     help="live cluster address; omit for in-process")
+    ap.add_argument("--fuse", action="store_true",
+                    help="drive the phases through a REAL /dev/fuse "
+                         "kernel mount (requires root) instead of the "
+                         "meta RPC client — measures the full "
+                         "syscall->kernel->daemon->meta path")
     ap.add_argument("--dirs", type=int, default=8)
     ap.add_argument("--files", type=int, default=64)
     ap.add_argument("--concurrency", type=int, default=32)

@@ -256,7 +256,8 @@ class DevCluster:
 async def _main(args) -> None:
     cluster = DevCluster(args.run_dir, num_storage=args.nodes,
                          replicas=args.replicas, num_chains=args.chains,
-                         with_meta=True, with_monitor=args.monitor)
+                         with_meta=True, with_monitor=args.monitor,
+                         kv_shards=args.kv_shards)
     await cluster.start()
     print(f"cluster up: mgmtd={cluster.mgmtd_address} "
           f"meta={cluster.meta_address} run_dir={cluster.run_dir}")
@@ -276,6 +277,10 @@ def main(argv: list[str] | None = None) -> None:
     ap.add_argument("--replicas", type=int, default=3)
     ap.add_argument("--chains", type=int, default=2)
     ap.add_argument("--monitor", action="store_true")
+    ap.add_argument("--kv-shards", type=int, default=0,
+                    help=">0: run meta over a range-sharded KV deployment "
+                         "of this many kv_main processes (2PC across "
+                         "shard groups)")
     asyncio.run(_main(ap.parse_args(argv)))
 
 

@@ -55,3 +55,16 @@ def test_meta_bench_phases():
                   "rename", "remove"):
         assert res[phase]["ops"] > 0 and res[phase]["ops_s"] > 0, phase
     assert res["batch_stat"]["inodes_s"] > 0
+
+
+def test_meta_bench_fuse_mode():
+    """--fuse drives the phases through a real kernel mount."""
+    import os
+    if os.geteuid() != 0 or not os.path.exists("/dev/fuse"):
+        pytest.skip("needs root + /dev/fuse")
+    from benchmarks.meta_bench import parse_args as mb_args, run_bench as mb_run
+    res = asyncio.run(mb_run(mb_args(
+        ["--fuse", "--dirs", "2", "--files", "4", "--concurrency", "4"])))
+    assert res["path"] == "fuse-kernel-mount"
+    for phase in ("mkdir", "create", "stat", "list", "rename", "remove"):
+        assert res[phase]["ops"] > 0 and res[phase]["ops_s"] > 0, phase
