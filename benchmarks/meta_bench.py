@@ -98,8 +98,18 @@ async def run_fuse_bench(args) -> dict:
         return os.path.join(os.path.dirname(p),
                             "r" + os.path.basename(p)[1:])
 
+    # a dedicated executor sized to the requested concurrency:
+    # asyncio.to_thread rides the default pool (cpu+4 threads — 5 on a
+    # 1-CPU box), which would silently cap --concurrency 32 at 5
+    # in-flight syscalls and mislabel the result
+    from concurrent.futures import ThreadPoolExecutor
+    pool = ThreadPoolExecutor(max_workers=C, thread_name_prefix="mdtest")
+    loop = asyncio.get_running_loop()
+
     def phase(fn, items):
-        return _run_phase([asyncio.to_thread(fn, it) for it in items], C)
+        async def one(it):                 # lazy: starts under the sem,
+            await loop.run_in_executor(pool, fn, it)  # inside the timer
+        return _run_phase([one(it) for it in items], C)
 
     try:
         out["mkdir"] = await phase(
@@ -115,6 +125,7 @@ async def run_fuse_bench(args) -> dict:
         out["remove"] = await phase(_rm, [_renamed(p) for p in files])
         return out
     finally:
+        pool.shutdown(wait=False, cancel_futures=True)
         await fuse.unmount()
         await cluster.stop()
         import shutil

@@ -100,6 +100,12 @@ class ApplicationBase:
                                       "node_id": str(node_id)})
         reporters = None
         if monitor_address:
+            # per-method RPC latency splits ride the same pipeline (the
+            # rpc-top data, queryable from the monitor sink over time).
+            # Only when a monitor exists: the log fallback drops
+            # payload-only rows, so the snapshot work would go nowhere.
+            from t3fs.net.rpcstats import register_monitor_recorder
+            register_monitor_recorder()
             self._reporter = MonitorReporter(monitor_address, node_id,
                                              self.node_type)
             reporters = [self._reporter]

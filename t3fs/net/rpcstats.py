@@ -32,13 +32,20 @@ RESERVOIR = 2048
 
 
 class _MethodStats:
-    __slots__ = ("count", "total_s", "samples")
+    __slots__ = ("count", "total_s", "samples",
+                 "wcount", "wtotal_s", "wsamples")
 
     def __init__(self):
         self.count = 0
         self.total_s = 0.0
         # each sample: (total, squeue, server, network)
         self.samples: list[tuple[float, float, float, float]] = []
+        # window tier: drained by the monitor recorder each collect tick
+        # (cumulative stats would flatten the time series — a latency
+        # spike at hour N must show in hour N's row)
+        self.wcount = 0
+        self.wtotal_s = 0.0
+        self.wsamples: list[tuple[float, float, float, float]] = []
 
     def add(self, sample: tuple[float, float, float, float]) -> None:
         self.count += 1
@@ -49,6 +56,10 @@ class _MethodStats:
             i = random.randrange(self.count)
             if i < RESERVOIR:
                 self.samples[i] = sample
+        self.wcount += 1
+        self.wtotal_s += sample[0]
+        if len(self.wsamples) < RESERVOIR:
+            self.wsamples.append(sample)   # capped; wcount stays exact
 
 
 class RpcStats:
@@ -67,27 +78,44 @@ class RpcStats:
                 st = self._methods.setdefault(method, _MethodStats())
         st.add((total, squeue, server, network))
 
-    def snapshot(self) -> dict:
+    @staticmethod
+    def _row(count: int, total_s: float, samples: list) -> dict:
         def pct(vals: list[float], q: float) -> float:
             if not vals:
                 return 0.0
             s = sorted(vals)
             return s[min(len(s) - 1, int(q * len(s)))]
 
-        out = {}
+        cols = list(zip(*samples)) if samples else [[], [], [], []]
+        row = {"count": count,
+               "avg_ms": round(total_s / count * 1e3, 3) if count else 0.0}
+        for name, vals in zip(("total", "squeue", "server", "network"),
+                              cols):
+            vals = list(vals)
+            row[f"{name}_p50_ms"] = round(pct(vals, 0.50) * 1e3, 3)
+            row[f"{name}_p99_ms"] = round(pct(vals, 0.99) * 1e3, 3)
+        return row
+
+    def snapshot(self) -> dict:
+        """Cumulative since process start (rpc-top dumps/CLI)."""
         with self._lock:
             items = list(self._methods.items())
-        for method, st in items:
-            cols = list(zip(*st.samples)) if st.samples else [[], [], [], []]
-            row = {"count": st.count,
-                   "avg_ms": round(st.total_s / st.count * 1e3, 3)
-                   if st.count else 0.0}
-            for name, vals in zip(("total", "squeue", "server", "network"),
-                                  cols):
-                vals = list(vals)
-                row[f"{name}_p50_ms"] = round(pct(vals, 0.50) * 1e3, 3)
-                row[f"{name}_p99_ms"] = round(pct(vals, 0.99) * 1e3, 3)
-            out[method] = row
+        return {m: self._row(st.count, st.total_s, st.samples)
+                for m, st in items}
+
+    def window_snapshot(self) -> dict:
+        """Per-window stats since the LAST window_snapshot call, then the
+        window resets — the monitor pipeline's per-tick time series
+        (every other registry recorder reports deltas too)."""
+        out = {}
+        with self._lock:
+            for m, st in self._methods.items():
+                if not st.wcount:
+                    continue
+                out[m] = self._row(st.wcount, st.wtotal_s, st.wsamples)
+                st.wcount = 0
+                st.wtotal_s = 0.0
+                st.wsamples = []
         return out
 
     def dump(self, path: str) -> None:
@@ -150,3 +178,23 @@ def render_top(snapshots: list[dict], sort_by: str = "total_p99_ms",
             f"{r['server_p50_ms']:>8.2f}{r['server_p99_ms']:>8.2f}"
             f"{r['network_p50_ms']:>8.2f}{r['network_p99_ms']:>8.2f}")
     return "\n".join(lines)
+
+
+def register_monitor_recorder() -> None:
+    """Feed the per-method latency decomposition into the monitor
+    pipeline: registers a metrics-registry Recorder whose collect()
+    row carries the full rpc-top snapshot (one row per tick; the
+    monitor sink keeps the dict in its JSON payload column, so
+    `metrics-query rpc.latency` returns the splits over time).
+    Idempotent."""
+    from t3fs.utils.metrics import Recorder, all_recorders
+
+    if any(r.name == "rpc.latency" for r in all_recorders()):
+        return
+
+    class _RpcStatsRecorder(Recorder):
+        def collect(self) -> dict:
+            return {"name": self.name, "type": "rpc_top",
+                    "methods": RPC_STATS.window_snapshot(), **self.tags}
+
+    _RpcStatsRecorder("rpc.latency")   # Recorder.__init__ registers it

@@ -102,3 +102,81 @@ def test_memory_watcher_gauges():
         assert seen and seen[0] == snap
     finally:
         reset_registry()
+
+
+def test_rpc_latency_rides_monitor_pipeline():
+    """The rpc-top decomposition reaches the monitor sink: the
+    rpc.latency recorder's snapshot rows land in the sqlite metrics DB
+    with the per-method splits in the JSON payload."""
+    import asyncio
+    import json
+
+    from t3fs.monitor.service import MetricsDB
+    from t3fs.net.rpcstats import RPC_STATS, register_monitor_recorder
+    from t3fs.utils.metrics import Collector, all_recorders
+
+    async def traffic():
+        from dataclasses import dataclass
+
+        from t3fs.net.client import Client
+        from t3fs.net.server import Server, rpc_method, service
+        from t3fs.utils.serde import serde_struct
+
+        @serde_struct
+        @dataclass
+        class MonPingReq:
+            n: int = 0
+
+        @service("MonPing")
+        class Svc:
+            @rpc_method
+            async def ping(self, req, payload, conn):
+                return MonPingReq(n=req.n + 1), b""
+
+        srv = Server(); srv.add_service(Svc()); await srv.start()
+        cli = Client()
+        try:
+            for i in range(4):
+                await cli.call(srv.address, "MonPing.ping", MonPingReq(n=i))
+        finally:
+            await cli.close()
+            await srv.stop()
+
+    from t3fs.utils.metrics import reset_registry
+    RPC_STATS.clear()
+    try:
+        register_monitor_recorder()
+        register_monitor_recorder()   # idempotent
+        assert sum(1 for r in all_recorders()
+                   if r.name == "rpc.latency") == 1
+        asyncio.run(traffic())
+
+        db = MetricsDB()
+        rows_holder = []
+
+        def sink(snapshot):
+            rows_holder.append(db.insert(7, "test", 0.0, snapshot))
+
+        collector = Collector(reporters=[sink])
+        collector.collect_once()
+        assert rows_holder and rows_holder[0] > 0
+        cur = db._conn.execute(
+            "SELECT payload FROM metrics WHERE name='rpc.latency'")
+        payloads = [json.loads(p) for (p,) in cur.fetchall()]
+        assert payloads, "rpc.latency row missing from the sink"
+        methods = payloads[-1]["methods"]
+        assert methods["MonPing.ping"]["count"] == 4
+        assert "server_p50_ms" in methods["MonPing.ping"]
+
+        # the monitor rows are PER-WINDOW (cumulative history would
+        # flatten the time series): a second tick with no traffic
+        # reports no MonPing row, while the cumulative CLI view keeps it
+        collector.collect_once()
+        cur = db._conn.execute(
+            "SELECT payload FROM metrics WHERE name='rpc.latency'")
+        last = json.loads(cur.fetchall()[-1][0])
+        assert "MonPing.ping" not in last["methods"], last
+        assert RPC_STATS.snapshot()["MonPing.ping"]["count"] == 4
+    finally:
+        reset_registry()
+        RPC_STATS.clear()
