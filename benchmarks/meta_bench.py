@@ -125,7 +125,10 @@ async def run_fuse_bench(args) -> dict:
         out["remove"] = await phase(_rm, [_renamed(p) for p in files])
         return out
     finally:
-        pool.shutdown(wait=False, cancel_futures=True)
+        # wait for in-flight syscalls: unmounting under them races EBUSY
+        # and would leak the mount + tmpdir on an error exit
+        await asyncio.to_thread(pool.shutdown, wait=True,
+                                cancel_futures=True)
         await fuse.unmount()
         await cluster.stop()
         import shutil

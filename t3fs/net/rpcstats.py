@@ -59,7 +59,15 @@ class _MethodStats:
         self.wcount += 1
         self.wtotal_s += sample[0]
         if len(self.wsamples) < RESERVOIR:
-            self.wsamples.append(sample)   # capped; wcount stays exact
+            self.wsamples.append(sample)
+        else:
+            # reservoir replacement, same as the cumulative tier: a
+            # first-2048-only cap would hide a latency spike landing
+            # late in a busy tick — the exact failure this tier exists
+            # to expose
+            i = random.randrange(self.wcount)
+            if i < RESERVOIR:
+                self.wsamples[i] = sample
 
 
 class RpcStats:

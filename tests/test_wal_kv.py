@@ -247,3 +247,148 @@ def test_wal_crash_fuzz_every_truncation_is_a_prefix():
                 assert snap == {} or snap in batches, (seed, cut, snap)
                 if cut == len(full):
                     assert snap == batches[-1], (seed, snap)
+
+
+def test_group_commit_concurrent_durability(monkeypatch):
+    """Group commit (sync=always): N concurrent committers share fsync
+    barriers — FEWER fsyncs than commits — and an ack must never precede
+    its frame's durability: after each acked commit the DURABLE snapshot
+    (current_version gates on the watermark) already shows the row, so a
+    no-op barrier would fail the visibility asserts, not just the
+    reopen."""
+    import asyncio
+
+    import t3fs.kv.wal_engine as wal_mod
+    from t3fs.kv.engine import with_transaction
+
+    real_fsync = os.fsync
+    fsyncs = {"n": 0}
+
+    def counting_fsync(fd):
+        fsyncs["n"] += 1
+        return real_fsync(fd)
+
+    monkeypatch.setattr(wal_mod.os, "fsync", counting_fsync)
+
+    with tempfile.TemporaryDirectory() as d:
+        async def writers():
+            eng = WalKVEngine(d, sync="always")
+            try:
+                sem = asyncio.Semaphore(24)
+
+                async def one(i):
+                    async with sem:
+                        async def op(txn):
+                            txn.set(b"gc%05d" % i, b"v%d" % i)
+                        await with_transaction(eng, op)
+                        # ACK implies durability implies visibility at
+                        # the durable snapshot — a barrier that returned
+                        # before its fsync would leave the watermark
+                        # (and so current_version) behind this row
+                        assert eng.read_at(b"gc%05d" % i,
+                                           eng.current_version()) \
+                            == b"v%d" % i, i
+                await asyncio.gather(*[one(i) for i in range(400)])
+                assert eng._synced_upto > 0 or eng._synced_epoch > 0
+            finally:
+                eng.close()
+        asyncio.run(writers())
+        # grouping actually happened: far fewer fsyncs than commits
+        assert fsyncs["n"] < 400, fsyncs
+
+        eng2 = WalKVEngine(d, sync="always")
+        try:
+            ver = eng2.current_version()
+            for i in range(400):
+                assert eng2.read_at(b"gc%05d" % i, ver) == b"v%d" % i, i
+        finally:
+            eng2.close()
+
+
+def test_group_commit_fsync_failure_is_terminal(monkeypatch):
+    """An fsync failure must (a) fail the in-flight commits, (b) brick
+    the engine (a RETRY could spuriously succeed after the kernel
+    dropped the dirty pages), and (c) truncate the un-durable tail so
+    the FAILED commits cannot resurrect on reopen."""
+    import asyncio
+
+    import t3fs.kv.wal_engine as wal_mod
+    from t3fs.kv.engine import with_transaction
+
+    real_fsync = os.fsync
+
+    with tempfile.TemporaryDirectory() as d:
+        async def run():
+            eng = WalKVEngine(d, sync="always")
+            async def op_ok(txn):
+                txn.set(b"pre", b"durable")
+            await with_transaction(eng, op_ok)
+
+            fail = {"on": True}
+
+            def flaky_fsync(fd):
+                if fail["on"]:
+                    raise OSError(5, "Input/output error")
+                return real_fsync(fd)
+
+            monkeypatch.setattr(wal_mod.os, "fsync", flaky_fsync)
+            async def op_lost(txn):
+                txn.set(b"lost", b"never-acked")
+            with pytest.raises(StatusError):
+                await with_transaction(eng, op_lost)
+            assert eng._broken
+            # broken engine refuses further commits
+            async def op_more(txn):
+                txn.set(b"more", b"x")
+            with pytest.raises(StatusError):
+                await with_transaction(eng, op_more)
+            fail["on"] = False
+            monkeypatch.setattr(wal_mod.os, "fsync", real_fsync)
+            eng.close()
+
+        asyncio.run(run())
+
+        eng2 = WalKVEngine(d, sync="always")
+        try:
+            ver = eng2.current_version()
+            assert eng2.read_at(b"pre", ver) == b"durable"
+            # the FAILED commit must not resurrect
+            assert eng2.read_at(b"lost", ver) is None
+            assert eng2.read_at(b"more", ver) is None
+        finally:
+            eng2.close()
+
+
+def test_group_commit_across_compaction():
+    """A WAL rotation mid-stream (epoch bump) must release barrier
+    waiters via the snapshot's fsync and keep every acked row."""
+    import asyncio
+
+    from t3fs.kv.engine import with_transaction
+
+    with tempfile.TemporaryDirectory() as d:
+        async def writers():
+            # tiny threshold: compaction triggers every few commits
+            eng = WalKVEngine(d, sync="always",
+                              compact_threshold_bytes=2048)
+            try:
+                sem = asyncio.Semaphore(16)
+
+                async def one(i):
+                    async with sem:
+                        async def op(txn):
+                            txn.set(b"rc%05d" % i, b"x" * 128)
+                        await with_transaction(eng, op)
+                await asyncio.gather(*[one(i) for i in range(300)])
+                assert eng._wal_epoch > 0, "no rotation happened"
+            finally:
+                eng.close()
+        asyncio.run(writers())
+
+        eng2 = WalKVEngine(d, sync="always")
+        try:
+            ver = eng2.current_version()
+            for i in range(300):
+                assert eng2.read_at(b"rc%05d" % i, ver) == b"x" * 128, i
+        finally:
+            eng2.close()
