@@ -544,7 +544,11 @@ def test_2pc_chaos_convergence():
         from t3fs.net.server import Server
         import random
 
-        rng = random.Random(20260731)
+        # default seed pinned for the suite; T3FS_CHAOS_SEED sweeps
+        # fresh schedules (end-of-round validation runs hundreds)
+        import os
+        rng = random.Random(int(os.environ.get("T3FS_CHAOS_SEED",
+                                               "20260731")))
         ship = Client()
         engines = [MemKVEngine(), MemKVEngine()]
         servers: list = [None, None]
