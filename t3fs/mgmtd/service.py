@@ -410,9 +410,27 @@ def next_chain_state(chain: ChainInfo,
             changed = True
         elif t.public_state == PublicTargetState.LASTSRV and a \
                 and ls != LocalTargetState.OFFLINE:
-            t.public_state = PublicTargetState.SERVING
-            serving_count += 1
-            has_lastsrv = False
+            if serving_count > 0 or new_lastsrv:
+                # SUPERSEDED lastsrv: while it was down the chain found
+                # another authority (an UPTODATE syncing member promoted,
+                # or a newer LASTSRV was minted this very pass), so its
+                # copy is no longer the lineage — and after a restart it
+                # may be wiped entirely.  Reseating it as SERVING forked
+                # the authority and the next resync propagated its EMPTY
+                # disk to the whole chain (hard-matrix craq sweep, seed
+                # 990583: crash+wipe+disk-fail combined — acked-write
+                # loss).  Rejoin as SYNCING and resync from the living
+                # authority instead.
+                t.public_state = PublicTargetState.SYNCING
+                # THIS target stops being lastsrv, but one minted earlier
+                # in the same pass still holds the authority: clearing
+                # the flag here let a later empty rejoiner cold-start
+                # seed as SERVING past it (code-review r4)
+                has_lastsrv = new_lastsrv
+            else:
+                t.public_state = PublicTargetState.SERVING
+                serving_count += 1
+                has_lastsrv = False
             changed = True
         elif t.public_state == PublicTargetState.LASTSRV \
                 and (not a or ls == LocalTargetState.OFFLINE) \

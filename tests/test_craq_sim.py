@@ -77,7 +77,14 @@ def test_wide_sweep_regression_seeds():
                      (400006, dict(crashes=2, disk_fails=1)),
                      (400014, dict(crashes=2, disk_fails=1)),
                      (400024, dict(crashes=2, disk_fails=1)),
-                     (400025, dict(crashes=2, disk_fails=1))):
+                     (400025, dict(crashes=2, disk_fails=1)),
+                     # round-4 hard-matrix find: a restarted (wiped)
+                     # LASTSRV reseated as SERVING while the chain had
+                     # already promoted another authority — acked-write
+                     # loss + empty-disk resync propagation (fixed:
+                     # superseded LASTSRV rejoins as SYNCING)
+                     (990583, dict(crashes=2, wipe_on_crash=True,
+                                   disk_fails=1))):
         sim = CraqSim(seed, **kw)
         sim.run()
         assert not sim.violations, (seed, sim.violations)

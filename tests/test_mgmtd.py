@@ -526,3 +526,51 @@ def test_no_double_lastsrv():
     nxt = next_chain_state(c, {1: False, 2: False}, {})
     states = {t.target_id: t.public_state for t in nxt.targets}
     assert states[102] == LAST and states[101] == OFF
+
+
+def test_superseded_lastsrv_rejoins_as_syncing():
+    """Round-4 hard-matrix find (craq seed 990583) + its review
+    refinement: a returning LASTSRV whose authority was superseded must
+    rejoin as SYNCING, and demoting it must not let an empty rejoiner
+    cold-start seed past a LASTSRV minted in the same pass."""
+    from t3fs.mgmtd.types import LocalTargetState, PublicTargetState
+
+    # case 1 (seed 990583): chain promoted another authority while the
+    # lastsrv was down -> returning lastsrv demotes to SYNCING
+    c = ChainInfo(chain_id=1, chain_ver=3, targets=[
+        ChainTargetInfo(101, 1, PublicTargetState.SERVING),
+        ChainTargetInfo(102, 2, PublicTargetState.LASTSRV),
+        ChainTargetInfo(103, 3, PublicTargetState.OFFLINE)])
+    nxt = next_chain_state(
+        c, {1: True, 2: True, 3: False},
+        {101: LocalTargetState.ONLINE, 102: LocalTargetState.ONLINE})
+    st = {t.target_id: t.public_state for t in nxt.targets}
+    assert st[102] == PublicTargetState.SYNCING
+    assert st[101] == PublicTargetState.SERVING
+
+    # case 2 (review repro): serving member dies (minted LASTSRV this
+    # pass) while a STALE lastsrv returns and an empty disk rejoins —
+    # the stale one demotes, the new lastsrv keeps the authority, and
+    # the empty rejoiner must NOT seed as SERVING
+    c = ChainInfo(chain_id=1, chain_ver=5, targets=[
+        ChainTargetInfo(2, 2, PublicTargetState.SERVING),
+        ChainTargetInfo(1, 1, PublicTargetState.LASTSRV),
+        ChainTargetInfo(3, 3, PublicTargetState.OFFLINE)])
+    nxt = next_chain_state(
+        c, {2: False, 1: True, 3: True},
+        {2: LocalTargetState.ONLINE, 1: LocalTargetState.ONLINE,
+         3: LocalTargetState.ONLINE})
+    st = {t.target_id: t.public_state for t in nxt.targets}
+    assert st[2] == PublicTargetState.LASTSRV
+    assert st[1] == PublicTargetState.SYNCING
+    assert st[3] == PublicTargetState.OFFLINE     # waits for the lastsrv
+
+    # case 3: sole-authority reseat unchanged — lastsrv returns with no
+    # other serving member and no newer mint -> SERVING again
+    c = ChainInfo(chain_id=1, chain_ver=7, targets=[
+        ChainTargetInfo(1, 1, PublicTargetState.LASTSRV),
+        ChainTargetInfo(2, 2, PublicTargetState.OFFLINE)])
+    nxt = next_chain_state(
+        c, {1: True, 2: False}, {1: LocalTargetState.ONLINE})
+    st = {t.target_id: t.public_state for t in nxt.targets}
+    assert st[1] == PublicTargetState.SERVING

@@ -234,7 +234,8 @@ def test_serde_fuzz_every_registered_struct():
     import t3fs.net.rdma           # noqa: F401
     import t3fs.client.ec_client   # noqa: F401
 
-    rng = random.Random(20260731)
+    import os as _os
+    rng = random.Random(int(_os.environ.get("T3FS_FUZZ_SEED", "20260731")))
 
     def value_for(hint, depth):
         origin = _t.get_origin(hint)
