@@ -108,10 +108,12 @@ class MgmtdClientForServer(MgmtdClient):
                  client: Client | None = None,
                  heartbeat_period_s: float = 0.3,
                  refresh_period_s: float = 0.5,
-                 default_lease_s: float = 2.0):
+                 default_lease_s: float = 2.0,
+                 fresh_targets: Callable[[], list[int]] | None = None):
         super().__init__(mgmtd_address, client, refresh_period_s)
         self.node = node
         self.target_states = target_states
+        self.fresh_targets = fresh_targets or (lambda: [])
         self.heartbeat_period_s = heartbeat_period_s
         self._hb_task: asyncio.Task | None = None
         self.last_heartbeat_ok: float = 0.0
@@ -142,7 +144,8 @@ class MgmtdClientForServer(MgmtdClient):
             rsp, _ = await self.client.call(
                 self.mgmtd_address, "Mgmtd.heartbeat",
                 HeartbeatReq(node=self.node, target_states=self.target_states(),
-                             routing_version=self._routing.version),
+                             routing_version=self._routing.version,
+                             fresh_targets=self.fresh_targets()),
                 timeout=5.0)
             self.last_heartbeat_ok = time.time()
             self._last_hb_mono = time.monotonic()

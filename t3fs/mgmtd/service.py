@@ -41,6 +41,11 @@ class HeartbeatReq:
     node: NodeInfo = field(default_factory=NodeInfo)
     target_states: dict[int, LocalTargetState] = field(default_factory=dict)
     routing_version: int = 0
+    # targets whose engine booted on a VIRGIN directory and have not yet
+    # completed a resync: the chain state machine must never reseat such
+    # a target as an authority (fresh-LASTSRV demotion; append-only
+    # field — old nodes simply never report any)
+    fresh_targets: list[int] = field(default_factory=list)
 
 
 @serde_struct
@@ -140,6 +145,10 @@ class MgmtdState:
         self.cfg = cfg
         self.last_heartbeat: dict[int, float] = {}
         self.local_states: dict[int, LocalTargetState] = {}   # target -> state
+        # targets currently reporting a virgin disk (HeartbeatReq.
+        # fresh_targets); in-memory like local_states — re-learned from
+        # the next heartbeats after an mgmtd restart
+        self.fresh_targets: set[int] = set()
         self._persisted_states: dict[int, LocalTargetState] = {}
         # targets whose node silently restarted: demote from SERVING so they
         # resync (cleared by the chains updater AFTER a successful save)
@@ -329,7 +338,8 @@ class MgmtdState:
 def next_chain_state(chain: ChainInfo,
                      alive: dict[int, bool],
                      local: dict[int, LocalTargetState],
-                     restarted: set[int] = frozenset()) -> ChainInfo | None:
+                     restarted: set[int] = frozenset(),
+                     fresh: set[int] = frozenset()) -> ChainInfo | None:
     """One step of the chain state machine (generateNewChain analog,
     mgmtd/service/updateChain.h:38; table at docs/design_notes.md:201-231).
     Returns a NEW ChainInfo with bumped version if anything changed."""
@@ -372,6 +382,14 @@ def next_chain_state(chain: ChainInfo,
     # a returning stale target must NOT be seated as serving (write loss)
     has_lastsrv = any(t.public_state == PublicTargetState.LASTSRV
                       for t in targets)
+    # an alive, disk-ok SYNCING member (pass-start view): gates fresh
+    # rejoiners out of the cold-start seed so real data wins the chain
+    has_live_syncing = any(
+        t.public_state == PublicTargetState.SYNCING
+        and alive.get(t.node_id, False)
+        and local.get(t.target_id, LocalTargetState.INVALID)
+        != LocalTargetState.OFFLINE
+        for t in targets)
     new_lastsrv = False                 # minted during THIS pass
     for t in targets:
         a = alive.get(t.node_id, False)
@@ -407,6 +425,18 @@ def next_chain_state(chain: ChainInfo,
         elif t.public_state == PublicTargetState.SYNCING \
                 and (not a or ls == LocalTargetState.OFFLINE):
             t.public_state = PublicTargetState.OFFLINE
+            changed = True
+        elif t.public_state == PublicTargetState.LASTSRV and a \
+                and t.target_id in fresh:
+            # the lastsrv came back on a VIRGIN disk (heartbeat fresh
+            # flag: wiped/replaced since it held the authority) — it has
+            # nothing to serve, and reseating it would make resync ERASE
+            # every surviving copy (mega-sweep seed 2802880: a wiped
+            # 2-replica lastsrv reseated and removed the syncing
+            # member's committed write).  Its lineage is gone: demote;
+            # the orphan-promotion below seats the best remaining copy.
+            t.public_state = PublicTargetState.OFFLINE
+            has_lastsrv = False
             changed = True
         elif t.public_state == PublicTargetState.LASTSRV and a \
                 and ls != LocalTargetState.OFFLINE:
@@ -452,9 +482,14 @@ def next_chain_state(chain: ChainInfo,
             if serving_count > 0:
                 t.public_state = PublicTargetState.SYNCING   # rejoin at tail
                 changed = True
-            elif not has_lastsrv:
+            elif not has_lastsrv and not (
+                    t.target_id in fresh and has_live_syncing):
                 # true cold start (nobody ever served or everyone wiped):
-                # the returning target seeds the chain
+                # the returning target seeds the chain.  A FRESH (virgin
+                # disk) rejoiner must not seed past an alive SYNCING
+                # member holding real data — the orphan promotion below
+                # seats that copy instead (code-review r4: the seed
+                # branch was a second door to the 2802880 loss)
                 t.public_state = PublicTargetState.SERVING
                 serving_count += 1
                 changed = True
@@ -462,6 +497,24 @@ def next_chain_state(chain: ChainInfo,
         elif t.public_state == PublicTargetState.SYNCING and a \
                 and ls == LocalTargetState.UPTODATE:
             t.public_state = PublicTargetState.SERVING       # promoted to tail
+            serving_count += 1
+            changed = True
+    # orphan promotion: zero serving members and no authoritative
+    # lastsrv left (e.g. the lastsrv returned on a virgin disk), but an
+    # alive disk-ok SYNCING member exists — its copy, pre-join gap and
+    # all, is the BEST the chain still has; seat it so the survivors
+    # resync from real data instead of an empty disk.  Prefer a
+    # non-fresh member (one that completed a resync or joined with
+    # data) over a fresh one.
+    if serving_count == 0 and not has_lastsrv:
+        candidates = [t for t in targets
+                      if t.public_state == PublicTargetState.SYNCING
+                      and alive.get(t.node_id, False)
+                      and local.get(t.target_id, LocalTargetState.INVALID)
+                      != LocalTargetState.OFFLINE]
+        candidates.sort(key=lambda t: t.target_id in fresh)
+        if candidates:
+            candidates[0].public_state = PublicTargetState.SERVING
             serving_count += 1
             changed = True
     if not changed:
@@ -671,6 +724,8 @@ class MgmtdService:
         for tid, ls in req.target_states.items():
             st.local_states[int(tid)] = LocalTargetState(ls)
             st.target_reporter[int(tid)] = req.node.node_id
+            st.fresh_targets.discard(int(tid))
+        st.fresh_targets.update(int(t) for t in req.fresh_targets)
         if not restarted and (known is None
                               or known.address != req.node.address
                               or known.generation != req.node.generation):
@@ -1149,7 +1204,8 @@ class MgmtdServer:
                 alive = {t.node_id: st.node_serviceable(t.node_id)
                          for t in chain.targets}
                 nxt = next_chain_state(chain, alive, st.local_states,
-                                       restarted=st.restarted_targets)
+                                       restarted=st.restarted_targets,
+                                       fresh=st.fresh_targets)
                 handled |= {t.target_id for t in chain.targets} \
                     & st.restarted_targets
                 if nxt is not None:

@@ -15,7 +15,7 @@ import time as _time
 from dataclasses import dataclass
 
 from t3fs.client.mgmtd_client import MgmtdClientForServer
-from t3fs.mgmtd.types import NodeInfo
+from t3fs.mgmtd.types import NodeInfo, PublicTargetState
 from t3fs.net.client import Client
 from t3fs.net.server import Server
 from t3fs.storage.resync import ResyncWorker
@@ -81,6 +81,29 @@ class StorageServer:
     def add_target(self, target_id: int, root: str, **kw):
         return self.node.add_target(target_id, root, **kw)
 
+    def _fresh_targets(self) -> list[int]:
+        """Heartbeat provider: targets still on a virgin disk.  A target
+        the ROUTING seats as SERVING/LASTSRV holds the chain's lineage —
+        clients write to it — so freshness ends there (the state machine
+        only seats a fresh target when its emptiness IS the lineage:
+        cold start / orphan promotion).  Without this, a seed target
+        that never resyncs reports fresh forever and a later fresh-
+        LASTSRV demotion would discard its real data (code-review r4)."""
+        routing = self.node.routing()
+        serving_roles = set()
+        for chain in routing.chains.values():
+            for t in chain.targets:
+                if t.public_state in (PublicTargetState.SERVING,
+                                      PublicTargetState.LASTSRV):
+                    serving_roles.add(t.target_id)
+        out = []
+        for tid, t in self.node.targets.items():
+            if t.booted_fresh and tid in serving_roles:
+                t.booted_fresh = False
+            elif t.booted_fresh:
+                out.append(tid)
+        return out
+
     def _on_config_updated(self, keys: list[str]) -> None:
         """Push hot values into running components (onConfigUpdated analog)."""
         self.heartbeat_period_s = self.cfg.heartbeat_period_s
@@ -106,7 +129,8 @@ class StorageServer:
                      generation=_time.time()),
             lambda: dict(self.node.local_states),
             heartbeat_period_s=self.heartbeat_period_s,
-            refresh_period_s=self.heartbeat_period_s)
+            refresh_period_s=self.heartbeat_period_s,
+            fresh_targets=self._fresh_targets)
         await self.mgmtd.start()
         # self-fencing: refuse writes once the mgmtd lease (reported in
         # heartbeat responses) has lapsed for lease/2 — see suicide.cc

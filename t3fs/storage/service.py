@@ -60,11 +60,20 @@ class StorageTarget:
     stays deterministic."""
 
     def __init__(self, target_id: int, root: str, engine_backend: str = "native"):
+        import os as _os
         from concurrent.futures import ThreadPoolExecutor
 
         from t3fs.storage.native_engine import make_engine
 
         self.target_id = target_id
+        # VIRGIN-disk detection for the chain state machine: a target
+        # booting on a directory with no prior engine state (fresh disk
+        # swap / wiped data) must not be reseated as a chain AUTHORITY —
+        # heartbeats carry this until a resync completes, and mgmtd's
+        # next_chain_state demotes a "fresh" LASTSRV instead of letting
+        # resync propagate its empty disk (craq mega-sweep seed 2802880)
+        self.booted_fresh = not (
+            _os.path.isdir(root) and _os.listdir(root))
         self.engine = make_engine(root, backend=engine_backend)
         self.replica = ChunkReplica(self.engine)
         from t3fs.utils.lock_manager import LockManager
@@ -725,4 +734,5 @@ class StorageService:
         up to date — report UPTODATE in heartbeats so mgmtd promotes it."""
         _, target = self.node._check_chain(req.chain_id, 0)
         self.node.local_states[target.target_id] = LocalTargetState.UPTODATE
+        target.booted_fresh = False     # now holds the chain's lineage
         return SyncDoneRsp(), b""

@@ -56,12 +56,18 @@ class SimNode:
     local_state: LocalTargetState = LocalTargetState.UPTODATE
     max_commit_seen: dict[bytes, int] = field(default_factory=dict)
     disk_epoch: int = 0           # bumped on every data loss (wipe/replace)
+    # heartbeat "virgin disk" flag: True from wipe/replace until a resync
+    # completes (sync_done) or the empty target legitimately seeds a cold
+    # chain — the product derives it from the engine booting on an empty
+    # data dir (no WAL/meta) and clears it the same way
+    disk_fresh: bool = False
 
     def wipe(self) -> None:
         """Disk loss on crash-restart (worst case)."""
         for m in self.engine.all_metas():
             self.engine.remove(m.chunk_id)
         self.disk_epoch += 1
+        self.disk_fresh = True
 
 
 @dataclass
@@ -443,7 +449,15 @@ class CraqSim:
         restarted = {n.target_id for n in self.nodes.values()
                      if self.node_gen[n.node_id]
                      != self.node_gen_persisted[n.node_id]}
-        new = next_chain_state(self.chain, alive, local, restarted=restarted)
+        fresh = {n.target_id for n in self.nodes.values() if n.disk_fresh}
+        new = next_chain_state(self.chain, alive, local,
+                               restarted=restarted, fresh=fresh)
+        if new is not None:
+            # an empty target that legitimately SEEDED a cold chain is
+            # the authority now: its (empty) content IS the lineage
+            for t in new.targets:
+                if t.public_state == PublicTargetState.SERVING:
+                    self.node_of_target(t.target_id).disk_fresh = False
         # generation persisted atomically with the (possibly empty) chain
         # save — mirrors update_chains_once's single-transaction behavior
         for n in self.nodes.values():
@@ -522,6 +536,7 @@ class CraqSim:
                 succ_node.replica.apply_update(io, b"")
             else:  # sync_done
                 succ_node.local_state = LocalTargetState.UPTODATE
+                succ_node.disk_fresh = False   # now holds the real lineage
                 self.resync_inflight.pop(succ_t, None)
         except StatusError as e:
             self.violations.append(f"resync {kind} t{succ_t}: {e}")

@@ -574,3 +574,64 @@ def test_superseded_lastsrv_rejoins_as_syncing():
         c, {1: True, 2: False}, {1: LocalTargetState.ONLINE})
     st = {t.target_id: t.public_state for t in nxt.targets}
     assert st[1] == PublicTargetState.SERVING
+
+
+def test_fresh_lastsrv_demotes_and_orphan_syncing_promotes():
+    """Mega-sweep seed 2802880: a LASTSRV returning on a VIRGIN disk
+    (heartbeat fresh flag) has nothing to serve — reseating it made
+    resync erase the syncing member's committed copy.  It must demote,
+    and the best remaining SYNCING copy seats as the authority."""
+    from t3fs.mgmtd.types import LocalTargetState, PublicTargetState
+
+    c = ChainInfo(chain_id=1, chain_ver=5, targets=[
+        ChainTargetInfo(102, 2, PublicTargetState.SYNCING),
+        ChainTargetInfo(101, 1, PublicTargetState.LASTSRV)])
+    nxt = next_chain_state(
+        c, {1: True, 2: True},
+        {101: LocalTargetState.ONLINE, 102: LocalTargetState.ONLINE},
+        fresh={101})
+    st = {t.target_id: t.public_state for t in nxt.targets}
+    assert st[101] == PublicTargetState.OFFLINE    # virgin lastsrv out
+    assert st[102] == PublicTargetState.SERVING    # orphan promoted
+
+    # orphan promotion prefers a NON-fresh syncing member
+    c = ChainInfo(chain_id=1, chain_ver=5, targets=[
+        ChainTargetInfo(102, 2, PublicTargetState.SYNCING),
+        ChainTargetInfo(103, 3, PublicTargetState.SYNCING),
+        ChainTargetInfo(101, 1, PublicTargetState.LASTSRV)])
+    nxt = next_chain_state(
+        c, {1: True, 2: True, 3: True},
+        {101: LocalTargetState.ONLINE, 102: LocalTargetState.ONLINE,
+         103: LocalTargetState.ONLINE},
+        fresh={101, 102})
+    st = {t.target_id: t.public_state for t in nxt.targets}
+    assert st[103] == PublicTargetState.SERVING    # non-fresh preferred
+    assert st[102] == PublicTargetState.SYNCING
+
+    # a NON-fresh lastsrv with no other authority still reseats
+    c = ChainInfo(chain_id=1, chain_ver=5, targets=[
+        ChainTargetInfo(101, 1, PublicTargetState.LASTSRV)])
+    nxt = next_chain_state(c, {1: True}, {101: LocalTargetState.ONLINE},
+                           fresh=set())
+    assert nxt.targets[0].public_state == PublicTargetState.SERVING
+
+
+def test_fresh_rejoiner_cannot_cold_start_seed_past_syncing_data():
+    """code-review r4: with the fresh LASTSRV demoting in the same tick,
+    an empty just-replaced rejoiner must not take the cold-start seed
+    branch while an alive SYNCING member holds real data."""
+    from t3fs.mgmtd.types import LocalTargetState, PublicTargetState
+
+    c = ChainInfo(chain_id=1, chain_ver=5, targets=[
+        ChainTargetInfo(102, 2, PublicTargetState.SYNCING),   # real data
+        ChainTargetInfo(101, 1, PublicTargetState.LASTSRV),   # virgin
+        ChainTargetInfo(103, 3, PublicTargetState.OFFLINE)])  # virgin
+    nxt = next_chain_state(
+        c, {1: True, 2: True, 3: True},
+        {101: LocalTargetState.ONLINE, 102: LocalTargetState.ONLINE,
+         103: LocalTargetState.ONLINE},
+        fresh={101, 103})
+    st = {t.target_id: t.public_state for t in nxt.targets}
+    assert st[102] == PublicTargetState.SERVING    # data wins the chain
+    assert st[101] == PublicTargetState.OFFLINE
+    assert st[103] != PublicTargetState.SERVING
