@@ -144,7 +144,10 @@ class FuseKernelMount:
         # (src/meta/components/AclCache.h:16).
         self.group_resolver = group_resolver
         self.group_ttl_s = group_ttl_s
-        self._gid_cache: dict[int, tuple[float, list[int] | None]] = {}
+        # value is the in-flight resolver Task until it completes, then
+        # the slot collapses to the plain result (see _full_gids)
+        self._gid_cache: dict[
+            int, tuple[float, "asyncio.Task | list[int] | None"]] = {}
         self.fd = -1
         self._next_fh = 1
         self._handles: dict[int, _Handle] = {}
@@ -312,13 +315,32 @@ class FuseKernelMount:
         now = _time.monotonic()
         hit = self._gid_cache.get(uid)
         if hit is None or hit[0] < now:
+            deadline = now + self.group_ttl_s
             task = asyncio.ensure_future(self._resolve_gids(uid))
-            self._gid_cache[uid] = (now + self.group_ttl_s, task)
-            extra = await task
+            self._gid_cache[uid] = (deadline, task)
         else:
-            extra = hit[1]
-            if isinstance(extra, asyncio.Task):
-                extra = await extra
+            deadline, task = hit
+        if isinstance(task, asyncio.Task):
+            # shield: cancelling ONE awaiting FUSE op must not cancel the
+            # shared resolver task — a cancelled Task cached here would
+            # raise CancelledError into every op for this uid until the
+            # TTL lapsed (ADVICE r4).  If the task still ends cancelled
+            # (loop shutdown), evict so the next op retries.
+            try:
+                extra = await asyncio.shield(task)
+            except asyncio.CancelledError:
+                if task.cancelled():
+                    cur = self._gid_cache.get(uid)
+                    if cur is not None and cur[1] is task:
+                        del self._gid_cache[uid]
+                raise
+            # collapse the slot to the plain result so later hits skip
+            # the await (and the annotation above stays honest)
+            cur = self._gid_cache.get(uid)
+            if cur is not None and cur[1] is task:
+                self._gid_cache[uid] = (deadline, extra)
+        else:
+            extra = task
         if not extra:
             return [gid]
         return list(dict.fromkeys([gid, *extra]))

@@ -121,6 +121,10 @@ class WalKVEngine(MemKVEngine):
         self._synced_epoch = 0           # watermark, under _sync_cv
         self._synced_upto = 0
         self._sync_leader = False
+        # bumped by clear_all: a committer parked at the barrier across a
+        # wipe must NOT ratchet the durable watermark back up afterwards
+        # (its frame's data is gone; see _commit / clear_all)
+        self._clear_gen = 0              # written under _io_lock+_sync_cv
         # rotation defers closing the outgoing WAL one epoch so a
         # leader's out-of-lock fsync of the previous epoch stays valid
         self._prev_wal = None
@@ -146,6 +150,28 @@ class WalKVEngine(MemKVEngine):
             return super().current_version()
         with self._sync_cv:
             return self._durable_version
+
+    def transaction(self) -> Transaction:
+        """Embedded-path snapshots must honor the durable-read watermark
+        too: meta/mgmtd running directly on a wal: engine open their
+        transactions here, and pinning at the applied (possibly
+        un-fsynced) _version would externalize state a crash erases —
+        the exact guarantee current_version() documents (ADVICE r4)."""
+        return Transaction(self, read_version=self.current_version())
+
+    def advance_version(self, version: int) -> None:
+        """Follower clock fast-forward (see MemKVEngine.advance_version).
+        The versions being skipped carry no local WAL frames — the
+        caller's adjacent replicated-batch / snapshot fsync covers the
+        state they name — so the durable watermark may advance up to
+        `version` with them.  Capped at `version` (not _version): any
+        locally-applied-but-unsynced frames above it must stay invisible
+        (ADVICE r4)."""
+        super().advance_version(version)
+        if self.sync == "always":
+            with self._sync_cv:
+                self._durable_version = max(
+                    self._durable_version, min(version, self._version))
 
     # --- recovery ---
 
@@ -275,6 +301,7 @@ class WalKVEngine(MemKVEngine):
                     raise
                 end_pos = self._wal.tell()
                 epoch = self._wal_epoch
+                gen = self._clear_gen
             with self._lock:
                 self._apply_locked(txn)
                 my_version = self._version
@@ -286,9 +313,15 @@ class WalKVEngine(MemKVEngine):
                 self._group_fsync(epoch, end_pos)
             # versions are assigned in WAL-append order (both under
             # _io_lock), so the barrier covering our frame covers every
-            # version <= ours: advance the read-visibility watermark
+            # version <= ours: advance the read-visibility watermark.
+            # Skip if clear_all ran while we were parked at the barrier
+            # (generation mismatch): our frame's data was wiped and the
+            # clock reset, so ratcheting the watermark back up would
+            # reopen the durable>_version hole clear_all closes
+            # (code-review r5).
             with self._sync_cv:
-                if my_version > self._durable_version:
+                if (gen == self._clear_gen
+                        and my_version > self._durable_version):
                     self._durable_version = my_version
 
     def _covered(self, epoch: int, end_pos: int) -> bool:
@@ -395,8 +428,21 @@ class WalKVEngine(MemKVEngine):
         clear_all would let pre-clear WAL frames replay on restart and
         resurrect keys that a subsequent snapshot load (KvService follower
         catch-up) had deleted cluster-wide."""
-        super().clear_all()
+        # the wipe, the empty snapshot, and the watermark reset are ONE
+        # step under _io_lock (commits serialize behind it), and the
+        # watermark drops FIRST: readers take only _sync_cv, so a reset
+        # after the wipe would leave a window where a cross-thread
+        # reader opens read_version above the wiped clock — stale-high
+        # watermarks make SSI checks unsound (ADVICE r4 + code-review
+        # r5).  Dropping early just shows them the empty post-clear view
+        # a moment sooner.  The generation bump stops barrier stragglers
+        # from ratcheting the watermark back up, and _compact_locked's
+        # own ratchet runs after _version is already 0.
         with self._io_lock:
+            with self._sync_cv:
+                self._clear_gen += 1
+                self._durable_version = 0
+            super().clear_all()
             self._compact_locked()   # empty snapshot + fresh WAL
 
     def _compact_locked(self) -> None:

@@ -83,18 +83,27 @@ class StorageServer:
 
     def _fresh_targets(self) -> list[int]:
         """Heartbeat provider: targets still on a virgin disk.  A target
-        the ROUTING seats as SERVING/LASTSRV holds the chain's lineage —
-        clients write to it — so freshness ends there (the state machine
-        only seats a fresh target when its emptiness IS the lineage:
-        cold start / orphan promotion).  Without this, a seed target
-        that never resyncs reports fresh forever and a later fresh-
-        LASTSRV demotion would discard its real data (code-review r4)."""
+        the ROUTING seats as SERVING holds the chain's lineage — clients
+        write to it — so freshness ends there (the state machine only
+        seats a fresh target when its emptiness IS the lineage: cold
+        start / orphan promotion).  Without this, a seed target that
+        never resyncs reports fresh forever and a later fresh-LASTSRV
+        demotion would discard its real data (code-review r4).
+
+        LASTSRV must NOT end freshness (ADVICE r4): a wiped target's
+        LASTSRV seat always predates the wipe — mgmtd never seats a
+        known-fresh target as LASTSRV — so a routing view still showing
+        LASTSRV is stale history, not lineage.  Clearing on it raced
+        mgmtd's chains tick: the second heartbeat dropped the fresh flag
+        before the demotion ran, the reseat branch made the empty disk
+        SERVING, and resync erased survivors (the seed-2802880 acked-
+        write loss).  craq_sim clears disk_fresh only on a SERVING seat
+        or sync_done; this now matches the protocol the sweeps verified."""
         routing = self.node.routing()
         serving_roles = set()
         for chain in routing.chains.values():
             for t in chain.targets:
-                if t.public_state in (PublicTargetState.SERVING,
-                                      PublicTargetState.LASTSRV):
+                if t.public_state == PublicTargetState.SERVING:
                     serving_roles.add(t.target_id)
         out = []
         for tid, t in self.node.targets.items():

@@ -618,3 +618,57 @@ def test_registry_group_resolver_roundtrip():
             await cli.close()
             await srv.stop()
     run(body())
+
+
+def test_gid_cache_survives_cancelled_first_awaiter():
+    """ADVICE r4: _full_gids caches the in-flight resolver Task; if the
+    FIRST awaiting FUSE op is cancelled (interrupted request), the cached
+    Task must keep running — a cancelled Task in the cache would raise
+    CancelledError into every op for that uid until the TTL lapsed."""
+    async def body():
+        from t3fs.fuse.kernel import FuseKernelMount
+
+        release = asyncio.Event()
+        calls = {"n": 0}
+
+        async def resolver(uid: int):
+            calls["n"] += 1
+            await release.wait()
+            return [uid, 4242]
+
+        m = FuseKernelMount.__new__(FuseKernelMount)   # unit: no mount
+        m.group_resolver = resolver
+        m.group_ttl_s = 60.0
+        m._gid_cache = {}
+
+        op1 = asyncio.ensure_future(m._full_gids(1000, 1000))
+        await asyncio.sleep(0)          # resolver task created + cached
+        op1.cancel()
+        try:
+            await op1
+        except asyncio.CancelledError:
+            pass
+        release.set()
+        # the shared resolver survived the awaiter's cancellation
+        assert await m._full_gids(1000, 1000) == [1000, 4242]
+        assert calls["n"] == 1          # ONE resolver call, shared
+
+        # hard-cancelled resolver (loop shutdown): the poisoned entry is
+        # evicted so the next op re-resolves instead of re-raising
+        task = m._gid_cache[1000][1]
+        assert not isinstance(task, asyncio.Task) or task.done()
+        m._gid_cache.clear()
+        blocked = asyncio.ensure_future(m._full_gids(2000, 2000))
+        await asyncio.sleep(0)
+        release.clear()
+        inner = m._gid_cache[2000][1]
+        inner.cancel()                   # kill the RESOLVER itself
+        try:
+            await blocked
+        except asyncio.CancelledError:
+            pass
+        assert 2000 not in m._gid_cache  # evicted, not poisoned
+        release.set()
+        assert await m._full_gids(2000, 2000) == [2000, 4242]
+
+    run(body())

@@ -252,3 +252,48 @@ def test_partitioned_head_self_fences():
         finally:
             await cluster.stop()
     asyncio.run(body())
+
+
+def test_fresh_flag_survives_lastsrv_routing_view():
+    """ADVICE r4: a wiped target's LASTSRV seat in the routing view always
+    predates the wipe (mgmtd never seats a known-fresh target as LASTSRV),
+    so the heartbeat provider must keep reporting fresh while the view
+    shows LASTSRV — clearing there raced mgmtd's fresh-LASTSRV demotion
+    tick and reopened the seed-2802880 acked-write loss.  Only a SERVING
+    seat (or sync_done) ends freshness, matching craq_sim's disk_fresh."""
+    from t3fs.mgmtd.types import ChainInfo, ChainTargetInfo, RoutingInfo
+    from t3fs.storage.server import StorageServer
+
+    class _T:
+        def __init__(self):
+            self.booted_fresh = True
+
+    class _Node:
+        def __init__(self, routing):
+            self._r = routing
+            self.targets = {101: _T()}
+
+        def routing(self):
+            return self._r
+
+    def view(state):
+        return RoutingInfo(chains={1: ChainInfo(chain_id=1, targets=[
+            ChainTargetInfo(target_id=101, node_id=1, public_state=state)])})
+
+    srv = StorageServer.__new__(StorageServer)   # unit: bypass full init
+
+    # stale LASTSRV view: still fresh, still reported
+    srv.node = _Node(view(PublicTargetState.LASTSRV))
+    assert srv._fresh_targets() == [101]
+    assert srv.node.targets[101].booted_fresh
+
+    # OFFLINE / SYNCING views: same
+    for st in (PublicTargetState.OFFLINE, PublicTargetState.SYNCING):
+        srv.node = _Node(view(st))
+        srv.node.targets[101].booted_fresh = True
+        assert srv._fresh_targets() == [101], st
+
+    # a SERVING seat is the lineage — freshness ends, flag clears
+    srv.node = _Node(view(PublicTargetState.SERVING))
+    assert srv._fresh_targets() == []
+    assert not srv.node.targets[101].booted_fresh

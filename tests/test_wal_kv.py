@@ -392,3 +392,108 @@ def test_group_commit_across_compaction():
                 assert eng2.read_at(b"rc%05d" % i, ver) == b"x" * 128, i
         finally:
             eng2.close()
+
+
+def test_embedded_transaction_pins_durable_watermark(monkeypatch):
+    """ADVICE r4: WalKVEngine.transaction() (the embedded meta/mgmtd
+    path) must pin its snapshot at the DURABLE watermark, not the applied
+    _version — group commit applies frames to memory before their fsync
+    lands, and an embedded reader at _version would externalize state a
+    crash erases."""
+    import threading
+
+    import t3fs.kv.wal_engine as wal_mod
+
+    real_fsync = os.fsync
+    gate = threading.Event()
+    entered = threading.Event()
+    block = {"on": False}
+
+    def gated_fsync(fd):
+        if block["on"]:
+            entered.set()
+            assert gate.wait(10), "test deadlock: fsync gate never opened"
+        return real_fsync(fd)
+
+    with tempfile.TemporaryDirectory() as d:
+        async def body():
+            eng = WalKVEngine(d, sync="always")
+            try:
+                t = eng.transaction()
+                t.set(b"a", b"1")
+                await t.commit()                       # durable @ v1
+                monkeypatch.setattr(wal_mod.os, "fsync", gated_fsync)
+                block["on"] = True
+                t2 = eng.transaction()
+                t2.set(b"b", b"2")
+                fut = asyncio.ensure_future(t2.commit())
+                # wait until the commit is applied to memory but parked
+                # inside its group-commit fsync
+                await asyncio.get_running_loop().run_in_executor(
+                    None, entered.wait, 10)
+                assert entered.is_set()
+                assert eng._version > eng.current_version()  # real divergence
+                snap = eng.transaction()
+                assert snap.read_version == eng.current_version()
+                assert await snap.get(b"b") is None  # unsynced: invisible
+                assert await snap.get(b"a") == b"1"
+                gate.set()
+                await fut
+                block["on"] = False
+                snap2 = eng.transaction()            # ack -> durable -> visible
+                assert await snap2.get(b"b") == b"2"
+            finally:
+                gate.set()
+                block["on"] = False
+                eng.close()
+        asyncio.run(body())
+
+
+def test_clear_all_resets_durable_watermark():
+    """ADVICE r4: clear_all resets _version to 0 but _compact_locked only
+    ratchets the durable watermark UP — the stale high watermark let
+    readers open above _version (seeing not-yet-durable writes, with
+    unsound SSI checks) until the clock caught back up."""
+    with tempfile.TemporaryDirectory() as d:
+        async def body():
+            eng = WalKVEngine(d, sync="always")
+            try:
+                for i in range(5):
+                    t = eng.transaction()
+                    t.set(b"k%d" % i, b"v")
+                    await t.commit()
+                assert eng.current_version() >= 5
+                eng.clear_all()
+                assert eng.current_version() == 0
+                assert eng.current_version() <= eng._version
+                t = eng.transaction()
+                t.set(b"new", b"1")
+                await t.commit()
+                assert eng.read_at(b"new", eng.current_version()) == b"1"
+                assert eng.current_version() <= eng._version
+            finally:
+                eng.close()
+        asyncio.run(body())
+
+
+def test_advance_version_advances_durable_watermark():
+    """ADVICE r4: follower clock fast-forward (apply_replica /
+    load_snapshot) must carry the durable watermark with it — the skipped
+    versions have no local frames, so reads at the advanced
+    current_version() are sound and report the primary's clock."""
+    with tempfile.TemporaryDirectory() as d:
+        async def body():
+            eng = WalKVEngine(d, sync="always")
+            try:
+                t = eng.transaction()
+                t.set(b"a", b"1")
+                await t.commit()
+                eng.advance_version(100)
+                assert eng._version == 100
+                assert eng.current_version() == 100
+                assert eng.read_at(b"a", eng.current_version()) == b"1"
+                # never beyond the clock
+                assert eng.current_version() <= eng._version
+            finally:
+                eng.close()
+        asyncio.run(body())
