@@ -50,6 +50,15 @@ class Transaction:
     async def snapshot_get(self, key: bytes) -> bytes | None:
         return await self.get(key, snapshot=True)
 
+    async def get_many(self, keys: list[bytes], *,
+                       snapshot: bool = False) -> list[bytes | None]:
+        """Point-read a batch at one snapshot.  Local engines answer from
+        memory; the REMOTE engines override this into one RPC per shard —
+        callers with N keys (batch_stat, readdirplus) should prefer it
+        over N awaited get()s (r4 verdict: per-key RPCs dropped sharded
+        batch_stat 12.5k -> 1.4k inodes/s)."""
+        return [await self.get(k, snapshot=snapshot) for k in keys]
+
     async def get_range(self, begin: bytes, end: bytes, *, limit: int = 0,
                         snapshot: bool = False) -> list[tuple[bytes, bytes]]:
         """Keys in [begin, end), sorted; limit 0 = unlimited."""

@@ -191,6 +191,79 @@ PREP_PREFIX = b"\x00t3fs2pc\x00p\x00"
 DEC_PREFIX = b"\x00t3fs2pc\x00d\x00"
 
 
+class _Footprint:
+    """A prepared transaction's conflict footprint: everything its slice
+    read or will write.  Between phase 1 and phase 2 the shard admits
+    OTHER commits freely as long as their mutations stay off every
+    registered footprint — this is what lets phase 2 apply
+    unconditionally without holding the shard's commit lock across the
+    inter-phase window (the FDB role's conflict-set commit admission,
+    ITransaction.h analog; ROADMAP #3a).
+
+    Conflict rule: a candidate's WRITES and CLEARS are checked against
+    the whole footprint (a mutation of a prepared read invalidates the
+    prepare-time validation phase 2 relies on; a mutation of a prepared
+    write reorders against an acked commit), and a candidate's READS
+    and READ RANGES are checked against the footprint's writes and
+    clears.  The read side is load-bearing for cross-shard consistency
+    (code-review r5): after phase 2 applied on shard A but not yet on
+    shard B, a transaction that read T1's X on A and validates a read
+    of pre-T1 Y on B would commit having observed T1 half-applied
+    (T1<T2 on A, T2<T1 on B — a serializability cycle).  The old
+    lock-hold prevented this by stalling B's commit/validation until
+    T1's slice applied and the version bump failed the SSI check; the
+    footprint read-check is the lock-free equivalent.  Read-vs-read
+    never conflicts."""
+
+    __slots__ = ("write_keys", "read_keys", "clear_ranges", "read_ranges")
+
+    def __init__(self, txn: Transaction):
+        self.write_keys = frozenset(txn._writes)
+        self.read_keys = frozenset(txn._read_keys)
+        self.clear_ranges = tuple(txn._range_clears)
+        self.read_ranges = tuple(txn._read_ranges)
+
+    def blocks(self, write_keys, clear_ranges,
+               read_keys=(), read_ranges=()) -> str | None:
+        """First conflict between a candidate txn and this footprint, or
+        None."""
+        for k in write_keys:
+            if k in self.write_keys or k in self.read_keys:
+                return f"key {k!r}"
+            for b, e in self.clear_ranges:
+                if b <= k < e:
+                    return f"key {k!r} in prepared clear [{b!r},{e!r})"
+            for b, e in self.read_ranges:
+                if b <= k < e:
+                    return f"key {k!r} in prepared read range [{b!r},{e!r})"
+        for cb, ce in clear_ranges:
+            for k in self.write_keys:
+                if cb <= k < ce:
+                    return f"clear [{cb!r},{ce!r}) covers prepared key {k!r}"
+            for k in self.read_keys:
+                if cb <= k < ce:
+                    return f"clear [{cb!r},{ce!r}) covers prepared read {k!r}"
+            for b, e in (*self.clear_ranges, *self.read_ranges):
+                if cb < e and b < ce:
+                    return f"clear [{cb!r},{ce!r}) overlaps [{b!r},{e!r})"
+        for k in read_keys:
+            if k in self.write_keys:
+                return f"read of {k!r} (prepared write)"
+            for b, e in self.clear_ranges:
+                if b <= k < e:
+                    return f"read of {k!r} in prepared clear [{b!r},{e!r})"
+        for rb, re_ in read_ranges:
+            for k in self.write_keys:
+                if rb <= k < re_:
+                    return (f"read range [{rb!r},{re_!r}) covers "
+                            f"prepared write {k!r}")
+            for b, e in self.clear_ranges:
+                if rb < e and b < re_:
+                    return (f"read range [{rb!r},{re_!r}) overlaps "
+                            f"prepared clear [{b!r},{e!r})")
+        return None
+
+
 @service("Kv")
 class KvService:
     def __init__(self, engine: KVEngine, *, primary: bool = True,
@@ -203,8 +276,18 @@ class KvService:
         self.seq = 0                    # last shipped/applied batch seq
         self._commit_lock = asyncio.Lock()
         # 2PC: txn_id -> (validated Transaction, expiry timer, prepare
-        # req); the commit lock is HELD while anything is prepared
+        # req).  The commit lock is held only WITHIN each phase — across
+        # the inter-phase window the prepared txn is protected by its
+        # registered footprint instead (see _Footprint), so unrelated
+        # commits keep flowing while a cross-shard txn is in flight
+        # (r4 verdict: one prepared txn serialized the whole shard at
+        # 147 creates/s).
         self._prepared: dict[str, tuple] = {}
+        # txn_id -> _Footprint for every prepared-but-unresolved txn;
+        # registered under the commit lock in prepare (and synchronously
+        # in recover_prepared), dropped only once the slice's phase-2
+        # apply (or abort) succeeded
+        self._footprints: dict[str, _Footprint] = {}
         self._resolving: set[str] = set()   # mid-resolution txn ids
         # txn_id -> final verdict ("C"/"A") for txns recently finished on
         # this shard.  Closes two races around late/duplicate prepares:
@@ -459,6 +542,10 @@ class KvService:
                               read_version=self.engine.current_version())
             for k, v in zip(req.keys, req.values):
                 rec._writes[k] = v
+            # prepared slices are protected by footprints, not the lock
+            # (r5): a bulk load over one would be erased/resurrected by
+            # the later unconditional phase-2 apply
+            self._check_footprints(rec)
             await self._replicate_and_apply(rec)
         return KvOkRsp(), b""
 
@@ -470,8 +557,35 @@ class KvService:
                               read_version=self.engine.current_version())
             rec._range_clears.append((max(req.begin, self._USER_FLOOR),
                                       req.end))
+            # a drain/cleanup clear over a prepared slice would delete
+            # rows the unconditional phase-2 apply then resurrects (or
+            # erase its pending writes): refuse, surgery retries once
+            # the 2pc resolves (prepare_timeout_s bounds the wait)
+            self._check_footprints(rec)
             await self._replicate_and_apply(rec)
         return KvOkRsp(), b""
+
+    def _check_footprints(self, txn: Transaction,
+                          exclude: str | None = None) -> None:
+        """Admission control vs prepared-but-unresolved txns: refuse any
+        mutation that lands on a registered footprint (TXN_CONFLICT —
+        retryable; with_transaction re-runs once the 2PC resolves).
+        Phase-2 applies skip this entirely (their own footprint IS the
+        guarantee that they still apply cleanly)."""
+        if not self._footprints:
+            return
+        writes = txn._writes
+        clears = txn._range_clears
+        reads = txn._read_keys
+        read_ranges = txn._read_ranges
+        for txn_id, fp in self._footprints.items():
+            if txn_id == exclude:
+                continue
+            hit = fp.blocks(writes, clears, reads, read_ranges)
+            if hit is not None:
+                raise make_error(
+                    StatusCode.TXN_CONFLICT,
+                    f"{hit} conflicts with prepared 2pc txn {txn_id}")
 
     def _txn_from_req(self, req: KvCommitReq) -> Transaction:
         txn = Transaction(self.engine, read_version=req.read_version)
@@ -525,6 +639,7 @@ class KvService:
             # the same seq, the stale follower answers KV_REPLICA_GAP, and
             # the snapshot push resets it to the primary's true state.
             self._check_shard_gates(txn)
+            self._check_footprints(txn)
             self.engine.check_conflicts(txn)
             await self._replicate_and_apply(txn)
         return KvCommitRsp(version=self.engine.current_version()), b""
@@ -534,37 +649,38 @@ class KvService:
     @rpc_method
     async def prepare(self, req: "KvPrepareReq", payload, conn):
         """Phase 1: validate this shard's slice of a cross-shard txn,
-        durably record it, and HOLD the commit lock until phase 2 (or
-        resolution).  Holding the lock makes the set of prepared shards a
-        consistent cut; the durable record (replicated like any write)
-        lets a restarted/failed-over shard finish the txn per the
-        decider's verdict instead of tearing it."""
+        durably record it, and register its FOOTPRINT.  The commit lock
+        is held only for the validation+record step; across the
+        inter-phase window the footprint keeps every later commit and
+        prepare off the slice's reads and writes (TXN_CONFLICT), which
+        is what entitles phase 2 to apply unconditionally.  The durable
+        record (replicated like any write) lets a restarted/failed-over
+        shard finish the txn per the decider's verdict instead of
+        tearing it."""
         self._require_primary()
         if not req.txn_id:
             raise make_error(StatusCode.INVALID_ARG, "empty txn_id")
         if self._refuse_stale_prepare(req.txn_id):
             return KvOkRsp(seq=self.seq), b""
         txn = self._txn_from_req(req.body)
-        await self._commit_lock.acquire()
-        try:
+        async with self._commit_lock:
             # re-check under the lock: phase 2 / an abort may have raced
             # this prepare while it sat queued on the lock — registering
-            # now would stall the shard until expiry (abort case) or
-            # re-apply an already-committed slice via the resolver
-            # (commit case)
+            # now would re-apply an already-committed slice via the
+            # resolver (commit case) or resurrect an aborted one
             if self._refuse_stale_prepare(req.txn_id):
-                self._commit_lock.release()
                 return KvOkRsp(seq=self.seq), b""
             self._check_shard_gates(txn)
+            self._check_footprints(txn)
             self.engine.check_conflicts(txn)
             rec = Transaction(self.engine,
                               read_version=self.engine.current_version())
             rec._writes[PREP_PREFIX + req.txn_id.encode()] = \
                 serde.dumps(req)
             await self._replicate_and_apply(rec)
-        except BaseException:
-            self._commit_lock.release()
-            raise
+            # register BEFORE the lock releases: from this instant no
+            # commit may touch the slice until the verdict applies
+            self._footprints[req.txn_id] = _Footprint(txn)
         timer = asyncio.create_task(self._resolve_later(req.txn_id))
         self._prepared[req.txn_id] = (txn, timer, req)
         return KvOkRsp(seq=self.seq), b""
@@ -572,11 +688,12 @@ class KvService:
     def _refuse_stale_prepare(self, txn_id: str) -> bool:
         """Duplicate/late-prepare gate (checked both outside AND under the
         commit lock).  True = ack idempotently without registering: the
-        txn is live here (original prepare's record + lock hold stand) or
+        txn is live here (original prepare's record + footprint stand) or
         already committed (a coordinator retry proceeding to phase 2 gets
         KV_TXN_NOT_FOUND and converges via the decider).  Raises for a
         txn this shard already aborted — presumed-abort's answer."""
-        if txn_id in self._prepared or txn_id in self._resolving:
+        if (txn_id in self._prepared or txn_id in self._resolving
+                or txn_id in self._footprints):
             return True
         verdict = self._resolved_tombstones.get(txn_id)
         if verdict == b"A":
@@ -736,16 +853,17 @@ class KvService:
             # late coordinator commit_prepared cannot resurrect the txn
             self._resolving.add(txn_id)
             try:
-                drop = Transaction(
-                    self.engine,
-                    read_version=self.engine.current_version())
-                self._finish_txn(drop, req, b"A")
-                await self._replicate_and_apply(drop)
+                async with self._commit_lock:
+                    drop = Transaction(
+                        self.engine,
+                        read_version=self.engine.current_version())
+                    self._finish_txn(drop, req, b"A")
+                    await self._replicate_and_apply(drop)
                 self._resolved_tombstones.set(txn_id, b"A")
             finally:
                 self._resolving.discard(txn_id)
             self._prepared.pop(txn_id, None)
-            self._commit_lock.release()
+            self._footprints.pop(txn_id, None)
             log.warning("2pc %s: decider expired -> ABORT tombstone", txn_id)
             self._spawn_push(req, commit=False)
             return True
@@ -761,22 +879,25 @@ class KvService:
             if self._prepared.get(txn_id) is not entry:
                 return True                 # consumed while asking (defense)
             if decision == "C":
-                # a decided COMMIT applies UNCONDITIONALLY: conflict
+                # a decided COMMIT applies UNCONDITIONALLY: the footprint
+                # kept interleaved commits off the slice, and conflict
                 # re-checking against the (now old) read version could
-                # veto the decider's global verdict and wedge the shard
+                # veto the decider's global verdict and wedge the txn
                 txn._read_keys.clear()
                 txn._read_ranges.clear()
                 self._finish_txn(txn, req, None)
-                await self._replicate_and_apply(txn)
+                async with self._commit_lock:
+                    await self._replicate_and_apply(txn)
                 self._resolved_tombstones.set(txn_id, b"C")
                 log.warning("2pc %s: decider says COMMITTED -> applied",
                             txn_id)
             else:                           # "A" or no trace: abort
-                drop = Transaction(
-                    self.engine,
-                    read_version=self.engine.current_version())
-                self._finish_txn(drop, req, None)
-                await self._replicate_and_apply(drop)
+                async with self._commit_lock:
+                    drop = Transaction(
+                        self.engine,
+                        read_version=self.engine.current_version())
+                    self._finish_txn(drop, req, None)
+                    await self._replicate_and_apply(drop)
                 self._resolved_tombstones.set(txn_id, b"A")
                 log.warning("2pc %s: resolved as aborted (%s)", txn_id,
                             decision)
@@ -784,7 +905,7 @@ class KvService:
             self._resolving.discard(txn_id)
         # on apply failure the exception escapes above: entry stays armed
         self._prepared.pop(txn_id, None)
-        self._commit_lock.release()
+        self._footprints.pop(txn_id, None)
         return True
 
     async def _ask_decider(self, req: KvPrepareReq) -> str:
@@ -842,21 +963,32 @@ class KvService:
             raise make_error(StatusCode.KV_TXN_NOT_FOUND, req.txn_id)
         txn, timer, preq = entry
         timer.cancel()
+        # a decided COMMIT applies UNCONDITIONALLY: the footprint kept
+        # every interleaved commit off the slice's reads and writes, and
+        # re-checking against the (now old) read version could veto the
+        # decider's global verdict and wedge the txn
+        txn._read_keys.clear()
+        txn._read_ranges.clear()
         self._finish_txn(txn, preq, b"C")
+        # _resolving guards the window where the entry is out of
+        # _prepared but the apply (awaiting the commit lock) hasn't
+        # landed — a duplicate prepare/abort must not slip in
+        self._resolving.add(req.txn_id)
         try:
-            await self._replicate_and_apply(txn)
-            # set BEFORE the lock releases below so a duplicate prepare
-            # queued on the lock sees the verdict in its under-lock check
+            async with self._commit_lock:
+                await self._replicate_and_apply(txn)
             self._resolved_tombstones.set(req.txn_id, b"C")
+            # verdict applied: the slice is ordinary committed state now
+            self._footprints.pop(req.txn_id, None)
         except BaseException:
-            # the slice did NOT apply; put the entry back so resolution
-            # (or a coordinator retry) can still finish it
+            # the slice did NOT apply; put the entry back (footprint
+            # still registered) so resolution or a coordinator retry can
+            # finish it
             timer2 = asyncio.create_task(self._resolve_later(req.txn_id))
             self._prepared[req.txn_id] = (txn, timer2, preq)
             raise
         finally:
-            if req.txn_id not in self._prepared:
-                self._commit_lock.release()
+            self._resolving.discard(req.txn_id)
         self._spawn_push(preq, commit=True)
         return KvCommitRsp(version=self.engine.current_version()), b""
 
@@ -881,12 +1013,16 @@ class KvService:
         if entry is not None:
             txn, timer, preq = entry
             timer.cancel()
-            drop = Transaction(self.engine,
-                               read_version=self.engine.current_version())
-            self._finish_txn(drop, preq, None)
+            self._resolving.add(req.txn_id)
             try:
-                await self._replicate_and_apply(drop)
+                async with self._commit_lock:
+                    drop = Transaction(
+                        self.engine,
+                        read_version=self.engine.current_version())
+                    self._finish_txn(drop, preq, None)
+                    await self._replicate_and_apply(drop)
                 self._resolved_tombstones.set(req.txn_id, b"A")
+                self._footprints.pop(req.txn_id, None)
             except BaseException:
                 # the PREP record still exists: re-arm so a resolver
                 # retires it (mirrors commit_prepared), or every other
@@ -896,17 +1032,19 @@ class KvService:
                 self._prepared[req.txn_id] = (txn, timer2, preq)
                 raise
             finally:
-                if req.txn_id not in self._prepared:
-                    self._commit_lock.release()
+                self._resolving.discard(req.txn_id)
         return KvOkRsp(), b""   # idempotent: unknown/expired is fine
 
     async def recover_prepared(self) -> int:
         """Post-restart/post-promote hook: re-arm durable prepare records
         so the crash/failover didn't tear any cross-shard txn.  Returns
-        the number of records found.  Arming is NON-BLOCKING — each record
-        gets a task that acquires the commit lock and resolves; the server
-        keeps serving (notably get_decision) meanwhile, or two shards
-        recovering each other's deciders would deadlock at startup."""
+        the number of records found.  Re-registration is SYNCHRONOUS
+        (pure memory: entry + footprint + resolution timer) — the
+        footprints must stand before this primary admits its first
+        post-recovery commit, or a commit could land on a prepared
+        slice's reads/writes ahead of the verdict.  Nothing blocks on
+        the commit lock here, so two shards recovering each other's
+        deciders start cleanly."""
         ver = self.engine.current_version()
         rows = self.engine.range_at(PREP_PREFIX,
                                     PREP_PREFIX + b"\xff", ver, 0)
@@ -916,24 +1054,16 @@ class KvService:
             if req.txn_id in self._prepared:
                 continue
             n += 1
-            asyncio.create_task(self._arm_recovered(req))
+            txn = self._txn_from_req(req.body)
+            self._footprints[req.txn_id] = _Footprint(txn)
+            # resolve promptly: the crash already consumed wall time, and
+            # the coordinator that would drive phase 2 is likely gone
+            timer = asyncio.create_task(
+                self._resolve_later(req.txn_id, initial_delay=0.5))
+            self._prepared[req.txn_id] = (txn, timer, req)
+            log.warning("2pc: recovered prepared txn %s from durable "
+                        "record", req.txn_id)
         return n
-
-    async def _arm_recovered(self, req: KvPrepareReq) -> None:
-        await self._commit_lock.acquire()
-        ver = self.engine.current_version()
-        if self.engine.read_at(PREP_PREFIX + req.txn_id.encode(),
-                               ver) is None:
-            self._commit_lock.release()     # resolved while we queued
-            return
-        txn = self._txn_from_req(req.body)
-        # resolve promptly: the crash already consumed wall time, and
-        # the coordinator that would drive phase 2 is likely gone
-        timer = asyncio.create_task(
-            self._resolve_later(req.txn_id, initial_delay=0.5))
-        self._prepared[req.txn_id] = (txn, timer, req)
-        log.warning("2pc: recovered prepared txn %s from durable record",
-                    req.txn_id)
 
     # ---- replication ----
 

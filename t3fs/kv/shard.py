@@ -13,17 +13,21 @@ Transaction protocol:
   - a commit touching ONE shard uses that group's plain one-shot commit
     (no extra round trips vs the unsharded service);
   - a commit touching SEVERAL shards runs 2PC: prepare on every shard in
-    shard order (each shard validates its slice's conflicts and HOLDS its
-    commit lock), then commit_prepared everywhere.  Prepared locks make
-    the prepare set a consistent cut; ordered acquisition prevents
-    coordinator deadlocks; prepare expiry (server-side timer) bounds a
-    crashed coordinator's lock hold.
+    shard order (each shard validates its slice's conflicts and registers
+    its FOOTPRINT — reads, writes, clears), then commit_prepared
+    everywhere.  Footprints make the prepare set a consistent cut without
+    holding any shard's commit lock across the inter-phase window:
+    unrelated commits keep flowing, and anything touching a registered
+    footprint gets TXN_CONFLICT (retryable) until the verdict applies
+    (KvService._Footprint; the FDB conflict-set admission analog).
+    Prepare expiry (server-side timer) bounds a crashed coordinator.
 
 Isolation: per-shard SSI.  Every cross-shard read is revalidated by its
-owning shard during prepare while all involved shards are locked, so any
-write that slipped between read and prepare aborts the transaction
-(TXN_CONFLICT -> with_transaction retries) — optimistic serializability,
-the same contract single-shard transactions have.
+owning shard during prepare and then SHIELDED by the registered footprint
+until the verdict applies, so any write that slipped between read and
+prepare aborts the transaction (TXN_CONFLICT -> with_transaction
+retries), and none can slip between prepare and commit — optimistic
+serializability, the same contract single-shard transactions have.
 
 Crash safety: prepares are DURABLE (replicated records in each shard's
 engine) and the protocol is presumed-abort with a decision record — the
@@ -37,6 +41,7 @@ Remaining polish (ROADMAP.md): decision-record GC, push-based resolution.
 
 from __future__ import annotations
 
+import asyncio
 import logging
 import uuid
 from dataclasses import dataclass, field
@@ -160,6 +165,29 @@ class ShardedTransaction:
     async def snapshot_get(self, key: bytes):
         return await self.get(key, snapshot=True)
 
+    async def get_many(self, keys: list[bytes], *,
+                       snapshot: bool = False) -> list[bytes | None]:
+        """Batched point reads: keys group by owning shard and each
+        shard answers its whole slice in ONE RPC (with the snapshot pin
+        folded into it), so a batch of N keys costs O(touched shards)
+        round trips instead of O(N) — the r4 verdict's sharded
+        batch_stat amplification (12.5k -> 1.4k inodes/s) was exactly
+        per-key version+read RPC pairs."""
+        by_shard: dict[int, list[tuple[int, bytes]]] = {}
+        for i, key in enumerate(keys):
+            by_shard.setdefault(self.engine.map.shard_of(key),
+                                []).append((i, key))
+        out: list[bytes | None] = [None] * len(keys)
+
+        async def one(shard: int, slice_: list[tuple[int, bytes]]):
+            vals = await self._retag_stale_map(self._sub(shard).get_many(
+                [k for _, k in slice_], snapshot=snapshot))
+            for (i, _k), v in zip(slice_, vals):
+                out[i] = v
+
+        await asyncio.gather(*(one(s, sl) for s, sl in by_shard.items()))
+        return out
+
     async def get_range(self, begin: bytes, end: bytes, *, limit: int = 0,
                         snapshot: bool = False):
         out = []
@@ -203,10 +231,18 @@ class ShardedTransaction:
             if sub._writes or sub._range_clears)
         touched = sorted(self._subs)
         if not mutating:
-            # read-only: each shard's reads validate against its own
-            # snapshot via the one-shot commit (no lock coupling needed)
+            if len(touched) <= 1:
+                # single-shard read-only: one pinned snapshot IS a
+                # consistent cut — no validation round trip (r5; this
+                # was a full read-set RPC per batch_stat)
+                self._committed = True
+                return
+            # multi-shard read-only: the shards were pinned at different
+            # moments, so each shard's reads must validate (the one-shot
+            # read-only commit skips the RPC now — use the explicit
+            # validation path)
             for s in touched:
-                await self._subs[s].commit()
+                await self._subs[s].validate_reads()
             self._committed = True
             return
         if len(touched) == 1:

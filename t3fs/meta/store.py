@@ -778,9 +778,12 @@ class MetaStore:
         return await self._txn(fn)
 
     async def batch_stat_inodes(self, inode_ids: list[int]) -> list[Inode | None]:
-        """Stat many inodes by id in one transaction (batchStat analog)."""
+        """Stat many inodes by id in one transaction (batchStat analog).
+        get_many batches the whole id list into one read RPC per touched
+        shard (r4 verdict: per-key reads cost sharded batch_stat 9x)."""
         async def fn(txn: Transaction):
-            return [await self._get_inode(txn, i) for i in inode_ids]
+            raws = await txn.get_many([Inode.key(i) for i in inode_ids])
+            return [serde.loads(r) if r else None for r in raws]
         return await self._txn(fn)
 
     async def list_inodes(self, after_inode: int = 0,

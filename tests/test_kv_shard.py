@@ -277,11 +277,23 @@ def test_durable_2pc_presumed_abort_when_undecided():
             await kv.groups[1]._call("Kv.prepare", KvPrepareReq(
                 txn_id="t-dead", body=mk(b"z", b"2"),
                 decider=dec_addrs, is_decider=False))
-            # both must resolve to ABORT; new commits flow again
+            # r5 footprint locks: an UNRELATED commit flows immediately,
+            # while the prepared txn is still live — it no longer waits
+            # out the expiry behind a shard-wide commit lock
+            assert "t-dead" in services[0][0]._prepared
             async def w(txn):
                 txn.set(b"after", b"y")
                 txn.set(b"zafter", b"y")
             await asyncio.wait_for(with_transaction(kv, w), timeout=8.0)
+            # both must resolve to ABORT on expiry (presumed abort)
+            from t3fs.kv.service import KvDecisionReq
+            for _ in range(200):
+                rsp = await kv.groups[0]._call(
+                    "Kv.get_decision", KvDecisionReq(txn_id="t-dead"))
+                if rsp.decision == "A":
+                    break
+                await asyncio.sleep(0.05)
+            assert rsp.decision == "A", rsp
             t = kv.transaction()
             assert await t.get(b"a") is None
             assert await t.get(b"z") is None
@@ -847,6 +859,428 @@ def test_2pc_slow_coordinator_races_prepare_expiry():
             t = kv.transaction()
             assert await t.get(b"after") == b"1"
             assert await t.get(b"zafter") == b"2"
+        finally:
+            await cleanup()
+    run(body())
+
+
+# ---- r5 footprint locks (ROADMAP #3a / r4 verdict #1) ----
+
+async def _mk_single_kv(prepare_timeout_s: float = 600.0):
+    """One KvService group + a second group to act as decider."""
+    from t3fs.kv.service import KvService
+    ship = Client()
+    dec_svc = KvService(MemKVEngine(), client=ship,
+                        prepare_timeout_s=prepare_timeout_s)
+    dec_srv = Server(); dec_srv.add_service(dec_svc)
+    await dec_srv.start()
+    svc = KvService(MemKVEngine(), client=ship,
+                    prepare_timeout_s=prepare_timeout_s)
+    srv = Server(); srv.add_service(svc)
+    await srv.start()
+
+    async def cleanup():
+        for s in list(svc._prepared.values()) + list(dec_svc._prepared.values()):
+            s[1].cancel()
+        await srv.stop(); await dec_srv.stop(); await ship.close()
+    return ship, svc, srv, dec_svc, dec_srv, cleanup
+
+
+def test_footprint_admits_unrelated_commits_during_2pc():
+    """The r4 bottleneck: ONE prepared cross-shard txn serialized every
+    commit on the shard until phase 2.  With footprint locks, commits
+    off the footprint flow freely across the inter-phase window."""
+    async def body():
+        from t3fs.kv.service import KvCommitReq, KvFinishReq, KvPrepareReq
+        ship, svc, srv, dec_svc, dec_srv, cleanup = await _mk_single_kv()
+        try:
+            await ship.call(srv.address, "Kv.prepare", KvPrepareReq(
+                txn_id="t-fp", body=KvCommitReq(
+                    write_keys=[b"locked"], write_values=[b"1"],
+                    write_deletes=[False], read_keys=[b"watched"]),
+                decider=[dec_srv.address]))
+            assert "t-fp" in svc._footprints
+            # unrelated commits land immediately, no expiry wait
+            for i in range(5):
+                rsp, _ = await asyncio.wait_for(ship.call(
+                    srv.address, "Kv.commit", KvCommitReq(
+                        write_keys=[b"free%d" % i], write_values=[b"v"],
+                        write_deletes=[False])), timeout=2.0)
+            ver = svc.engine.current_version()
+            assert svc.engine.read_at(b"free4", ver) == b"v"
+            assert svc.engine.read_at(b"locked", ver) is None  # not yet
+            # phase 2 applies the slice unconditionally afterwards
+            await ship.call(srv.address, "Kv.commit_prepared",
+                            KvFinishReq(txn_id="t-fp"))
+            ver = svc.engine.current_version()
+            assert svc.engine.read_at(b"locked", ver) == b"1"
+            assert "t-fp" not in svc._footprints
+        finally:
+            await cleanup()
+    run(body())
+
+
+def test_footprint_blocks_conflicting_commit_and_prepare():
+    """Writes/clears landing on a prepared txn's reads OR writes get
+    TXN_CONFLICT (retryable) until the verdict applies; so does a second
+    prepare whose slice overlaps the footprint."""
+    async def body():
+        from t3fs.kv.service import KvCommitReq, KvFinishReq, KvPrepareReq
+        ship, svc, srv, dec_svc, dec_srv, cleanup = await _mk_single_kv()
+        try:
+            await ship.call(srv.address, "Kv.prepare", KvPrepareReq(
+                txn_id="t-a", body=KvCommitReq(
+                    write_keys=[b"wkey"], write_values=[b"1"],
+                    write_deletes=[False], read_keys=[b"rkey"],
+                    range_begins=[b"rga"], range_ends=[b"rgz"]),
+                decider=[dec_srv.address]))
+            # write to the prepared WRITE key
+            for bad in (
+                KvCommitReq(write_keys=[b"wkey"], write_values=[b"x"],
+                            write_deletes=[False]),
+                # write to the prepared READ key
+                KvCommitReq(write_keys=[b"rkey"], write_values=[b"x"],
+                            write_deletes=[False]),
+                # write INTO the prepared read range
+                KvCommitReq(write_keys=[b"rgm"], write_values=[b"x"],
+                            write_deletes=[False]),
+                # clear COVERING the prepared write key
+                KvCommitReq(clear_begins=[b"w"], clear_ends=[b"x"]),
+            ):
+                with pytest.raises(StatusError) as ei:
+                    await ship.call(srv.address, "Kv.commit", bad)
+                assert ei.value.code == StatusCode.TXN_CONFLICT, bad
+            # second prepare overlapping the footprint: refused too
+            with pytest.raises(StatusError) as ei:
+                await ship.call(srv.address, "Kv.prepare", KvPrepareReq(
+                    txn_id="t-b", body=KvCommitReq(
+                        write_keys=[b"rkey"], write_values=[b"y"],
+                        write_deletes=[False]),
+                    decider=[dec_srv.address]))
+            assert ei.value.code == StatusCode.TXN_CONFLICT
+            assert "t-b" not in svc._footprints
+            # resolution releases the footprint: same commit now lands
+            await ship.call(srv.address, "Kv.abort_prepared",
+                            KvFinishReq(txn_id="t-a"))
+            assert "t-a" not in svc._footprints
+            await ship.call(srv.address, "Kv.commit", KvCommitReq(
+                write_keys=[b"wkey"], write_values=[b"x"],
+                write_deletes=[False]))
+            ver = svc.engine.current_version()
+            assert svc.engine.read_at(b"wkey", ver) == b"x"
+        finally:
+            await cleanup()
+    run(body())
+
+
+def test_footprint_disjoint_prepares_coexist():
+    """Two cross-shard txns with disjoint slices prepare concurrently on
+    one shard — the old protocol deadlocked/serialized them on the
+    commit lock."""
+    async def body():
+        from t3fs.kv.service import KvCommitReq, KvFinishReq, KvPrepareReq
+        ship, svc, srv, dec_svc, dec_srv, cleanup = await _mk_single_kv()
+        try:
+            for name, key in (("t-1", b"one"), ("t-2", b"two")):
+                await asyncio.wait_for(ship.call(
+                    srv.address, "Kv.prepare", KvPrepareReq(
+                        txn_id=name, body=KvCommitReq(
+                            write_keys=[key], write_values=[b"v"],
+                            write_deletes=[False]),
+                        decider=[dec_srv.address])), timeout=2.0)
+            assert set(svc._footprints) == {"t-1", "t-2"}
+            for name in ("t-1", "t-2"):
+                await ship.call(srv.address, "Kv.commit_prepared",
+                                KvFinishReq(txn_id=name))
+            ver = svc.engine.current_version()
+            assert svc.engine.read_at(b"one", ver) == b"v"
+            assert svc.engine.read_at(b"two", ver) == b"v"
+        finally:
+            await cleanup()
+    run(body())
+
+
+def test_footprint_reregistered_on_restart_and_promotion():
+    """recover_prepared (restart AND failover promotion) re-registers
+    footprints from durable PREP records BEFORE the first post-recovery
+    commit can land on a prepared slice."""
+    async def body():
+        from t3fs.kv.engine import MemKVEngine
+        from t3fs.kv.service import (
+            KvCommitReq, KvFinishReq, KvPrepareReq, KvService,
+        )
+        ship = Client()
+        dec_svc = KvService(MemKVEngine(), client=ship,
+                            prepare_timeout_s=600.0)
+        dec_srv = Server(); dec_srv.add_service(dec_svc)
+        await dec_srv.start()
+        eng = MemKVEngine()
+        svc = KvService(eng, client=ship, prepare_timeout_s=600.0)
+        srv = Server(); srv.add_service(svc)
+        await srv.start()
+        try:
+            await ship.call(srv.address, "Kv.prepare", KvPrepareReq(
+                txn_id="t-rst", body=KvCommitReq(
+                    write_keys=[b"held"], write_values=[b"1"],
+                    write_deletes=[False]),
+                decider=[dec_srv.address], is_decider=False))
+            # "crash": service state lost, engine (durable PREP) kept
+            await srv.stop()
+            for e in list(svc._prepared.values()):
+                e[1].cancel()
+            svc2 = KvService(eng, client=ship, prepare_timeout_s=600.0)
+            srv2 = Server(); srv2.add_service(svc2)
+            await srv2.start()
+            assert await svc2.recover_prepared() == 1
+            assert "t-rst" in svc2._footprints    # registered synchronously
+            with pytest.raises(StatusError) as ei:
+                await ship.call(srv2.address, "Kv.commit", KvCommitReq(
+                    write_keys=[b"held"], write_values=[b"x"],
+                    write_deletes=[False]))
+            assert ei.value.code == StatusCode.TXN_CONFLICT
+            # decider commits -> resolution applies the slice + releases
+            await ship.call(dec_srv.address, "Kv.prepare", KvPrepareReq(
+                txn_id="t-rst", body=KvCommitReq(
+                    write_keys=[b"dec"], write_values=[b"1"],
+                    write_deletes=[False]),
+                decider=[dec_srv.address], is_decider=True))
+            await ship.call(dec_srv.address, "Kv.commit_prepared",
+                            KvFinishReq(txn_id="t-rst"))
+            await ship.call(srv2.address, "Kv.commit_prepared",
+                            KvFinishReq(txn_id="t-rst"))
+            ver = eng.read_at(b"held", eng.current_version())
+            assert ver == b"1"
+            assert "t-rst" not in svc2._footprints
+            await ship.call(srv2.address, "Kv.commit", KvCommitReq(
+                write_keys=[b"held"], write_values=[b"x"],
+                write_deletes=[False]))
+            assert eng.read_at(b"held", eng.current_version()) == b"x"
+            await srv2.stop()
+            for e in list(svc2._prepared.values()):
+                e[1].cancel()
+        finally:
+            await dec_srv.stop()
+            try:
+                await srv.stop()
+            except Exception:
+                pass
+            for e in list(dec_svc._prepared.values()):
+                e[1].cancel()
+            await ship.close()
+    run(body())
+
+
+def test_get_many_pays_per_shard_not_per_key_rpcs():
+    """r4 verdict weak #2 (read half): a batched point-read of N keys
+    against a sharded KV must cost O(touched shards) RPCs — one read per
+    shard with the snapshot pin FOLDED into it — not O(N) version+read
+    pairs."""
+    async def body():
+        kv, services, cleanup = await _mk_sharded(b"m")
+        try:
+            async def seed(txn):
+                for i in range(10):
+                    txn.set(b"a%02d" % i, b"L%d" % i)   # shard 0
+                    txn.set(b"z%02d" % i, b"R%d" % i)   # shard 1
+            await with_transaction(kv, seed)
+
+            from t3fs.kv.remote import RemoteKVEngine
+            calls: list[str] = []
+            orig = RemoteKVEngine._call
+
+            async def counting(self, method, req, **kw):
+                calls.append(method)
+                return await orig(self, method, req, **kw)
+
+            RemoteKVEngine._call = counting
+            try:
+                t = kv.transaction()
+                keys = [b"a%02d" % i for i in range(10)] + \
+                       [b"z%02d" % i for i in range(10)] + [b"missing"]
+                vals = await t.get_many(keys)
+            finally:
+                RemoteKVEngine._call = orig
+            assert vals[:10] == [b"L%d" % i for i in range(10)]
+            assert vals[10:20] == [b"R%d" % i for i in range(10)]
+            assert vals[20] is None
+            # 2 shards touched -> exactly 2 RPCs, all Kv.read (the pin
+            # rode along via version=-1; no Kv.get_version round trips)
+            assert calls == ["Kv.read", "Kv.read"], calls
+            # read-your-writes + clear overlay still hold through the batch
+            t2 = kv.transaction()
+            t2.set(b"a00", b"new")
+            t2.clear_range(b"z00", b"z05")
+            vals = await t2.get_many([b"a00", b"z03", b"z07"])
+            assert vals == [b"new", None, b"R7"]
+        finally:
+            await cleanup()
+    run(body())
+
+
+def test_first_read_folds_version_pin():
+    """A transaction's FIRST read costs one round trip, not a
+    get_version + read pair; concurrent first reads share one pin."""
+    async def body():
+        kv, services, cleanup = await _mk_sharded(b"m")
+        try:
+            async def seed(txn):
+                txn.set(b"k1", b"v1")
+                txn.set(b"k2", b"v2")
+            await with_transaction(kv, seed)
+
+            from t3fs.kv.remote import RemoteKVEngine
+            calls: list[str] = []
+            orig = RemoteKVEngine._call
+
+            async def counting(self, method, req, **kw):
+                calls.append(method)
+                return await orig(self, method, req, **kw)
+
+            RemoteKVEngine._call = counting
+            try:
+                t = kv.transaction()
+                # concurrent first reads: both must see ONE consistent pin
+                v1, v2 = await asyncio.gather(t.get(b"k1"), t.get(b"k2"))
+            finally:
+                RemoteKVEngine._call = orig
+            assert (v1, v2) == (b"v1", b"v2")
+            assert "Kv.get_version" not in calls, calls
+            assert calls.count("Kv.read") == 2
+            sub = t._subs[0]
+            assert sub.read_version is not None
+        finally:
+            await cleanup()
+    run(body())
+
+
+def test_footprint_survives_failover_promotion():
+    """A FOLLOWER promoted mid-2PC re-registers the prepared txn's
+    footprint from the replicated PREP record: commits landing on the
+    slice between promotion and the verdict get TXN_CONFLICT, and the
+    verdict then applies cleanly on the new primary."""
+    async def body():
+        from t3fs.kv.engine import MemKVEngine
+        from t3fs.kv.service import (
+            KvCommitReq, KvFinishReq, KvPrepareReq, KvService,
+        )
+        ship = Client()
+        # decider group (single member, stays up)
+        dec_svc = KvService(MemKVEngine(), client=ship,
+                            prepare_timeout_s=600.0)
+        dec_srv = Server(); dec_srv.add_service(dec_svc)
+        await dec_srv.start()
+        # participant group: primary + follower
+        p_svc = KvService(MemKVEngine(), client=ship,
+                          prepare_timeout_s=600.0)
+        p_srv = Server(); p_srv.add_service(p_svc)
+        await p_srv.start()
+        f_svc = KvService(MemKVEngine(), primary=False, client=ship,
+                          prepare_timeout_s=600.0)
+        f_srv = Server(); f_srv.add_service(f_svc)
+        await f_srv.start()
+        p_svc.followers = [f_srv.address]
+        try:
+            await ship.call(p_srv.address, "Kv.prepare", KvPrepareReq(
+                txn_id="t-fo", body=KvCommitReq(
+                    write_keys=[b"slice"], write_values=[b"1"],
+                    write_deletes=[False], read_keys=[b"guard"]),
+                decider=[dec_srv.address], is_decider=False))
+            # primary dies mid-window; follower promoted
+            await p_srv.stop()
+            for e in list(p_svc._prepared.values()):
+                e[1].cancel()
+            await ship.call(f_srv.address, "Kv.promote", None)
+            assert "t-fo" in f_svc._footprints     # re-armed from PREP
+            # the slice is shielded on the NEW primary
+            for bad_key in (b"slice", b"guard"):
+                with pytest.raises(StatusError) as ei:
+                    await ship.call(f_srv.address, "Kv.commit", KvCommitReq(
+                        write_keys=[bad_key], write_values=[b"x"],
+                        write_deletes=[False]))
+                assert ei.value.code == StatusCode.TXN_CONFLICT, bad_key
+            # unrelated commits flow on the new primary meanwhile
+            await ship.call(f_srv.address, "Kv.commit", KvCommitReq(
+                write_keys=[b"free"], write_values=[b"y"],
+                write_deletes=[False]))
+            # decider decides COMMIT; new primary applies per verdict
+            await ship.call(dec_srv.address, "Kv.prepare", KvPrepareReq(
+                txn_id="t-fo", body=KvCommitReq(
+                    write_keys=[b"dec"], write_values=[b"1"],
+                    write_deletes=[False]),
+                decider=[dec_srv.address], is_decider=True))
+            await ship.call(dec_srv.address, "Kv.commit_prepared",
+                            KvFinishReq(txn_id="t-fo"))
+            await ship.call(f_srv.address, "Kv.commit_prepared",
+                            KvFinishReq(txn_id="t-fo"))
+            eng = f_svc.engine
+            assert eng.read_at(b"slice", eng.current_version()) == b"1"
+            assert "t-fo" not in f_svc._footprints
+            # the shield is gone: the previously-refused commit lands
+            await ship.call(f_srv.address, "Kv.commit", KvCommitReq(
+                write_keys=[b"slice"], write_values=[b"x"],
+                write_deletes=[False]))
+            assert eng.read_at(b"slice", eng.current_version()) == b"x"
+        finally:
+            for svc in (dec_svc, p_svc, f_svc):
+                for e in list(svc._prepared.values()):
+                    e[1].cancel()
+            await f_srv.stop(); await dec_srv.stop()
+            try:
+                await p_srv.stop()
+            except Exception:
+                pass
+            await ship.close()
+    run(body())
+
+
+def test_footprint_blocks_torn_cross_shard_read():
+    """code-review r5: after phase 2 applied on shard A but NOT yet on
+    shard B, a transaction reading T1's write on A and validating a read
+    of pre-T1 state on B must NOT commit (it observed T1 half-applied —
+    a serializability cycle).  The footprint read-check is what refuses
+    it: a candidate's READS conflict with a registered footprint's
+    WRITES."""
+    async def body():
+        from t3fs.kv.service import KvCommitReq, KvFinishReq, KvPrepareReq
+        ship, svc, srv, dec_svc, dec_srv, cleanup = await _mk_single_kv()
+        try:
+            # T1's slice on this shard writes Y (cross-shard txn; the
+            # other slice is elsewhere).  Prepared, verdict not yet in.
+            await ship.call(srv.address, "Kv.prepare", KvPrepareReq(
+                txn_id="t-torn", body=KvCommitReq(
+                    write_keys=[b"Y"], write_values=[b"new"],
+                    write_deletes=[False]),
+                decider=[dec_srv.address]))
+            ver_rsp, _ = await ship.call(srv.address, "Kv.get_version",
+                                         None)
+            # T2 read pre-T1 Y here (and, in the torn scenario, T1's
+            # already-applied X on another shard): its validation /
+            # commit carrying that read must be refused until T1's
+            # verdict applies
+            for req in (
+                # writer that read Y
+                KvCommitReq(read_version=ver_rsp.version,
+                            read_keys=[b"Y"], write_keys=[b"Z"],
+                            write_values=[b"z"], write_deletes=[False]),
+                # read-only validation (validate_reads wire shape)
+                KvCommitReq(read_version=ver_rsp.version,
+                            read_keys=[b"Y"]),
+                # range read covering the prepared write
+                KvCommitReq(read_version=ver_rsp.version,
+                            range_begins=[b"A"], range_ends=[b"c"]),
+            ):
+                with pytest.raises(StatusError) as ei:
+                    await ship.call(srv.address, "Kv.commit", req)
+                assert ei.value.code == StatusCode.TXN_CONFLICT, req
+            # verdict applies -> the same reads validate fine (they now
+            # see T1 fully applied and re-pin a fresh version on retry)
+            await ship.call(srv.address, "Kv.commit_prepared",
+                            KvFinishReq(txn_id="t-torn"))
+            ver2, _ = await ship.call(srv.address, "Kv.get_version", None)
+            await ship.call(srv.address, "Kv.commit", KvCommitReq(
+                read_version=ver2.version, read_keys=[b"Y"],
+                write_keys=[b"Z"], write_values=[b"z"],
+                write_deletes=[False]))
         finally:
             await cleanup()
     run(body())
