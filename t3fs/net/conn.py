@@ -141,6 +141,11 @@ class Connection:
                 if payload and len(payload) > 64 << 10:
                     self.writer.write(head + msg)
                     self.writer.write(payload)
+                elif payload and not isinstance(payload, bytes):
+                    # forwarded zero-copy RX memoryview: bytes.__add__
+                    # rejects it, so ship it as a second write
+                    self.writer.write(head + msg)
+                    self.writer.write(payload)
                 else:
                     self.writer.write(head + msg + payload)
                 await self.writer.drain()

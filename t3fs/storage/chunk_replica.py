@@ -157,8 +157,10 @@ class ChunkReplica:
         else:
             end = io.offset + len(payload)
             if io.offset == len(old):
-                # pure append: combine instead of recompute (Common.h:191 trick)
-                content = old + payload
+                # pure append: combine instead of recompute (Common.h:191
+                # trick).  join, not +: payload may be a zero-copy RX
+                # memoryview (bytes.__add__ rejects those)
+                content = b"".join((old, payload))
                 old_crc = meta.checksum if meta else 0
                 checksum = (self.crc_combine(old_crc, payload_crc, len(payload))
                             if old else payload_crc)

@@ -63,7 +63,10 @@ def _bind():
     lib.t3fs_ce_punch_freed.restype = C.c_uint64
     lib.t3fs_ce_punch_freed.argtypes = [C.c_void_p, C.c_uint64]
     lib.t3fs_crc32c.restype = C.c_uint32
-    lib.t3fs_crc32c.argtypes = [C.c_char_p, C.c_uint64, C.c_uint32]
+    # c_void_p, not c_char_p: accepts bytes AND ctypes views over
+    # writable buffers, so zero-copy RX payloads (memoryview over the
+    # net pump's buffer) CRC without a copy
+    lib.t3fs_crc32c.argtypes = [C.c_void_p, C.c_uint64, C.c_uint32]
     lib.t3fs_crc32c_combine.restype = C.c_uint32
     lib.t3fs_crc32c_combine.argtypes = [C.c_uint32, C.c_uint32, C.c_uint64]
     return lib
@@ -78,9 +81,20 @@ def native_lib():
     return _libholder[0]
 
 
-def crc32c_native(data: bytes, crc: int = 0) -> int:
-    """Hardware (SSE4.2) CRC32C — the CPU-side checksum oracle/fast path."""
-    return native_lib().t3fs_crc32c(bytes(data), len(data), crc)
+def crc32c_native(data, crc: int = 0) -> int:
+    """Hardware (SSE4.2) CRC32C — the CPU-side checksum oracle/fast path.
+    Accepts any bytes-like input; bytes and writable buffers (incl. the
+    net pump's zero-copy RX memoryviews) pass WITHOUT a staging copy —
+    the old bytes(data) here was a hidden per-payload copy on the write
+    path (r5 zero-copy audit)."""
+    if isinstance(data, bytes):
+        return native_lib().t3fs_crc32c(data, len(data), crc)
+    mv = data if isinstance(data, memoryview) else memoryview(data)
+    if mv.readonly:
+        b = bytes(mv)
+        return native_lib().t3fs_crc32c(b, len(b), crc)
+    arr = (C.c_ubyte * mv.nbytes).from_buffer(mv)
+    return native_lib().t3fs_crc32c(arr, mv.nbytes, crc)
 
 
 def crc32c_combine_native(a: int, b: int, len_b: int) -> int:
