@@ -33,18 +33,16 @@ on-device:
 	$(PY) scripts/ondevice.py
 
 # The CI gate (reference: .github/workflows/build.yml — deps -> build ->
-# test): native build, the suite (with the two timing-flaky tests split
-# out and retried in isolation — they are load-sensitive, not broken),
-# both sanitizer passes, and a bounded device probe (records reachability
-# without failing the gate: the tunnel is environment, not code).
-FLAKY := tests/test_kv_shard.py::test_meta_over_sharded_kv_multiprocess \
-         tests/test_app_cluster.py
+# test): native build, the full suite in ONE pass, both sanitizer
+# passes, and a bounded device probe (records reachability without
+# failing the gate: the tunnel is environment, not code).  The r4
+# deselect+retry loop for the two "flaky" tests is GONE: the flake was
+# root-caused (r5) to DevCluster._wait_port's 20 s hang-detector firing
+# on slow child startup under load, plus fixed sleeps racing the
+# heartbeat timeout — both replaced with event-driven waits.
 ci:
 	$(PY) -m t3fs.native.build
-	$(PY) -m pytest tests/ -x -q $(foreach t,$(FLAKY),--deselect $(t))
-	for i in 1 2 3; do \
-	  $(PY) -m pytest $(FLAKY) -q && break || [ $$i -lt 3 ] || exit 1; \
-	done
+	$(PY) -m pytest tests/ -x -q
 	$(MAKE) sanitize
 	$(PY) scripts/ondevice.py --probe || true
 	@echo "ci: green"

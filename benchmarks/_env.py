@@ -75,3 +75,28 @@ async def make_meta_env(mgmtd_address: str):
         await mg.stop()
 
     return mc, stop
+
+
+async def medianize(fn, n: int = 3):
+    """Drift-proof measurement (docs/bench_protocol.md): run the async
+    bench `fn` (no args, returns a float) n times and return
+    (median, runs).  The caller records BOTH — value quotes the median,
+    the runs array goes in the entry verbatim."""
+    import statistics
+    runs = []
+    for _ in range(n):
+        runs.append(await fn())
+    return statistics.median(runs), runs
+
+
+async def medianize_ab(fn_a, fn_b, n: int = 3):
+    """Interleaved A/B per docs/bench_protocol.md: alternate a/b within
+    one session so drift hits both sides equally.  Returns
+    ((median_a, runs_a), (median_b, runs_b))."""
+    import statistics
+    runs_a, runs_b = [], []
+    for _ in range(n):
+        runs_a.append(await fn_a())
+        runs_b.append(await fn_b())
+    return ((statistics.median(runs_a), runs_a),
+            (statistics.median(runs_b), runs_b))

@@ -1,0 +1,27 @@
+-- t3fs metric store DDL — ClickHouse dialect (production sink).
+--
+-- Reference analog: deploy/sql/3fs-monitor.sql (the ClickHouse DDL the
+-- reference's monitor writes through common/monitor/ClickHouseClient.h).
+-- t3fs's ClickHouseClient (t3fs/monitor/clickhouse.py) INSERTs into this
+-- table over the HTTP interface with FORMAT JSONEachRow; the column set
+-- is IDENTICAL to the sqlite dev DDL (t3fs-monitor.sql) so queries port
+-- unchanged — tests/test_monitor.py asserts the sink's wire rows carry
+-- exactly these columns.
+--
+-- Apply (operators):  clickhouse-client --multiquery < t3fs-monitor-clickhouse.sql
+
+CREATE DATABASE IF NOT EXISTS t3fs_monitor;
+
+CREATE TABLE IF NOT EXISTS t3fs_monitor.metrics (
+  ts        Float64,
+  node_id   Int64,
+  node_type String,
+  name      String,
+  kind      String,
+  value     Nullable(Float64),
+  payload   String
+)
+ENGINE = MergeTree
+PARTITION BY toDate(toDateTime(ts))
+ORDER BY (name, ts)
+TTL toDateTime(ts) + INTERVAL 30 DAY;

@@ -112,13 +112,22 @@ class QueryMetricsRsp:
 
 @service("Monitor")
 class MonitorCollectorService:
-    def __init__(self, db: MetricsDB | None = None):
+    def __init__(self, db: MetricsDB | None = None, clickhouse=None):
         self.db = db or MetricsDB()
+        # optional production sink (t3fs/monitor/clickhouse.py): reported
+        # batches forward to ClickHouse with the ORIGIN node's identity,
+        # sqlite stays for the admin CLI's local queries — the reference's
+        # monitor_collector writes ClickHouse as its primary store
+        self.clickhouse = clickhouse
 
     @rpc_method
     async def report(self, req: ReportMetricsReq, payload, conn):
-        n = self.db.insert(req.node_id, req.node_type,
-                           req.ts or time.time(), req.samples)
+        ts = req.ts or time.time()
+        n = self.db.insert(req.node_id, req.node_type, ts, req.samples)
+        if self.clickhouse is not None:
+            from t3fs.monitor.clickhouse import samples_to_rows
+            self.clickhouse.push_rows(samples_to_rows(
+                req.node_id, req.node_type, ts, req.samples))
         return ReportMetricsRsp(n), b""
 
     @rpc_method
