@@ -132,16 +132,44 @@ def main(quick: bool = False) -> None:
     raw_lo = chained_timer(step, words, iters=ITERS_LO)
     cal_hi = chained_timer(make_copy3d, words, iters=iters_hi)
     cal_lo = chained_timer(make_copy3d, words, iters=ITERS_LO)
+    # Glitch robustness (r5: the first live capture reported value==nbytes):
+    # differencing PER-REP pairs lets one slow raw_lo() sample — a tunnel
+    # hiccup — produce a negative difference, and a floor of 1e-9 s then
+    # wins the min and yields an absurd headline.  Instead, min() each
+    # sample population FIRST (best case of each is stable) and difference
+    # the mins; a group whose difference still comes out non-positive was
+    # glitched end-to-end and is resampled, never floored into the result.
     t_ops, t_raws = [], []
     for group in range(groups):
-        for _ in range(reps):
-            r = (raw_hi() - raw_lo()) / d_iters      # op + xor pass
-            c = (cal_hi() - cal_lo()) / d_iters / 2  # one xor-like pass
-            t_raws.append(max(r, 1e-9))
-            t_ops.append(max(r - c, 1e-9))
-        if nbytes / min(t_ops) / 1e9 >= 1.3 * LINE_RATE_GBPS:
+        rh, rl, ch, cl = [], [], [], []
+        for _ in range(reps):                        # interleave for drift
+            rh.append(raw_hi())
+            rl.append(raw_lo())
+            ch.append(cal_hi())
+            cl.append(cal_lo())
+        r = (min(rh) - min(rl)) / d_iters            # op + xor pass
+        c = (min(ch) - min(cl)) / d_iters / 2        # one xor-like pass
+        # Per-group plausibility: reject glitched groups (non-positive
+        # difference, or an implied throughput past the v5e HBM roofline
+        # ~819 GB/s — a hi/lo pair straddling device-speed windows can
+        # produce tiny-but-positive differences) and keep the clean ones.
+        t = (r - c) if (r > 0 and r - c > 0) else r
+        if r > 0 and nbytes / t / 1e9 <= 900.0:
+            t_raws.append(r)
+            t_ops.append(t)
+        if t_ops and nbytes / min(t_ops) / 1e9 >= 1.3 * LINE_RATE_GBPS:
             break                       # fast window caught; enough proof
         _time.sleep(10.0)
+    if not t_ops:
+        print(json.dumps({
+            "metric": "rs8+2_crc32c_stripe_encode",
+            "value": 0.0,
+            "unit": "GB/s/chip",
+            "vs_baseline": 0.0,
+            "error": "all sampling groups glitched (tunnel hiccups made "
+                     "every hi-lo difference non-positive)",
+        }), flush=True)
+        return
     t_raw = min(t_raws)
     t_op = min(t_ops)
 

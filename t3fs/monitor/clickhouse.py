@@ -172,8 +172,6 @@ class ClickHouseReporter:
                 if self._stop.is_set():
                     return          # stop only once the queue drained
                 continue
-            if rows is None:
-                return
             for attempt in (1, 2):      # one retry on a fresh connection
                 try:
                     self.client.insert_rows(rows)

@@ -125,12 +125,23 @@ def _crc_seg_kernel(x_ref, l_ref, out_ref):
     out_ref[...] = acc.astype(jnp.int32) & 1
 
 
+def _norm_block_r(block_r: int) -> int:
+    """Mosaic requires the second-minor block dim be a multiple of the 8-row
+    sublane granule (or equal the array dim); interpret mode accepted any
+    value, which hid this until the first real-hardware run (r5).  Round up
+    so tiny test/bench block sizes still compile on the chip."""
+    return -(-block_r // 8) * 8
+
+
 def make_crc_seg_pallas(seg_bytes: int = DEFAULT_SEG_BYTES, block_r: int = 256,
                         interpret: bool = False):
     """(R, seg_bytes) uint8 segment rows -> (R, 32) int32 0/1 raw segment CRCs.
 
-    R must be a multiple of block_r (callers pad rows; CRC of a zero row is 0
-    so padding is harmless to downstream combines)."""
+    block_r is rounded up to a multiple of 8 (_norm_block_r); R must be a
+    multiple of the NORMALIZED block_r — callers that pad should run their
+    block_r through _norm_block_r first (the assembled wrappers below do).
+    CRC of a zero row is 0, so padding is harmless to downstream combines."""
+    block_r = _norm_block_r(block_r)
     mats = default_matrices()
     Lseg = mats.segment_matrix(seg_bytes)                 # (8B, 32) LSB-first
     perm = _plane_major_perm(seg_bytes)
@@ -161,6 +172,7 @@ def make_crc32c_raw_fast(padded_len: int, seg_bytes: int = DEFAULT_SEG_BYTES,
     """Drop-in for jax_codec.make_crc32c_raw: (n, padded_len) uint8 ->
     (n, 32) int32 0/1 raw CRC, but with the segment stage in Pallas."""
     assert padded_len % seg_bytes == 0
+    block_r = _norm_block_r(block_r)
     nseg = padded_len // seg_bytes
     mats = default_matrices()
     Pj = jnp.asarray(mats.combine_stack(nseg, seg_bytes).astype(np.int32))
@@ -322,7 +334,10 @@ def _crc_word_weights() -> np.ndarray:
 def make_crc_seg_words_pallas(block_r: int = 512, interpret: bool = False):
     """(R, 128) uint32 segment rows -> (R, 32) int32 0/1 raw segment CRCs.
 
-    R must be a multiple of block_r (pad with zero rows: CRC of zeros is 0)."""
+    block_r is rounded up to a multiple of 8 (_norm_block_r); R must be a
+    multiple of the NORMALIZED block_r (pad with zero rows: CRC of zeros
+    is 0)."""
+    block_r = _norm_block_r(block_r)
     Mj = jnp.asarray(_crc_word_weights().astype(np.int8))
 
     def seg_crc(rows: jax.Array) -> jax.Array:
@@ -359,6 +374,7 @@ def make_crc32c_words_raw(chunk_words: int, block_r: int = 512,
     from t3fs.ops.jax_codec import pack_bits_u32
 
     assert chunk_words % _SEG_W == 0, chunk_words
+    block_r = _norm_block_r(block_r)
     nseg = chunk_words // _SEG_W
     mats = default_matrices()
     # combine as one bf16 matmul: raw = mod2( seg_bits (n, S*32) @ C (S*32, 32) )

@@ -262,7 +262,10 @@ def test_clickhouse_sink_insert_shape_matches_ddl():
             # column list in the INSERT == DDL columns
             import re
             cols = re.search(r"\(([^)]*)\)", query).group(1)
-            ddl = open("deploy/sql/t3fs-monitor-clickhouse.sql").read()
+            import os
+            ddl = open(os.path.join(
+                os.path.dirname(__file__), "..",
+                "deploy/sql/t3fs-monitor-clickhouse.sql")).read()
             ddl_cols = re.findall(
                 r"^\s{2}(\w+)\s", ddl.split("CREATE TABLE", 1)[1],
                 re.MULTILINE)
