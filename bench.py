@@ -154,7 +154,11 @@ def main(quick: bool = False) -> None:
         # ~819 GB/s — a hi/lo pair straddling device-speed windows can
         # produce tiny-but-positive differences) and keep the clean ones.
         t = (r - c) if (r > 0 and r - c > 0) else r
-        if r > 0 and nbytes / t / 1e9 <= 900.0:
+        # guard BOTH times: a negative calibration difference (its own
+        # glitch mode) can leave t plausible while r is absurd — r feeds
+        # raw_incl_harness, so it must pass the roofline check too
+        if r > 0 and nbytes / t / 1e9 <= 900.0 \
+                and nbytes / r / 1e9 <= 900.0:
             t_raws.append(r)
             t_ops.append(t)
         if t_ops and nbytes / min(t_ops) / 1e9 >= 1.3 * LINE_RATE_GBPS:

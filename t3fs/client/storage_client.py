@@ -443,51 +443,35 @@ class StorageClient:
             out.append((bytes(data), results[lo:hi]))
         return out
 
-    async def query_last_chunk(self, layout: FileLayout, inode: int) -> int:
-        """File length via per-chain last-chunk queries (FileOperation analog)."""
-        routing = self.routing()
-        best = 0
-        for chain_id in set(layout.chains):
+    async def _call_chain_head(self, chain_id: int, method: str, req,
+                               *, check_result: bool = False):
+        """Call `method` on the chain's CURRENT head, refreshing routing
+        and retrying retryable failures — a just-failed-over head is the
+        common case (meta's close path lands here moments after a storage
+        kill, when its routing cache can still name the dead node; the r5
+        test_app_cluster failure once the test's waits went event-driven
+        and outpaced the cache).  A chain that stays missing/headless is
+        an ERROR, not a skip: callers settle lengths or reclaim chunks,
+        and silently skipping would under-report a length or leak chunks.
+        check_result=True additionally unwraps rsp.result.status."""
+        last: StatusError | None = None
+        for attempt in range(self.cfg.max_retries):
+            routing = self.routing()
             chain = routing.chain(chain_id)
-            if chain is None:
-                continue
-            head = chain.head()
+            head = chain.head() if chain is not None else None
             if head is None:
-                continue
-            rsp, _ = await self.client.call(
-                routing.node_address(head.node_id), "Storage.query_last_chunk",
-                QueryLastChunkReq(chain_id=chain_id, inode=inode))
-            if rsp.last_index >= 0:
-                best = max(best, rsp.last_index * layout.chunk_size + rsp.last_length)
-        return best
-
-    async def remove_file_chunks(self, layout: FileLayout, inode: int) -> None:
-        """Remove the file's chunks on every chain; raises on failure so
-        callers (meta GC) requeue instead of leaking chunks."""
-        for chain_id in set(layout.chains):
-            last: StatusError | None = None
-            for attempt in range(self.cfg.max_retries):
-                routing = self.routing()
-                chain = routing.chain(chain_id)
-                if chain is None or chain.head() is None:
-                    # missing chain/head is a FAILURE if it persists —
-                    # returning success here would let meta GC mark the
-                    # inode reclaimed while its chunks still exist
-                    last = StatusError(StatusCode.TARGET_NOT_FOUND,
-                                       f"chain {chain_id}: no head in routing")
-                    await self._backoff(attempt)
-                    await self._maybe_refresh()
-                    continue
+                last = StatusError(StatusCode.TARGET_NOT_FOUND,
+                                   f"chain {chain_id}: no head in routing")
+            else:
                 try:
                     rsp, _ = await self.client.call(
-                        routing.node_address(chain.head().node_id),
-                        "Storage.remove_chunks",
-                        RemoveChunksReq(chain_id=chain_id, inode=inode))
+                        routing.node_address(head.node_id), method, req)
+                    if not check_result:
+                        return rsp
                     st = Status(StatusCode(rsp.result.status.code),
                                 rsp.result.status.message)
                     if st.ok:
-                        last = None
-                        break
+                        return rsp
                     last = StatusError(st.code, st.message)
                     if not st.retryable:
                         break
@@ -495,27 +479,45 @@ class StorageClient:
                     last = e
                     if not e.status.retryable:
                         break
-                await self._backoff(attempt)
-                await self._maybe_refresh()
-            if last is not None:
-                raise last
+            await self._backoff(attempt)
+            await self._maybe_refresh()
+        raise last if last is not None else StatusError(
+            StatusCode.TIMEOUT, f"chain {chain_id}: retries exhausted")
+
+    async def query_last_chunk(self, layout: FileLayout, inode: int) -> int:
+        """File length via per-chain last-chunk queries (FileOperation
+        analog), failover-robust per _call_chain_head."""
+        best = 0
+        for chain_id in set(layout.chains):
+            rsp = await self._call_chain_head(
+                chain_id, "Storage.query_last_chunk",
+                QueryLastChunkReq(chain_id=chain_id, inode=inode))
+            if rsp.last_index >= 0:
+                best = max(best, rsp.last_index * layout.chunk_size
+                           + rsp.last_length)
+        return best
+
+    async def remove_file_chunks(self, layout: FileLayout, inode: int) -> None:
+        """Remove the file's chunks on every chain; raises on failure so
+        callers (meta GC) requeue instead of leaking chunks."""
+        for chain_id in set(layout.chains):
+            await self._call_chain_head(
+                chain_id, "Storage.remove_chunks",
+                RemoveChunksReq(chain_id=chain_id, inode=inode),
+                check_result=True)
 
     async def truncate_file(self, layout: FileLayout, inode: int,
                             new_length: int) -> None:
         """Remove whole chunks past the cut, truncate the boundary chunk."""
-        routing = self.routing()
         boundary = new_length // layout.chunk_size
         boundary_off = new_length - boundary * layout.chunk_size
+        begin = boundary + (1 if boundary_off else 0)
         for chain_id in set(layout.chains):
-            chain = routing.chain(chain_id)
-            if chain is None or chain.head() is None:
-                continue
-            begin = boundary + (1 if boundary_off else 0)
-            await self.client.call(
-                routing.node_address(chain.head().node_id),
-                "Storage.remove_chunks",
+            await self._call_chain_head(
+                chain_id, "Storage.remove_chunks",
                 RemoveChunksReq(chain_id=chain_id, inode=inode,
-                                begin_index=begin))
+                                begin_index=begin),
+                check_result=True)
         if boundary_off:
             await self.write_chunk(
                 layout.chain_of(boundary), ChunkId(inode, boundary), 0, b"",

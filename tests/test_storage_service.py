@@ -228,6 +228,44 @@ def test_query_last_chunk_and_remove():
     run(body())
 
 
+def test_query_last_chunk_retries_through_stale_head():
+    """query_last_chunk must refresh routing and retry when the cached
+    head is unreachable — meta's close path calls it moments after a
+    failover, when its routing cache can still name the dead node (the
+    r5 test_app_cluster regression once the test's waits went
+    event-driven)."""
+    from t3fs.client.layout import FileLayout
+    from t3fs.mgmtd.types import NodeInfo
+
+    async def body():
+        fabric = StorageFabric(num_nodes=1, replicas=1)
+        await fabric.start()
+        try:
+            await write(fabric, ChunkId(44, 0), b"x" * 100, seq=1)
+
+            # stale view: head's node address points at a dead port
+            import copy
+            stale = copy.deepcopy(fabric.routing)
+            live_node = fabric.routing.nodes[1]
+            stale.nodes[1] = NodeInfo(1, "127.0.0.1:1")
+            view = {"r": stale}
+
+            async def refresh():
+                view["r"] = fabric.routing   # mgmtd heals the view
+
+            sc = StorageClient(
+                lambda: view["r"],
+                config=StorageClientConfig(retry_backoff_s=0.005),
+                client=fabric.client, refresh_routing=refresh)
+            lay = FileLayout(chunk_size=4096, chains=[fabric.chain_id])
+            assert await sc.query_last_chunk(lay, 44) == 100
+            assert view["r"] is fabric.routing  # retried via the refresh
+            assert live_node is fabric.routing.nodes[1]
+        finally:
+            await fabric.stop()
+    run(body())
+
+
 def test_uncommitted_not_served_and_concurrent_chunks():
     async def body():
         fabric = StorageFabric(num_nodes=3, replicas=3)
