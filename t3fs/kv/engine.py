@@ -117,6 +117,20 @@ class KVEngine:
         commit off the event loop; the in-memory commit stays inline."""
         self._commit(txn)
 
+    async def commit_submit(self, txn: Transaction):
+        """Pipelined commit, phase A: conflict-check + APPLY now, in call
+        order (the caller serializes submits — KvService's applier loop).
+        Returns an awaitable that completes when the commit is DURABLE
+        (phase B).  Splitting the phases is what lets the service overlap
+        N commits' fsyncs into one group-commit barrier while applies
+        stay strictly ordered (the FDB commit-pipeline role,
+        /root/reference/src/fdb/FDBTransaction.h analog).  Engines whose
+        commit is already durable-on-apply get a completed phase B."""
+        await self.commit_async(txn)
+        fut = asyncio.get_running_loop().create_future()
+        fut.set_result(None)
+        return fut
+
 
 class MemKVEngine(KVEngine):
     """In-memory multi-version store with SSI conflict checking."""
@@ -140,6 +154,15 @@ class MemKVEngine(KVEngine):
     # --- service accessors (KvService reads at explicit versions) ---
 
     def current_version(self) -> int:
+        return self._version
+
+    def applied_version(self) -> int:
+        """The APPLIED MVCC version — distinct from current_version(),
+        which durable engines clamp to the fsync watermark for reader
+        visibility.  The commit pipeline chains new versions off this
+        (admission must continue from what the engine really assigned)
+        and stamps follower snapshots with it (the rows reflect applied
+        state)."""
         return self._version
 
     def read_at(self, key: bytes, version: int) -> bytes | None:

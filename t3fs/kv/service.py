@@ -8,10 +8,18 @@ and synchronously ships every committed batch to followers before acking,
 so any follower can be promoted without losing acknowledged commits.
 
 Replication protocol:
-  - commits are serialized on the primary (one in flight) and numbered;
-  - followers apply batches strictly in sequence; a gap (follower restarted
-    behind the primary) answers KV_REPLICA_GAP and the primary pushes a full
-    snapshot, then resumes incremental shipping;
+  - commits are PIPELINED on the primary (ROADMAP #3b, the FDB
+    commit-pipeline role): admission (conflict checks + seq/version
+    assignment) happens under a short lock hold, replication to followers
+    runs concurrently across in-flight commits, applies land strictly in
+    seq order via a single applier loop, and the WAL fsync barrier
+    overlaps across commits (engine group commit).  A failed commit
+    cascade-aborts every in-flight successor and rolls seq back;
+  - followers apply batches strictly in sequence, parking briefly on
+    out-of-order arrivals (the pipeline ships concurrently); a real gap
+    (follower restarted behind the primary) answers KV_REPLICA_GAP and
+    the primary pushes a full snapshot, then resumes incremental
+    shipping;
   - promotion is an admin op (Kv.promote); clients fail over by probing
     their address list for whoever accepts commits (KV_NOT_PRIMARY
     redirects them) — the same manual-failover model as the fork's external
@@ -22,6 +30,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+from collections import deque
 from dataclasses import dataclass, field
 
 from t3fs.kv.engine import KVEngine, Transaction
@@ -92,6 +101,11 @@ class KvCommitRsp:
 class KvReplicateReq:
     seq: int = 0
     version: int = 0               # primary's MVCC version for this batch
+    # primary's applied seq at ship time: every batch <= floor was already
+    # acked by ALL followers, so a follower holding seq < floor is missing
+    # batches that will never be re-shipped — it answers KV_REPLICA_GAP
+    # immediately instead of parking for an in-flight predecessor
+    floor: int = 0
     write_keys: list[bytes] = field(default_factory=list)
     write_values: list[bytes] = field(default_factory=list)
     write_deletes: list[bool] = field(default_factory=list)
@@ -264,6 +278,29 @@ class _Footprint:
         return None
 
 
+class _PipeEntry:
+    """One admitted-but-not-yet-applied commit in the primary's pipeline
+    (ROADMAP #3b, the FDB commit-pipeline role).  Admission assigns seq +
+    MVCC version under a short _commit_lock hold; replication to every
+    follower runs CONCURRENTLY across entries (followers reorder by seq);
+    the applier loop applies strictly in seq order; the durability
+    barrier (group fsync) overlaps across entries.  `fp` keeps later
+    admissions' READS off this entry's writes until it applies — the
+    engine's conflict check can't see un-applied writes."""
+
+    __slots__ = ("seq", "version", "txn", "fp", "rep_task", "done")
+
+    def __init__(self, seq: int, version: int, txn: Transaction):
+        self.seq = seq
+        self.version = version
+        self.txn = txn
+        self.fp = _Footprint(txn)
+        self.rep_task: asyncio.Task | None = None
+        # resolves to the engine's phase-B (durability) awaitable once the
+        # entry is replicated + applied; exception on failure/cascade
+        self.done: asyncio.Future = asyncio.get_running_loop().create_future()
+
+
 @service("Kv")
 class KvService:
     def __init__(self, engine: KVEngine, *, primary: bool = True,
@@ -273,8 +310,21 @@ class KvService:
         self.primary = primary
         self.followers = list(followers or [])
         self.client = client            # net Client for follower shipping
-        self.seq = 0                    # last shipped/applied batch seq
+        self.seq = 0                    # last ASSIGNED batch seq
         self._commit_lock = asyncio.Lock()
+        # commit pipeline state (primary): admitted entries awaiting
+        # ordered apply; see _PipeEntry
+        self._pipe: deque[_PipeEntry] = deque()
+        self._pipe_event = asyncio.Event()
+        self._applier_task: asyncio.Task | None = None
+        self._apply_mu = asyncio.Lock()   # quiesces applies (snapshot push)
+        self._applied_seq = 0             # seq of last locally applied batch
+        self._push_locks: dict[str, asyncio.Lock] = {}
+        # follower: reorder buffer — concurrently-shipped batches can
+        # arrive out of seq order; appliers park here until their
+        # predecessor lands (bounded; timeout answers KV_REPLICA_GAP)
+        self._fol_cv = asyncio.Condition()
+        self.replica_park_timeout_s = 8.0
         # 2PC: txn_id -> (validated Transaction, expiry timer, prepare
         # req).  The commit lock is held only WITHIN each phase — across
         # the inter-phase window the prepared txn is protected by its
@@ -329,6 +379,12 @@ class KvService:
         for t in list(self._push_tasks):
             t.cancel()
         self._push_tasks.clear()
+        if self._applier_task is not None:
+            self._applier_task.cancel()
+            self._applier_task = None
+        if self._pipe:
+            self._cascade_fail(make_error(StatusCode.INTERNAL,
+                                          "KV service stopping"))
 
     async def _gc_loop(self) -> None:
         while True:
@@ -598,51 +654,157 @@ class KvService:
         txn._range_clears = list(zip(req.clear_begins, req.clear_ends))
         return txn
 
+    # ---- commit pipeline (primary; ROADMAP #3b) ----
+
+    def _check_pipeline(self, txn: Transaction) -> None:
+        """Admission control vs in-flight (admitted, not yet applied)
+        pipeline entries: the engine's conflict check can only see
+        APPLIED writes, so a candidate's reads must additionally prove
+        they don't overlap any in-flight entry's writes/clears — the
+        candidate read at a snapshot that predates them, and admitting
+        it would serialize it after writes it never saw.  Write-write
+        overlap needs no check: applies land strictly in seq order, so
+        the later admission wins exactly as SSI orders them."""
+        for e in self._pipe:
+            hit = e.fp.blocks((), (), txn._read_keys, txn._read_ranges)
+            if hit is not None:
+                raise make_error(
+                    StatusCode.TXN_CONFLICT,
+                    f"{hit} conflicts with in-flight commit seq {e.seq}")
+
+    def _enqueue_locked(self, txn: Transaction) -> _PipeEntry:
+        """Admit a validated txn: assign seq + version, start replication
+        immediately (concurrent across entries), queue for ordered apply.
+        Caller holds _commit_lock."""
+        self._ensure_applier()
+        self.seq += 1
+        version = (self._pipe[-1].version if self._pipe
+                   else self.engine.applied_version()) + 1
+        entry = _PipeEntry(self.seq, version, txn)
+        entry.rep_task = asyncio.create_task(self._replicate(KvReplicateReq(
+            seq=entry.seq,
+            version=version,
+            floor=self._applied_seq,
+            write_keys=list(txn._writes.keys()),
+            write_values=[v if v is not None else b""
+                          for v in txn._writes.values()],
+            write_deletes=[v is None for v in txn._writes.values()],
+            clear_begins=[b for b, _ in txn._range_clears],
+            clear_ends=[e for _, e in txn._range_clears])))
+        self._pipe.append(entry)
+        self._pipe_event.set()
+        return entry
+
+    def _ensure_applier(self) -> None:
+        if self._applier_task is None or self._applier_task.done():
+            self._applier_task = asyncio.create_task(self._apply_loop())
+
+    async def _apply_loop(self) -> None:
+        """Single ordered applier: per entry, wait for its replication
+        (all followers hold the batch — nothing becomes visible on the
+        primary before that, same invariant as the serialized path),
+        then apply via the engine's phase A in strict seq order.  The
+        durability barrier (phase B) is NOT awaited here — each waiter
+        awaits its own, so N commits' fsyncs collapse into the engine's
+        group-commit window.  Any failure cascade-aborts every queued
+        entry (their admission checks assumed the failed predecessor's
+        writes would land) and rolls seq back so the next commit reuses
+        it — the follower-side GAP + snapshot push heals divergence."""
+        while True:
+            while not self._pipe:
+                self._pipe_event.clear()
+                await self._pipe_event.wait()
+            entry = self._pipe[0]
+            try:
+                await asyncio.shield(entry.rep_task)
+            except asyncio.CancelledError:
+                raise               # the applier itself is being stopped
+            except BaseException as e:
+                self._cascade_fail(e)
+                continue
+            try:
+                async with self._apply_mu:
+                    # the local apply is inside the cascade scope: if the
+                    # WAL append fails (disk full) after followers applied
+                    # this seq, seq reuse + snapshot push resets them to
+                    # the primary's true (unapplied) state
+                    barrier = await self.engine.commit_submit(entry.txn)
+                    self._applied_seq = entry.seq
+            except asyncio.CancelledError:
+                raise
+            except BaseException as e:
+                self._cascade_fail(e)
+                continue
+            self._pipe.popleft()
+            if not entry.done.done():
+                entry.done.set_result(barrier)
+
+    def _cascade_fail(self, exc: BaseException) -> None:
+        """Fail the pipeline head and every queued successor, SYNCHRONOUSLY
+        (no awaits): admissions hold _commit_lock and run without yielding,
+        so a synchronous cascade can't interleave with one — every entry
+        present now is the complete set that assumed the failed
+        predecessor, and seq rolls back atomically with their removal."""
+        entries = list(self._pipe)
+        self._pipe.clear()
+        if not entries:
+            return
+        first = entries[0].seq
+        self.seq = first - 1
+        for i, e in enumerate(entries):
+            if e.rep_task is not None and not e.rep_task.done():
+                e.rep_task.cancel()
+            if e.rep_task is not None:
+                e.rep_task.add_done_callback(
+                    lambda f: f.cancelled() or f.exception())
+            if not e.done.done():
+                err = exc if i == 0 else make_error(
+                    StatusCode.KV_REPLICATION_FAILED,
+                    f"pipeline predecessor seq {first} failed; this "
+                    f"batch (seq {e.seq}) may exist on some followers")
+                e.done.set_exception(err)
+                # mark retrieved: an enqueuer cancelled mid-await must not
+                # leave a never-retrieved-exception warning
+                e.done.exception()
+        log.warning("commit pipeline cascade: %d entries aborted from "
+                    "seq %d (%s)", len(entries), first, exc)
+
+    async def _await_entry(self, entry: _PipeEntry) -> None:
+        """Wait out an entry end-to-end: replicated + applied (done) and
+        durable (the engine's phase-B barrier)."""
+        barrier = await entry.done
+        await barrier
+
     async def _replicate_and_apply(self, txn: Transaction) -> None:
-        """Ship to followers, then apply locally.  Caller holds
-        _commit_lock and has already conflict-checked."""
+        """Enqueue + wait end-to-end.  Caller holds _commit_lock and has
+        already conflict-checked; internal record writes keep the old
+        fully-serialized semantics by awaiting inline under the lock."""
         if not (txn._writes or txn._range_clears):
             return
-        self.seq += 1
-        try:
-            await self._replicate(KvReplicateReq(
-                seq=self.seq,
-                version=self.engine.current_version() + 1,
-                write_keys=list(txn._writes.keys()),
-                write_values=[v if v is not None else b""
-                              for v in txn._writes.values()],
-                write_deletes=[v is None for v in txn._writes.values()],
-                clear_begins=[b for b, _ in txn._range_clears],
-                clear_ends=[e for _, e in txn._range_clears]))
-            # the local apply is INSIDE the rollback scope: if the
-            # WAL append fails (OSError: disk full) after followers
-            # applied this seq, rolling seq back makes the next
-            # commit reuse it, the followers answer KV_REPLICA_GAP,
-            # and the snapshot push resets them to the primary's
-            # true (unapplied) state — no silent divergence
-            await self.engine.commit_async(txn)
-        except Exception:
-            self.seq -= 1
-            raise
+        await self._await_entry(self._enqueue_locked(txn))
 
     @rpc_method
     async def commit(self, req: KvCommitReq, payload, conn):
         self._require_primary()
         txn = self._txn_from_req(req)
         async with self._commit_lock:
-            # Order: conflict-check -> replicate -> apply.  Nothing becomes
-            # visible on the primary until every follower holds the batch,
-            # so a commit that fails with KV_REPLICATION_FAILED leaves the
-            # primary exactly as it was (no write visible to clients exists
-            # only here).  A follower that applied the batch before a later
-            # follower failed is healed by seq reuse: the next commit ships
-            # the same seq, the stale follower answers KV_REPLICA_GAP, and
-            # the snapshot push resets it to the primary's true state.
+            # Admission: conflict-check against applied state (engine),
+            # prepared 2PC footprints, and in-flight pipeline entries —
+            # then assign seq/version and release the lock.  Replication,
+            # ordered apply, and the fsync barrier all overlap with later
+            # commits' (this lock hold has NO awaits in it).
             self._check_shard_gates(txn)
             self._check_footprints(txn)
+            self._check_pipeline(txn)
             self.engine.check_conflicts(txn)
-            await self._replicate_and_apply(txn)
-        return KvCommitRsp(version=self.engine.current_version()), b""
+            if not (txn._writes or txn._range_clears):
+                # read-only validation (sharded multi-shard read path):
+                # nothing to pipeline once the reads proved valid
+                return KvCommitRsp(
+                    version=self.engine.current_version()), b""
+            entry = self._enqueue_locked(txn)
+        await self._await_entry(entry)
+        return KvCommitRsp(version=entry.version), b""
 
     # ---- 2PC surface (cross-shard transactions; see t3fs/kv/shard.py) ----
 
@@ -672,6 +834,7 @@ class KvService:
                 return KvOkRsp(seq=self.seq), b""
             self._check_shard_gates(txn)
             self._check_footprints(txn)
+            self._check_pipeline(txn)
             self.engine.check_conflicts(txn)
             rec = Transaction(self.engine,
                               read_version=self.engine.current_version())
@@ -1089,21 +1252,45 @@ class KvService:
             await self.client.call(addr, "Kv.apply_replica", req,
                                    timeout=10.0)
             self.replicated += 1
+            return
         except StatusError as e:
             if e.code != StatusCode.KV_REPLICA_GAP:
                 raise
-            # the engine still holds the PRE-batch state (apply happens
-            # after replication), so snapshot at seq-1 and then ship this
-            # batch incrementally on top
-            await self._push_snapshot(addr, req.seq - 1)
-            await self.client.call(addr, "Kv.apply_replica", req,
-                                   timeout=10.0)
-            self.replicated += 1
+        # GAP: the follower restarted (or fell behind a healed wipe).
+        # Serialize heals per follower — under the pipeline, several
+        # in-flight batches hit the same restarted follower at once and
+        # concurrent snapshot pushes would interleave with applies.
+        lock = self._push_locks.setdefault(addr, asyncio.Lock())
+        last: StatusError | None = None
+        for round_ in range(3):
+            async with lock:
+                try:
+                    # a predecessor's push may have healed us already
+                    await self.client.call(addr, "Kv.apply_replica", req,
+                                           timeout=10.0)
+                    self.replicated += 1
+                    return
+                except StatusError as e:
+                    if e.code != StatusCode.KV_REPLICA_GAP:
+                        raise
+                    last = e
+                await self._push_snapshot(addr)
+            # outside the lock: the batch may PARK on the follower while
+            # predecessors (already acked to this follower pre-restart,
+            # so never re-sent) reach it via the applier's next push
+        raise last
 
-    async def _push_snapshot(self, addr: str, seq: int) -> None:
-        rows = self.engine.snapshot_rows()
+    async def _push_snapshot(self, addr: str) -> None:
+        """Reset a follower to the primary's APPLIED state.  Quiesces the
+        applier (_apply_mu) so rows, seq, and version are one consistent
+        cut — under the pipeline the engine may otherwise be mid-apply of
+        a later seq than the row scan reflects."""
+        async with self._apply_mu:
+            rows = self.engine.snapshot_rows()
+            seq = self._applied_seq
+            version = self.engine.applied_version()
         await self.client.call(addr, "Kv.load_snapshot", KvSnapshotReq(
-            seq=seq, version=self.engine.current_version(),
+            seq=seq, version=version,
             keys=[k for k, _ in rows], values=[v for _, v in rows]),
             timeout=60.0)
         self.snapshots_pushed += 1
@@ -1115,20 +1302,66 @@ class KvService:
         if self.primary:
             raise make_error(StatusCode.INVALID_ARG,
                              "primary cannot apply replica batches")
-        if req.seq != self.seq + 1:
-            raise make_error(StatusCode.KV_REPLICA_GAP,
-                             f"have seq {self.seq}, got {req.seq}")
-        txn = Transaction(self.engine)
-        for k, v, is_del in zip(req.write_keys, req.write_values,
-                                req.write_deletes):
-            txn._writes[k] = None if is_del else v
-        txn._range_clears = list(zip(req.clear_begins, req.clear_ends))
-        # stamp this batch with the PRIMARY's version so versions stay
-        # comparable across a promotion (pinned read_versions, SSI checks)
-        if req.version > 0:
-            self.engine.advance_version(req.version - 1)
-        await self.engine.commit_async(txn)   # no reads -> no conflicts
-        self.seq = req.seq
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + self.replica_park_timeout_s
+        async with self._fol_cv:
+            # reorder buffer: the primary ships pipelined batches
+            # concurrently, so seq N+1 can land before N — park until the
+            # predecessor applies (bounded: a predecessor lost to a
+            # primary-side cascade never arrives, and the pipelined
+            # sender heals the resulting GAP with a snapshot)
+            while req.seq > self.seq + 1:
+                if self.primary:
+                    # promoted while this batch sat parked: it came from
+                    # the DEPOSED primary's pipeline — applying it now
+                    # would write phantom state and collide seqs with
+                    # our own pipeline (code-review r5)
+                    raise make_error(StatusCode.INVALID_ARG,
+                                     "primary cannot apply replica batches")
+                if self.seq < req.floor:
+                    # the predecessor we'd park for was already acked by
+                    # every follower (it is at or below the primary's
+                    # applied floor) — we LOST it (restart/wipe); it will
+                    # never be re-shipped, so fail fast to the snapshot
+                    raise make_error(
+                        StatusCode.KV_REPLICA_GAP,
+                        f"have seq {self.seq}, got {req.seq} "
+                        f"(floor {req.floor}: predecessors already acked)")
+                remaining = deadline - loop.time()
+                if remaining <= 0:
+                    raise make_error(
+                        StatusCode.KV_REPLICA_GAP,
+                        f"have seq {self.seq}, got {req.seq} "
+                        f"(predecessor never arrived)")
+                try:
+                    await asyncio.wait_for(self._fol_cv.wait(), remaining)
+                except TimeoutError:
+                    continue        # loop re-checks seq, then expires
+            if self.primary:
+                raise make_error(StatusCode.INVALID_ARG,
+                                 "primary cannot apply replica batches")
+            if req.seq <= self.seq:
+                # stale or duplicate — NOT idempotent-ok: after a
+                # primary-side cascade the same seq re-ships with
+                # DIFFERENT content, and acking would silently diverge
+                raise make_error(StatusCode.KV_REPLICA_GAP,
+                                 f"have seq {self.seq}, got {req.seq}")
+            txn = Transaction(self.engine)
+            for k, v, is_del in zip(req.write_keys, req.write_values,
+                                    req.write_deletes):
+                txn._writes[k] = None if is_del else v
+            txn._range_clears = list(zip(req.clear_begins, req.clear_ends))
+            # stamp this batch with the PRIMARY's version so versions stay
+            # comparable across a promotion (pinned read_versions, SSI)
+            if req.version > 0:
+                self.engine.advance_version(req.version - 1)
+            # phase A (apply) in seq order under the cv; the durability
+            # barrier is awaited OUTSIDE it so parked successors start
+            # their appends and the follower's fsyncs group too
+            barrier = await self.engine.commit_submit(txn)  # no reads
+            self.seq = req.seq
+            self._fol_cv.notify_all()
+        await barrier
         return KvOkRsp(seq=self.seq), b""
 
     @rpc_method
@@ -1136,16 +1369,21 @@ class KvService:
         if self.primary:
             raise make_error(StatusCode.INVALID_ARG,
                              "primary cannot load snapshots")
-        self.engine.clear_all()
-        txn = Transaction(self.engine)
-        for k, v in zip(req.keys, req.values):
-            txn._writes[k] = v
-        await self.engine.commit_async(txn)
-        # fast-forward to the primary's clock: post-promotion, reads pinned
-        # at old-primary versions resolve against this snapshot and new
-        # writes version strictly above it (conflict checks stay sound)
-        self.engine.advance_version(req.version)
-        self.seq = req.seq
+        async with self._fol_cv:
+            self.engine.clear_all()
+            txn = Transaction(self.engine)
+            for k, v in zip(req.keys, req.values):
+                txn._writes[k] = v
+            await self.engine.commit_async(txn)
+            # fast-forward to the primary's clock: post-promotion, reads
+            # pinned at old-primary versions resolve against this snapshot
+            # and new writes version strictly above it (conflict checks
+            # stay sound)
+            self.engine.advance_version(req.version)
+            self.seq = req.seq
+            # parked out-of-order batches re-check against the new seq:
+            # successors of the snapshot apply in order, stale ones GAP
+            self._fol_cv.notify_all()
         return KvOkRsp(seq=self.seq), b""
 
     # ---- admin ----
@@ -1157,6 +1395,14 @@ class KvService:
         2PC prepare records re-arm so a failover mid-cross-shard-txn
         still resolves it."""
         self.primary = True
+        # everything this follower applied is the new primary's truth:
+        # the commit pipeline starts empty at the applied watermark
+        self._applied_seq = self.seq
+        # drain the reorder buffer: parked batches from the deposed
+        # primary must re-check self.primary and be refused, not apply
+        # into the new primary's pipeline
+        async with self._fol_cv:
+            self._fol_cv.notify_all()
         # shard-surgery caches reload from the replicated records: the
         # promoted copy must enforce exactly what the old primary did
         self._owned = "unloaded"

@@ -133,6 +133,7 @@ class WalKVEngine(MemKVEngine):
         # would erase (applied-but-unsynced frames are invisible until
         # their group's fsync lands)
         self._durable_version = self._version
+        self.fsyncs = 0                  # observability: barrier fsyncs
         # dedicated commit pool: the loop's default executor is cpu+4
         # threads, which would cap the group size at ~5 — barrier
         # waiters are parked threads, so a wide pool is cheap
@@ -254,8 +255,41 @@ class WalKVEngine(MemKVEngine):
             fut.add_done_callback(lambda f: f.cancelled() or f.exception())
             raise
 
+    async def commit_submit(self, txn: Transaction):
+        """Pipelined commit: phase A (conflict-check + WAL append + apply,
+        atomic under _io_lock, in the caller's submit order) runs before
+        this returns; the returned awaitable is phase B (the group-commit
+        durability barrier).  A caller that overlaps N phase-B waits pays
+        ~1 fsync for the whole window — the engine-level group commit
+        finally sees concurrent frames (KvService serialized commit_async
+        end-to-end, so the barrier never had company)."""
+        loop = asyncio.get_running_loop()
+        fut = loop.run_in_executor(self._commit_pool, self._commit_phase_a,
+                                   txn)
+        try:
+            tokens = await asyncio.shield(fut)
+        except asyncio.CancelledError:
+            fut.add_done_callback(lambda f: f.cancelled() or f.exception())
+            raise
+        if tokens is None or self.sync != "always":
+            done = loop.create_future()
+            done.set_result(None)
+            return done
+        barrier = loop.run_in_executor(self._commit_pool,
+                                       self._commit_phase_b, *tokens)
+        # consume a late error even if the awaiting caller is cancelled:
+        # the barrier thread cannot be interrupted and its failure would
+        # otherwise log as a never-retrieved exception
+        barrier.add_done_callback(lambda f: f.cancelled() or f.exception())
+        return barrier
+
     def _commit(self, txn: Transaction) -> None:
-        end_pos = epoch = None
+        tokens = self._commit_phase_a(txn)
+        if tokens is not None and self.sync == "always":
+            self._commit_phase_b(*tokens)
+
+    def _commit_phase_a(self, txn: Transaction) -> tuple | None:
+        end_pos = epoch = gen = my_version = None
         with self._io_lock:
             # standard WAL ordering: conflict-check, LOG, then apply — a
             # failed append must leave memory untouched, or restart silently
@@ -308,21 +342,25 @@ class WalKVEngine(MemKVEngine):
             if self._wal.tell() >= self.compact_threshold_bytes:
                 self._compact_locked()
                 epoch = None          # rotation's snapshot fsync covers us
-        if end_pos is not None and self.sync == "always":
-            if epoch is not None:
-                self._group_fsync(epoch, end_pos)
-            # versions are assigned in WAL-append order (both under
-            # _io_lock), so the barrier covering our frame covers every
-            # version <= ours: advance the read-visibility watermark.
-            # Skip if clear_all ran while we were parked at the barrier
-            # (generation mismatch): our frame's data was wiped and the
-            # clock reset, so ratcheting the watermark back up would
-            # reopen the durable>_version hole clear_all closes
-            # (code-review r5).
-            with self._sync_cv:
-                if (gen == self._clear_gen
-                        and my_version > self._durable_version):
-                    self._durable_version = my_version
+        if end_pos is None:
+            return None
+        return (epoch, end_pos, gen, my_version)
+
+    def _commit_phase_b(self, epoch, end_pos, gen, my_version) -> None:
+        if epoch is not None:
+            self._group_fsync(epoch, end_pos)
+        # versions are assigned in WAL-append order (both under
+        # _io_lock), so the barrier covering our frame covers every
+        # version <= ours: advance the read-visibility watermark.
+        # Skip if clear_all ran while we were parked at the barrier
+        # (generation mismatch): our frame's data was wiped and the
+        # clock reset, so ratcheting the watermark back up would
+        # reopen the durable>_version hole clear_all closes
+        # (code-review r5).
+        with self._sync_cv:
+            if (gen == self._clear_gen
+                    and my_version > self._durable_version):
+                self._durable_version = my_version
 
     def _covered(self, epoch: int, end_pos: int) -> bool:
         """Caller holds _sync_cv."""
@@ -384,6 +422,7 @@ class WalKVEngine(MemKVEngine):
                     StatusCode.INTERNAL,
                     "WAL fsync failed; commit durability unknown — "
                     "engine is read-only until reopen")
+            self.fsyncs += 1
             with self._sync_cv:
                 if (target_epoch > self._synced_epoch
                         or (target_epoch == self._synced_epoch
