@@ -13,7 +13,9 @@ import random
 import uuid as uuidlib
 
 from t3fs.meta.acl import UserInfo
-from t3fs.meta.schema import DirEntry, Inode
+from t3fs.meta.schema import DirEntry, Inode, InodeType
+from t3fs.utils import serde
+from t3fs.utils.status import StatusCode
 from t3fs.meta.service import (
     BatchStatReq, EntryReq, InodeReq, LockDirReq, PathReq, PruneSessionReq,
     SetAttrReq,
@@ -167,6 +169,32 @@ class MetaClient:
                      user: UserInfo | None = None) -> Inode:
         return (await self._call("lookup", EntryReq(
             parent=parent, name=name), user=user)).inode
+
+    async def readdir_plus(self, inode_id: int, limit: int = 0,
+                           user: UserInfo | None = None,
+                           attrs_only: bool = False):
+        """One-RPC listing: (dir inode, entries, entry inodes) from one
+        snapshot — the FUSE OPENDIR hot path.  attrs_only=True tag-skips
+        each inode's layout during decode (the one heavy field; attr
+        serving never reads it).  Falls back to the 3-RPC shape against
+        an older meta server."""
+        try:
+            rsp = await self._call("readdir_plus",
+                                   EntryReq(inode_id=inode_id, limit=limit),
+                                   user=user)
+            entries = [DirEntry(inode_id, n, i, InodeType(t))
+                       for n, i, t in zip(rsp.names, rsp.ids, rsp.types)]
+            skip = frozenset({"layout"}) if attrs_only else frozenset()
+            return rsp.dir, entries, serde.loads_many(rsp.inode_blobs,
+                                                      Inode, skip=skip)
+        except StatusError as e:
+            if e.code != StatusCode.RPC_METHOD_NOT_FOUND:
+                raise
+        entries = await self.readdir_inode(inode_id, limit, user=user)
+        dir_inode = await self.stat_inode(inode_id)
+        inodes = await self.batch_stat_inodes(
+            [e.inode_id for e in entries]) if entries else []
+        return dir_inode, entries, inodes
 
     async def readdir_inode(self, inode_id: int, limit: int = 0,
                             user: UserInfo | None = None

@@ -101,16 +101,17 @@ class KvCommitRsp:
 class KvReplicateReq:
     seq: int = 0
     version: int = 0               # primary's MVCC version for this batch
-    # primary's applied seq at ship time: every batch <= floor was already
-    # acked by ALL followers, so a follower holding seq < floor is missing
-    # batches that will never be re-shipped — it answers KV_REPLICA_GAP
-    # immediately instead of parking for an in-flight predecessor
-    floor: int = 0
     write_keys: list[bytes] = field(default_factory=list)
     write_values: list[bytes] = field(default_factory=list)
     write_deletes: list[bool] = field(default_factory=list)
     clear_begins: list[bytes] = field(default_factory=list)
     clear_ends: list[bytes] = field(default_factory=list)
+    # primary's applied seq at ship time: every batch <= floor was already
+    # acked by ALL followers, so a follower holding seq < floor is missing
+    # batches that will never be re-shipped — it answers KV_REPLICA_GAP
+    # immediately instead of parking for an in-flight predecessor.
+    # APPENDED last: serde cross-version compat is positional.
+    floor: int = 0
 
 
 @serde_struct

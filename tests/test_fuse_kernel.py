@@ -220,13 +220,27 @@ def test_readdirplus_batched_attrs():
         tmp = tempfile.mkdtemp(prefix="t3fs-fuse-")
         cluster, fuse, mnt = await _mounted(tmp)
         try:
-            calls = {"batch": 0}
-            orig = fuse.mc.batch_stat_inodes
+            calls = {"batch": 0, "plus": 0, "stat": 0}
+            orig_batch = fuse.mc.batch_stat_inodes
+            orig_plus = fuse.mc.readdir_plus
+            orig_stat = fuse.mc.stat_inode
 
-            async def counting(ids):
+            async def counting_batch(ids):
                 calls["batch"] += 1
-                return await orig(ids)
-            fuse.mc.batch_stat_inodes = counting
+                return await orig_batch(ids)
+
+            async def counting_plus(inode_id, limit=0, user=None,
+                                    attrs_only=False):
+                calls["plus"] += 1
+                return await orig_plus(inode_id, limit, user=user,
+                                       attrs_only=attrs_only)
+
+            async def counting_stat(inode_id):
+                calls["stat"] += 1
+                return await orig_stat(inode_id)
+            fuse.mc.batch_stat_inodes = counting_batch
+            fuse.mc.readdir_plus = counting_plus
+            fuse.mc.stat_inode = counting_stat
 
             def posix_ops():
                 os.mkdir(f"{mnt}/d")
@@ -245,11 +259,14 @@ def test_readdirplus_batched_attrs():
             assert len(out) == 12
             for i in range(12):
                 assert out[f"f{i:02d}"] == (10 + i, 0o600 + i), i
-            # one OPENDIR -> one batched stat (the kernel may re-list;
-            # allow a small number, never one-per-entry)
-            assert 1 <= calls["batch"] <= 3, calls
-            await fuse.unmount()
+            # ONE readdir_plus RPC primes entries AND attrs at OPENDIR
+            # (r5: was readdir + stat_inode + batch_stat_inodes); never
+            # a GETATTR/stat per entry, and no separate batch RPC
+            assert 1 <= calls["plus"] <= 3, calls
+            assert calls["batch"] == 0, calls
+            assert calls["stat"] <= 3, calls
         finally:
+            await fuse.unmount()
             await cluster.stop()
             shutil.rmtree(tmp, ignore_errors=True)
     run(body())

@@ -215,6 +215,24 @@ def test_lock_manager_never_evicts_waited_locks():
     asyncio.run(run())
 
 
+def test_serde_loads_many_matches_loads():
+    """loads_many (hoisted same-type batch decode) must be
+    outcome-identical to a per-blob loads loop, incl. empty->None and
+    the wrong-type fallback."""
+    from t3fs.meta.schema import DirEntry, Inode, InodeType
+    from t3fs.utils import serde
+
+    blobs = [serde.dumps(Inode(inode_id=i, itype=InodeType.FILE))
+             for i in range(5)]
+    blobs.insert(2, b"")                               # raced-away row
+    blobs.append(serde.dumps(DirEntry(1, "odd", 7)))   # wrong-type blob
+    out = serde.loads_many(blobs, Inode)
+    ref = [serde.loads(b) if b else None for b in blobs]
+    assert out == ref
+    assert out[2] is None
+    assert isinstance(out[-1], DirEntry)
+
+
 def test_serde_fuzz_every_registered_struct():
     """Property test over the ENTIRE wire-type registry: build each
     registered struct with randomized field values (drawn from its type
