@@ -426,8 +426,14 @@ def make_stripe_encode_step_words(chunk_words: int, k: int = 8, m: int = 2,
     (src/fbs/storage/Common.h:158); the RS data path is a t3fs addition."""
     assert m == 2, "word path is RAID-6 (m=2); use make_stripe_encode_step_fast"
     rs = default_rs(k, m)
-    rs_enc = make_rs_encode_words_pallas(rs, interpret=interpret)
-    crc = make_crc32c_words(chunk_words, interpret=interpret)
+    # r5 live-chip sweep (v5e, 96 MiB batch): RS is the bound (210 GB/s
+    # alone vs CRC's 400); block_w 128Ki words (+6% RS; 256Ki OOMs the
+    # 16M scoped vmem) and block_r 2048 lift the fused step 96 -> ~107
+    # GB/s two-point.  encode() clamps block_w to W for smaller chunks.
+    from t3fs.ops.blocks import pick_block
+    rs_enc = make_rs_encode_words_pallas(
+        rs, block_w=pick_block(chunk_words, 131072), interpret=interpret)
+    crc = make_crc32c_words(chunk_words, block_r=2048, interpret=interpret)
 
     def step(words: jax.Array):
         n = words.shape[0]
