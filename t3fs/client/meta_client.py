@@ -171,22 +171,18 @@ class MetaClient:
             parent=parent, name=name), user=user)).inode
 
     async def readdir_plus(self, inode_id: int, limit: int = 0,
-                           user: UserInfo | None = None,
-                           attrs_only: bool = False):
+                           user: UserInfo | None = None):
         """One-RPC listing: (dir inode, entries, entry inodes) from one
-        snapshot — the FUSE OPENDIR hot path.  attrs_only=True tag-skips
-        each inode's layout during decode (the one heavy field; attr
-        serving never reads it).  Falls back to the 3-RPC shape against
-        an older meta server."""
+        snapshot — the FUSE OPENDIR hot path.  Falls back to the 3-RPC
+        shape against an older meta server."""
         try:
             rsp = await self._call("readdir_plus",
                                    EntryReq(inode_id=inode_id, limit=limit),
                                    user=user)
             entries = [DirEntry(inode_id, n, i, InodeType(t))
                        for n, i, t in zip(rsp.names, rsp.ids, rsp.types)]
-            skip = frozenset({"layout"}) if attrs_only else frozenset()
             return rsp.dir, entries, serde.loads_many(rsp.inode_blobs,
-                                                      Inode, skip=skip)
+                                                      Inode)
         except StatusError as e:
             if e.code != StatusCode.RPC_METHOD_NOT_FOUND:
                 raise

@@ -394,7 +394,7 @@ class FuseKernelMount:
             # single snapshot (r4 verdict weak #6: this was 3 RPCs —
             # readdir + stat + first-page batch_stat — at 151 list/s)
             inode, entries, inodes = await self.mc.readdir_plus(
-                nodeid, user=user, attrs_only=True)
+                nodeid, user=user)
             listing = [(nodeid, ".", InodeType.DIRECTORY),
                        (inode.parent or nodeid, "..", InodeType.DIRECTORY)]
             listing += [(e.inode_id, e.name, InodeType(e.itype))

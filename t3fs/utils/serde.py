@@ -78,8 +78,8 @@ class _Plan:
     tests/test_utils.py fuzzes every registered struct against the generic
     path to hold that equivalence."""
 
-    __slots__ = ("cls", "header", "names", "enc", "dec", "_coercers",
-                 "_hint_err")
+    __slots__ = ("cls", "header", "names", "enc", "dec", "dec_raw",
+                 "_coercers", "_hint_err")
 
     def __init__(self, cls: type):
         self.cls = cls
@@ -108,8 +108,10 @@ class _Plan:
         try:
             if self._coercers is None:
                 raise ValueError("hints unresolved")
-            self.dec = _compile_decoder(self, hints)
+            self.dec_raw = _compile_decoder_raw(self, hints)
+            self.dec = _make_dec_shim(self.dec_raw)
         except Exception:          # codegen must never break decoding
+            self.dec_raw = self._generic_dec_raw
             self.dec = self._generic_dec
 
     def _generic_enc(self, w: bytearray, obj) -> None:
@@ -119,6 +121,11 @@ class _Plan:
 
     def _generic_dec(self, r: "_Reader"):
         return _decode_struct_body(r, self.cls, self)
+
+    def _generic_dec_raw(self, buf: bytes, pos: int):
+        r = _Reader(buf)
+        r.pos = pos
+        return _decode_struct_body(r, self.cls, self), r.pos
 
     @property
     def coercers(self) -> tuple:
@@ -245,163 +252,11 @@ def _emit_value(lines, ns, ind, v, hint, depth):
     return True
 
 
-def _emit_read(lines, ns, ind, v, hint):
-    """Emit a tag-checked fast read into variable `v` for the hinted type,
-    falling back to `_decode_with_tag` (+ the compiled coercer where one
-    exists) on any tag mismatch — outcome-identical to the generic path."""
-    hint, optional = _unwrap_optional(hint)
-    lines.append(f"{ind}_t = r.tag()")
-    if optional:
-        lines.append(f"{ind}if _t == {T_NONE}:")
-        lines.append(f"{ind}    {v} = None")
-        lines.append(f"{ind}else:")
-        ind += "    "
-    enum_name = None
-    if isinstance(hint, type) and issubclass(hint, enum.Enum):
-        enum_name = f"_E{len(ns)}"
-        ns[enum_name] = hint
-        hint = int if issubclass(hint, int) else (
-            str if issubclass(hint, str) else None)
-        if hint is None:
-            # plain/bytes-based enum: generic read, epilogue coerces
-            lines.append(f"{ind}{v} = _decode_with_tag(r, _t)")
-            lines.append(f"{ind}if {v} is not None "
-                         f"and not isinstance({v}, {enum_name}):")
-            lines.append(f"{ind}    {v} = {enum_name}({v})")
-            return
-    if hint is bool:
-        lines += [f"{ind}if _t == {T_TRUE}:",
-                  f"{ind}    {v} = True",
-                  f"{ind}elif _t == {T_FALSE}:",
-                  f"{ind}    {v} = False",
-                  f"{ind}else:",
-                  f"{ind}    {v} = _decode_with_tag(r, _t)"]
-    elif hint is int:
-        lines += [f"{ind}if _t == {T_INT}:",
-                  f"{ind}    {v} = r.varint()",
-                  f"{ind}elif _t == {T_NEGINT}:",
-                  f"{ind}    {v} = -r.varint() - 1",
-                  f"{ind}else:",
-                  f"{ind}    {v} = _decode_with_tag(r, _t)"]
-    elif hint is float:
-        lines += [f"{ind}if _t == {T_FLOAT}:",
-                  f"{ind}    {v} = _unpack_d(r.exact(8))[0]",
-                  f"{ind}else:",
-                  f"{ind}    {v} = _decode_with_tag(r, _t)"]
-    elif hint is str:
-        lines += [f"{ind}if _t == {T_STR}:",
-                  f"{ind}    {v} = r.exact(r.varint()).decode('utf-8')",
-                  f"{ind}else:",
-                  f"{ind}    {v} = _decode_with_tag(r, _t)"]
-    elif hint is bytes:
-        lines += [f"{ind}if _t == {T_BYTES}:",
-                  f"{ind}    {v} = r.exact(r.varint())",
-                  f"{ind}else:",
-                  f"{ind}    {v} = _decode_with_tag(r, _t)"]
-    elif isinstance(hint, type) and is_dataclass(hint) \
-            and _registry.get(hint.__name__) is hint:
-        cn = f"_C{len(ns)}"
-        nb = f"_N{len(ns)}"
-        ns[cn] = hint
-        ns[nb] = hint.__name__.encode()
-        lines += [f"{ind}if _t == {T_STRUCT}:",
-                  f"{ind}    _nm = r.exact(r.varint())",
-                  f"{ind}    if _nm == {nb}:",
-                  f"{ind}        {v} = _plan_of({cn}).dec(r)",
-                  f"{ind}    else:",
-                  f"{ind}        {v} = _struct_by_name(r, _nm)",
-                  f"{ind}else:",
-                  f"{ind}    {v} = _decode_with_tag(r, _t)"]
-    elif (typing.get_origin(hint) is list and typing.get_args(hint)
-          and (lambda e: isinstance(e[0], type) and is_dataclass(e[0])
-               and _registry.get(e[0].__name__) is e[0])(
-              _unwrap_optional(typing.get_args(hint)[0]))):
-        # list[Struct] / list[Struct | None]: inline the per-element
-        # struct decode — the generic path pays a dispatch + registry
-        # lookup per element, which dominated batched responses
-        # (readdir_plus: 64 DirEntries + 64 Inodes per call, r5)
-        ecls, eopt = _unwrap_optional(typing.get_args(hint)[0])
-        cn = f"_C{len(ns)}"
-        nb = f"_N{len(ns)}"
-        ns[cn] = ecls
-        ns[nb] = ecls.__name__.encode()
-        none_arm = (f"(None if _et == {T_NONE} else "
-                    if eopt else "(")
-        lines += [
-            f"{ind}if _t == {T_LIST}:",
-            f"{ind}    {v} = []",
-            f"{ind}    _ap = {v}.append",
-            f"{ind}    _dec = _plan_of({cn}).dec",
-            f"{ind}    for _ in range(r.varint()):",
-            f"{ind}        _et = r.tag()",
-            f"{ind}        if _et == {T_STRUCT}:",
-            f"{ind}            _nm = r.exact(r.varint())",
-            f"{ind}            _ap(_dec(r) if _nm == {nb}",
-            f"{ind}                else _struct_by_name(r, _nm))",
-            f"{ind}        else:",
-            f"{ind}            _ap({none_arm}"
-            f"_decode_with_tag(r, _et)))",
-            f"{ind}else:",
-            f"{ind}    {v} = _decode_with_tag(r, _t)"]
-    elif typing.get_origin(hint) is list and typing.get_args(hint) \
-            and typing.get_args(hint)[0] in (int, str, bytes):
-        elem = typing.get_args(hint)[0]
-        inner = {int: f"(r.varint() if _et == {T_INT} else "
-                      f"(-r.varint() - 1 if _et == {T_NEGINT} else "
-                      f"_decode_with_tag(r, _et)))",
-                 str: f"(r.exact(r.varint()).decode('utf-8') "
-                      f"if _et == {T_STR} else _decode_with_tag(r, _et))",
-                 bytes: f"(r.exact(r.varint()) if _et == {T_BYTES} "
-                        f"else _decode_with_tag(r, _et))"}[elem]
-        lines += [f"{ind}if _t == {T_LIST}:",
-                  f"{ind}    {v} = []",
-                  f"{ind}    for _ in range(r.varint()):",
-                  f"{ind}        _et = r.tag()",
-                  f"{ind}        {v}.append({inner})",
-                  f"{ind}else:",
-                  f"{ind}    {v} = _decode_with_tag(r, _t)"]
-    else:
-        # no fast path: generic decode + the compiled coercer (if any)
-        lines.append(f"{ind}{v} = _decode_with_tag(r, _t)")
-        coercer = _compile_coercer(hint)
-        if coercer is not None:
-            cc = f"_c{len(ns)}"
-            ns[cc] = coercer
-            lines.append(f"{ind}{v} = {cc}({v})")
-        return
-    if enum_name is not None:
-        lines.append(f"{ind}if {v} is not None "
-                     f"and not isinstance({v}, {enum_name}):")
-        lines.append(f"{ind}    {v} = {enum_name}({v})")
-
-
 def _struct_by_name(r: "_Reader", name_b: bytes):
     cls = _registry.get(name_b.decode())
     if cls is None:
         raise ValueError(f"serde: unknown struct {name_b!r}")
     return _plan_of(cls).dec(r)
-
-
-def _compile_decoder(plan: "_Plan", hints: dict):
-    """exec-generate dec(r) for one registered dataclass: tag-checked
-    inline reads per field in declaration order, bailing to the generic
-    loop when the wire field count differs (cross-version compat)."""
-    ns: dict = {"_decode_with_tag": _decode_with_tag,
-                "_decode_struct_body": _decode_struct_body,
-                "_unpack_d": _unpack_d, "_plan_of": _plan_of,
-                "_struct_by_name": _struct_by_name,
-                "_CLS": plan.cls, "_PLAN": plan}
-    n = len(plan.names)
-    lines = ["def dec(r):",
-             "    nfields = r.varint()",
-             f"    if nfields != {n}:",
-             "        return _decode_struct_body(r, _CLS, _PLAN, nfields)"]
-    for i, name in enumerate(plan.names):
-        _emit_read(lines, ns, "    ", f"v{i}", hints.get(name))
-    args = ", ".join(f"v{i}" for i in range(n))
-    lines.append(f"    return _CLS({args})")
-    exec("\n".join(lines), ns)          # noqa: S102 (trusted codegen)
-    return ns["dec"]
 
 
 def _compile_encoder(plan: "_Plan", hints: dict):
@@ -416,6 +271,237 @@ def _compile_encoder(plan: "_Plan", hints: dict):
         _emit_value(lines, ns, "    ", v, hints.get(name), 0)
     exec("\n".join(lines), ns)          # noqa: S102 (trusted codegen)
     return ns["enc"]
+
+
+def _fallback_read(buf: bytes, pos: int, tag: int):
+    """Raw-decoder escape hatch: decode one tag-consumed value via the
+    generic reader path; returns (value, new_pos)."""
+    r = _Reader(buf)
+    r.pos = pos
+    v = _decode_with_tag(r, tag)
+    return v, r.pos
+
+
+def _emit_varint_read(lines, ind, v):
+    """Inline little-endian-base-128 read of `v` from (buf, pos)."""
+    lines += [f"{ind}_b = buf[pos]; pos += 1",
+              f"{ind}if _b < 128:",
+              f"{ind}    {v} = _b",
+              f"{ind}else:",
+              f"{ind}    {v} = _b & 0x7F",
+              f"{ind}    _s = 7",
+              f"{ind}    while True:",
+              f"{ind}        _b = buf[pos]; pos += 1",
+              f"{ind}        {v} |= (_b & 0x7F) << _s",
+              f"{ind}        if _b < 128:",
+              f"{ind}            break",
+              f"{ind}        _s += 7"]
+
+
+def _emit_read_raw(lines, ns, ind, v, hint):
+    """Raw-buffer twin of _emit_read: straight-line reads over local
+    (buf, pos) with zero per-field method calls on the fast paths.
+    Single-byte reads bounds-check via IndexError (the dec shim converts
+    it); slice reads check against _blen explicitly (slices never
+    raise).  Any tag mismatch falls back to the generic reader path —
+    outcome-identical to the reflective decoder."""
+    hint, optional = _unwrap_optional(hint)
+    lines.append(f"{ind}_t = buf[pos]; pos += 1")
+    if optional:
+        lines.append(f"{ind}if _t == {T_NONE}:")
+        lines.append(f"{ind}    {v} = None")
+        lines.append(f"{ind}else:")
+        ind += "    "
+    enum_name = None
+    enum_map = None
+    if isinstance(hint, type) and issubclass(hint, enum.Enum):
+        enum_name = f"_E{len(ns)}"
+        enum_map = f"_EM{len(ns)}"
+        ns[enum_name] = hint
+        # value->member dict lookup beats Enum.__call__ ~10x; __call__
+        # stays the fallback for aliases/unknowns so behavior matches
+        ns[enum_map] = dict(hint._value2member_map_)
+        hint = int if issubclass(hint, int) else (
+            str if issubclass(hint, str) else None)
+        if hint is None:
+            lines.append(f"{ind}{v}, pos = _FB(buf, pos, _t)")
+            lines.append(f"{ind}if {v} is not None "
+                         f"and not isinstance({v}, {enum_name}):")
+            lines.append(f"{ind}    _m = {enum_map}.get({v})")
+            lines.append(f"{ind}    {v} = _m if _m is not None "
+                         f"else {enum_name}({v})")
+            return
+    if hint is bool:
+        lines += [f"{ind}if _t == {T_TRUE}:",
+                  f"{ind}    {v} = True",
+                  f"{ind}elif _t == {T_FALSE}:",
+                  f"{ind}    {v} = False",
+                  f"{ind}else:",
+                  f"{ind}    {v}, pos = _FB(buf, pos, _t)"]
+    elif hint is int:
+        lines.append(f"{ind}if _t == {T_INT}:")
+        _emit_varint_read(lines, ind + "    ", v)
+        lines.append(f"{ind}elif _t == {T_NEGINT}:")
+        _emit_varint_read(lines, ind + "    ", v)
+        lines.append(f"{ind}    {v} = -{v} - 1")
+        lines.append(f"{ind}else:")
+        lines.append(f"{ind}    {v}, pos = _FB(buf, pos, _t)")
+    elif hint is float:
+        lines += [f"{ind}if _t == {T_FLOAT}:",
+                  f"{ind}    if pos + 8 > _blen:",
+                  f"{ind}        raise ValueError('serde: truncated input')",
+                  f"{ind}    {v} = _unpack_d(buf, pos)[0]",
+                  f"{ind}    pos += 8",
+                  f"{ind}else:",
+                  f"{ind}    {v}, pos = _FB(buf, pos, _t)"]
+    elif hint is str or hint is bytes:
+        tagc = T_STR if hint is str else T_BYTES
+        suffix = ".decode('utf-8')" if hint is str else ""
+        lines.append(f"{ind}if _t == {tagc}:")
+        _emit_varint_read(lines, ind + "    ", "_l")
+        lines += [f"{ind}    if pos + _l > _blen:",
+                  f"{ind}        raise ValueError('serde: truncated input')",
+                  f"{ind}    {v} = buf[pos:pos + _l]{suffix}",
+                  f"{ind}    pos += _l",
+                  f"{ind}else:",
+                  f"{ind}    {v}, pos = _FB(buf, pos, _t)"]
+    elif isinstance(hint, type) and is_dataclass(hint) \
+            and _registry.get(hint.__name__) is hint:
+        cn = f"_C{len(ns)}"
+        nb = f"_N{len(ns)}"
+        nl = f"_L{len(ns)}"
+        ns[cn] = hint
+        # expected-name compare via one slice: the wire is
+        # tag + varint(len) + name, and registered names are < 128 chars
+        # so the varint is one byte — compare varint+name wholesale; any
+        # other struct (or a pathological long name) takes the generic
+        # fallback, which re-reads the name correctly
+        hb = _varint(len(hint.__name__.encode())) + hint.__name__.encode()
+        ns[nb] = hb
+        ns[nl] = len(hb)
+        lines += [f"{ind}if _t == {T_STRUCT} "
+                  f"and buf[pos:pos + {nl}] == {nb}:",
+                  f"{ind}    {v}, pos = _plan_of({cn}).dec_raw("
+                  f"buf, pos + {nl})",
+                  f"{ind}else:",
+                  f"{ind}    {v}, pos = _FB(buf, pos, _t)"]
+    elif (typing.get_origin(hint) is list and typing.get_args(hint)
+          and (lambda e: isinstance(e[0], type) and is_dataclass(e[0])
+               and _registry.get(e[0].__name__) is e[0])(
+              _unwrap_optional(typing.get_args(hint)[0]))):
+        ecls, eopt = _unwrap_optional(typing.get_args(hint)[0])
+        cn = f"_C{len(ns)}"
+        nb = f"_N{len(ns)}"
+        nl = f"_L{len(ns)}"
+        ns[cn] = ecls
+        hb = _varint(len(ecls.__name__.encode())) + ecls.__name__.encode()
+        ns[nb] = hb
+        ns[nl] = len(hb)
+        none_arm = ([f"{ind}        elif _et == {T_NONE}:",
+                     f"{ind}            _ap(None)"] if eopt else [])
+        lines += [f"{ind}if _t == {T_LIST}:"]
+        _emit_varint_read(lines, ind + "    ", "_n")
+        lines += [f"{ind}    {v} = []",
+                  f"{ind}    _ap = {v}.append",
+                  f"{ind}    _dr = _plan_of({cn}).dec_raw",
+                  f"{ind}    for _ in range(_n):",
+                  f"{ind}        _et = buf[pos]; pos += 1",
+                  f"{ind}        if _et == {T_STRUCT} "
+                  f"and buf[pos:pos + {nl}] == {nb}:",
+                  f"{ind}            _o, pos = _dr(buf, pos + {nl})",
+                  f"{ind}            _ap(_o)",
+                  *none_arm,
+                  f"{ind}        else:",
+                  f"{ind}            _o, pos = _FB(buf, pos, _et)",
+                  f"{ind}            _ap(_o)",
+                  f"{ind}else:",
+                  f"{ind}    {v}, pos = _FB(buf, pos, _t)"]
+    elif typing.get_origin(hint) is list and typing.get_args(hint) \
+            and typing.get_args(hint)[0] in (int, str, bytes):
+        elem = typing.get_args(hint)[0]
+        lines += [f"{ind}if _t == {T_LIST}:"]
+        _emit_varint_read(lines, ind + "    ", "_n")
+        lines += [f"{ind}    {v} = []",
+                  f"{ind}    _ap = {v}.append",
+                  f"{ind}    for _ in range(_n):",
+                  f"{ind}        _et = buf[pos]; pos += 1"]
+        ind2 = ind + "        "
+        if elem is int:
+            lines.append(f"{ind2}if _et == {T_INT}:")
+            _emit_varint_read(lines, ind2 + "    ", "_e")
+            lines.append(f"{ind2}    _ap(_e)")
+            lines.append(f"{ind2}elif _et == {T_NEGINT}:")
+            _emit_varint_read(lines, ind2 + "    ", "_e")
+            lines.append(f"{ind2}    _ap(-_e - 1)")
+        else:
+            tagc = T_STR if elem is str else T_BYTES
+            suffix = ".decode('utf-8')" if elem is str else ""
+            lines.append(f"{ind2}if _et == {tagc}:")
+            _emit_varint_read(lines, ind2 + "    ", "_l")
+            lines += [f"{ind2}    if pos + _l > _blen:",
+                      f"{ind2}        raise ValueError("
+                      f"'serde: truncated input')",
+                      f"{ind2}    _ap(buf[pos:pos + _l]{suffix})",
+                      f"{ind2}    pos += _l"]
+        lines += [f"{ind2}else:",
+                  f"{ind2}    _e, pos = _FB(buf, pos, _et)",
+                  f"{ind2}    _ap(_e)",
+                  f"{ind}else:",
+                  f"{ind}    {v}, pos = _FB(buf, pos, _t)"]
+    else:
+        lines.append(f"{ind}{v}, pos = _FB(buf, pos, _t)")
+        coercer = _compile_coercer(hint)
+        if coercer is not None:
+            cc = f"_c{len(ns)}"
+            ns[cc] = coercer
+            lines.append(f"{ind}{v} = {cc}({v})")
+        return
+    if enum_name is not None:
+        lines.append(f"{ind}if {v} is not None "
+                     f"and not isinstance({v}, {enum_name}):")
+        lines.append(f"{ind}    _m = {enum_map}.get({v})")
+        lines.append(f"{ind}    {v} = _m if _m is not None "
+                     f"else {enum_name}({v})")
+
+
+def _compile_decoder_raw(plan: "_Plan", hints: dict):
+    """exec-generate dec_raw(buf, pos) -> (obj, pos): the compiled
+    decoder over raw buffer offsets.  The reader-object variant paid ~3
+    bound-method calls per field (tag/varint/exact); this emits the
+    byte reads inline — the difference is ~4x on decode-heavy paths
+    (readdir_plus: 128 inodes/listing), which dominated the FUSE
+    listing profile (r5)."""
+    ns: dict = {"_decode_struct_body": _decode_struct_body,
+                "_unpack_d": _unpack_d, "_plan_of": _plan_of,
+                "_FB": _fallback_read, "_Reader": _Reader,
+                "_CLS": plan.cls, "_PLAN": plan}
+    n = len(plan.names)
+    lines = ["def dec_raw(buf, pos):",
+             "    _blen = len(buf)"]
+    _emit_varint_read(lines, "    ", "_nf")
+    lines += ["    if _nf != %d:" % n,
+              "        _r = _Reader(buf)",
+              "        _r.pos = pos",
+              "        _o = _decode_struct_body(_r, _CLS, _PLAN, _nf)",
+              "        return _o, _r.pos"]
+    for i, name in enumerate(plan.names):
+        _emit_read_raw(lines, ns, "    ", f"v{i}", hints.get(name))
+    args = ", ".join(f"v{i}" for i in range(n))
+    lines.append(f"    return _CLS({args}), pos")
+    exec("\n".join(lines), ns)          # noqa: S102 (trusted codegen)
+    return ns["dec_raw"]
+
+
+def _make_dec_shim(dec_raw):
+    """Reader-interface wrapper over a raw decoder (IndexError from a
+    single-byte read past the end becomes the reader's ValueError)."""
+    def dec(r):
+        try:
+            obj, r.pos = dec_raw(r.buf, r.pos)
+        except IndexError:
+            raise ValueError("serde: truncated input") from None
+        return obj
+    return dec
 
 
 def _plan_of(cls: type) -> _Plan:
@@ -632,119 +718,34 @@ def loads(data: bytes | memoryview):
     return _decode(_Reader(bytes(data)))
 
 
-def loads_many(blobs: list, cls: type, *, skip: frozenset = frozenset()
-               ) -> list:
+def loads_many(blobs: list, cls: type) -> list:
     """Decode many same-typed struct blobs with the dispatch hoisted:
     one plan lookup + one expected-header compare per element instead of
     the generic tag walk + registry lookup.  Empty/None blobs decode to
     None (the batched-read convention for raced-away rows).  A blob
     whose header isn't `cls` falls back to the generic decoder —
-    outcome-identical to [loads(b) for b in blobs].
-
-    `skip` names fields to tag-SKIP instead of decode: the bytes are
-    walked but no objects are constructed and the dataclass default is
-    used — for wide structs with one heavy field (Inode.layout: nested
-    struct + list) a caller that only needs attrs saves most of the
-    decode (the FUSE readdirplus page)."""
+    outcome-identical to [loads(b) for b in blobs]."""
     plan = _plan_of(cls)
-    dec = plan.dec if not skip else _partial_decoder(cls, frozenset(skip))
     name_b = cls.__name__.encode()
     hdr = bytes([T_STRUCT]) + _varint(len(name_b)) + name_b
     hlen = len(hdr)
     out = []
-    for b in blobs:
-        if not b:
-            out.append(None)
-            continue
-        b = bytes(b)
-        if b[:hlen] == hdr:
-            r = _Reader(b)
-            r.pos = hlen
-            out.append(dec(r))
-        else:
-            out.append(loads(b))
+    dec_raw = plan.dec_raw
+    ap = out.append
+    try:
+        for b in blobs:
+            if not b:
+                ap(None)
+                continue
+            if type(b) is not bytes:
+                b = bytes(b)
+            if b.startswith(hdr):
+                ap(dec_raw(b, hlen)[0])
+            else:
+                ap(loads(b))
+    except IndexError:
+        raise ValueError("serde: truncated input") from None
     return out
 
 
-_partial_cache: dict = {}
 
-
-def _partial_decoder(cls: type, skip: frozenset):
-    """Codegen a dec(r) that tag-skips the named fields (dataclass
-    defaults fill them) and fast-reads the rest — same structure as the
-    full compiled decoder, same generic bail-out on a field-count
-    mismatch (which decodes fully; harmless, just slower)."""
-    key = (cls, skip)
-    dec = _partial_cache.get(key)
-    if dec is not None:
-        return dec
-    plan = _plan_of(cls)
-    import dataclasses as _dc
-    hints = typing.get_type_hints(cls)
-    # (value, is_factory): factories are embedded as callables and
-    # invoked PER DECODE — a single pre-built instance would be aliased
-    # across every decoded object (shared mutable default)
-    defaults: dict = {}
-    for f in _dc.fields(cls):
-        if f.name in skip:
-            if f.default is not _dc.MISSING:
-                defaults[f.name] = (f.default, False)
-            elif f.default_factory is not _dc.MISSING:  # type: ignore
-                defaults[f.name] = (f.default_factory, True)
-            else:
-                defaults[f.name] = (None, False)
-    ns: dict = {"_decode_with_tag": _decode_with_tag,
-                "_decode_struct_body": _decode_struct_body,
-                "_unpack_d": _unpack_d, "_plan_of": _plan_of,
-                "_struct_by_name": _struct_by_name, "_skip_value": _skip_value,
-                "_CLS": plan.cls, "_PLAN": plan}
-    n = len(plan.names)
-    lines = ["def dec(r):",
-             "    nfields = r.varint()",
-             f"    if nfields != {n}:",
-             "        return _decode_struct_body(r, _CLS, _PLAN, nfields)"]
-    for i, name in enumerate(plan.names):
-        if name in skip:
-            dv = f"_D{i}"
-            val, is_factory = defaults[name]
-            ns[dv] = val
-            lines += [f"    _skip_value(r, r.tag())",
-                      f"    v{i} = {dv}()" if is_factory
-                      else f"    v{i} = {dv}"]
-        else:
-            _emit_read(lines, ns, "    ", f"v{i}", hints.get(name))
-    args = ", ".join(f"v{i}" for i in range(n))
-    lines.append(f"    return _CLS({args})")
-    exec("\n".join(lines), ns)          # noqa: S102 (trusted codegen)
-    dec = _partial_cache[key] = ns["dec"]
-    return dec
-
-
-def _skip_value(r: _Reader, tag: int) -> None:
-    """Advance the reader past one tagged value without constructing it."""
-    if tag in (T_NONE, T_TRUE, T_FALSE):
-        return
-    if tag in (T_INT, T_NEGINT):
-        r.varint()
-        return
-    if tag == T_FLOAT:
-        r.exact(8)
-        return
-    if tag in (T_BYTES, T_STR):
-        r.exact(r.varint())
-        return
-    if tag == T_STRUCT:
-        r.exact(r.varint())               # name
-        for _ in range(r.varint()):       # tagged field values
-            _skip_value(r, r.tag())
-        return
-    if tag == T_LIST:
-        for _ in range(r.varint()):
-            _skip_value(r, r.tag())
-        return
-    if tag == T_MAP:
-        for _ in range(r.varint()):
-            _skip_value(r, r.tag())
-            _skip_value(r, r.tag())
-        return
-    raise ValueError(f"serde: bad tag {tag}")

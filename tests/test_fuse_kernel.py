@@ -229,11 +229,9 @@ def test_readdirplus_batched_attrs():
                 calls["batch"] += 1
                 return await orig_batch(ids)
 
-            async def counting_plus(inode_id, limit=0, user=None,
-                                    attrs_only=False):
+            async def counting_plus(inode_id, limit=0, user=None):
                 calls["plus"] += 1
-                return await orig_plus(inode_id, limit, user=user,
-                                       attrs_only=attrs_only)
+                return await orig_plus(inode_id, limit, user=user)
 
             async def counting_stat(inode_id):
                 calls["stat"] += 1

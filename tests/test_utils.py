@@ -233,6 +233,35 @@ def test_serde_loads_many_matches_loads():
     assert isinstance(out[-1], DirEntry)
 
 
+def test_serde_truncated_raises_valueerror():
+    """Every truncation point must surface serde's ValueError — the raw
+    compiled decoder reads by buffer index (IndexError) and the shim
+    must convert, at any cut point, incl. inside nested structs."""
+    from t3fs.meta.schema import Inode, InodeType
+    from t3fs.client.layout import FileLayout
+    from t3fs.utils import serde
+
+    blob = serde.dumps(Inode(inode_id=7, itype=InodeType.FILE,
+                             layout=FileLayout(chains=[1, 2, 3]),
+                             symlink_target="zzz", mtime=1.5e9))
+    for cut in range(len(blob)):
+        try:
+            serde.loads(blob[:cut])
+        except ValueError:
+            pass
+        else:
+            raise AssertionError(f"no error at cut {cut}")
+        if cut:   # cut 0 is the empty blob -> None by convention
+            try:
+                serde.loads_many([blob[:cut]], Inode)
+            except ValueError:
+                pass
+            else:
+                raise AssertionError(f"loads_many: no error at cut {cut}")
+    assert serde.loads_many([b""], Inode) == [None]
+    assert serde.loads(blob) == serde.loads_many([blob], Inode)[0]
+
+
 def test_serde_fuzz_every_registered_struct():
     """Property test over the ENTIRE wire-type registry: build each
     registered struct with randomized field values (drawn from its type
