@@ -53,11 +53,30 @@ class Transaction:
     async def get_many(self, keys: list[bytes], *,
                        snapshot: bool = False) -> list[bytes | None]:
         """Point-read a batch at one snapshot.  Local engines answer from
-        memory; the REMOTE engines override this into one RPC per shard —
-        callers with N keys (batch_stat, readdirplus) should prefer it
-        over N awaited get()s (r4 verdict: per-key RPCs dropped sharded
-        batch_stat 12.5k -> 1.4k inodes/s)."""
-        return [await self.get(k, snapshot=snapshot) for k in keys]
+        memory under ONE lock acquisition (an N-key batch paid N awaits
+        + N lock round trips before — ~0.4 ms of a 128-entry readdirplus
+        listing, r5); the REMOTE engines override this into one RPC per
+        shard — callers with N keys (batch_stat, readdirplus) should
+        prefer it over N awaited get()s (r4 verdict: per-key RPCs
+        dropped sharded batch_stat 12.5k -> 1.4k inodes/s)."""
+        out: list[bytes | None] = [None] * len(keys)
+        misses: list[tuple[int, bytes]] = []
+        clears = self._range_clears
+        for i, key in enumerate(keys):
+            if key in self._writes:
+                out[i] = self._writes[key]
+                continue
+            if not snapshot:
+                self._read_keys.add(key)
+            if clears and any(b <= key < e for b, e in clears):
+                continue
+            misses.append((i, key))
+        if misses:
+            vals = self.engine._get_at_many([k for _, k in misses],
+                                            self.read_version)
+            for (i, _k), val in zip(misses, vals):
+                out[i] = val
+        return out
 
     async def get_range(self, begin: bytes, end: bytes, *, limit: int = 0,
                         snapshot: bool = False) -> list[tuple[bytes, bytes]]:
@@ -199,6 +218,24 @@ class MemKVEngine(KVEngine):
                 if ver <= version:
                     return val
             return None
+
+    def _get_at_many(self, keys: list[bytes],
+                     version: int) -> list[bytes | None]:
+        """Batch point-read under ONE lock acquisition (the engine-seam
+        twin of _get_at; an N-key readdirplus batch paid N lock round
+        trips through per-key reads, r5)."""
+        out: list[bytes | None] = [None] * len(keys)
+        with self._lock:
+            data = self._data
+            for i, key in enumerate(keys):
+                versions = data.get(key)
+                if not versions:
+                    continue
+                for ver, val in reversed(versions):
+                    if ver <= version:
+                        out[i] = val
+                        break
+        return out
 
     def _range_at(self, begin: bytes, end: bytes, version: int) -> list[tuple[bytes, bytes]]:
         out = []
