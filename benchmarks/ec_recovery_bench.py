@@ -16,6 +16,13 @@ Phases (all timed separately, MB/s of logical stripe data):
     python -m benchmarks.ec_recovery_bench --stripes 24 --json
     (--device runs RS on the accelerator; default numpy keeps the bench
      honest on machines where the chip is tunneled/absent)
+
+With --device the bench also runs a decode microbench on synthetic
+survivors: the fused word-packed decode+verify launch
+(make_stripe_decode_step_words) plus, under --decode-ab, the byte-plane
+bit-matmul kernel for the A/B recorded in docs/codec_economics.md.
+Regardless of flags the bench ends with a one-line JSON decode metric
+(rs{k}+{m}_reconstruct GB/s + degraded-read MB/s) for log scraping.
 """
 
 from __future__ import annotations
@@ -174,6 +181,71 @@ async def _run(args, cluster: LocalCluster, k: int, m: int,
     }
 
 
+def _decode_microbench(args, platform: str | None) -> dict | None:
+    """Kernel-level decode throughput on synthetic survivors (no cluster
+    IO in the way).  Times the fused word-packed decode+verify launch —
+    reconstruct of the 2 lost shards AND CRC32C of all k+|want| shards
+    in ONE kernel pass — and, with --decode-ab, the byte-plane
+    bit-matmul reconstruct for comparison.  GB/s counts survivor bytes
+    in per launch (n*k*L), the same convention as the encode bench.
+
+    On CPU (no accelerator) the Pallas kernels run under the
+    interpreter, so absolute numbers are meaningless; the metric still
+    records them (with "interpret": true) so the path stays exercised.
+    """
+    if not args.device:
+        return None
+    import jax
+
+    from t3fs.ops.blocks import pick_block
+    from t3fs.ops.pallas_codec import (
+        make_rs_reconstruct_pallas, make_rs_reconstruct_words_pallas,
+        make_stripe_decode_step_words,
+    )
+    from t3fs.ops.rs import default_rs
+
+    k, m = args.k, args.m
+    rs_code = default_rs(k, m)
+    interpret = platform == "cpu"
+    # interpret mode walks the grid in python — shrink the problem so the
+    # metric line still appears in CI logs without minutes of warmup
+    L = min(args.chunk_size, 64 << 10) if interpret else args.chunk_size
+    L -= L % 512
+    n = 1 if interpret else max(1, args.decode_batch)
+    present = tuple(range(2, k + m))     # drop shards 0 and 1 (double erasure)
+    want = (0, 1)
+    rng = np.random.default_rng(7)
+    survivors = rng.integers(0, 256, (n, k, L), dtype=np.uint8)
+    words = np.ascontiguousarray(survivors).view(np.uint32).reshape(
+        n, k, L // 4)
+    iters = 1 if interpret else 20
+
+    def gbps(fn, x):
+        out = jax.block_until_ready(fn(x))       # compile + warm
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fn(x)
+        jax.block_until_ready(out)
+        dt = (time.perf_counter() - t0) / iters
+        return round(n * k * L / dt / 1e9, 3)
+
+    res: dict = {"L": L, "batch": n, "interpret": interpret}
+    if rs_code.raid6:
+        fused = jax.jit(make_stripe_decode_step_words(
+            L // 4, present, want, k=k, m=m, interpret=interpret))
+        res["fused_decode_verify_GB_s"] = gbps(fused, words)
+        rec_w = jax.jit(make_rs_reconstruct_words_pallas(
+            present, want, rs_code, block_w=pick_block(L // 4, 16384),
+            interpret=interpret))
+        res["word_reconstruct_GB_s"] = gbps(rec_w, words)
+    if args.decode_ab or not rs_code.raid6:
+        rec_b = jax.jit(make_rs_reconstruct_pallas(
+            present, want, rs_code, block_t=pick_block(L, 32768),
+            interpret=interpret))
+        res["byteplane_reconstruct_GB_s"] = gbps(rec_b, survivors)
+    return res
+
+
 def parse_args(argv=None):
     ap = argparse.ArgumentParser(prog="ec_recovery_bench")
     ap.add_argument("--nodes", type=int, default=5)
@@ -184,6 +256,11 @@ def parse_args(argv=None):
     ap.add_argument("--concurrency", type=int, default=4)
     ap.add_argument("--device", action="store_true",
                     help="RS encode/decode on the accelerator")
+    ap.add_argument("--decode-ab", action="store_true",
+                    help="with --device: also time the byte-plane "
+                         "reconstruct kernel for the word-vs-byte A/B")
+    ap.add_argument("--decode-batch", type=int, default=4,
+                    help="stripes per launch in the decode microbench")
     ap.add_argument("--json", action="store_true")
     return ap.parse_args(argv)
 
@@ -197,11 +274,20 @@ def main(argv=None) -> int:
     result = asyncio.run(run_bench(args))
     if platform is not None:
         result["platform"] = platform
+    micro = _decode_microbench(args, platform)
+    if micro is not None:
+        result["decode_microbench"] = micro
     if args.json:
         print(json.dumps(result))
     else:
         for kk, v in result.items():
             print(f"{kk:>20}: {v}")
+    # one-line scrapable decode metric, printed in BOTH output modes
+    print(json.dumps({"decode_metric": {
+        f"rs{args.k}+{args.m}_reconstruct_GB_s":
+            (micro or {}).get("fused_decode_verify_GB_s"),
+        "degraded_read_MB_s": result["degraded_read_MB_s"],
+    }}))
     return 0
 
 

@@ -8,6 +8,9 @@ chunks, each of the k+m shards on a different chain (replication factor 1 —
 parity replaces replication), encoded/decoded by the word-packed Pallas
 kernels (t3fs.client.ec_codec — the same configuration bench.py measures)
 on the co-located TPU, with concurrent stripes micro-batched per launch.
+Reconstruction runs the fused decode+verify step: one launch rebuilds the
+missing shards AND returns their CRC32Cs, which repair write-back hands to
+write_chunk so rebuilt full chunks skip the host crc32c entirely.
 
 Addressing: data chunk j of stripe s  -> ChunkId(inode, s*k + j)
             parity chunk p of stripe s -> ChunkId(inode | PARITY_NS, s*m + p)
@@ -146,6 +149,19 @@ class ECStorageClient:
             return default_rs(k, m).decode_ref(shards, list(want))
         return await asyncio.to_thread(run)
 
+    async def _reconstruct_verified(self, present_rows: np.ndarray,
+                                    present: tuple[int, ...],
+                                    want: tuple[int, ...], k: int, m: int
+                                    ) -> tuple[np.ndarray, np.ndarray | None]:
+        """Decode + shard CRCs in ONE device launch (the fused
+        decode+verify step); the numpy oracle has no fused CRC, so it
+        returns None and callers fall back to the host crc32c."""
+        if self.codec is not None:
+            return await self.codec.reconstruct_verified(
+                present_rows, present, want, k, m)
+        return await self._reconstruct(present_rows, present, want,
+                                       k, m), None
+
     async def close(self) -> None:
         if self.codec is not None:
             await self.codec.close()
@@ -221,9 +237,9 @@ class ECStorageClient:
         missing.sort()
         if missing:
             zero_shards = frozenset(j for j in range(k) if lens[j] == 0)
-            rec = await self._reconstruct_shards(layout, inode, stripe,
-                                                 tuple(missing), zero_shards,
-                                                 known=chunks)
+            rec, _crcs = await self._reconstruct_shards(
+                layout, inode, stripe, tuple(missing), zero_shards,
+                known=chunks)
             for j, content in zip(missing, rec):
                 chunks[j] = content[: lens[j]]
         return b"".join(chunks[j][: lens[j]].ljust(lens[j], b"\x00")
@@ -234,9 +250,12 @@ class ECStorageClient:
                                   zero_shards: frozenset[int],
                                   known: dict[int, bytes] | None = None,
                                   prefer: tuple[int, ...] | None = None
-                                  ) -> list[bytes]:
+                                  ) -> tuple[list[bytes], list[int | None]]:
         """Fetch enough surviving shards (data we already have + parity +
         other data) and decode the wanted shard indices (0..k+m-1 space).
+        Returns (contents, crcs) aligned with `want`: crc is the DEVICE
+        CRC32C of the full-chunk content when the fused decode+verify step
+        produced the shard, else None (directly-recovered / oracle path).
 
         `zero_shards` lists data shards the CALLER knows were never written
         (short stripe) — only those may be substituted with zeros on
@@ -312,16 +331,24 @@ class ECStorageClient:
         # shards recovered directly need no decoding
         still_want = tuple(s for s in want if s not in have)
         decoded: dict[int, bytes] = {}
+        crc_of: dict[int, int] = {}
         if still_want:
             # recovered want-shards may serve as decode inputs; only the
             # still-missing ones must stay out of the present set
             present = tuple(sorted(s for s in have.keys()
                                    if s not in still_want)[:k])
             rows = np.stack([have[s] for s in present])
-            out = await self._reconstruct(rows, present, still_want, k, m)
+            out, crcs = await self._reconstruct_verified(
+                rows, present, still_want, k, m)
             decoded = {s: bytes(out[i]) for i, s in enumerate(still_want)}
-        return [decoded[s] if s in decoded else bytes(have[s])
-                for s in want]
+            if crcs is not None:
+                # fused-step layout: k survivor CRCs, then the rebuilt
+                # shards' CRCs in still_want order
+                crc_of = {s: int(crcs[k + i])
+                          for i, s in enumerate(still_want)}
+        return ([decoded[s] if s in decoded else bytes(have[s])
+                 for s in want],
+                [crc_of.get(s) for s in want])
 
     async def repair_chunk(self, layout: ECLayout, inode: int, stripe: int,
                            shard: int, stripe_len: int) -> IOResult:
@@ -354,19 +381,25 @@ class ECStorageClient:
         # one means ensuring absence, not REPLACE-writing an empty chunk
         holes = [s for s in shards if s in zero_shards]
         lost = tuple(s for s in shards if s not in zero_shards)
-        rec = (await self._reconstruct_shards(layout, inode, stripe, lost,
-                                              zero_shards,
-                                              prefer=read_shards)
-               if lost else [])
+        rec, crcs = (await self._reconstruct_shards(layout, inode, stripe,
+                                                    lost, zero_shards,
+                                                    prefer=read_shards)
+                     if lost else ([], []))
 
-        async def write_back(shard: int, content: bytes) -> IOResult:
+        async def write_back(shard: int, content: bytes,
+                             crc: int | None) -> IOResult:
             cid = (layout.data_chunk(inode, stripe, shard) if shard < k
                    else layout.parity_chunk(inode, stripe, shard - k))
             if shard < k:
                 content = content[: lens[shard]]
+            if len(content) != cs:
+                # truncated data shard: the device CRC covers the full
+                # chunk, not the tail-trimmed bytes — let the client re-CRC
+                crc = None
             return await self.sc.write_chunk(
                 layout.shard_chain(stripe, shard), cid, 0, bytes(content),
-                chunk_size=cs, update_type=UpdateType.REPLACE)
+                chunk_size=cs, update_type=UpdateType.REPLACE,
+                checksum=crc)
 
         async def remove_hole(shard: int) -> IOResult:
             return await self.sc.write_chunk(
@@ -375,7 +408,7 @@ class ECStorageClient:
                 chunk_size=cs, update_type=UpdateType.REMOVE)
 
         done = dict(zip(lost, await asyncio.gather(
-            *(write_back(s, c) for s, c in zip(lost, rec)))))
+            *(write_back(s, c, crc) for s, c, crc in zip(lost, rec, crcs)))))
         done.update(zip(holes, await asyncio.gather(
             *(remove_hole(s) for s in holes))))
         return [done[s] for s in shards]

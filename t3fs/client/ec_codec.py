@@ -8,11 +8,17 @@ reconstruct through the SAME kernels the bench measures:
   - encode: `make_rs_encode_words_pallas` — the RAID-6 SWAR word kernel
     (P = xor-reduce, Q = g^i multiply-accumulate over uint32 words), the
     parity half of bench.py's `make_stripe_encode_step_words`;
-  - reconstruct: `make_rs_reconstruct_pallas` — the GF(2) bit-matmul
-    kernel with the decode matrix baked in.
+  - reconstruct: `make_rs_reconstruct_words_pallas` — the decode-side word
+    kernel (GF(2^8) decode constants as SWAR xtimes/xor chains), with the
+    byte-plane `make_rs_reconstruct_pallas` bit-matmul reachable only as
+    the non-RAID-6 fallback;
+  - reconstruct_verified: `make_stripe_decode_step_words` — the fused
+    decode+verify step; one launch rebuilds the missing shards AND returns
+    CRC32Cs of survivors + rebuilt, so degraded reads/repair pay no
+    per-shard CPU crc32c after the device round trip.
 
 `jax_codec` stays as the oracle and the fallback for non-RAID-6 (k, m)
-codes (the word kernel is m=2-specific).  Platform dispatch (r3 verdict
+codes (the word kernels are m=2-specific).  Platform dispatch (r3 verdict
 weak #3: interpreted-Pallas as the only CPU path cost a 3-4x regression
 on CPU fabrics): a real accelerator gets the Pallas word kernels; the
 CPU backend gets the compiled XLA bit-matmul path, with
@@ -66,7 +72,8 @@ from t3fs.ops.blocks import pick_block as _pick_block
 class ECCodec:
     """Batched device codec for EC stripes with a per-shape jit cache.
 
-    kind keys: ("enc", k, m, L) and ("rec", present, want, k, m, L);
+    kind keys: ("enc", k, m, L), ("rec", present, want, k, m, L) and
+    ("recv", present, want, k, m, L) — the fused decode+verify step;
     requests under one key stack into a single kernel call.
     """
 
@@ -81,7 +88,9 @@ class ECCodec:
         self._use_pallas: bool | None = None
         self._closed = False
         # observability: which codec implementation served each call
-        # ("pallas-words" | "pallas-bitmatmul" | "xla-bitmatmul")
+        # ("pallas-words" | "pallas-rec-words" | "pallas-decode-words" |
+        #  "pallas-bitmatmul" | "xla-bitmatmul"); warmup compiles count too
+        # (they run the same fns on the same codec thread)
         self.codec_counts: dict[str, int] = {}
         self.last_codec: str | None = None
         self.batches = 0
@@ -101,6 +110,19 @@ class ECCodec:
         """(k, L) uint8 present shards -> (len(want), L) uint8."""
         L = present_rows.shape[-1]
         return await self._submit(("rec", present, want, k, m, L),
+                                  present_rows)
+
+    async def reconstruct_verified(self, present_rows: np.ndarray,
+                                   present: tuple[int, ...],
+                                   want: tuple[int, ...], k: int, m: int
+                                   ) -> tuple[np.ndarray, np.ndarray]:
+        """(k, L) uint8 present shards -> (rebuilt (len(want), L) uint8,
+        crcs (k + len(want),) uint32): decode + CRC32C of survivors (in
+        `present` order) and rebuilt shards (in `want` order), all from the
+        SAME device launch — the degraded-read path pays no per-shard CPU
+        crc32c after the round trip."""
+        L = present_rows.shape[-1]
+        return await self._submit(("recv", present, want, k, m, L),
                                   present_rows)
 
     async def close(self) -> None:
@@ -172,10 +194,14 @@ class ECCodec:
         for key, items in groups.items():
             fn = self._fn(key)
             stacked = np.stack([it.rows for it in items])
-            out = np.asarray(fn(stacked))
+            out = fn(stacked)
             for i, it in enumerate(items):
+                # fused steps return a tuple of stacked arrays (shards,
+                # crcs); each caller gets its row of every output
+                res = (tuple(o[i] for o in out) if isinstance(out, tuple)
+                       else np.asarray(out)[i])
                 it.loop.call_soon_threadsafe(
-                    _set_result_safe, it.future, out[i])
+                    _set_result_safe, it.future, res)
 
     # --- kernel selection + jit cache ---
 
@@ -184,6 +210,13 @@ class ECCodec:
         if fn is not None:
             return fn
         import jax
+
+        # on-disk executable cache: decode-kernel compiles are paid once
+        # per machine, not once per process (same rationale as the
+        # checksum backend — a ~10 s Mosaic compile on the first degraded
+        # read after a node loss is exactly what warmup_decode avoids)
+        from t3fs.storage.codec_backend import _enable_persistent_cache
+        _enable_persistent_cache()
 
         if self._interpret is None:
             # CPU backend (real accelerators may register under plugin
@@ -198,6 +231,8 @@ class ECCodec:
             self._use_pallas = (not cpu) or force
         if key[0] == "enc":
             fn = self._build_encode(key)
+        elif key[0] == "recv":
+            fn = self._build_reconstruct_verified(key)
         else:
             fn = self._build_reconstruct(key)
         self._fns[key] = fn
@@ -251,10 +286,32 @@ class ECCodec:
 
         import jax
 
-        from t3fs.ops.pallas_codec import make_rs_reconstruct_pallas
         from t3fs.ops.rs import default_rs
 
         rs = default_rs(k, m)
+        if rs.raid6 and L % 4 == 0:
+            # RAID-6 decode stays word-packed: the GF(2^8) decode constants
+            # run as SWAR xtimes/xor chains at encode-class rates (the
+            # byte-plane bit-matmul below is ~8-16 GB/s; this is the
+            # degraded-read/repair hot path)
+            from t3fs.ops.pallas_codec import make_rs_reconstruct_words_pallas
+            W = L // 4
+            bw = _pick_block(W, 16384)
+            raw = jax.jit(make_rs_reconstruct_words_pallas(
+                present, want, rs, block_w=bw, interpret=self._interpret))
+            nwant = len(want)
+
+            def reconstruct_words(stacked: np.ndarray) -> np.ndarray:
+                self._count("pallas-rec-words")
+                words = stacked.view(np.uint32).reshape(
+                    stacked.shape[0], k, W)
+                out = np.asarray(raw(words))
+                return out.view(np.uint8).reshape(out.shape[0], nwant, L)
+            return reconstruct_words
+
+        # non-RAID-6 (k, m) / odd lengths: byte-plane bit-matmul fallback
+        from t3fs.ops.pallas_codec import make_rs_reconstruct_pallas
+
         bt = _pick_block(L, 32768)
         raw = jax.jit(make_rs_reconstruct_pallas(
             present, want, rs, block_t=bt, interpret=self._interpret))
@@ -263,3 +320,99 @@ class ECCodec:
             self._count("pallas-bitmatmul")
             return np.asarray(raw(stacked))
         return reconstruct
+
+    def _build_reconstruct_verified(self, key: tuple) -> Callable:
+        """Fused decode+verify: one launch returns (rebuilt, crcs) where
+        crcs covers survivors + rebuilt shards.  Word-fused on RAID-6
+        512-multiple chunks; otherwise an XLA-fused program (still one
+        device round trip, still no CPU crc32c)."""
+        _kind, present, want, k, m, L = key
+        import jax
+
+        from t3fs.ops.rs import default_rs
+
+        rs = default_rs(k, m)
+        nwant = len(want)
+        if self._use_pallas and rs.raid6 and L % 512 == 0:
+            from t3fs.ops.pallas_codec import make_stripe_decode_step_words
+            step = jax.jit(make_stripe_decode_step_words(
+                L // 4, present, want, k, m, interpret=self._interpret))
+
+            def decode_words(stacked: np.ndarray
+                             ) -> tuple[np.ndarray, np.ndarray]:
+                self._count("pallas-decode-words")
+                words = stacked.view(np.uint32).reshape(
+                    stacked.shape[0], k, L // 4)
+                rebuilt, crcs = step(words)
+                rebuilt = np.asarray(rebuilt).view(np.uint8).reshape(
+                    stacked.shape[0], nwant, L)
+                return rebuilt, np.asarray(crcs)
+            return decode_words
+
+        import jax.numpy as jnp
+
+        from t3fs.ops import jax_codec
+
+        recf = jax_codec.make_rs_reconstruct(present, want, rs)
+        crcf = jax_codec.make_crc32c_batch(L)
+
+        @jax.jit
+        def fused(stacked):
+            rebuilt = recf(stacked)
+            n = stacked.shape[0]
+            scrc = crcf(stacked.reshape(n * k, L)).reshape(n, k)
+            rcrc = crcf(rebuilt.reshape(n * nwant, L)).reshape(n, nwant)
+            return rebuilt, jnp.concatenate([scrc, rcrc], axis=1)
+
+        def decode_xla(stacked: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+            self._count("xla-bitmatmul")
+            rebuilt, crcs = fused(stacked)
+            return np.asarray(rebuilt), np.asarray(crcs)
+        return decode_xla
+
+    # --- decode warmup (DeviceChecksumBackend.warmup analog) ---
+
+    def warmup_decode(self, patterns: list[tuple[tuple[int, ...],
+                                                 tuple[int, ...]]],
+                      L: int, k: int = 8, m: int = 2,
+                      batch_sizes: tuple[int, ...] = (1,)) -> None:
+        """Precompile the hot (present, want, L) reconstruct kernels
+        off-path — call at server start / when a node loss is detected, so
+        the FIRST degraded read doesn't eat a multi-second Mosaic compile
+        on the read path.  Mirrors DeviceChecksumBackend.warmup: each
+        compile is its own job on the codec thread, so close() (shutdown
+        with cancel_futures) drops whatever hasn't started."""
+        from concurrent.futures import CancelledError
+
+        from t3fs.storage.codec_backend import _enable_persistent_cache
+
+        _enable_persistent_cache()
+
+        def one(key: tuple, nb: int) -> None:
+            if self._closed:
+                return
+            try:
+                arr = np.zeros((nb, key[3], key[5]), dtype=np.uint8)
+                self._fn(key)(arr)
+            except Exception:
+                # a failed precompile must be LOUD (the affected pattern
+                # pays the compile on the first degraded read) but must not
+                # abort the rest of the warmup
+                log.exception("EC decode warmup compile failed "
+                              "(key=%s, n=%d)", key, nb)
+
+        futs = []
+        for present, want in patterns:
+            key = ("recv", tuple(present), tuple(want), k, m, L)
+            for nb in batch_sizes:
+                if self._closed:
+                    return
+                try:
+                    futs.append(self._pool.submit(one, key, nb))
+                except RuntimeError:   # pool already shut down
+                    return
+        for f in futs:
+            try:
+                f.result()
+            except CancelledError:
+                return

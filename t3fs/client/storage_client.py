@@ -156,8 +156,14 @@ class StorageClient:
     async def write_chunk(self, chain_id: int, chunk_id: ChunkId, offset: int,
                           data: bytes, chunk_size: int,
                           update_type: UpdateType = UpdateType.WRITE,
-                          truncate_len: int = 0) -> IOResult:
-        """One chunk-granular CRAQ write (retries are seq-stable)."""
+                          truncate_len: int = 0,
+                          checksum: int | None = None) -> IOResult:
+        """One chunk-granular CRAQ write (retries are seq-stable).
+
+        `checksum` is an optional precomputed CRC32C of `data` (e.g. the EC
+        client's fused device decode+verify step): when given, the host-side
+        crc32c is skipped — the caller vouches for the bytes it computed
+        the CRC over."""
         channel, seq = await self.channels.acquire()
         try:
             io = UpdateIO(
@@ -165,7 +171,9 @@ class StorageClient:
                 update_type=update_type, offset=offset,
                 length=len(data) if update_type == UpdateType.WRITE else truncate_len,
                 chunk_size=chunk_size,
-                checksum=crc32c_ref(data) if (self.cfg.generate_checksums and data) else 0,
+                checksum=(checksum if checksum is not None else
+                          crc32c_ref(data)
+                          if (self.cfg.generate_checksums and data) else 0),
                 channel=channel, channel_seq=seq,
                 client_id=self.client_id, inline=True,
                 debug=self.cfg.debug)

@@ -28,7 +28,10 @@ class StorageClientInMem:
     async def write_chunk(self, chain_id: int, chunk_id: ChunkId, offset: int,
                           data: bytes, chunk_size: int,
                           update_type: UpdateType = UpdateType.WRITE,
-                          truncate_len: int = 0) -> IOResult:
+                          truncate_len: int = 0,
+                          checksum: int | None = None) -> IOResult:
+        # checksum: accepted for StorageClient duck-type parity (EC repair
+        # passes device-computed CRCs); the fake always re-CRCs itself.
         key = (chain_id, chunk_id)
         cur = self.chunks.get(key, _Chunk())
         if update_type == UpdateType.TRUNCATE:

@@ -1,4 +1,4 @@
-"""Mesh-sharded RS(8+2)+CRC32C encode — the multi-chip data plane.
+"""Mesh-sharded RS(8+2)+CRC32C encode/decode — the multi-chip data plane.
 
 Parallelism mapping (SURVEY.md §2.9/§5.7): a file-system's "parallelism" is
 data distribution.  On a TPU pod slice the codec pipeline shards two ways:
@@ -191,37 +191,58 @@ def make_sharded_reconstruct_step_words(mesh: Mesh, chunk_len: int,
                                         want: tuple[int, ...],
                                         k: int = 8, m: int = 2,
                                         interpret: bool = False):
-    """Word-kernel recovery path under the mesh: the Pallas bit-matmul
-    reconstruct (same kernel the EC client ships) decodes each device's
-    local span, and the rebuilt shards' CRCs ride the word-kernel CRC +
-    cp psum.
+    """Word-kernel recovery path under the mesh: the SWAR word
+    reconstruct (same kernel the EC client ships for RAID-6) decodes
+    each device's local span with bytes kept packed 4-per-uint32-lane,
+    and the rebuilt shards' CRCs ride the word-kernel CRC + cp psum.
+    Non-RAID-6 codes fall back to the byte-plane bit-matmul kernel.
 
       survivors (n, k, chunk_len) uint8 sharded P('dp', None, 'cp')
         -> rebuilt (n, |want|, chunk_len) uint8 same sharding,
            crcs (n, |want|) uint32 replicated over cp.
     """
-    from t3fs.ops.pallas_codec import make_rs_reconstruct_pallas
+    from t3fs.ops.blocks import pick_block
+    from t3fs.ops.pallas_codec import (
+        make_rs_reconstruct_pallas, make_rs_reconstruct_words_pallas,
+    )
 
     cp = mesh.shape["cp"]
     assert chunk_len % (4 * cp) == 0, (chunk_len, cp)
     local_len = chunk_len // cp
     local_words, raw_bits, crc_combine = _crc_combine_words_setup(
         mesh, chunk_len // 4, interpret)
-    from t3fs.ops.blocks import pick_block
-    rec = make_rs_reconstruct_pallas(present, want, default_rs(k, m),
-                                     block_t=pick_block(local_len, 32768),
-                                     interpret=interpret)
+    rs = default_rs(k, m)
     w = len(want)
+    if rs.raid6:
+        rec_words = make_rs_reconstruct_words_pallas(
+            present, want, rs, block_w=pick_block(local_words, 16384),
+            interpret=interpret)
 
-    def local_step(survivors: jax.Array):
-        n = survivors.shape[0]              # (n_local, k, local_len) uint8
-        rebuilt = rec(survivors)
-        # free little-endian view of the rebuilt bytes as uint32 words
-        # (same layout as numpy .view(np.uint32) on the host)
-        words = jax.lax.bitcast_convert_type(
-            rebuilt.reshape(n * w, local_words, 4), jnp.uint32)
-        crcs = crc_combine(raw_bits(words), n, w)
-        return rebuilt, crcs
+        def local_step(survivors: jax.Array):
+            n = survivors.shape[0]          # (n_local, k, local_len) uint8
+            # free little-endian reinterpret to packed uint32 words (same
+            # layout as numpy .view(np.uint32) on the host), decode in
+            # word space, reinterpret back — no unpack/repack passes
+            words = jax.lax.bitcast_convert_type(
+                survivors.reshape(n, k, local_words, 4), jnp.uint32)
+            rwords = rec_words(words)       # (n, w, local_words) uint32
+            rebuilt = jax.lax.bitcast_convert_type(
+                rwords, jnp.uint8).reshape(n, w, local_len)
+            crcs = crc_combine(
+                raw_bits(rwords.reshape(n * w, local_words)), n, w)
+            return rebuilt, crcs
+    else:
+        rec = make_rs_reconstruct_pallas(present, want, rs,
+                                         block_t=pick_block(local_len, 32768),
+                                         interpret=interpret)
+
+        def local_step(survivors: jax.Array):
+            n = survivors.shape[0]          # (n_local, k, local_len) uint8
+            rebuilt = rec(survivors)
+            words = jax.lax.bitcast_convert_type(
+                rebuilt.reshape(n * w, local_words, 4), jnp.uint32)
+            crcs = crc_combine(raw_bits(words), n, w)
+            return rebuilt, crcs
 
     mapped = jax.shard_map(
         local_step, mesh=mesh,

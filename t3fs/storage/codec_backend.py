@@ -115,6 +115,11 @@ class DeviceChecksumBackend(ChecksumBackend):
     # --- public API ---
 
     async def payload_crc(self, data: bytes) -> int:
+        if self._closed:
+            # fail fast: enqueueing after close() would RESTART the worker
+            # below and either hang (pool gone) or fail late — shutdown
+            # races surface as a clean backend-closed error instead
+            raise make_closed_error()
         if len(data) < self.min_device_bytes:
             return cpu_crc32c(data)
         if self._worker is None or self._worker.done():

@@ -83,6 +83,25 @@ def test_close_fails_inflight_futures():
     run(body())
 
 
+def test_payload_crc_after_close_fails_fast():
+    """Regression: payload_crc() AFTER close() used to re-spawn the worker
+    task and enqueue into a dead pool (hang or late failure).  It must
+    fail fast with the backend-closed StatusError — and must NOT restart
+    the worker — even for sub-threshold payloads that would otherwise
+    take the host path."""
+    from t3fs.utils.status import StatusError
+
+    async def body():
+        b = DeviceChecksumBackend(min_device_bytes=0)
+        await b.close()
+        with pytest.raises(StatusError, match="closed"):
+            await b.payload_crc(b"x" * 1024)
+        with pytest.raises(StatusError, match="closed"):
+            await b.payload_crc(b"tiny")      # small-payload path too
+        assert b._worker is None              # close() killed it; not revived
+    run(body())
+
+
 def test_null_backend_end_to_end_write_read_verify():
     """null backend must be self-consistent: writes store 0, appends combine
     to 0, reads with verify_checksum pass (nothing spuriously mismatches)."""

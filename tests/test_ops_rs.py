@@ -93,3 +93,42 @@ def test_jax_reconstruct_two_erasures():
     for b in range(2):
         for r, idx in enumerate(lost):
             np.testing.assert_array_equal(got[b, r], full[b, idx])
+
+
+def test_xtimes_chain_decomposition_matches_gf_mul():
+    """Host-level pin of the SWAR decode construction: c*x over GF(2^8)
+    equals XOR over the set bits b of c of xtimes^b(x) — the identity
+    make_rs_reconstruct_words_pallas compiles each decode coefficient
+    into (shared xtimes ladder + XOR taps)."""
+    gf = default_field()
+    rng = np.random.default_rng(5)
+    xs = rng.integers(0, 256, 64, dtype=np.uint8)
+    two = np.uint8(2)
+    for c in range(256):
+        acc = np.zeros_like(xs)
+        t = xs.copy()
+        for b in range(8):
+            if (c >> b) & 1:
+                acc ^= t
+            t = gf.mul(t, two)                 # xtimes: one ladder rung
+        np.testing.assert_array_equal(
+            acc, gf.mul(np.uint8(c), xs), err_msg=f"c={c}")
+
+
+def test_reconstruct_gfmatrix_roundtrip_all_masks():
+    """The decode matrix W = G[want] @ inv(G[present]) rebuilds every
+    single/double erasure of RS(8+2) when applied by plain gf.matmul —
+    the host-side ground truth the word kernel's coefficients come from."""
+    rs = default_rs()
+    rng = np.random.default_rng(6)
+    data = rng.integers(0, 256, (8, 64), dtype=np.uint8)
+    full = np.concatenate([data, rs.encode_ref(data)], axis=0)
+    n = rs.k + rs.m
+    masks = [(a,) for a in range(n)] + [
+        (a, b) for a in range(n) for b in range(a + 1, n)]
+    assert len(masks) == 55
+    for lost in masks:
+        present = [i for i in range(n) if i not in lost][:rs.k]
+        W = rs.reconstruct_gfmatrix(present, list(lost))
+        got = rs.gf.matmul(W, full[present])
+        np.testing.assert_array_equal(got, full[list(lost)])
