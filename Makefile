@@ -17,10 +17,16 @@ SAN_TESTS := tests/test_native_engine.py tests/test_usrbio.py \
              tests/test_storage_service.py tests/test_native_net.py
 SAN_FILTER := -k "not device"
 
-.PHONY: test sanitize sanitize-thread sanitize-address probe on-device ci
+.PHONY: test sanitize sanitize-thread sanitize-address probe on-device ci \
+        ckpt-bench
 
 test:
 	$(PY) -m pytest tests/ -x -q
+
+# Checkpoint save/restore throughput (median of --runs fresh clusters
+# per docs/bench_protocol.md); add --kill for the degraded-restore phase.
+ckpt-bench:
+	$(PY) -m benchmarks.ckpt_bench --json
 
 # Bounded TPU-tunnel probe; ALWAYS appends a dated record to
 # DEVICE_PROBE_LOG.jsonl (proof the chip was retried, r3 verdict #1).

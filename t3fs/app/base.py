@@ -61,7 +61,10 @@ def setup_logging(cfg: LogConfig, name: str) -> None:
 
 def parse_overrides(pairs: list[str]) -> dict:
     """--set a.b=3 style overrides; values parsed as TOML scalars."""
-    import tomllib
+    try:
+        import tomllib
+    except ImportError:
+        import tomli as tomllib  # type: ignore[no-redef]
     out = {}
     for pair in pairs:
         key, eq, raw = pair.partition("=")
@@ -142,7 +145,10 @@ class ApplicationBase:
         if args.config:
             # apply ONLY the keys present in the file — dumping a parsed
             # config object would clobber template values with defaults
-            import tomllib
+            try:
+                import tomllib
+            except ImportError:
+                import tomli as tomllib  # type: ignore[no-redef]
             with open(args.config, "rb") as f:
                 base.update(tomllib.load(f), hot_only=False)
         if args.set:

@@ -787,6 +787,78 @@ async def trash_clean(ctx: AdminContext, args) -> None:
     print(f"removed {len(removed)}: {removed}")
 
 
+# ---------------- checkpoints ----------------
+
+async def _ckpt_store(ctx: AdminContext, directory: str):
+    from t3fs.ckpt import CheckpointStore
+    fs = await ctx.fs()
+    return fs, CheckpointStore(fs, directory)
+
+
+@command("ckpt-list", "committed checkpoint steps in a directory")
+@args_(("directory", {}))
+async def ckpt_list(ctx: AdminContext, args) -> None:
+    _, store = await _ckpt_store(ctx, args.directory)
+    rows = []
+    for step in await store.list_steps():
+        man = await store.load(step)
+        rows.append([step, len(man.leaves), man.total_bytes(),
+                     time.strftime("%Y-%m-%d %H:%M:%S",
+                                   time.localtime(man.created_at))])
+    print(_fmt_table(rows, ["step", "leaves", "bytes", "created"]))
+
+
+@command("ckpt-stat", "one checkpoint's manifest: layout + per-leaf shard map")
+@args_(("directory", {}),
+       ("--step", {"type": int, "default": None,
+                   "help": "default: latest committed"}))
+async def ckpt_stat(ctx: AdminContext, args) -> None:
+    _, store = await _ckpt_store(ctx, args.directory)
+    man = await store.load(args.step)
+    lay = man.layout
+    print(f"step={man.step} leaves={len(man.leaves)} "
+          f"bytes={man.total_bytes()} "
+          f"rs=({lay.k}+{lay.m}) chunk_size={lay.chunk_size} "
+          f"chains={lay.chains}")
+    rows = [[lf.path, lf.dtype, "x".join(map(str, lf.shape)) or "-",
+             lf.nbytes, lf.num_stripes, f"{lf.inode:#x}"]
+            for lf in man.leaves]
+    print(_fmt_table(rows, ["path", "dtype", "shape", "bytes", "stripes",
+                            "inode"]))
+
+
+@command("ckpt-verify", "scrub a checkpoint's shards against manifest CRCs")
+@args_(("directory", {}),
+       ("--step", {"type": int, "default": None}),
+       ("--repair", {"action": "store_true",
+                     "help": "re-encode lost/corrupt shards in place"}))
+async def ckpt_verify(ctx: AdminContext, args) -> None:
+    from t3fs.ckpt import CheckpointReader
+    from t3fs.client.ec_client import ECStorageClient
+    fs = await ctx.fs()
+    ec = ECStorageClient(await ctx.storage_client())
+    try:
+        reader = CheckpointReader(ec, fs, args.directory)
+        rep = await reader.scrub(args.step, repair=args.repair)
+    finally:
+        await ec.close()
+    print(f"checked={rep.shards_checked} missing={rep.shards_missing} "
+          f"corrupt={rep.shards_corrupt} repaired={rep.shards_repaired} "
+          f"unrecoverable={rep.stripes_unrecoverable}")
+    if rep.stripes_unrecoverable:
+        raise SystemExit(1)
+
+
+@command("ckpt-gc", "keep the newest N checkpoints, reclaim the rest")
+@args_(("directory", {}),
+       ("--keep", {"type": int, "required": True, "metavar": "N"}))
+async def ckpt_gc(ctx: AdminContext, args) -> None:
+    _, store = await _ckpt_store(ctx, args.directory)
+    rep = await store.gc(await ctx.storage_client(), args.keep)
+    print(f"kept={rep.steps_kept} removed={rep.steps_removed} "
+          f"leaves={rep.leaves_removed} bytes={rep.bytes_removed}")
+
+
 # ---------------- storage ----------------
 
 @command("space-info", "capacity/used/free of a storage node")

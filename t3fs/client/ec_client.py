@@ -90,6 +90,49 @@ class ECLayout:
     def parity_chunk(self, inode: int, stripe: int, p: int) -> ChunkId:
         return ChunkId(inode | PARITY_NS, stripe * self.m + p)
 
+    def data_file_layout(self):
+        """A FileLayout whose chain_of() reproduces THIS layout's data-chunk
+        placement: data chunk idx (= stripe*k + j) lives on
+        chains[((idx//k)*(k+m) + idx%k) % n], which is periodic in idx with
+        period k*n — so plain StorageClient.read_file_ranges serves healthy
+        EC reads (e.g. resharded checkpoint restore) with no EC-aware
+        plumbing; only stripes with failed shards need read_stripe."""
+        from t3fs.client.layout import FileLayout
+        n = len(self.chains)
+        chains = [self.chains[((i // self.k) * (self.k + self.m)
+                               + i % self.k) % n]
+                  for i in range(self.k * n)]
+        return FileLayout(chunk_size=self.chunk_size, chains=chains)
+
+
+@dataclass
+class StripeEncoding:
+    """One encoded stripe, ready to write shard-by-shard: the k data shards
+    (tail-trimmed to their true lengths; b"" for zero holes) followed by the
+    m full-size parity shards, with the CRC32C each chunk will carry once
+    stored (device-computed by the fused encode+CRC step for full shards;
+    host crc32c only for the at-most-one trimmed tail shard; 0 for holes)."""
+    lens: list[int]             # per data shard true length (0 = hole)
+    contents: list[bytes]       # k+m stored contents in shard order
+    crcs: list[int]             # CRC32C of contents[i]; 0 for holes
+
+
+class ChainAdmission:
+    """Per-chain admission window: bounds in-flight chunk writes per chain so
+    one slow chain backpressures only its own shards, not the whole fan-out
+    (the checkpoint writer's per-chain window; the fleet-wide stripe window
+    is the caller's own semaphore)."""
+
+    def __init__(self, per_chain: int = 2):
+        self.per_chain = per_chain
+        self._sems: dict[int, asyncio.Semaphore] = {}
+
+    def sem(self, chain_id: int) -> asyncio.Semaphore:
+        sem = self._sems.get(chain_id)
+        if sem is None:
+            sem = self._sems[chain_id] = asyncio.Semaphore(self.per_chain)
+        return sem
+
 
 class ECStorageClient:
     """Stripe-granular EC write/read/repair over a StorageClient."""
@@ -137,6 +180,15 @@ class ECStorageClient:
         return await asyncio.to_thread(default_rs(k, m).encode_ref,
                                        data_shards)
 
+    async def _encode_verified(self, data_shards: np.ndarray, k: int, m: int
+                               ) -> tuple[np.ndarray, np.ndarray | None]:
+        """Encode + shard CRCs in ONE device launch (the fused encode+CRC
+        step); the numpy oracle has no fused CRC, so it returns None and
+        callers fall back to the host crc32c."""
+        if self.codec is not None:
+            return await self.codec.encode_verified(data_shards, k, m)
+        return await self._encode(data_shards, k, m), None
+
     async def _reconstruct(self, present_rows: np.ndarray,
                            present: tuple[int, ...], want: tuple[int, ...],
                            k: int, m: int) -> np.ndarray:
@@ -168,10 +220,13 @@ class ECStorageClient:
 
     # --- write ---
 
-    async def write_stripe(self, layout: ECLayout, inode: int, stripe: int,
-                           data: bytes) -> list[IOResult]:
-        """Write one full stripe (k*chunk_size bytes; shorter data is
-        zero-padded on the wire but chunk lengths preserve the true size)."""
+    async def encode_stripe(self, layout: ECLayout, data: bytes
+                            ) -> StripeEncoding:
+        """Encode one stripe's data into its k+m stored shard contents plus
+        the CRC32C each chunk will carry — via the fused encode+CRC step, so
+        full shards (the hot path) never touch the host crc32c.  The result
+        feeds write_encoded (possibly more than once: retries / resumed
+        saves rewrite a shard subset without re-encoding)."""
         k, m, cs = layout.k, layout.m, layout.chunk_size
         assert len(data) <= k * cs
         lens = [max(0, min(cs, len(data) - j * cs)) for j in range(k)]
@@ -181,32 +236,78 @@ class ECStorageClient:
             if lens[j]:
                 arr[j, :lens[j]] = flat[j * cs: j * cs + lens[j]]
         layout.check_code(default_rs(k, m))
-        parity = await self._encode(arr, k, m)
+        parity, dev_crcs = await self._encode_verified(arr, k, m)
 
-        # whole-chunk REPLACE (not splice-write) so a shorter re-write of the
-        # stripe cannot leave stale tail bytes that disagree with the new
-        # parity; shards emptied by the re-write are REMOVEd for the same
-        # reason (absent == zeros is the decode contract)
-        tasks = []
+        from t3fs.ops.codec import crc32c
+        contents: list[bytes] = []
+        crcs: list[int] = []
         for j in range(k):
-            cid = layout.data_chunk(inode, stripe, j)
-            chain = layout.shard_chain(stripe, j)
+            content = bytes(arr[j, :lens[j]]) if lens[j] else b""
+            contents.append(content)
             if lens[j] == 0:
-                tasks.append(self.sc.write_chunk(
-                    chain, cid, 0, b"", chunk_size=cs,
-                    update_type=UpdateType.REMOVE))
+                crcs.append(0)
+            elif lens[j] == cs and dev_crcs is not None:
+                crcs.append(int(dev_crcs[j]))
             else:
-                tasks.append(self.sc.write_chunk(
-                    chain, cid, 0, bytes(arr[j, :lens[j]]), chunk_size=cs,
-                    update_type=UpdateType.REPLACE))
+                # trimmed tail shard: the device CRC covers the padded full
+                # chunk, not the stored bytes (at most one per file — cold)
+                crcs.append(crc32c(content))
         for p in range(m):
-            # parity covers the zero-padded full stripe: store full-size
-            tasks.append(self.sc.write_chunk(
-                layout.shard_chain(stripe, k + p),
-                layout.parity_chunk(inode, stripe, p),
-                0, bytes(parity[p]), chunk_size=cs,
-                update_type=UpdateType.REPLACE))
-        return list(await asyncio.gather(*tasks))
+            contents.append(bytes(parity[p]))
+            crcs.append(int(dev_crcs[k + p]) if dev_crcs is not None
+                        else crc32c(contents[-1]))
+        return StripeEncoding(lens=lens, contents=contents, crcs=crcs)
+
+    async def write_stripe(self, layout: ECLayout, inode: int, stripe: int,
+                           data: bytes,
+                           shards: tuple[int, ...] | None = None
+                           ) -> list[IOResult]:
+        """Write one full stripe (k*chunk_size bytes; shorter data is
+        zero-padded on the wire but chunk lengths preserve the true size).
+        Returns per-shard IOResults aligned with `shards` (default: all k+m,
+        data shards first then parity) — a partial failure names exactly the
+        shards to retry, via write_encoded, without rewriting the stripe."""
+        enc = await self.encode_stripe(layout, data)
+        return await self.write_encoded(layout, inode, stripe, enc, shards)
+
+    async def write_encoded(self, layout: ECLayout, inode: int, stripe: int,
+                            enc: StripeEncoding,
+                            shards: tuple[int, ...] | None = None,
+                            admission: ChainAdmission | None = None
+                            ) -> list[IOResult]:
+        """Write a subset of an encoded stripe's shards (default all k+m).
+        Results align with `shards` order, so callers retry exactly the
+        failed entries.  Stored CRCs ride along as write_chunk checksums:
+        the server cross-checks the payload against the device-computed CRC
+        and the host crc32c never runs.
+
+        Whole-chunk REPLACE (not splice-write) so a shorter re-write of the
+        stripe cannot leave stale tail bytes that disagree with the new
+        parity; shards emptied by the re-write are REMOVEd for the same
+        reason (absent == zeros is the decode contract)."""
+        k, m, cs = layout.k, layout.m, layout.chunk_size
+        if shards is None:
+            shards = tuple(range(k + m))
+
+        async def one(s: int) -> IOResult:
+            chain = layout.shard_chain(stripe, s)
+            cid = (layout.data_chunk(inode, stripe, s) if s < k
+                   else layout.parity_chunk(inode, stripe, s - k))
+            if s < k and enc.lens[s] == 0:
+                kwargs = dict(update_type=UpdateType.REMOVE)
+                content: bytes = b""
+            else:
+                kwargs = dict(update_type=UpdateType.REPLACE,
+                              checksum=enc.crcs[s])
+                content = enc.contents[s]
+            if admission is None:
+                return await self.sc.write_chunk(chain, cid, 0, content,
+                                                 chunk_size=cs, **kwargs)
+            async with admission.sem(chain):
+                return await self.sc.write_chunk(chain, cid, 0, content,
+                                                 chunk_size=cs, **kwargs)
+
+        return list(await asyncio.gather(*(one(s) for s in shards)))
 
     # --- read with reconstruct-on-unavailability ---
 
@@ -214,9 +315,24 @@ class ECStorageClient:
                           stripe_len: int) -> bytes:
         """Read a stripe's data, reconstructing any unavailable data chunks
         from surviving shards (the EC-decode recovery path, BASELINE #4)."""
+        data, _crcs = await self.read_stripe_with_crcs(layout, inode, stripe,
+                                                       stripe_len)
+        return data
+
+    async def read_stripe_with_crcs(self, layout: ECLayout, inode: int,
+                                    stripe: int, stripe_len: int
+                                    ) -> tuple[bytes, list[int | None]]:
+        """read_stripe + per-data-shard CRC32C of the STORED chunk content,
+        aligned with shard index 0..k-1: a directly-read shard reports the
+        storage layer's stored CRC (IOResult.checksum); a reconstructed full
+        shard reports the fused decode+verify step's device CRC; None where
+        neither applies (zero holes, trimmed reconstructed tails, the numpy
+        oracle).  Manifest-verified restores (t3fs.ckpt) compare these
+        against committed CRCs without hashing a byte on the host."""
         k, m, cs = layout.k, layout.m, layout.chunk_size
         lens = [max(0, min(cs, stripe_len - j * cs)) for j in range(k)]
         chunks: dict[int, bytes] = {}
+        crcs: dict[int, int | None] = {}
         missing: list[int] = []
         ios, idxs = [], []
         for j in range(k):
@@ -232,18 +348,23 @@ class ECStorageClient:
         for j, r, p in zip(idxs, results, payloads):
             if r.status.code == int(StatusCode.OK):
                 chunks[j] = p
+                crcs[j] = int(r.checksum)
             else:
                 missing.append(j)
         missing.sort()
         if missing:
             zero_shards = frozenset(j for j in range(k) if lens[j] == 0)
-            rec, _crcs = await self._reconstruct_shards(
+            rec, rcrcs = await self._reconstruct_shards(
                 layout, inode, stripe, tuple(missing), zero_shards,
                 known=chunks)
-            for j, content in zip(missing, rec):
+            for j, content, rc in zip(missing, rec, rcrcs):
                 chunks[j] = content[: lens[j]]
-        return b"".join(chunks[j][: lens[j]].ljust(lens[j], b"\x00")
-                        for j in range(k) if lens[j])
+                # the device CRC covers the full chunk: it matches the
+                # stored-content CRC only for untrimmed shards
+                crcs[j] = rc if lens[j] == cs else None
+        return (b"".join(chunks[j][: lens[j]].ljust(lens[j], b"\x00")
+                         for j in range(k) if lens[j]),
+                [crcs.get(j) for j in range(k)])
 
     async def _reconstruct_shards(self, layout: ECLayout, inode: int,
                                   stripe: int, want: tuple[int, ...],

@@ -104,6 +104,15 @@ class ECCodec:
         L = data_shards.shape[-1]
         return await self._submit(("enc", k, m, L), data_shards)
 
+    async def encode_verified(self, data_shards: np.ndarray, k: int, m: int
+                              ) -> tuple[np.ndarray, np.ndarray]:
+        """(k, L) uint8 data shards -> (parity (m, L) uint8,
+        crcs (k+m,) uint32): parity + CRC32C of every shard (data first,
+        then parity) from the SAME device launch — the write path hands
+        the CRCs to write_chunk, so no host crc32c runs per shard."""
+        L = data_shards.shape[-1]
+        return await self._submit(("encv", k, m, L), data_shards)
+
     async def reconstruct(self, present_rows: np.ndarray,
                           present: tuple[int, ...], want: tuple[int, ...],
                           k: int, m: int) -> np.ndarray:
@@ -158,16 +167,23 @@ class ECCodec:
         try:
             while True:
                 batch = [await self._q.get()]
-                deadline = loop.time() + self.max_wait_s
+                # drain-then-sleep-then-drain, NEVER wait_for(q.get()):
+                # on py<3.12 a timed-out wait_for can cancel Queue.get
+                # AFTER it dequeued an item, silently dropping it — the
+                # submitter's future then never resolves (rare hang under
+                # the ckpt writer's submission rate)
                 while len(batch) < self.max_batch:
-                    timeout = deadline - loop.time()
-                    if timeout <= 0:
-                        break
                     try:
-                        batch.append(
-                            await asyncio.wait_for(self._q.get(), timeout))
-                    except asyncio.TimeoutError:
+                        batch.append(self._q.get_nowait())
+                    except asyncio.QueueEmpty:
                         break
+                if len(batch) < self.max_batch and self.max_wait_s > 0:
+                    await asyncio.sleep(self.max_wait_s)
+                    while len(batch) < self.max_batch:
+                        try:
+                            batch.append(self._q.get_nowait())
+                        except asyncio.QueueEmpty:
+                            break
                 groups: dict[tuple, list[_Pending]] = {}
                 for key, item in batch:
                     groups.setdefault(key, []).append(item)
@@ -231,6 +247,8 @@ class ECCodec:
             self._use_pallas = (not cpu) or force
         if key[0] == "enc":
             fn = self._build_encode(key)
+        elif key[0] == "encv":
+            fn = self._build_encode_verified(key)
         elif key[0] == "recv":
             fn = self._build_reconstruct_verified(key)
         else:
@@ -271,6 +289,55 @@ class ECCodec:
         def encode_xla(stacked: np.ndarray) -> np.ndarray:
             self._count("xla-bitmatmul")
             return np.asarray(raw(stacked))
+        return encode_xla
+
+    def _build_encode_verified(self, key: tuple) -> Callable:
+        """Fused encode+CRC: one launch returns (parity, crcs) where crcs
+        covers data shards then parity — the write-path twin of
+        _build_reconstruct_verified.  Word-fused on RAID-6 512-multiple
+        chunks (bench.py's measured stripe step); otherwise an XLA-fused
+        program (still one device round trip, still no CPU crc32c)."""
+        _kind, k, m, L = key
+        import jax
+
+        from t3fs.ops.rs import default_rs
+
+        rs = default_rs(k, m)
+        if self._use_pallas and rs.raid6 and L % 512 == 0:
+            from t3fs.ops.pallas_codec import make_stripe_encode_step_words
+            step = jax.jit(make_stripe_encode_step_words(
+                L // 4, k, m, interpret=self._interpret))
+
+            def encode_words(stacked: np.ndarray
+                             ) -> tuple[np.ndarray, np.ndarray]:
+                self._count("pallas-encode-words")
+                words = stacked.view(np.uint32).reshape(
+                    stacked.shape[0], k, L // 4)
+                parity, crcs = step(words)
+                parity = np.asarray(parity).view(np.uint8).reshape(
+                    stacked.shape[0], m, L)
+                return parity, np.asarray(crcs)
+            return encode_words
+
+        import jax.numpy as jnp
+
+        from t3fs.ops import jax_codec
+
+        encf = jax_codec.make_rs_encode(rs)
+        crcf = jax_codec.make_crc32c_batch(L)
+
+        @jax.jit
+        def fused(stacked):
+            parity = encf(stacked)
+            n = stacked.shape[0]
+            dcrc = crcf(stacked.reshape(n * k, L)).reshape(n, k)
+            pcrc = crcf(parity.reshape(n * m, L)).reshape(n, m)
+            return parity, jnp.concatenate([dcrc, pcrc], axis=1)
+
+        def encode_xla(stacked: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+            self._count("xla-bitmatmul")
+            parity, crcs = fused(stacked)
+            return np.asarray(parity), np.asarray(crcs)
         return encode_xla
 
     def _build_reconstruct(self, key: tuple) -> Callable:

@@ -125,3 +125,69 @@ def test_admin_cli_families():
             assert "write:" in out and "read:" in out
         finally:
             asyncio.run(teardown(cluster))
+
+
+@pytest.mark.slow
+def test_admin_cli_ckpt_family():
+    """ckpt-list/stat/verify/gc against a real multi-process cluster: the
+    checkpoint is written in-process (the CLI is an operator surface, not
+    a writer), then inspected and reclaimed through the CLI."""
+    import numpy as np
+
+    from t3fs.ckpt import CheckpointWriter
+    from t3fs.client.ec_client import ECLayout, ECStorageClient
+    from t3fs.client.meta_client import MetaClient
+    from t3fs.client.mgmtd_client import MgmtdClient
+    from t3fs.client.storage_client import StorageClient, StorageClientConfig
+    from t3fs.fuse.vfs import FileSystem
+
+    async def save_ckpts(cluster):
+        mgmtd = MgmtdClient(cluster.mgmtd_address, refresh_period_s=0.2)
+        await mgmtd.start()
+        sc = StorageClient(mgmtd.routing,
+                           config=StorageClientConfig(retry_backoff_s=0.1),
+                           refresh_routing=mgmtd.refresh)
+        meta = MetaClient([cluster.meta_address])
+        fs = FileSystem(meta, sc)
+        lay = ECLayout.create(k=2, m=2, chunk_size=2048,
+                              chains=[1, 2, 3, 4])
+        ec = ECStorageClient(sc)
+        rng = np.random.default_rng(9)
+        w = CheckpointWriter(ec, fs, lay, "/ckpts/run")
+        for step in (10, 20):
+            await w.save(step, {
+                "w": rng.standard_normal(2000).astype(np.float32),
+                "b": rng.standard_normal(100).astype(np.float64)})
+        await ec.close()
+        await meta.close_conn()
+        await sc.close()
+        await mgmtd.stop()
+
+    with tempfile.TemporaryDirectory(prefix="t3fs-cli-ckpt-") as d:
+        async def up():
+            cluster = DevCluster(d, num_storage=2, replicas=1,
+                                 num_chains=4, with_meta=True,
+                                 durable=False, chunk_size=64 * 1024)
+            await cluster.start()
+            return cluster
+        cluster = asyncio.run(up())
+        try:
+            asyncio.run(save_ckpts(cluster))
+
+            out = run_cli(cluster, "ckpt-list", "/ckpts/run")
+            assert "10" in out and "20" in out
+
+            out = run_cli(cluster, "ckpt-stat", "/ckpts/run", "--step", "10")
+            assert "rs=(2+2)" in out and "float32" in out
+            assert "w" in out and "b" in out
+
+            out = run_cli(cluster, "ckpt-verify", "/ckpts/run")
+            assert "missing=0" in out and "corrupt=0" in out
+            assert "unrecoverable=0" in out
+
+            out = run_cli(cluster, "ckpt-gc", "/ckpts/run", "--keep", "1")
+            assert "removed=[10]" in out and "kept=[20]" in out
+            out = run_cli(cluster, "ckpt-list", "/ckpts/run")
+            assert "20" in out and " 10 " not in out
+        finally:
+            asyncio.run(cluster.stop())

@@ -68,3 +68,19 @@ def test_meta_bench_fuse_mode():
     assert res["path"] == "fuse-kernel-mount"
     for phase in ("mkdir", "create", "stat", "list", "rename", "remove"):
         assert res[phase]["ops"] > 0 and res[phase]["ops_s"] > 0, phase
+
+
+@pytest.mark.slow
+def test_ckpt_bench_save_restore_degraded():
+    """Checkpoint bench end to end on a tiny budget: save, healthy
+    restore, and (--kill) degraded restore all report positive MB/s,
+    medians carry their runs arrays (bench_protocol rule 1)."""
+    from benchmarks.ckpt_bench import parse_args as cb_args, run_bench as cb_run
+    res = asyncio.run(cb_run(cb_args(
+        ["--leaves", "2", "--leaf-mb", "1", "--chunk-size", "65536",
+         "--runs", "3", "--kill"])))
+    assert res["verified"]
+    assert res["save_MB_s"] > 0 and len(res["save_runs"]) == 3
+    assert res["restore_MB_s"] > 0 and len(res["restore_runs"]) == 3
+    assert res["degraded_restore_MB_s"] > 0
+    assert res["stripes"] > 0 and res["bytes"] == 2 << 20

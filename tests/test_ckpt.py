@@ -1,0 +1,444 @@
+"""Checkpoint engine e2e over the in-memory cluster: save/restore (healthy,
+partial, resharded, degraded), interrupt-resume, scrub-repair, GC.
+
+Acceptance (ISSUE 3): save a multi-leaf pytree, kill two chains, restore
+bit-identical through reconstruct-verified reads; interrupt a save mid-way
+and the resumed save rewrites only the missing stripes.
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from t3fs.ckpt import (CheckpointManifest, CheckpointReader, CheckpointStore,
+                       CheckpointWriter, ckpt_inode, flatten_tree,
+                       manifest_name, parse_step, unflatten_tree)
+from t3fs.client.ec_client import ECLayout, ECStorageClient, PARITY_NS
+from t3fs.fuse.vfs import FileSystem
+from t3fs.storage.types import UpdateType
+from t3fs.testing.cluster import LocalCluster
+from t3fs.utils import serde
+from t3fs.utils.status import StatusCode, StatusError
+
+
+def run(coro):
+    # watchdog wrapper: a wedged await fails loudly with every task's
+    # coroutine stack instead of hanging the whole suite
+    async def _watch():
+        task = asyncio.ensure_future(coro)
+        done, _ = await asyncio.wait({task}, timeout=120)
+        if not done:
+            import sys
+            print("\n==== HANG: asyncio task dump ====", file=sys.stderr)
+            for t in asyncio.all_tasks():
+                t.print_stack(file=sys.stderr)
+            task.cancel()
+            raise TimeoutError("test hang (see task dump on stderr)")
+        return task.result()
+    return asyncio.run(_watch())
+
+
+def make_tree(rng):
+    """Multi-leaf pytree: mixed dtypes/shapes, nested containers, a tail
+    that doesn't fill a stripe, a tiny leaf, and a None."""
+    return {
+        "params": {
+            "w": rng.standard_normal((64, 33)).astype(np.float32),
+            "b": rng.standard_normal(257).astype(np.float64),
+        },
+        "opt": [rng.integers(0, 1 << 31, 5000, dtype=np.int32),
+                np.float32(3.5)],
+        "meta": None,
+        "step_count": np.int64(12345),
+    }
+
+
+def trees_equal(a, b):
+    fa, _ = flatten_tree(a)
+    fb, _ = flatten_tree(b)
+    assert [p for p, _ in fa] == [p for p, _ in fb]
+    for (pa, la), (_pb, lb) in zip(fa, fb):
+        xa, xb = np.asarray(la), np.asarray(lb)
+        assert xa.dtype == xb.dtype and xa.shape == xb.shape, pa
+        assert np.array_equal(xa, xb), pa
+
+
+# ---------------- pure-python manifest/treedef units ----------------
+
+def test_tree_flatten_unflatten_roundtrip():
+    rng = np.random.default_rng(0)
+    tree = make_tree(rng)
+    leaves, treedef = flatten_tree(tree)
+    paths = [p for p, _ in leaves]
+    assert paths == sorted(paths) or paths  # deterministic order
+    assert "params/w" in paths and "opt/0" in paths
+    rebuilt = unflatten_tree(treedef, {i: l for i, (_, l) in
+                                       enumerate(leaves)})
+    assert rebuilt["meta"] is None
+    assert isinstance(rebuilt["opt"], list)
+    trees_equal(tree, rebuilt)
+    # partial: missing indices become None
+    sparse = unflatten_tree(treedef, {0: leaves[0][1]})
+    assert sparse["step_count"] is None
+
+    # non-string / slashed dict keys are rejected up front
+    with pytest.raises(StatusError):
+        flatten_tree({"a/b": np.zeros(1)})
+    with pytest.raises(StatusError):
+        flatten_tree({1: np.zeros(1)})
+
+
+def test_manifest_serde_and_naming():
+    lay = ECLayout.create(k=2, m=2, chunk_size=512, chains=[1, 2, 3, 4])
+    man = CheckpointManifest(version=1, directory="/ck", step=7,
+                             treedef='{"t":"leaf","i":0}', layout=lay,
+                             created_at=123.0)
+    man2 = serde.loads(serde.dumps(man))
+    assert isinstance(man2, CheckpointManifest)
+    assert man2.step == 7 and man2.layout.k == 2
+    assert man2.layout.chains == [1, 2, 3, 4]
+
+    assert parse_step(manifest_name(7)) == 7
+    assert parse_step("step-000000000042.t3ckpt") == 42
+    assert parse_step(".tmp-step-000000000042.t3ckpt") is None
+    assert parse_step("notes.txt") is None
+
+    # derived inodes: stable, distinct per (dir, step, path), never in the
+    # parity namespace
+    a = ckpt_inode("/ck", 7, "params/w")
+    assert a == ckpt_inode("/ck", 7, "params/w")
+    assert a != ckpt_inode("/ck", 8, "params/w")
+    assert a != ckpt_inode("/ck", 7, "params/b")
+    assert not a & PARITY_NS and a & (1 << 63)
+
+
+# ---------------- cluster e2e ----------------
+
+def test_ckpt_save_restore_partial_resharded(monkeypatch):
+    monkeypatch.setenv("T3FS_FORCE_PALLAS_INTERPRET", "1")
+
+    async def body():
+        cluster = LocalCluster(num_nodes=3, replicas=1, num_chains=6,
+                               with_meta=True)
+        await cluster.start()
+        try:
+            lay = ECLayout.create(k=4, m=2, chunk_size=2048,
+                                  chains=[1, 2, 3, 4, 5, 6])
+            ec = ECStorageClient(cluster.sc)
+            fs = FileSystem(cluster.mc, cluster.sc)
+            tree = make_tree(np.random.default_rng(1))
+            w = CheckpointWriter(ec, fs, lay, "/ckpt/run1")
+            stats = await w.save(100, tree)
+            assert stats.stripes_total > 1
+            assert stats.shards_written > 0
+            assert stats.manifest_path == \
+                f"/ckpt/run1/{manifest_name(100)}"
+            # writes went through the fused device encode+CRC step
+            assert ec.codec.codec_counts.get("pallas-encode-words", 0) >= 1
+
+            r = CheckpointReader(ec, fs, "/ckpt/run1")
+            trees_equal(tree, await r.restore())
+            trees_equal(tree, await r.restore(step=100))
+
+            # partial restore: a subtree prefix and an exact path
+            part = await r.restore(paths=["params"])
+            assert part["opt"] == [None, None]   # containers survive,
+            assert part["step_count"] is None    # unloaded leaves -> None
+            assert np.array_equal(part["params"]["w"], tree["params"]["w"])
+            one = await r.restore(paths=["opt/0"])
+            assert np.array_equal(one["opt"][0], tree["opt"][0])
+            assert one["opt"][1] is None
+
+            # resharded restore: 1 writer -> 3 readers, disjoint + complete
+            shards = [await r.restore_shard(i, 3) for i in range(3)]
+            seen = {}
+            for sh in shards:
+                for path, arr in sh.items():
+                    assert path not in seen, "reader shards must be disjoint"
+                    seen[path] = arr
+            flat, _ = flatten_tree(tree)
+            assert set(seen) == {p for p, _ in flat}
+            for path, leaf in flat:
+                assert np.array_equal(seen[path], np.asarray(leaf)), path
+            await ec.close()
+        finally:
+            await cluster.stop()
+    run(body())
+
+
+def test_ckpt_resume_skips_committed_stripes(monkeypatch):
+    monkeypatch.setenv("T3FS_FORCE_PALLAS_INTERPRET", "1")
+
+    async def body():
+        cluster = LocalCluster(num_nodes=3, replicas=1, num_chains=6,
+                               with_meta=True)
+        await cluster.start()
+        try:
+            lay = ECLayout.create(k=4, m=2, chunk_size=2048,
+                                  chains=[1, 2, 3, 4, 5, 6])
+            ec = ECStorageClient(cluster.sc)
+            fs = FileSystem(cluster.mc, cluster.sc)
+            tree = make_tree(np.random.default_rng(2))
+            w = CheckpointWriter(ec, fs, lay, "/ckpt/run2")
+            first = await w.save(5, tree)
+            # identical re-save: every stripe's CRC probe matches
+            again = await w.save(5, tree)
+            assert again.stripes_total == first.stripes_total
+            assert again.stripes_skipped == again.stripes_total
+            assert again.shards_written == 0 and again.bytes_written == 0
+            # resume=False rewrites everything
+            forced = await w.save(5, tree, resume=False)
+            assert forced.shards_written > 0
+            r = CheckpointReader(ec, fs, "/ckpt/run2")
+            trees_equal(tree, await r.restore())
+            await ec.close()
+        finally:
+            await cluster.stop()
+    run(body())
+
+
+def test_ckpt_interrupt_then_resume_rewrites_only_missing(monkeypatch):
+    """ISSUE acceptance: cancel a save mid-flight (manifest uncommitted),
+    re-run it — only the not-yet-committed stripes are rewritten."""
+    monkeypatch.setenv("T3FS_FORCE_PALLAS_INTERPRET", "1")
+
+    async def body():
+        cluster = LocalCluster(num_nodes=3, replicas=1, num_chains=6,
+                               with_meta=True)
+        await cluster.start()
+        try:
+            lay = ECLayout.create(k=4, m=2, chunk_size=2048,
+                                  chains=[1, 2, 3, 4, 5, 6])
+            ec = ECStorageClient(cluster.sc)
+            fs = FileSystem(cluster.mc, cluster.sc)
+            rng = np.random.default_rng(3)
+            tree = {"a": rng.integers(0, 255, 9 * 4 * 2048,
+                                      dtype=np.uint8)}   # 9 stripes
+            # window=1 so "3 stripes done" means exactly 3 settled
+            w = CheckpointWriter(ec, fs, lay, "/ckpt/irq", window=1)
+            hit = asyncio.Event()
+
+            def on_stripe(done, total):
+                if done >= 3:
+                    hit.set()
+
+            task = asyncio.create_task(w.save(7, tree, on_stripe=on_stripe))
+            await hit.wait()
+            task.cancel()
+            with pytest.raises(asyncio.CancelledError):
+                await task
+            # the commit never ran: no checkpoint is visible
+            store = CheckpointStore(fs, "/ckpt/irq")
+            assert await store.list_steps() == []
+
+            stats = await w.save(7, tree)
+            assert stats.stripes_total == 9
+            assert stats.stripes_skipped >= 3, stats
+            assert stats.shards_written <= (9 - 3) * 6, stats
+            assert await store.list_steps() == [7]
+            r = CheckpointReader(ec, fs, "/ckpt/irq")
+            assert np.array_equal((await r.restore())["a"], tree["a"])
+            await ec.close()
+        finally:
+            await cluster.stop()
+    run(body())
+
+
+def test_ckpt_degraded_restore_two_chains_down(monkeypatch):
+    """ISSUE acceptance: kill two chains (one data, one parity shard of
+    every stripe) and restore bit-identically through the fused
+    reconstruct-verify read path."""
+    monkeypatch.setenv("T3FS_FORCE_PALLAS_INTERPRET", "1")
+
+    async def body():
+        # 8 nodes / 8 chains, replicas=1: chain c's only target is node c,
+        # so killing a node fail-stops exactly one chain
+        cluster = LocalCluster(num_nodes=8, replicas=1, num_chains=8,
+                               with_meta=True, heartbeat_timeout_s=0.6)
+        await cluster.start()
+        try:
+            lay = ECLayout.create(k=4, m=2, chunk_size=2048,
+                                  chains=[1, 2, 3, 4, 5, 6])
+            ec = ECStorageClient(cluster.sc)
+            fs = FileSystem(cluster.mc, cluster.sc)
+            tree = make_tree(np.random.default_rng(4))
+            w = CheckpointWriter(ec, fs, lay, "/ckpt/deg")
+            stats = await w.save(11, tree)
+
+            # pick one data chain and one parity chain to kill, avoiding
+            # whatever chains the manifest file itself landed on
+            ino = await fs.stat(stats.manifest_path)
+            used = set(ino.layout.chains)
+            data_chain = next(c for c in (2, 3, 4) if c not in used)
+            parity_chain = next(c for c in (5, 6) if c not in used)
+            for chain in (data_chain, parity_chain):
+                await cluster.kill_storage_node(chain)
+            for _ in range(100):
+                if all(c.chain_ver >= 2 for c in
+                       cluster.mgmtd.state.routing().chains.values()
+                       if any(t.node_id in (data_chain, parity_chain)
+                              for t in c.targets)):
+                    break
+                await asyncio.sleep(0.1)
+            await cluster.mgmtd_client.refresh()
+
+            r = CheckpointReader(ec, fs, "/ckpt/deg")
+            trees_equal(tree, await r.restore())
+            # the degraded stripes went through the fused decode+verify
+            assert ec.codec.codec_counts.get("pallas-decode-words", 0) >= 1, \
+                ec.codec.codec_counts
+
+            # scrub without repair sees the missing shards but no stripe
+            # is unrecoverable at two losses (m=2)
+            rep = await r.scrub(11, repair=False)
+            assert rep.shards_missing > 0
+            assert rep.stripes_unrecoverable == 0
+            await ec.close()
+        finally:
+            await cluster.stop()
+    run(body())
+
+
+def test_ckpt_scrub_repairs_stale_and_missing_shards(monkeypatch):
+    monkeypatch.setenv("T3FS_FORCE_PALLAS_INTERPRET", "1")
+
+    async def body():
+        cluster = LocalCluster(num_nodes=3, replicas=1, num_chains=6,
+                               with_meta=True)
+        await cluster.start()
+        try:
+            lay = ECLayout.create(k=4, m=2, chunk_size=2048,
+                                  chains=[1, 2, 3, 4, 5, 6])
+            ec = ECStorageClient(cluster.sc)
+            fs = FileSystem(cluster.mc, cluster.sc)
+            rng = np.random.default_rng(5)
+            tree = {"a": rng.integers(0, 255, 4 * 4 * 2048, dtype=np.uint8)}
+            w = CheckpointWriter(ec, fs, lay, "/ckpt/scrub")
+            await w.save(1, tree)
+            store = CheckpointStore(fs, "/ckpt/scrub")
+            lf = (await store.load(1)).leaves[0]
+
+            # silent corruption: REPLACE data shard 1 of stripe 2 with
+            # readable-but-wrong bytes; hard loss: REMOVE parity 0 of
+            # stripe 3
+            await cluster.sc.write_chunk(
+                lay.shard_chain(2, 1), lay.data_chunk(lf.inode, 2, 1), 0,
+                bytes(2048), chunk_size=2048,
+                update_type=UpdateType.REPLACE)
+            await cluster.sc.write_chunk(
+                lay.shard_chain(3, 4), lay.parity_chunk(lf.inode, 3, 0), 0,
+                b"", chunk_size=2048, update_type=UpdateType.REMOVE)
+
+            # restore must detect the stale shard by manifest CRC and
+            # reconstruct around it
+            r = CheckpointReader(ec, fs, "/ckpt/scrub")
+            assert np.array_equal((await r.restore())["a"], tree["a"])
+
+            rep = await r.scrub(1)
+            assert rep.shards_corrupt >= 1 and rep.shards_missing >= 1
+            assert rep.shards_repaired >= 2
+            assert rep.stripes_unrecoverable == 0
+            # second scrub is clean
+            rep2 = await r.scrub(1)
+            assert rep2.shards_corrupt == 0 and rep2.shards_missing == 0
+            assert np.array_equal((await r.restore())["a"], tree["a"])
+            await ec.close()
+        finally:
+            await cluster.stop()
+    run(body())
+
+
+def test_ckpt_gc_keep_last(monkeypatch):
+    monkeypatch.setenv("T3FS_FORCE_PALLAS_INTERPRET", "1")
+
+    async def body():
+        cluster = LocalCluster(num_nodes=3, replicas=1, num_chains=6,
+                               with_meta=True)
+        await cluster.start()
+        try:
+            lay = ECLayout.create(k=4, m=2, chunk_size=2048,
+                                  chains=[1, 2, 3, 4, 5, 6])
+            ec = ECStorageClient(cluster.sc)
+            fs = FileSystem(cluster.mc, cluster.sc)
+            w = CheckpointWriter(ec, fs, lay, "/ckpt/gc")
+            trees = {}
+            for step in (100, 200, 300):
+                trees[step] = {"x": np.full(3 * 4 * 2048, step % 251,
+                                            dtype=np.uint8)}
+                await w.save(step, trees[step])
+            store = CheckpointStore(fs, "/ckpt/gc")
+            assert await store.list_steps() == [100, 200, 300]
+
+            old_inode = ckpt_inode("/ckpt/gc", 100, "x")
+            rep = await store.gc(cluster.sc, keep_last=2)
+            assert rep.steps_removed == [100]
+            assert rep.steps_kept == [200, 300]
+            assert rep.bytes_removed == 3 * 4 * 2048
+            assert await store.list_steps() == [200, 300]
+
+            # the removed step's chunks are gone from storage
+            res, _ = await cluster.sc.read_chunk(
+                lay.shard_chain(0, 0), lay.data_chunk(old_inode, 0, 0))
+            assert res.status.code == int(StatusCode.CHUNK_NOT_FOUND)
+
+            # kept steps still restore
+            r = CheckpointReader(ec, fs, "/ckpt/gc")
+            assert np.array_equal((await r.restore(step=200))["x"],
+                                  trees[200]["x"])
+            with pytest.raises(StatusError):
+                await store.gc(cluster.sc, keep_last=0)
+            await ec.close()
+        finally:
+            await cluster.stop()
+    run(body())
+
+
+def test_write_stripe_reports_per_shard_failures(monkeypatch):
+    """Satellite: write_stripe/write_encoded return per-shard IOResults
+    aligned with the shard list, so a caller retries only what failed."""
+    monkeypatch.setenv("T3FS_FORCE_PALLAS_INTERPRET", "1")
+
+    async def body():
+        cluster = LocalCluster(num_nodes=6, replicas=1, num_chains=6,
+                               with_meta=False, heartbeat_timeout_s=0.6)
+        await cluster.start()
+        try:
+            lay = ECLayout.create(k=4, m=2, chunk_size=2048,
+                                  chains=[1, 2, 3, 4, 5, 6])
+            ec = ECStorageClient(cluster.sc)
+            data = bytes(range(256)) * 32
+            enc = await ec.encode_stripe(lay, data)
+
+            # subset writes: results align with the requested shard list
+            sub = (1, 4)
+            results = await ec.write_encoded(lay, 77, 0, enc, shards=sub)
+            assert len(results) == len(sub)
+            assert all(r.status.code == int(StatusCode.OK) for r in results)
+            # the other shards were not written
+            res, _ = await cluster.sc.read_chunk(
+                lay.shard_chain(0, 0), lay.data_chunk(77, 0, 0))
+            assert res.status.code == int(StatusCode.CHUNK_NOT_FOUND)
+
+            # fail-stop the node behind shard 2's chain: a full-stripe
+            # write reports failure for that shard ONLY
+            dead_chain = lay.shard_chain(0, 2)
+            await cluster.kill_storage_node(dead_chain)
+            for _ in range(100):
+                if all(c.chain_ver >= 2 for c in
+                       cluster.mgmtd.state.routing().chains.values()
+                       if any(t.node_id == dead_chain for t in c.targets)):
+                    break
+                await asyncio.sleep(0.1)
+            await cluster.mgmtd_client.refresh()
+
+            results = await ec.write_stripe(lay, 88, 0, data)
+            assert len(results) == 6
+            bad = [s for s, r in enumerate(results)
+                   if r.status.code != int(StatusCode.OK)]
+            assert bad == [2], [StatusCode(r.status.code).name
+                                for r in results]
+            await ec.close()
+        finally:
+            await cluster.stop()
+    run(body())

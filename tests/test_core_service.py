@@ -5,7 +5,11 @@ hotUpdateConfig, getLastConfigUpdateRecord) + fbs/core user ctrl.
 """
 
 import asyncio
-import tomllib
+
+try:
+    import tomllib
+except ImportError:
+    import tomli as tomllib  # type: ignore[no-redef]
 from dataclasses import dataclass
 
 import pytest

@@ -75,12 +75,13 @@ def test_ec_write_read_roundtrip_and_reconstruct(monkeypatch):
             got = await ec.read_stripe(lay, 9, 0, len(data))
             assert got == data, "EC reconstruction must mask the lost node"
 
-            # the SHIPPING codec path served the calls: the RAID-6 word
-            # kernel for encode, the FUSED word decode+verify for the
+            # the SHIPPING codec path served the calls: the FUSED word
+            # encode+CRC for the writes (stored CRCs ride along as
+            # write_chunk checksums), the FUSED word decode+verify for the
             # degraded read (VERDICT r2: the EC client previously used
             # the slow XLA path while bench.py measured the word kernels;
             # the byte-plane bit-matmul is now the non-RAID-6 fallback)
-            assert ec.codec.codec_counts.get("pallas-words", 0) >= 1, \
+            assert ec.codec.codec_counts.get("pallas-encode-words", 0) >= 1, \
                 ec.codec.codec_counts
             assert ec.codec.codec_counts.get("pallas-decode-words", 0) >= 1, \
                 ec.codec.codec_counts

@@ -537,3 +537,84 @@ def test_packed_ver_memo_dies_with_the_connection():
         finally:
             await fab.stop()
     _a.run(body())
+
+
+def test_read_file_ranges_out_of_order_and_overlapping():
+    """One batch_read fan-out serves many ranges regardless of order or
+    overlap; per-range (bytes, per-piece IOResults) stay aligned with the
+    request list (ckpt resharded-restore leans on this)."""
+    async def body():
+        fabric = StorageFabric(num_nodes=2, replicas=2)
+        await fabric.start()
+        try:
+            sc = StorageClient(lambda: fabric.routing, client=fabric.client)
+            lay = FileLayout(chunk_size=4096, chains=[fabric.chain_id])
+            data = bytes(range(256)) * 48          # 12288B = 3 chunks
+            await sc.write_file_range(lay, 60, 0, data)
+            await sc.write_file_range(lay, 61, 0, b"B" * 5000)
+
+            ranges = [
+                (60, 8000, 2000),     # out of order: tail chunk first
+                (60, 0, 4096),        # exactly chunk 0
+                (60, 2000, 4000),     # overlaps the previous two ranges
+                (61, 100, 200),       # second inode interleaved
+                (60, 2000, 4000),     # duplicate range
+                (60, 12000, 1000),    # runs past EOF: zero-padded tail
+                (62, 0, 300),         # absent inode: hole, zero-filled
+            ]
+            out = await sc.read_file_ranges(lay, ranges)
+            assert len(out) == len(ranges)
+            want = [
+                data[8000:10000], data[0:4096], data[2000:6000],
+                b"B" * 200, data[2000:6000],
+                data[12000:] + b"\x00" * (13000 - len(data)),
+                b"\x00" * 300,
+            ]
+            for (got, results), w, (inode, off, ln) in zip(out, want, ranges):
+                assert got == w, (inode, off, ln)
+                assert len(got) == ln
+                # one IOResult per chunk piece of THIS range
+                assert len(results) == len(lay.chunk_span(off, ln))
+            # the hole range surfaced CHUNK_NOT_FOUND, not OK
+            assert out[-1][1][0].status.code == \
+                int(StatusCode.CHUNK_NOT_FOUND)
+            ok = out[1][1]
+            assert all(r.status.code == int(StatusCode.OK) for r in ok)
+        finally:
+            await fabric.stop()
+    run(body())
+
+
+def test_read_file_ranges_retry_exhaustion_surfaces_errors():
+    """Chain fully down: after max_retries the per-piece IOResults carry
+    the transport error (NOT silently OK, NOT an exception) and the bytes
+    zero-fill, so callers can distinguish hole from failure."""
+    async def body():
+        fabric = StorageFabric(num_nodes=1, replicas=1)
+        await fabric.start()
+        try:
+            sc = StorageClient(
+                lambda: fabric.routing, client=fabric.client,
+                config=StorageClientConfig(max_retries=2,
+                                           retry_backoff_s=0.01))
+            lay = FileLayout(chunk_size=4096, chains=[fabric.chain_id])
+            data = b"x" * 6000
+            await sc.write_file_range(lay, 70, 0, data)
+            got, _ = await sc.read_file_range(lay, 70, 0, 6000)
+            assert got == data
+
+            await fabric.servers[0].stop()
+            out = await sc.read_file_ranges(
+                lay, [(70, 0, 6000), (70, 1000, 500)])
+            for got, results in out:
+                assert got == b"\x00" * len(got)
+                assert results, "per-piece results must surface"
+                for r in results:
+                    assert r.status.code != int(StatusCode.OK)
+                    assert r.status.code != \
+                        int(StatusCode.CHUNK_NOT_FOUND), \
+                        "failure must not read as a hole"
+            assert len(out[0][0]) == 6000 and len(out[1][0]) == 500
+        finally:
+            await fabric.stop()
+    run(body())
