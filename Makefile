@@ -18,7 +18,7 @@ SAN_TESTS := tests/test_native_engine.py tests/test_usrbio.py \
 SAN_FILTER := -k "not device"
 
 .PHONY: test sanitize sanitize-thread sanitize-address probe on-device ci \
-        ckpt-bench
+        ckpt-bench write-bench
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -27,6 +27,12 @@ test:
 # per docs/bench_protocol.md); add --kill for the degraded-restore phase.
 ckpt-bench:
 	$(PY) -m benchmarks.ckpt_bench --json
+
+# Write-pipeline A/B (ISSUE 4): p50 of 4 MiB 3-replica chain writes at
+# concurrency 1, one JSON line with off/overlap/streamed side by side.
+write-bench:
+	JAX_PLATFORMS=cpu $(PY) -m benchmarks.storage_bench --write-ab \
+		--chunk-size 4194304 --replicas 3 --num-ops 16
 
 # Bounded TPU-tunnel probe; ALWAYS appends a dated record to
 # DEVICE_PROBE_LOG.jsonl (proof the chip was retried, r3 verdict #1).

@@ -44,7 +44,9 @@ async def make_env(args, config: StorageClientConfig | None = None):
     fab = StorageFabric(
         num_nodes=args.nodes, replicas=args.replicas,
         checksum_backend=getattr(args, "checksum_backend", None),
-        aio_read=not getattr(args, "no_aio", False))
+        aio_read=not getattr(args, "no_aio", False),
+        write_pipeline=getattr(args, "write_pipeline", None),
+        stream_threshold=getattr(args, "stream_threshold", None))
     await fab.start()
     sc = StorageClient(lambda: fab.routing, client=fab.client, config=config)
     return fab, sc, [fab.chain_id]

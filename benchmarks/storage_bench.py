@@ -117,6 +117,7 @@ async def run_bench(args) -> dict:
     snap = lat.collect()
     result = {
         "mode": args.mode, "chunk_size": args.chunk_size,
+        "write_pipeline": getattr(args, "write_pipeline", "off"),
         "concurrency": args.concurrency, "wall_s": round(wall, 3),
         "ops": counters["ops"], "errors": counters["errors"],
         "iops": round(counters["ops"] / wall, 1),
@@ -128,6 +129,77 @@ async def run_bench(args) -> dict:
     await sc.close()
     await env.stop()
     return result
+
+
+def run_write_bench(value_size: int, num_ops: int, concurrency: int = 1,
+                    replicas: int = 3, write_pipeline: str = "off",
+                    stream_threshold: int | None = None) -> dict:
+    """Fixed-op chain-write latency probe (the `make write-bench` A/B and
+    the CI streamed-path smoke): `num_ops` writes of `value_size` through a
+    `replicas`-deep chain, per-op latencies recorded.  Unlike run_bench's
+    throughput loop this is latency-bound by construction — concurrency 1
+    measures exactly the hop-serialization the write pipeline attacks."""
+    from t3fs.client.storage_client import StorageClient
+    from t3fs.testing.fabric import StorageFabric
+    from t3fs.utils.metrics import LatencyRecorder
+
+    async def body() -> dict:
+        fab = StorageFabric(num_nodes=max(3, replicas), replicas=replicas,
+                            write_pipeline=write_pipeline,
+                            stream_threshold=stream_threshold)
+        await fab.start()
+        sc = StorageClient(lambda: fab.routing, client=fab.client)
+        lat = LatencyRecorder("bench.write")
+        counters = {"ok": 0, "errors": 0}
+        payloads = [os.urandom(value_size) for _ in range(4)]
+        try:
+            # warm the path (conn setup, first-chunk alloc) off the clock
+            await sc.write_chunk(fab.chain_id, ChunkId(BENCH_INODE, 0), 0,
+                                 payloads[0], value_size)
+
+            async def worker(widx: int) -> None:
+                for i in range(widx, num_ops, concurrency):
+                    cid = ChunkId(BENCH_INODE, 1 + i)
+                    try:
+                        with lat.time():
+                            await sc.write_chunk(
+                                fab.chain_id, cid, 0,
+                                payloads[i % len(payloads)], value_size)
+                        counters["ok"] += 1
+                    except Exception:
+                        counters["errors"] += 1
+
+            t0 = time.perf_counter()
+            await asyncio.gather(*[worker(w) for w in range(concurrency)])
+            wall = time.perf_counter() - t0
+        finally:
+            await sc.close()
+            await fab.stop()
+        snap = lat.collect()
+        return {
+            "write_pipeline": write_pipeline, "value_size": value_size,
+            "num_ops": num_ops, "concurrency": concurrency,
+            "replicas": replicas, "ok": counters["ok"],
+            "errors": counters["errors"], "wall_s": round(wall, 3),
+            "p50_ms": round(snap.get("p50", 0) * 1e3, 3),
+            "p99_ms": round(snap.get("p99", 0) * 1e3, 3),
+        }
+
+    return asyncio.run(body())
+
+
+def write_pipeline_ab(value_size: int = 4 << 20, num_ops: int = 16,
+                      replicas: int = 3) -> dict:
+    """The ISSUE-4 acceptance matrix: p50 of 4 MiB `replicas`-chain writes
+    at concurrency 1, one entry per write_pipeline mode."""
+    out = {}
+    for mode in ("off", "overlap", "streamed"):
+        out[mode] = run_write_bench(value_size, num_ops, concurrency=1,
+                                    replicas=replicas, write_pipeline=mode)
+    base = out["off"]["p50_ms"] or 1.0
+    for mode in ("overlap", "streamed"):
+        out[mode]["p50_vs_off"] = round(out[mode]["p50_ms"] / base, 3)
+    return out
 
 
 def parse_args(argv=None):
@@ -152,12 +224,28 @@ def parse_args(argv=None):
                     help="server-side codec seam (local cluster mode)")
     ap.add_argument("--inject-server-error", type=float, default=0.0,
                     help="probability of injected server errors (DebugFlags)")
+    ap.add_argument("--write-pipeline", dest="write_pipeline",
+                    choices=["off", "overlap", "streamed"], default="off",
+                    help="chain write pipelining A/B (local cluster mode)")
+    ap.add_argument("--stream-threshold", dest="stream_threshold",
+                    type=int, default=None,
+                    help="streamed-mode fragment threshold override (bytes)")
+    ap.add_argument("--write-ab", dest="write_ab", action="store_true",
+                    help="run the write-pipeline A/B matrix "
+                         "(off/overlap/streamed) and print one JSON line")
+    ap.add_argument("--num-ops", dest="num_ops", type=int, default=16,
+                    help="fixed op count for --write-ab")
     ap.add_argument("--json", action="store_true")
     return ap.parse_args(argv)
 
 
 def main(argv=None) -> None:
     args = parse_args(argv)
+    if args.write_ab:
+        print(json.dumps(write_pipeline_ab(
+            value_size=args.chunk_size, num_ops=args.num_ops,
+            replicas=args.replicas)))
+        return
     result = asyncio.run(run_bench(args))
     if args.json:
         print(json.dumps(result))

@@ -38,6 +38,12 @@ class StorageEventTrace:
     forward_status: int = 0
     commit_status: int = 0
     latency_s: float = 0.0
+    # write-pipeline decomposition (appended last for schema stability):
+    # forward_s = time awaiting the successor leg, apply_s = local
+    # CRC+apply leg; under overlap the two windows run concurrently, so
+    # latency_s ≈ max(...) + commit instead of their sum
+    forward_s: float = 0.0
+    apply_s: float = 0.0
 
 
 class StructuredTraceLog:

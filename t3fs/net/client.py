@@ -93,6 +93,12 @@ class Client:
         conn = await self._get_conn(address)
         return await conn.call(method, body, payload, timeout)
 
+    async def post(self, address: str, method: str, body: object = None,
+                   payload: bytes = b"") -> None:
+        """One-way send (Connection.post): no response awaited."""
+        conn = await self._get_conn(address)
+        await conn.post(method, body, payload)
+
     async def close(self) -> None:
         for conn in list(self._conns.values()):
             await conn.close()

@@ -153,6 +153,17 @@ class Connection:
                 raise make_error(StatusCode.RPC_SEND_FAILED,
                                  f"send on {self.name}: {e}") from None
 
+    async def post(self, method: str, body: object = None,
+                   payload: bytes = b"") -> None:
+        """One-way request: uuid 0 means the peer runs the handler but
+        sends no response frame, and none is awaited here.  Carries the
+        bulk frames of an UPDATE_FRAG stream, whose failures surface on
+        the stream's windowed call()s / final update RPC instead.  (The
+        uuid counter starts at 1, so 0 can never collide with a waiter.)"""
+        packet = MessagePacket(uuid=0, method=method, is_req=True).stamp_called()
+        packet.body = body
+        await self._send_frame(packet, payload, FLAG_IS_REQ)
+
     async def call(self, method: str, body: object = None, payload: bytes = b"",
                    timeout: float = 30.0) -> tuple[object, bytes]:
         """Issue a request, await the typed response (+ raw payload).
@@ -249,6 +260,8 @@ class Connection:
             log.exception("handler %s failed", packet.method)
             rsp.status = WireStatus(int(StatusCode.INTERNAL), f"{type(e).__name__}: {e}")
         rsp.ts_server_replied = time.time()
+        if packet.uuid == 0:
+            return  # one-way post(): no response frame (errors logged above)
         try:
             await self._send_frame(rsp, rsp_payload, 0)
         except Exception:

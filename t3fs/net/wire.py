@@ -115,6 +115,54 @@ def check_msg_crc(msg: bytes, msg_crc: int) -> None:
         raise FrameError("message crc mismatch")
 
 
+# ---- UPDATE_FRAG framing (pipelined CRAQ writes) ----
+# A fragment stream ships one update's payload as bounded frames AHEAD of
+# the update RPC that consumes it (cut-through forwarding, storage/
+# reliable.py).  Like the packed batch-read path, the descriptor is a
+# fixed-stride struct riding one bytes field, negotiated by method name
+# (Storage.update_frag answers RPC_METHOD_NOT_FOUND on an old server).
+
+FRAG_EOF = 1 << 0      # last fragment of the stream
+FRAG_RELAY = 1 << 1    # receiver should relay downstream (cut-through)
+
+_FRAG_FMT = struct.Struct("<4qIBB")  # chain chain_ver seq total_len crc flags sid_len
+
+
+@dataclass
+class UpdateFrag:
+    """Decoded UPDATE_FRAG descriptor (not a serde struct: packed)."""
+    stream_id: str = ""
+    chain_id: int = 0
+    chain_ver: int = 0
+    seq: int = 0           # 0-based fragment index
+    total_len: int = 0     # whole payload length (every frame carries it)
+    frag_crc: int = 0      # CRC32C of this fragment's bytes
+    eof: bool = False
+    relay: bool = False
+
+
+def pack_update_frag(frag: UpdateFrag) -> bytes:
+    sid = frag.stream_id.encode()
+    if len(sid) > 255:
+        raise FrameError(f"stream id too long ({len(sid)})")
+    flags = (FRAG_EOF if frag.eof else 0) | (FRAG_RELAY if frag.relay else 0)
+    return _FRAG_FMT.pack(frag.chain_id, frag.chain_ver, frag.seq,
+                          frag.total_len, frag.frag_crc, flags,
+                          len(sid)) + sid
+
+
+def unpack_update_frag(blob: bytes) -> UpdateFrag:
+    (chain_id, chain_ver, seq, total_len, crc, flags,
+     sid_len) = _FRAG_FMT.unpack_from(blob)
+    sid = blob[_FRAG_FMT.size:]
+    if len(sid) != sid_len:
+        raise FrameError(f"frag stream-id tail {len(sid)} != {sid_len}")
+    return UpdateFrag(stream_id=sid.decode(), chain_id=chain_id,
+                      chain_ver=chain_ver, seq=seq, total_len=total_len,
+                      frag_crc=crc, eof=bool(flags & FRAG_EOF),
+                      relay=bool(flags & FRAG_RELAY))
+
+
 @serde_struct
 @dataclass
 class WireStatus:

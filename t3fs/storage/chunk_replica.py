@@ -110,7 +110,20 @@ class ChunkReplica:
         cur_state = meta.state if meta else ChunkState.COMMIT
 
         if io.update_ver <= cur_commit:
-            # already applied and committed (late duplicate)
+            if io.update_ver == cur_commit and cur_update == cur_commit:
+                # re-delivery of the update this replica already COMMITTED.
+                # The tail commits before its predecessors, so a mid-chain
+                # failure after the tail committed leaves the head retrying
+                # v against a tail already at committed v — rare under the
+                # serialized write path, DETERMINISTIC under write
+                # pipelining (the successor leg runs concurrently with the
+                # failing hop's apply).  Versions uniquely name updates
+                # chain-wide (assigned under the head's per-chunk lock,
+                # pinned across retries by remember_version), so this is
+                # the same update: ack with the committed meta.
+                return IOResult(WireStatus(), meta.length, meta.update_ver,
+                                meta.commit_ver, meta.chain_ver, meta.checksum)
+            # older than committed state: genuinely late duplicate
             raise make_error(StatusCode.CHUNK_STALE_UPDATE,
                              f"{io.chunk_id}: v{io.update_ver} <= committed v{cur_commit}")
         if io.update_ver == cur_update and cur_state == ChunkState.DIRTY:

@@ -32,7 +32,7 @@ from dataclasses import dataclass
 
 import numpy as np
 
-from t3fs.ops.codec import crc32c as cpu_crc32c
+from t3fs.ops.codec import crc32c as cpu_crc32c, crc32c_combine
 from t3fs.ops.crc32c import default_matrices
 
 log = logging.getLogger("t3fs.storage.codec")
@@ -52,6 +52,13 @@ class ChecksumBackend:
 
     async def payload_crc(self, data: bytes) -> int:
         raise NotImplementedError
+
+    def combine(self, a: int, b: int, len_b: int) -> int:
+        """CRC32C of a concatenation from the parts' CRCs — the incremental
+        rollup fragment streams use so per-fragment CRCs fold up to the
+        chunk checksum without a second pass over the bytes (O(log n)
+        matrix fold per fragment, no data touched)."""
+        return crc32c_combine(a, b, len_b)
 
     @property
     def verify_enabled(self) -> bool:
@@ -75,6 +82,9 @@ class NullChecksumBackend(ChecksumBackend):
 
     async def payload_crc(self, data: bytes) -> int:
         return 0
+
+    def combine(self, a: int, b: int, len_b: int) -> int:
+        return 0   # every checksum path must agree on 0 (see add_target)
 
     @property
     def verify_enabled(self) -> bool:

@@ -41,6 +41,18 @@ class StorageConfig(ConfigBase):
     # io_uring read pipeline (AioReadWorker analog); auto-disables when the
     # kernel lacks io_uring
     aio_read: bool = citem(True, hot=False)
+    # pipelined CRAQ writes (docs/design_notes.md §3): off = serialize
+    # apply -> CRC -> forward (legacy behavior, byte-identical); overlap =
+    # forward concurrently with local CRC+apply; streamed = overlap +
+    # cut-through UPDATE_FRAG fragment forwarding
+    write_pipeline: str = citem(
+        "off", validator=lambda v: v in ("off", "overlap", "streamed"))
+    # payloads at/above this stream as fragments (write_pipeline=streamed)
+    stream_threshold: int = citem(512 << 10, validator=lambda v: v > 0)
+    stream_frag_bytes: int = citem(256 << 10, validator=lambda v: v > 0)
+    # unacknowledged in-flight fragments per stream (every window-th frame
+    # is a call() whose response is the cumulative ack)
+    stream_window: int = citem(4, validator=lambda v: v > 0)
 
 
 class StorageServer:
@@ -49,15 +61,21 @@ class StorageServer:
                  heartbeat_period_s: float = 0.3,
                  resync_period_s: float = 0.2,
                  checksum_backend: str = "cpu",
+                 write_pipeline: str = "off",
                  cfg: StorageConfig | None = None,
                  admin_token: str = ""):
         self.cfg = cfg or StorageConfig(
             host=host, port=port, heartbeat_period_s=heartbeat_period_s,
-            resync_period_s=resync_period_s, checksum_backend=checksum_backend)
+            resync_period_s=resync_period_s, checksum_backend=checksum_backend,
+            write_pipeline=write_pipeline)
         self.node_id = node_id
         self.server = Server(self.cfg.host, self.cfg.port)
         self.node = StorageNode(node_id, self._routing, Client(),
-                                checksum_backend=self.cfg.checksum_backend)
+                                checksum_backend=self.cfg.checksum_backend,
+                                write_pipeline=self.cfg.write_pipeline)
+        self.node.stream_threshold = self.cfg.stream_threshold
+        self.node.stream_frag_bytes = self.cfg.stream_frag_bytes
+        self.node.stream_window = self.cfg.stream_window
         self.service = StorageService(self.node)
         self.server.add_service(self.service)
         from t3fs.core.service import AppInfo, CoreService
@@ -120,6 +138,10 @@ class StorageServer:
             self.mgmtd.heartbeat_period_s = self.cfg.heartbeat_period_s
             self.mgmtd.refresh_period_s = self.cfg.heartbeat_period_s
         self.resync.period_s = self.cfg.resync_period_s
+        self.node.write_pipeline = self.cfg.write_pipeline
+        self.node.stream_threshold = self.cfg.stream_threshold
+        self.node.stream_frag_bytes = self.cfg.stream_frag_bytes
+        self.node.stream_window = self.cfg.stream_window
 
     async def start(self) -> None:
         if self.cfg.aio_read:

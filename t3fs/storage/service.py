@@ -25,18 +25,20 @@ from t3fs.mgmtd.types import (
 from t3fs.net.conn import Connection
 from t3fs.net.rdma import remote_read, remote_write
 from t3fs.net.server import rpc_method, service
-from t3fs.net.wire import WireStatus
+from t3fs.net.wire import UpdateFrag, WireStatus, unpack_update_frag
 from t3fs.storage.chunk_engine import ChunkEngine
 from t3fs.storage.chunk_replica import ChunkReplica
-from t3fs.storage.reliable import ReliableForwarding, ReliableUpdate
+from t3fs.storage.reliable import (
+    FragmentStore, ReliableForwarding, ReliableUpdate,
+)
 from t3fs.storage.types import (
     BatchReadReq, BatchReadRsp, ChunkId, IOResult, PACKED_READIO_VER,
     PackedIOReq, PackedIORsp,
     QueryChunkReq, QueryChunkRsp, QueryLastChunkReq, QueryLastChunkRsp,
     ReadIO, RemoveChunksReq, SpaceInfoRsp, SyncDoneReq, SyncDoneRsp,
     SyncStartReq, SyncStartRsp, TargetOpReq, TargetOpRsp, TruncateChunkReq,
-    UpdateIO, UpdateType, WriteReq, WriteRsp, pack_ioresults,
-    unpack_readios, unpack_updateio,
+    UpdateFragReq, UpdateFragRsp, UpdateIO, UpdateType, WriteReq, WriteRsp,
+    pack_ioresults, unpack_readios, unpack_updateio,
 )
 from t3fs.analytics.trace_log import StorageEventTrace
 from t3fs.utils.fault_injection import fault_raise
@@ -111,7 +113,8 @@ class StorageNode:
 
     def __init__(self, node_id: int, routing_provider: Callable[[], RoutingInfo],
                  client, forward_timeout_s: float = 10.0,
-                 checksum_backend: str = "cpu", read_concurrency: int = 16):
+                 checksum_backend: str = "cpu", read_concurrency: int = 16,
+                 write_pipeline: str = "off"):
         from t3fs.storage.codec_backend import make_checksum_backend
 
         self.node_id = node_id
@@ -121,6 +124,16 @@ class StorageNode:
         # the codec seam (north star): cpu | tpu | null
         self.codec = make_checksum_backend(checksum_backend)
         self.read_concurrency = read_concurrency
+        # pipelined CRAQ writes (docs/design_notes.md §3): off = serialize
+        # apply -> CRC -> forward exactly as before; overlap = dispatch the
+        # successor forward concurrently with the local CRC+apply; streamed
+        # = overlap + cut-through UPDATE_FRAG forwarding above
+        # stream_threshold.  All hot-updatable (StorageConfig).
+        self.write_pipeline = write_pipeline
+        self.stream_threshold = 512 << 10
+        self.stream_frag_bytes = 256 << 10
+        self.stream_window = 4
+        self.frag_store = FragmentStore(combine=self.codec.combine)
         self._read_sem: asyncio.Semaphore | None = None
         # io_uring read pipeline (AioReadWorker.h:21-44 analog); started by
         # the server when the kernel supports it, else large reads keep the
@@ -287,6 +300,45 @@ class StorageService:
                                               require_head=False)
         return self._packed_rsp(result), b""
 
+    # -- fragment streaming (write_pipeline=streamed; design_notes.md §3) --
+
+    @rpc_method
+    async def update_frag(self, req: UpdateFragReq, payload: bytes,
+                          conn: Connection):
+        """One UPDATE_FRAG frame: buffer it for the update RPC that will
+        consume the stream, and — cut-through — relay it toward the chain
+        successor before this hop's own apply ever runs.  Fragments are
+        unvalidated bytes until the version-gated update consumes them; a
+        stream orphaned by a dead sender expires by TTL in FragmentStore."""
+        node = self.node
+        frag = unpack_update_frag(req.blob)
+        received = node.frag_store.put(frag, payload)
+        if frag.relay and node.write_pipeline == "streamed":
+            address = self._frag_relay_address(frag)
+            if address is not None:
+                node.frag_store.mark_relayed(frag.stream_id, address)
+                await node.forwarding.relay_frag(address, req, payload,
+                                                 frag.eof)
+        return UpdateFragRsp(received=received), b""
+
+    def _frag_relay_address(self, frag: UpdateFrag) -> str | None:
+        """Successor address for cut-through relay, or None to keep the
+        fragments local (tail, SYNCING successor — which needs the full
+        applied chunk, not raw fragments — or a moved/unknown chain; the
+        consuming update's own forward handles every such case)."""
+        node = self.node
+        routing = node.routing()
+        chain = routing.chain(frag.chain_id) if routing else None
+        if chain is None or chain.chain_ver != frag.chain_ver:
+            return None
+        target = node._target_for_chain(chain)
+        if target is None:
+            return None
+        succ = chain.successor_of(target.target_id)
+        if succ is None or succ.public_state == PublicTargetState.SYNCING:
+            return None
+        return routing.node_address(succ.node_id)
+
     async def _handle_update(self, io: UpdateIO, payload: bytes,
                              conn: Connection, require_head: bool) -> IOResult:
         """Trace-wrapped update: one StorageEventTrace row per update hop
@@ -314,7 +366,9 @@ class StorageService:
                 checksum=result.checksum if result else 0,
                 forward_status=trace.get("forward_status", 0),
                 commit_status=result.status.code if result else -1,
-                latency_s=_time.perf_counter() - t0))
+                latency_s=_time.perf_counter() - t0,
+                forward_s=trace.get("forward_s", 0.0),
+                apply_s=trace.get("apply_s", 0.0)))
 
     async def _handle_update_inner(self, io: UpdateIO, payload: bytes,
                                    conn: Connection, require_head: bool,
@@ -374,10 +428,18 @@ class StorageService:
         from t3fs.storage.types import UpdateType
         if require_head:
             node.reliable_update.begin(io)
-        # fetch payload: one-sided pull from requester, or inline frame
+        # fetch payload: one-sided pull from requester, inline frame, or
+        # UPDATE_FRAG stream (already buffered/relayed by update_frag)
+        frags_relayed_to: str | None = None
+        stream_crc: int | None = None
         if io.buf is not None and not io.inline:
             payload = await remote_read(conn, io.buf)
             trace_add("storage.update.pulled", f"len={len(payload)}")
+        elif io.stream_id and not payload:
+            payload, stream_crc, frags_relayed_to = \
+                await node.frag_store.take(io.stream_id,
+                                           timeout=node.forward_timeout_s)
+            trace_add("storage.update.stream", f"len={len(payload)}")
         if io.update_ver == 0:
             # a retry of a retryably-failed attempt reuses the version it
             # was assigned: the replica's idempotent-pending branch then
@@ -393,6 +455,19 @@ class StorageService:
                     node.reliable_update.remember_version(io)
         io.chain_ver = chain.chain_ver
 
+        # hop overlap (write_pipeline != off): dispatch the successor
+        # forward CONCURRENTLY with the local CRC+apply below, instead of
+        # after them.  Commit ordering is preserved — the tail still
+        # commits first, every replica version-gates what it applies, and
+        # the head acks only after BOTH legs returned OK — so the only new
+        # state is a successor holding a DIRTY version whose local apply
+        # failed, which the same retry/resync machinery that already
+        # handles the mirror case (local applied, forward failed)
+        # reconciles.  Excluded: a SYNCING successor, whose forward ships
+        # the full APPLIED chunk and so needs the local apply first.
+        overlap = node.write_pipeline != "off" \
+            and self._overlap_ok(chain, target, io)
+
         # checksum via the codec seam: the device backend micro-batches
         # CRCs across every update concurrently in flight on this node
         # (BASELINE north star; replaces folly::crc32c, Common.h:158)
@@ -402,28 +477,65 @@ class StorageService:
             if not node.codec.verify_enabled:
                 io.checksum = 0
                 payload_crc = 0
-            else:
+            elif stream_crc is not None:
+                # fragment CRCs rolled up at reassembly — no second pass
+                payload_crc = stream_crc
+            elif not overlap:
                 payload_crc = await node.codec.payload_crc(payload)
+                # else: computed under the overlap window below
 
+        fwd_task: asyncio.Task | None = None
+        t_fwd = _time.perf_counter()
+        if overlap:
+            fwd_task = asyncio.ensure_future(self._forward(
+                chain, target, io, payload, frags_relayed_to,
+                defer_full_replace=True))
+
+        t_apply = _time.perf_counter()
         try:
+            if overlap and payload_crc is None and payload and \
+                    io.update_type in (UpdateType.WRITE, UpdateType.REPLACE):
+                payload_crc = await node.codec.payload_crc(payload)
             result = await target.run_update(
                 target.replica.apply_update, io, payload, payload_crc)
             trace_add("storage.update.applied", f"ver={io.update_ver}")
         except (OSError, StatusError) as e:
+            if fwd_task is not None:
+                # let the in-flight forward settle before surfacing the
+                # local failure: the successor may apply this version, and
+                # version gating + retry/resync reconcile it either way
+                await asyncio.gather(fwd_task, return_exceptions=True)
             if node.mark_if_disk_error(target, e):
                 result = IOResult(WireStatus(int(StatusCode.DISK_ERROR),
                                              f"disk error: {e}"))
             else:
                 result = IOResult(WireStatus(int(e.code), str(e)))
             return result  # _update_to_result records all failures
+        trace["apply_s"] = _time.perf_counter() - t_apply
 
-        # forward down the chain (tail commits first)
+        # forward down the chain (tail commits first); under overlap the
+        # forward has been in flight since before the apply
         try:
-            succ_result = await self._forward(chain, target, io, payload)
+            if fwd_task is not None:
+                succ_result = await fwd_task
+            else:
+                t_fwd = _time.perf_counter()
+                succ_result = await self._forward(chain, target, io, payload,
+                                                  frags_relayed_to)
+            if succ_result is not None and succ_result.status.code == int(
+                    StatusCode.CHUNK_MISSING_UPDATE) \
+                    and io.update_type in (UpdateType.WRITE,
+                                           UpdateType.TRUNCATE) and overlap:
+                # deferred full-replace: under overlap the fallback must
+                # wait for the LOCAL apply (it ships the applied chunk),
+                # so _forward returned the miss for us to retry here
+                succ_result = await self._forward_full_replace(target, io)
             trace_add("storage.update.forwarded")
+            trace["forward_s"] = _time.perf_counter() - t_fwd
             if succ_result is not None:
                 trace["forward_status"] = succ_result.status.code
         except StatusError as e:
+            trace["forward_s"] = _time.perf_counter() - t_fwd
             return IOResult(WireStatus(int(e.code), f"forward: {e}"))
 
         if succ_result is not None and succ_result.status.code == int(StatusCode.OK):
@@ -452,8 +564,23 @@ class StorageService:
             node.reliable_update.record(io, result)
         return result
 
+    @staticmethod
+    def _overlap_ok(chain: ChainInfo, target: StorageTarget,
+                    io: UpdateIO) -> bool:
+        """Overlap only when the forward doesn't depend on the LOCAL apply
+        having finished: a SYNCING successor gets the full APPLIED chunk
+        (_forward_full_replace), which exists only after apply."""
+        succ = chain.successor_of(target.target_id)
+        if succ is None:
+            return False   # tail: nothing to overlap with
+        return not (succ.public_state == PublicTargetState.SYNCING
+                    and io.update_type in (UpdateType.WRITE,
+                                           UpdateType.TRUNCATE))
+
     async def _forward(self, chain: ChainInfo, target: StorageTarget,
-                       io: UpdateIO, payload: bytes) -> IOResult | None:
+                       io: UpdateIO, payload: bytes,
+                       relayed_to: str | None = None,
+                       defer_full_replace: bool = False) -> IOResult | None:
         succ = chain.successor_of(target.target_id)
         if succ is None:
             return None
@@ -462,7 +589,8 @@ class StorageService:
             # write-during-recovery: ship the FULL updated chunk so the
             # syncing successor converges (design_notes.md:240-246)
             return await self._forward_full_replace(target, io)
-        result = await self.node.forwarding.forward(target.target_id, io, payload)
+        result = await self.node.forwarding.forward(target.target_id, io,
+                                                    payload, relayed_to)
         if result is not None and result.status.code == int(
                 StatusCode.CHUNK_MISSING_UPDATE) \
                 and io.update_type in (UpdateType.WRITE, UpdateType.TRUNCATE):
@@ -472,6 +600,11 @@ class StorageService:
             # back to full-chunk forwarding (ReliableForwarding.cc:33-138);
             # replace with our applied content, version-gated so it can
             # never regress a newer successor copy.
+            if defer_full_replace:
+                # overlap mode: the local apply may still be running —
+                # _locked_update retries the full replace after gathering
+                # both legs, when the applied content exists
+                return result
             return await self._forward_full_replace(target, io)
         return result
 
@@ -479,12 +612,10 @@ class StorageService:
                                     io: UpdateIO) -> IOResult | None:
         meta = target.engine.get_meta(io.chunk_id)
         full = target.engine.read(io.chunk_id)
-        rep = UpdateIO(**{**io.__dict__})
-        rep.update_type = UpdateType.REPLACE
-        rep.offset = 0
-        rep.length = len(full)
-        rep.checksum = meta.checksum
-        rep.commit_ver = 0  # commit decided by chain flow
+        rep = io.clone(update_type=UpdateType.REPLACE, offset=0,
+                       length=len(full), checksum=meta.checksum,
+                       commit_ver=0,  # commit decided by chain flow
+                       stream_id="")
         return await self.node.forwarding.forward(target.target_id, rep, full)
 
     # ---- read path ----

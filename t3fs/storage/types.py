@@ -9,7 +9,7 @@ from __future__ import annotations
 
 import enum
 import struct
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, replace as _dc_replace
 
 from t3fs.utils.serde import serde_struct
 from t3fs.net.wire import WireStatus
@@ -84,6 +84,21 @@ class UpdateIO:
     from_head: bool = False            # set on forwarded hops
     commit_only: bool = False
     debug: DebugFlags = field(default_factory=DebugFlags)
+    # fragment-streamed payload (write pipelining, docs/design_notes.md §3):
+    # non-empty names an UPDATE_FRAG stream the receiver reassembles instead
+    # of reading the frame payload.  Appended last (serde add-only).
+    stream_id: str = ""
+
+    def clone(self, **overrides) -> "UpdateIO":
+        """Copy for a forwarded/derived hop.  The old
+        `UpdateIO(**io.__dict__)` idiom shared the mutable DebugFlags (a
+        fault-injection countdown on the copy would tick the original's
+        state too, and vice versa); clone gives the copy its own debug
+        unless the caller overrides it."""
+        out = _dc_replace(self, **overrides)
+        if "debug" not in overrides:
+            out.debug = _dc_replace(self.debug)
+        return out
 
 
 @serde_struct
@@ -368,7 +383,7 @@ def pack_updateio(io: UpdateIO) -> bytes | None:
     """None when the IO needs the full struct (RemoteBuf pull, fault
     injection flags, oversized client_id, out-of-range field)."""
     d = io.debug
-    if io.buf is not None or d.inject_server_error_prob or \
+    if io.buf is not None or io.stream_id or d.inject_server_error_prob or \
             d.inject_client_error_prob or d.num_points_before_fail:
         return None
     cid = io.client_id.encode()
@@ -418,6 +433,24 @@ class PackedIORsp:
     result carries the full struct otherwise."""
     packed: bytes = b""
     result: IOResult | None = None
+
+
+@serde_struct
+@dataclass
+class UpdateFragReq:
+    """One UPDATE_FRAG frame (pipelined writes): the fixed-stride frag
+    descriptor (t3fs/net/wire.py pack_update_frag) rides a single bytes
+    field, the fragment data rides the frame payload."""
+    blob: bytes = b""
+
+
+@serde_struct
+@dataclass
+class UpdateFragRsp:
+    """Window ack for a call()-type fragment; received = bytes of this
+    stream buffered so far on the receiver (diagnostics)."""
+    ok: bool = True
+    received: int = 0
 
 
 async def update_rpc(client, address: str, io: UpdateIO, payload: bytes,

@@ -28,10 +28,17 @@ class LocalCluster:
     def __init__(self, num_nodes: int = 3, replicas: int = 3,
                  num_chains: int = 1,
                  heartbeat_timeout_s: float = 0.6,
-                 with_meta: bool = False):
+                 with_meta: bool = False,
+                 write_pipeline: str = "off",
+                 stream_threshold: int | None = None):
         self.num_nodes = num_nodes
         self.replicas = replicas
         self.num_chains = num_chains
+        # write-pipeline mode for every storage node (tests parameterize
+        # resync/fault suites over it); stream_threshold lets small-chunk
+        # tests exercise the fragment path
+        self.write_pipeline = write_pipeline
+        self.stream_threshold = stream_threshold
         self.with_meta = with_meta
         self.meta: MetaServer | None = None
         self.meta_rpc: Server | None = None
@@ -119,7 +126,11 @@ class LocalCluster:
         ss = StorageServer(node_id, self.mgmtd_rpc.address,
                            heartbeat_period_s=min(
                                0.15, self.mgmtd_cfg.heartbeat_timeout_s / 6),
-                           resync_period_s=0.1)
+                           resync_period_s=0.1,
+                           write_pipeline=self.write_pipeline)
+        if self.stream_threshold is not None:
+            ss.node.stream_threshold = self.stream_threshold
+            ss.node.stream_frag_bytes = max(1, self.stream_threshold // 2)
         try:
             for c in range(self.num_chains):
                 # every node pre-creates targets for chains it may serve

@@ -27,10 +27,14 @@ class StorageFabric:
     default_checksum_backend: str = "cpu"
     default_engine_backend: str = "native"
     default_aio_read: bool = True
+    default_write_pipeline: str = "off"
+    default_stream_threshold: int | None = None
 
     def __init__(self, num_nodes: int = 3, replicas: int = 3, chain_id: int = 1,
                  checksum_backend=None, engine_backend: str | None = None,
-                 aio_read: bool | None = None):
+                 aio_read: bool | None = None,
+                 write_pipeline: str | None = None,
+                 stream_threshold: int | None = None):
         assert replicas <= num_nodes
         self.num_nodes = num_nodes
         self.replicas = replicas
@@ -40,6 +44,10 @@ class StorageFabric:
         self.checksum_backend = (checksum_backend if checksum_backend is not None
                                  else self.default_checksum_backend)
         self.engine_backend = engine_backend or self.default_engine_backend
+        self.write_pipeline = write_pipeline or self.default_write_pipeline
+        # tests lower the threshold so small payloads exercise streaming
+        self.stream_threshold = (stream_threshold if stream_threshold
+                                 is not None else self.default_stream_threshold)
         self.routing = RoutingInfo(version=1)
         self.servers: list[Server] = []
         self.nodes: list[StorageNode] = []
@@ -55,7 +63,11 @@ class StorageFabric:
         for i in range(self.num_nodes):
             node_id = i + 1
             node = StorageNode(node_id, lambda: self.routing, Client(),
-                               checksum_backend=self.checksum_backend)
+                               checksum_backend=self.checksum_backend,
+                               write_pipeline=self.write_pipeline)
+            if self.stream_threshold is not None:
+                node.stream_threshold = self.stream_threshold
+                node.stream_frag_bytes = max(1, self.stream_threshold // 2)
             if self.aio_read:
                 from t3fs.storage.aio import AioReadWorker
                 if AioReadWorker.available():

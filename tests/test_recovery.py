@@ -25,9 +25,19 @@ async def wait_for(predicate, timeout=10.0, interval=0.05, desc="condition"):
     raise AssertionError(f"timeout waiting for {desc}")
 
 
-def test_cluster_write_read():
+# write-pipeline matrix for the tests that push data through the chain:
+# streamed runs with a small threshold so these modest payloads fragment
+PIPELINE_MODES = [("off", None), ("overlap", None), ("streamed", 2048)]
+PIPELINE_IDS = [m for m, _ in PIPELINE_MODES]
+
+
+@pytest.mark.parametrize("write_pipeline,stream_threshold", PIPELINE_MODES,
+                         ids=PIPELINE_IDS)
+def test_cluster_write_read(write_pipeline, stream_threshold):
     async def body():
-        cluster = LocalCluster(num_nodes=3, replicas=3)
+        cluster = LocalCluster(num_nodes=3, replicas=3,
+                               write_pipeline=write_pipeline,
+                               stream_threshold=stream_threshold)
         await cluster.start()
         try:
             lay = FileLayout(chunk_size=4096, chains=[1])
@@ -41,10 +51,15 @@ def test_cluster_write_read():
     asyncio.run(body())
 
 
-def test_failstop_reshape_write_rejoin_resync():
+@pytest.mark.parametrize("write_pipeline,stream_threshold", PIPELINE_MODES,
+                         ids=PIPELINE_IDS)
+def test_failstop_reshape_write_rejoin_resync(write_pipeline,
+                                              stream_threshold):
     async def body():
         cluster = LocalCluster(num_nodes=3, replicas=3,
-                               heartbeat_timeout_s=0.6)
+                               heartbeat_timeout_s=0.6,
+                               write_pipeline=write_pipeline,
+                               stream_threshold=stream_threshold)
         await cluster.start()
         try:
             lay = FileLayout(chunk_size=4096, chains=[1])
@@ -126,7 +141,10 @@ def test_rejoining_node_drops_extra_chunks():
     asyncio.run(body())
 
 
-def test_disk_failure_offline_replace_resync():
+@pytest.mark.parametrize("write_pipeline,stream_threshold", PIPELINE_MODES,
+                         ids=PIPELINE_IDS)
+def test_disk_failure_offline_replace_resync(write_pipeline,
+                                             stream_threshold):
     """Disk dies under a LIVE node mid-writes: write error marks the target
     OFFLINE, heartbeats propagate, mgmtd pulls it from the chain with no
     acked-write loss; operator 'replaces the disk' and the target resyncs
@@ -134,7 +152,9 @@ def test_disk_failure_offline_replace_resync():
     worker/CheckWorker analogs)."""
     async def body():
         cluster = LocalCluster(num_nodes=3, replicas=3,
-                               heartbeat_timeout_s=0.6)
+                               heartbeat_timeout_s=0.6,
+                               write_pipeline=write_pipeline,
+                               stream_threshold=stream_threshold)
         await cluster.start()
         try:
             lay = FileLayout(chunk_size=4096, chains=[1])

@@ -54,6 +54,26 @@ def _checksum_backend(request, monkeypatch):
                 min_device_bytes=0, max_wait_us=200)))
 
 
+@pytest.fixture(autouse=True, params=["off", "overlap", "streamed"])
+def _write_pipeline(request, monkeypatch):
+    """All three write-pipeline modes (docs/design_notes.md §3).  `off`
+    (the legacy serialized path) runs against the full engine/read/checksum
+    matrix; the pipelined modes run only on the canonical combo
+    (native+aio+cpu) — the pipeline restructures _locked_update's dataflow,
+    which is orthogonal to engine/read/checksum choice, and the full
+    cross-product would triple suite wall-time for no added coverage."""
+    mode = request.param
+    if mode != "off":
+        p = request.node.callspec.params
+        if (p.get("_engine_backend"), p.get("_read_pipeline"),
+                p.get("_checksum_backend")) != ("native", "aio", "cpu"):
+            pytest.skip("pipelined modes run on the canonical combo only")
+    monkeypatch.setattr(StorageFabric, "default_write_pipeline", mode)
+    if mode == "streamed":
+        # small threshold so ordinary test payloads exercise fragmentation
+        monkeypatch.setattr(StorageFabric, "default_stream_threshold", 512)
+
+
 def make_write(fabric, cid, data, *, offset=0, seq=1, channel=7,
                update_ver=0, chunk_size=4096):
     return WriteReq(io=UpdateIO(

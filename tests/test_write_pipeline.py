@@ -1,0 +1,297 @@
+"""Pipelined CRAQ writes (docs/design_notes.md §3): fragment reassembly,
+UpdateIO.clone isolation, cut-through streaming end-to-end, and the
+mid-stream successor-death fault path (head must fail retryably, never
+ack, and converge on a same-seq retry).
+
+Reference analogs: ReliableForwarding.cc:33-138 (retry-until-reshape),
+TestStorageServiceFailStop.cc (successor death under writes).
+"""
+
+import asyncio
+import random
+
+import pytest
+
+from t3fs.mgmtd.types import ChainTargetInfo, PublicTargetState
+from t3fs.net.wire import UpdateFrag
+from t3fs.ops.crc32c import crc32c_ref
+from t3fs.storage.reliable import FragmentStore
+from t3fs.storage.types import (
+    BatchReadReq, ChunkId, ReadIO, UpdateIO, UpdateType, WriteReq,
+)
+from t3fs.testing.fabric import StorageFabric
+from t3fs.utils.status import Status, StatusCode, StatusError
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+# --- FragmentStore units ---
+
+def _frags(data: bytes, frag_bytes: int, stream_id: str = "s1"):
+    n = max(1, -(-len(data) // frag_bytes))
+    out = []
+    for seq in range(n):
+        part = data[seq * frag_bytes:(seq + 1) * frag_bytes]
+        out.append((UpdateFrag(stream_id=stream_id, seq=seq,
+                               total_len=len(data),
+                               frag_crc=crc32c_ref(part),
+                               eof=seq == n - 1), part))
+    return out
+
+
+def test_fragment_store_out_of_order_reassembly_and_crc_rollup():
+    async def body():
+        store = FragmentStore()
+        data = bytes(random.Random(7).randbytes(10_000))
+        frags = _frags(data, 1024)
+        random.Random(11).shuffle(frags)       # arrival order is not seq order
+        for frag, part in frags:
+            store.put(frag, part)
+        payload, crc, relayed = await store.take("s1", timeout=1.0)
+        assert payload == data
+        # fragment CRCs rolled up via crc32c_combine == whole-payload CRC
+        assert crc == crc32c_ref(data)
+        assert relayed is None
+        assert store.buffered_bytes == 0       # take() releases the buffer
+    run(body())
+
+
+def test_fragment_store_take_blocks_until_eof_arrives():
+    async def body():
+        store = FragmentStore()
+        data = b"ab" * 3000
+        frags = _frags(data, 1000)
+        for frag, part in frags[:-1]:
+            store.put(frag, part)
+
+        async def late_eof():
+            await asyncio.sleep(0.05)
+            store.put(*frags[-1])
+
+        task = asyncio.ensure_future(late_eof())
+        payload, crc, _ = await store.take("s1", timeout=2.0)
+        await task
+        assert payload == data and crc == crc32c_ref(data)
+    run(body())
+
+
+def test_fragment_store_incomplete_stream_times_out_retryably():
+    async def body():
+        store = FragmentStore()
+        frag, part = _frags(b"x" * 100, 10)[0]   # first fragment only, no EOF
+        store.put(frag, part)
+        with pytest.raises(StatusError) as ei:
+            await store.take("s1", timeout=0.05)
+        assert ei.value.status.retryable         # predecessor died: retry
+        assert store.buffered_bytes == 0         # timed-out stream discarded
+    run(body())
+
+
+def test_fragment_store_capacity_and_duplicate_frames():
+    async def body():
+        store = FragmentStore(max_bytes=100)
+        frag, part = _frags(b"y" * 60, 60)[0]
+        store.put(frag, part)
+        store.put(frag, part)                    # duplicate frame: dropped
+        assert store.buffered_bytes == 60
+        with pytest.raises(StatusError) as ei:
+            store.put(_frags(b"z" * 60, 60, "s2")[0][0], b"z" * 60)
+        assert StatusCode(ei.value.status.code) == StatusCode.BUSY
+        store.discard("s1")
+        assert store.buffered_bytes == 0
+    run(body())
+
+
+# --- UpdateIO.clone (satellite: the shared-debug aliasing fix) ---
+
+def test_updateio_clone_does_not_share_debug():
+    io = UpdateIO(chunk_id=ChunkId(1, 0), chain_id=1)
+    io.debug.num_points_before_fail = 3
+    copy = io.clone(update_type=UpdateType.REPLACE, offset=0)
+    assert copy.update_type == UpdateType.REPLACE
+    assert copy.debug is not io.debug
+    copy.debug.num_points_before_fail = 1        # fault countdown on the copy
+    assert io.debug.num_points_before_fail == 3  # ... must not tick the original
+    # and an explicit debug override is honored as-is
+    copy2 = io.clone(debug=io.debug)
+    assert copy2.debug is io.debug
+
+
+# --- committed re-delivery (the gap hop overlap made deterministic) ---
+
+def test_redelivery_of_committed_update_is_idempotent(tmp_path):
+    """The tail commits before its predecessors, so a mid-chain failure can
+    leave the head retrying v against a replica that already COMMITTED v.
+    Re-delivery of exactly the committed version must ack with the committed
+    meta; anything older stays CHUNK_STALE_UPDATE."""
+    from t3fs.storage.chunk_engine import ChunkEngine
+    from t3fs.storage.chunk_replica import ChunkReplica
+
+    rep = ChunkReplica(ChunkEngine(str(tmp_path / "t")))
+    cid = ChunkId(31, 0)
+    data = b"v1-bytes" * 64
+    io1 = UpdateIO(chunk_id=cid, chain_id=1, update_type=UpdateType.WRITE,
+                   offset=0, length=len(data), chunk_size=4096,
+                   checksum=crc32c_ref(data), update_ver=1)
+    rep.apply_update(io1, data)
+    rep.commit(cid, 1, 1)
+
+    again = rep.apply_update(io1, data)      # same v, already committed
+    assert again.update_ver == 1 and again.commit_ver == 1
+    assert again.checksum == crc32c_ref(data)
+
+    data2 = b"v2-bytes" * 64
+    io2 = UpdateIO(chunk_id=cid, chain_id=1, update_type=UpdateType.WRITE,
+                   offset=0, length=len(data2), chunk_size=4096,
+                   checksum=crc32c_ref(data2), update_ver=2)
+    rep.apply_update(io2, data2)
+    rep.commit(cid, 2, 1)
+    with pytest.raises(StatusError) as ei:   # v1 now genuinely stale
+        rep.apply_update(io1, data)
+    assert StatusCode(ei.value.status.code) == StatusCode.CHUNK_STALE_UPDATE
+
+
+# --- end-to-end streamed writes ---
+
+def make_write(fabric, cid, data, *, seq=1, channel=7, chunk_size=1 << 20):
+    return WriteReq(io=UpdateIO(
+        chunk_id=cid, chain_id=fabric.chain_id,
+        chain_ver=fabric.chain().chain_ver,
+        update_type=UpdateType.WRITE, offset=0, length=len(data),
+        chunk_size=chunk_size, checksum=crc32c_ref(data),
+        channel=channel, channel_seq=seq, client_id="wp-test", inline=True))
+
+
+async def write(fabric, cid, data, **kw):
+    rsp, _ = await fabric.client.call(
+        fabric.head_address(), "Storage.write",
+        make_write(fabric, cid, data, **kw), payload=data)
+    return rsp.result
+
+
+def test_streamed_write_replicates_byte_exact():
+    """4-frag stream through a 3-deep chain: every replica byte-identical,
+    and the fragment path actually engaged (no silent inline fallback)."""
+    async def body():
+        fab = StorageFabric(num_nodes=3, replicas=3, write_pipeline="streamed",
+                            stream_threshold=2048)
+        await fab.start()
+        try:
+            puts = []
+            for node in fab.nodes:
+                orig = node.frag_store.put
+                node.frag_store.put = (
+                    lambda frag, payload, _o=orig:
+                    (puts.append(frag.stream_id), _o(frag, payload))[1])
+            data = bytes(random.Random(3).randbytes(8192))
+            cid = ChunkId(21, 0)
+            result = await write(fab, cid, data)
+            assert result.status.code == int(StatusCode.OK), result.status
+            assert puts, "streamed mode never sent a fragment"
+            for i in range(3):
+                target = fab.nodes[i].targets[fab.target_id(i)]
+                assert target.engine.read(cid) == data, f"replica {i} diverged"
+                assert target.engine.get_meta(cid).commit_ver == 1
+        finally:
+            await fab.stop()
+    run(body())
+
+
+def test_successor_death_mid_stream_is_retryable_and_retry_converges():
+    """Kill the middle replica while the head is streaming fragments to it:
+    the head must return a RETRYABLE status (never OK — the chain did not
+    commit), and after mgmtd drops the dead successor a retry on the SAME
+    channel seq converges with the same update_ver (dedupe +
+    remember_version hold across the failure)."""
+    async def body():
+        fab = StorageFabric(num_nodes=3, replicas=3, write_pipeline="streamed",
+                            stream_threshold=2048)
+        await fab.start()
+        # fast-fail the head's forwarding so the test doesn't ride out the
+        # full retry-until-reshape window
+        fab.nodes[0].forwarding.max_attempts = 3
+        fab.nodes[0].forwarding.retry_delay_s = 0.01
+        try:
+            mid = fab.nodes[1]
+            mid_server = fab.servers[1]
+            seen = []
+            orig_put = mid.frag_store.put
+
+            def dying_put(frag, payload):
+                seen.append(frag.seq)
+                if len(seen) >= 2:   # "crash" mid-stream: drop the rest
+                    asyncio.ensure_future(mid_server.stop())
+                    raise StatusError(StatusCode.TARGET_OFFLINE,
+                                      "injected: successor died mid-stream")
+                return orig_put(frag, payload)
+
+            mid.frag_store.put = dying_put
+
+            data = bytes(random.Random(5).randbytes(8192))   # 8 fragments
+            cid = ChunkId(22, 0)
+            result = await write(fab, cid, data, seq=1)
+            st = Status(StatusCode(result.status.code), result.status.message)
+            assert not st.ok, "head acked a write the chain never committed"
+            assert st.retryable, f"non-retryable failure: {st}"
+            # head applied locally but must NOT have committed
+            head_target = fab.nodes[0].targets[fab.target_id(0)]
+            assert head_target.engine.get_meta(cid).commit_ver == 0
+
+            # mgmtd reshapes: dead successor drops off the chain
+            fab.bump_chain([
+                ChainTargetInfo(fab.target_id(0), 1, PublicTargetState.SERVING),
+                ChainTargetInfo(fab.target_id(2), 3, PublicTargetState.SERVING),
+            ])
+            mid.frag_store.put = orig_put
+
+            retry = await write(fab, cid, data, seq=1)   # SAME channel seq
+            assert retry.status.code == int(StatusCode.OK), retry.status
+            assert retry.update_ver == 1, \
+                "retry must reuse the remembered update_ver"
+            assert retry.commit_ver == 1
+            for i in (0, 2):
+                target = fab.nodes[i].targets[fab.target_id(i)]
+                assert target.engine.read(cid) == data
+                assert target.engine.get_meta(cid).commit_ver == 1
+        finally:
+            await fab.stop()
+    run(body())
+
+
+def test_off_mode_never_streams():
+    """write_pipeline=off must be byte-for-byte today's behavior: no
+    fragment traffic even for payloads above the threshold."""
+    async def body():
+        fab = StorageFabric(num_nodes=3, replicas=3, write_pipeline="off",
+                            stream_threshold=1024)
+        await fab.start()
+        try:
+            puts = []
+            for node in fab.nodes:
+                orig = node.frag_store.put
+                node.frag_store.put = (
+                    lambda frag, payload, _o=orig:
+                    (puts.append(1), _o(frag, payload))[1])
+            data = b"q" * 8192
+            result = await write(fab, ChunkId(23, 0), data)
+            assert result.status.code == int(StatusCode.OK)
+            assert not puts, "off mode sent fragments"
+        finally:
+            await fab.stop()
+    run(body())
+
+
+@pytest.mark.slow
+def test_streamed_smoke_via_bench():
+    """CI smoke for the full streamed path through the bench harness
+    (make write-bench analog): 3-replica 1 MiB writes, both off and
+    streamed, sane latencies out of the same code path the A/B uses."""
+    from benchmarks.storage_bench import run_write_bench
+
+    for mode in ("off", "streamed"):
+        out = run_write_bench(value_size=1 << 20, num_ops=4, concurrency=1,
+                              replicas=3, write_pipeline=mode)
+        assert out["ok"] == out["num_ops"], out
+        assert out["p50_ms"] > 0
