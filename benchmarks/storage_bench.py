@@ -27,11 +27,19 @@ from t3fs.utils.metrics import LatencyRecorder
 BENCH_INODE = 0xBE7C
 
 
+_SELECTION = {"load_balance": 0, "round_robin": 1, "head": 2, "tail": 3,
+              "adaptive": 4}   # TargetSelection by CLI name
+
+
 async def run_bench(args) -> dict:
     from benchmarks._env import make_env
+    from t3fs.client.storage_client import TargetSelection
     from t3fs.utils.fault_injection import DebugFlags
     env, sc, chains = await make_env(args, StorageClientConfig(
         verify_checksums=args.verify_checksums,
+        read_selection=TargetSelection(
+            _SELECTION[getattr(args, "read_selection", "load_balance")]),
+        read_hedging=getattr(args, "read_hedging", "off"),
         debug=DebugFlags(inject_server_error_prob=args.inject_server_error)))
     chain_id = chains[0]
     lat = LatencyRecorder("bench.op")
@@ -202,6 +210,130 @@ def write_pipeline_ab(value_size: int = 4 << 20, num_ops: int = 16,
     return out
 
 
+async def _read_bench_once(chunk_size: int, num_ops: int, *,
+                           replicas: int = 3, read_hedging: str = "off",
+                           read_selection: str = "load_balance",
+                           straggler_delay_s: float = 0.0,
+                           straggler_node: int = 0, batch: int = 4,
+                           num_chunks: int = 64) -> dict:
+    """Fixed-op batched-random-read latency probe against an in-process
+    fabric with one optional injected-straggler node (the ISSUE-5 shape:
+    the read tail is the hot path, and hedging + adaptive selection attack
+    exactly the straggler-induced p99).  Serial ops at `batch` IOs each —
+    with load_balance over 3 replicas and batch=4, ~80% of ops touch the
+    straggler, so its delay IS the unhedged p50/p99."""
+    import random as _random
+
+    from t3fs.client.storage_client import StorageClient, TargetSelection
+    from t3fs.net.rpcstats import READ_STATS
+    from t3fs.storage.types import ReadIO
+    from t3fs.testing.fabric import StorageFabric
+    from t3fs.utils.metrics import LatencyRecorder
+
+    READ_STATS.clear()   # fresh quantile state per run (bench hygiene)
+    fab = StorageFabric(num_nodes=max(3, replicas), replicas=replicas)
+    await fab.start()
+    sc = StorageClient(
+        lambda: fab.routing, client=fab.client,
+        config=StorageClientConfig(
+            read_selection=TargetSelection(_SELECTION[read_selection]),
+            read_hedging=read_hedging,
+            hedge_delay_floor_s=0.005, hedge_delay_cap_s=0.1))
+    lat = LatencyRecorder("bench.read")
+    stats: dict = {}
+    payload = os.urandom(chunk_size)
+    try:
+        await asyncio.gather(*[
+            sc.write_chunk(fab.chain_id, ChunkId(BENCH_INODE, i), 0,
+                           payload, chunk_size)
+            for i in range(num_chunks)])
+        fab.nodes[straggler_node].read_delay_s = straggler_delay_s
+        rng = _random.Random(0xD1CE)
+        t0 = time.perf_counter()
+        for _ in range(num_ops):
+            ios = [ReadIO(chunk_id=ChunkId(BENCH_INODE,
+                                           rng.randrange(num_chunks)),
+                          chain_id=fab.chain_id)
+                   for _ in range(batch)]
+            with lat.time():
+                await sc.batch_read(ios, stats=stats)
+        wall = time.perf_counter() - t0
+    finally:
+        fab.nodes[straggler_node].read_delay_s = 0.0
+        await sc.close()
+        await fab.stop()
+    snap = lat.collect()
+    fired = stats.get("hedge_fired", 0)
+    return {
+        "read_hedging": read_hedging, "read_selection": read_selection,
+        "chunk_size": chunk_size, "num_ops": num_ops, "batch": batch,
+        "replicas": replicas,
+        "straggler_delay_ms": round(straggler_delay_s * 1e3, 3),
+        "wall_s": round(wall, 3),
+        "p50_ms": round(snap.get("p50", 0) * 1e3, 3),
+        "p99_ms": round(snap.get("p99", 0) * 1e3, 3),
+        "hedge_fired": fired,
+        "hedge_won": stats.get("hedge_won", 0),
+        "hedge_wasted": stats.get("hedge_wasted", 0),
+        # per-IO hedge rate: the acceptance bound is the token-bucket
+        # budget (pct * reads + burst)
+        "hedge_rate": round(fired / max(1, num_ops * batch), 4),
+    }
+
+
+def run_read_bench(chunk_size: int, num_ops: int, **kw) -> dict:
+    return asyncio.run(_read_bench_once(chunk_size, num_ops, **kw))
+
+
+def read_hedging_ab(chunk_size: int = 64 << 10, num_ops: int = 120,
+                    replicas: int = 3, straggler_delay_s: float = 0.01,
+                    runs: int = 3) -> dict:
+    """The ISSUE-5 acceptance A/B: the same random-read workload against a
+    fabric with one injected 10ms-straggler node — off (load_balance, no
+    hedging, today's path) vs on (adaptive selection + hedged reads).
+    Interleaved off/on per docs/bench_protocol.md; quotes the median of
+    `runs` with the run arrays recorded verbatim."""
+    import statistics
+
+    async def body() -> dict:
+        off_runs, on_runs = [], []
+        for _ in range(runs):
+            off_runs.append(await _read_bench_once(
+                chunk_size, num_ops, replicas=replicas,
+                straggler_delay_s=straggler_delay_s))
+            on_runs.append(await _read_bench_once(
+                chunk_size, num_ops, replicas=replicas,
+                read_hedging="on", read_selection="adaptive",
+                straggler_delay_s=straggler_delay_s))
+
+        def med(rs: list[dict], key: str):
+            return round(statistics.median(r[key] for r in rs), 4)
+
+        out = {}
+        for mode, rs in (("off", off_runs), ("on", on_runs)):
+            out[mode] = {
+                "read_hedging": rs[0]["read_hedging"],
+                "read_selection": rs[0]["read_selection"],
+                "p50_ms": med(rs, "p50_ms"), "p99_ms": med(rs, "p99_ms"),
+                "hedge_fired": med(rs, "hedge_fired"),
+                "hedge_won": med(rs, "hedge_won"),
+                "hedge_wasted": med(rs, "hedge_wasted"),
+                "hedge_rate": med(rs, "hedge_rate"),
+                "runs_p50_ms": [r["p50_ms"] for r in rs],
+                "runs_p99_ms": [r["p99_ms"] for r in rs],
+            }
+        out["config"] = {"chunk_size": chunk_size, "num_ops": num_ops,
+                         "batch": off_runs[0]["batch"],
+                         "replicas": replicas, "runs": runs,
+                         "straggler_delay_ms": round(straggler_delay_s * 1e3,
+                                                     3)}
+        base = out["off"]["p99_ms"] or 1.0
+        out["p99_on_vs_off"] = round(out["on"]["p99_ms"] / base, 3)
+        return out
+
+    return asyncio.run(body())
+
+
 def parse_args(argv=None):
     ap = argparse.ArgumentParser(prog="storage_bench")
     ap.add_argument("--mode", choices=["write", "read", "mixed"],
@@ -234,7 +366,21 @@ def parse_args(argv=None):
                     help="run the write-pipeline A/B matrix "
                          "(off/overlap/streamed) and print one JSON line")
     ap.add_argument("--num-ops", dest="num_ops", type=int, default=16,
-                    help="fixed op count for --write-ab")
+                    help="fixed op count for --write-ab / --read-ab")
+    ap.add_argument("--read-hedging", dest="read_hedging",
+                    choices=["off", "on"], default="off",
+                    help="hedged batch reads (off is byte-for-byte the "
+                         "plain read path)")
+    ap.add_argument("--read-selection", dest="read_selection",
+                    choices=sorted(_SELECTION), default="load_balance",
+                    help="read replica selection policy")
+    ap.add_argument("--read-ab", dest="read_ab", action="store_true",
+                    help="run the hedged-vs-off read A/B under an "
+                         "injected straggler and print one JSON line")
+    ap.add_argument("--straggler-delay-ms", dest="straggler_delay_ms",
+                    type=float, default=10.0,
+                    help="injected per-read delay on one node for "
+                         "--read-ab")
     ap.add_argument("--json", action="store_true")
     return ap.parse_args(argv)
 
@@ -245,6 +391,12 @@ def main(argv=None) -> None:
         print(json.dumps(write_pipeline_ab(
             value_size=args.chunk_size, num_ops=args.num_ops,
             replicas=args.replicas)))
+        return
+    if args.read_ab:
+        print(json.dumps(read_hedging_ab(
+            chunk_size=args.chunk_size, num_ops=args.num_ops,
+            replicas=args.replicas,
+            straggler_delay_s=args.straggler_delay_ms / 1e3)))
         return
     result = asyncio.run(run_bench(args))
     if args.json:

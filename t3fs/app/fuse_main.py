@@ -17,9 +17,47 @@ from dataclasses import dataclass
 from t3fs.app.base import ApplicationBase, LogConfig
 from t3fs.client.meta_client import MetaClient
 from t3fs.client.mgmtd_client import MgmtdClient
-from t3fs.client.storage_client import StorageClient, StorageClientConfig
+from t3fs.client.storage_client import (
+    StorageClient, StorageClientConfig, TargetSelection,
+)
 from t3fs.fuse.kernel import FuseKernelMount
-from t3fs.utils.config import ConfigBase, citem, cobj
+from t3fs.utils.config import ConfigBase, cchoice, citem, cobj
+
+
+@dataclass
+class StorageTuning(ConfigBase):
+    """[storage] section: the mount's read-path policy (all hot-updatable).
+
+    read_selection picks the replica policy per read; "adaptive" weighs
+    in-flight RPCs and observed p50 per address.  read_hedging re-issues
+    IOs still pending past the primary's tracked p9x (clamped to
+    [floor, cap] ms) to a different replica, bounded by the token-bucket
+    budget (pct of reads + burst) — "off" is byte-for-byte the plain path.
+    """
+    read_selection: str = citem(
+        "load_balance",
+        validator=cchoice("load_balance", "round_robin", "head", "tail",
+                          "adaptive"))
+    read_hedging: str = citem("off", validator=cchoice("off", "on"))
+    hedge_delay_floor_ms: float = citem(2.0, validator=lambda v: v >= 0)
+    hedge_delay_cap_ms: float = citem(500.0, validator=lambda v: v >= 0)
+    hedge_budget_pct: float = citem(0.05, validator=lambda v: 0 <= v <= 1)
+    hedge_budget_burst: int = citem(8, validator=lambda v: v >= 0)
+
+    _SELECTION = {"load_balance": TargetSelection.LOAD_BALANCE,
+                  "round_robin": TargetSelection.ROUND_ROBIN,
+                  "head": TargetSelection.HEAD_TARGET,
+                  "tail": TargetSelection.TAIL_TARGET,
+                  "adaptive": TargetSelection.ADAPTIVE}
+
+    def client_config(self) -> StorageClientConfig:
+        return StorageClientConfig(
+            read_selection=self._SELECTION[self.read_selection],
+            read_hedging=self.read_hedging,
+            hedge_delay_floor_s=self.hedge_delay_floor_ms / 1e3,
+            hedge_delay_cap_s=self.hedge_delay_cap_ms / 1e3,
+            hedge_budget_pct=self.hedge_budget_pct,
+            hedge_budget_burst=self.hedge_budget_burst)
 
 
 @dataclass
@@ -45,6 +83,7 @@ class FuseMainConfig(ConfigBase):
     group_source: str = citem(
         "registry", hot=False,
         validator=lambda v: v in ("registry", "host", "none"))
+    storage: StorageTuning = cobj(StorageTuning)
     log: LogConfig = cobj(LogConfig)
 
 
@@ -62,7 +101,7 @@ async def serve(cfg: FuseMainConfig, app: ApplicationBase) -> None:
         if not meta_addrs:
             raise RuntimeError("no meta servers in routing; is meta up?")
         mc = MetaClient(meta_addrs, client_id=client_id)
-        sc = StorageClient(mgmtd.routing, config=StorageClientConfig(),
+        sc = StorageClient(mgmtd.routing, config=cfg.storage.client_config(),
                            refresh_routing=mgmtd.refresh)
         from t3fs.fuse.user_config import MountUserConfig
         resolver = None

@@ -9,8 +9,10 @@ Restore strategy is two-tier:
     leaf (the dataloader read path, reused verbatim; this is also what
     makes resharded N-writers -> M-readers restores disjoint range reads);
   * any stripe with a failed, missing, or CRC-stale piece falls back to
-    `read_stripe_with_crcs`, whose fused decode+verify reconstruction
-    serves routed-out chains (degraded restore).
+    `read_stripe_with_crcs`, whose first-k fan-out requests all k+m shards
+    concurrently and completes on the first k to land — a straggling or
+    dead shard becomes an erasure the fused decode+verify reconstruction
+    covers, so degraded restore never waits out a slow node's timeout.
 
 Every accepted chunk is checked against the manifest's committed CRCs:
 directly-read shards via the stored CRC the storage layer returns with

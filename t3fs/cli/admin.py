@@ -310,6 +310,33 @@ async def rpc_top(ctx: AdminContext, args) -> None:
     print(render_top(snaps, sort_by=args.sort, limit=args.limit))
 
 
+@command("read-stats", "per-address read latency quantiles, in-flight "
+                       "counts, and hedge fired/won/wasted counters from "
+                       "T3FS_READ_STATS dumps (adaptive read path "
+                       "observability)")
+@args_(("paths", {"nargs": "+",
+                  "help": "read-stats JSON files (one per process; set "
+                          "T3FS_READ_STATS=<path> on a bench/client run "
+                          "to produce them at exit)"}),
+       ("--limit", {"type": int, "default": 40}))
+async def read_stats(ctx: AdminContext, args) -> None:
+    import glob as _glob
+    import json as _json
+    from t3fs.net.rpcstats import render_read_stats
+    snaps = []
+    for pat in args.paths:
+        for path in sorted(_glob.glob(pat)) or [pat]:
+            try:
+                with open(path) as f:
+                    snaps.append(_json.load(f))
+            except (OSError, ValueError) as e:
+                print(f"skipping {path}: {e}")
+    if not any(snaps):
+        print("no read stats found")
+        return
+    print(render_read_stats(snaps, limit=args.limit))
+
+
 @command("kv-publish-map", "bootstrap the versioned shard map from a "
                            "shards spec (group;hexsplit;group;...)")
 @args_(("spec", {"help": "same grammar as the 'shards:' engine spec, "

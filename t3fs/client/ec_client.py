@@ -30,7 +30,7 @@ from t3fs.client.ec_codec import ECCodec
 from t3fs.ops.rs import default_rs
 from t3fs.storage.types import ChunkId, IOResult, ReadIO, UpdateType
 from t3fs.utils.serde import serde_struct
-from t3fs.utils.status import StatusCode, make_error
+from t3fs.utils.status import StatusCode, StatusError, make_error
 
 log = logging.getLogger("t3fs.client.ec")
 
@@ -328,35 +328,77 @@ class ECStorageClient:
         shard reports the fused decode+verify step's device CRC; None where
         neither applies (zero holes, trimmed reconstructed tails, the numpy
         oracle).  Manifest-verified restores (t3fs.ckpt) compare these
-        against committed CRCs without hashing a byte on the host."""
+        against committed CRCs without hashing a byte on the host.
+
+        First-k fan-out: ALL k+m shards are requested concurrently and the
+        read completes as soon as every live data shard has landed OR any k
+        shards (zero holes count for free) can feed the fused decode+verify
+        step — a straggling data shard becomes an erasure the parity
+        covers, never a wait."""
         k, m, cs = layout.k, layout.m, layout.chunk_size
         lens = [max(0, min(cs, stripe_len - j * cs)) for j in range(k)]
+        zero_shards = frozenset(j for j in range(k) if lens[j] == 0)
+        needed = [j for j in range(k) if lens[j]]
+        got: dict[int, tuple[bytes, int]] = {}   # shard -> (content, crc)
+        tasks: dict[asyncio.Task, int] = {}
+        for s in range(k + m):
+            if s < k and lens[s] == 0:
+                continue   # zero hole: free decode input, never read
+            chain = layout.shard_chain(stripe, s)
+            if self._routed_out(chain):
+                continue   # fast-fail: no serving target routed
+            cid = (layout.data_chunk(inode, stripe, s) if s < k
+                   else layout.parity_chunk(inode, stripe, s - k))
+            t = asyncio.create_task(self._fast.batch_read(
+                [ReadIO(chunk_id=cid, chain_id=chain)]))
+            tasks[t] = s
+        pending = set(tasks)
+        try:
+            while pending:
+                if all(j in got for j in needed):
+                    break
+                if len(got) + len(zero_shards) >= k:
+                    break
+                done, pending = await asyncio.wait(
+                    pending, return_when=asyncio.FIRST_COMPLETED)
+                for t in done:
+                    try:
+                        results, payloads = t.result()
+                    except StatusError:
+                        continue   # transport failure == shard missing
+                    r = results[0]
+                    if r.status.code == int(StatusCode.OK):
+                        got[tasks[t]] = (payloads[0], int(r.checksum))
+        finally:
+            for t in pending:
+                t.cancel()
+            if pending:
+                await asyncio.gather(*pending, return_exceptions=True)
         chunks: dict[int, bytes] = {}
         crcs: dict[int, int | None] = {}
-        missing: list[int] = []
-        ios, idxs = [], []
-        for j in range(k):
-            if not lens[j]:
-                continue
-            if self._routed_out(layout.shard_chain(stripe, j)):
-                missing.append(j)     # fast-fail: no serving target routed
-                continue
-            ios.append(ReadIO(chunk_id=layout.data_chunk(inode, stripe, j),
-                              chain_id=layout.shard_chain(stripe, j)))
-            idxs.append(j)
-        results, payloads = await self._fast.batch_read(ios)
-        for j, r, p in zip(idxs, results, payloads):
-            if r.status.code == int(StatusCode.OK):
-                chunks[j] = p
-                crcs[j] = int(r.checksum)
-            else:
-                missing.append(j)
-        missing.sort()
+        for j in needed:
+            if j in got:
+                chunks[j], crcs[j] = got[j]
+        missing = tuple(j for j in needed if j not in got)
         if missing:
-            zero_shards = frozenset(j for j in range(k) if lens[j] == 0)
-            rec, rcrcs = await self._reconstruct_shards(
-                layout, inode, stripe, tuple(missing), zero_shards,
-                known=chunks)
+            have: dict[int, np.ndarray] = {}
+            for s, (content, _crc) in got.items():
+                buf = np.zeros(cs, dtype=np.uint8)
+                buf[: len(content)] = np.frombuffer(content, dtype=np.uint8)
+                have[s] = buf
+            for j in zero_shards:
+                have[j] = np.zeros(cs, dtype=np.uint8)
+            if len(have) >= k:
+                # enough landed before the stragglers: decode right here
+                # from what the fan-out already paid for
+                rec, rcrcs = await self._decode_from(layout, have,
+                                                     missing, k, m)
+            else:
+                # the fan-out drained short of k: patient path (re-reads
+                # survivors AND want-shards with full retry budget)
+                rec, rcrcs = await self._reconstruct_shards(
+                    layout, inode, stripe, missing, zero_shards,
+                    known={s: content for s, (content, _) in got.items()})
             for j, content, rc in zip(missing, rec, rcrcs):
                 chunks[j] = content[: lens[j]]
                 # the device CRC covers the full chunk: it matches the
@@ -448,6 +490,18 @@ class ECStorageClient:
                 StatusCode.TARGET_OFFLINE,
                 f"EC stripe {stripe}: only {len(have)} of {k + m} shards "
                 f"available, need {k}")
+        return await self._decode_from(layout, have, want, k, m)
+
+    async def _decode_from(self, layout: ECLayout,
+                           have: dict[int, np.ndarray],
+                           want: tuple[int, ...], k: int, m: int
+                           ) -> tuple[list[bytes], list[int | None]]:
+        """Decode `want` shard indices from >= k available full-chunk-size
+        buffers (`have`, keyed in 0..k+m shard space — zero holes included
+        as zero buffers).  Returns (contents, crcs) aligned with `want`;
+        crc is the fused decode+verify step's device CRC32C of the
+        full-chunk content when that step produced the shard, else None.
+        Want-shards already in `have` pass through without decoding."""
         layout.check_code(default_rs(k, m))
         # shards recovered directly need no decoding
         still_want = tuple(s for s in want if s not in have)

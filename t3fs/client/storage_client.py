@@ -22,6 +22,7 @@ from typing import Callable
 from t3fs.client.layout import FileLayout
 from t3fs.mgmtd.types import ChainInfo, PublicTargetState, RoutingInfo
 from t3fs.net.client import Client
+from t3fs.net.rpcstats import READ_STATS
 from t3fs.net.wire import WireStatus
 from t3fs.ops.codec import crc32c as crc32c_ref
 from t3fs.storage.types import (
@@ -41,6 +42,10 @@ class TargetSelection(enum.IntEnum):
     ROUND_ROBIN = 1
     HEAD_TARGET = 2
     TAIL_TARGET = 3
+    # latency-aware: weigh each serving target's in-flight RPC count and
+    # observed read p50 (READ_STATS) so hot or degraded nodes shed reads
+    # to clean replicas automatically
+    ADAPTIVE = 4
 
 
 @dataclass
@@ -52,6 +57,20 @@ class StorageClientConfig:
     verify_checksums: bool = False
     read_selection: TargetSelection = TargetSelection.LOAD_BALANCE
     num_channels: int = 64
+    # hedged batch reads (storage.read_hedging = off|on): IOs still
+    # pending after an adaptive delay — the primary address's tracked
+    # p9x, clamped to [floor, cap] — are re-issued to a DIFFERENT serving
+    # replica; the first OK result wins and the loser is discarded.
+    # "off" is byte-for-byte the unhedged read path.
+    read_hedging: str = "off"
+    hedge_delay_floor_s: float = 0.002
+    hedge_delay_cap_s: float = 0.5
+    # token-bucket hedge budget: issuing a primary read earns
+    # hedge_budget_pct tokens (capped at hedge_budget_burst), hedging one
+    # IO spends one — total hedges <= pct * reads + burst, so hedging can
+    # never amplify a tail-latency incident into a load incident
+    hedge_budget_pct: float = 0.05
+    hedge_budget_burst: int = 8
     # transfer discipline for bulk payloads: "inline" frames data in the RPC
     # (one round trip; best on TCP), "remote_buf" registers a pooled buffer
     # and lets the server pull/push one-sided (the reference's RDMA flow,
@@ -61,6 +80,26 @@ class StorageClientConfig:
     # fault-injection flags carried in every request (reference
     # StorageClient.h:162-166 driving DebugFlags, Common.h:290-307)
     debug: DebugFlags = field(default_factory=DebugFlags)
+
+
+class _HedgeBudget:
+    """Token bucket bounding hedged re-issues to a fraction of reads.
+    Starts full (burst) so a cold client can hedge its first slow reads;
+    refills only by issuing primary reads, so a quiet client cannot bank
+    unlimited hedges."""
+
+    def __init__(self, pct: float, burst: int):
+        self.pct = pct
+        self.burst = float(burst)
+        self.tokens = float(burst)
+
+    def earn(self, reads: int) -> None:
+        self.tokens = min(self.tokens + self.pct * reads, self.burst)
+
+    def take(self, want: int) -> int:
+        grant = min(int(self.tokens), want)
+        self.tokens -= grant
+        return grant
 
 
 class UpdateChannelAllocator:
@@ -99,6 +138,10 @@ class StorageClient:
         self.client_id = client_id or f"sc-{random.getrandbits(48):012x}"
         self.channels = UpdateChannelAllocator(self.cfg.num_channels)
         self._rr = itertools.count()
+        # shared across copy.copy views (EC fast reads, kvcache): the
+        # budget bounds this PROCESS's hedge amplification, not one view's
+        self._hedge_budget = _HedgeBudget(self.cfg.hedge_budget_pct,
+                                          self.cfg.hedge_budget_burst)
         # per-address (packed-ReadIO version, connection epoch) the
         # server ADVERTISED via BatchReadRsp.packed_ver (absent =
         # unknown: send struct; a pre-packed server never advertises —
@@ -132,7 +175,16 @@ class StorageClient:
 
     # --- target selection ---
 
-    def _pick_read_target(self, chain: ChainInfo, attempt: int):
+    @staticmethod
+    def _adaptive_score(routing: RoutingInfo, target) -> float:
+        """Load x latency: (in-flight RPCs + 1) * observed read p50.  An
+        address with no samples scores 0.0 — optimism under uncertainty,
+        so fresh/unknown replicas get probed instead of starved."""
+        address = routing.node_address(target.node_id)
+        return (READ_STATS.inflight(address) + 1) * READ_STATS.p50(address)
+
+    def _pick_read_target(self, chain: ChainInfo, attempt: int,
+                          routing: RoutingInfo | None = None):
         serving = chain.serving()
         if not serving:
             raise make_error(StatusCode.TARGET_OFFLINE,
@@ -144,12 +196,30 @@ class StorageClient:
             pick = serving[-1]
         elif sel == TargetSelection.ROUND_ROBIN:
             pick = serving[next(self._rr) % len(serving)]
+        elif sel == TargetSelection.ADAPTIVE:
+            routing = routing if routing is not None else self.routing()
+            scored = [(self._adaptive_score(routing, t), t) for t in serving]
+            best = min(s for s, _ in scored)
+            # random tie-break among the leaders: with no samples yet every
+            # score is 0.0 and this must not collapse into head-hammering
+            ties = [t for s, t in scored if s == best]
+            pick = ties[random.randrange(len(ties))]
         else:
             pick = serving[random.randrange(len(serving))]
         # failover: later attempts walk the chain
         if attempt:
             pick = serving[(serving.index(pick) + attempt) % len(serving)]
         return pick
+
+    def _pick_hedge_target(self, chain: ChainInfo, routing: RoutingInfo,
+                           exclude_address: str):
+        """Best serving target on a DIFFERENT node than the (slow) primary;
+        None when the chain has no alternative to hedge to."""
+        alts = [t for t in chain.serving()
+                if routing.node_address(t.node_id) != exclude_address]
+        if not alts:
+            return None
+        return min(alts, key=lambda t: self._adaptive_score(routing, t))
 
     # --- single-chunk ops ---
 
@@ -269,11 +339,27 @@ class StorageClient:
 
     # --- batched ops ---
 
-    async def batch_read(self, ios: list[ReadIO]) -> tuple[list[IOResult], list[bytes]]:
+    async def batch_read(self, ios: list[ReadIO], *,
+                         stats: dict | None = None
+                         ) -> tuple[list[IOResult], list[bytes]]:
         """Group by serving node, dispatch per-node batches in parallel,
-        retry failed IOs with target failover."""
+        retry failed IOs with target failover.
+
+        With cfg.read_hedging == "on", IOs still pending after an
+        adaptive delay (the primary address's tracked read p9x, clamped
+        to [hedge_delay_floor_s, hedge_delay_cap_s]) are re-issued to a
+        different serving replica under the token-bucket hedge budget;
+        the first OK result wins, the loser is discarded.  "off" is
+        byte-for-byte the unhedged path (same RPC sequence).
+
+        `stats`, when provided, accumulates this call's
+        hedge_fired/hedge_won/hedge_wasted counts (kvcache get_many
+        surfaces them to its callers)."""
         results: list[IOResult | None] = [None] * len(ios)
         payloads: list[bytes] = [b""] * len(ios)
+        winner: list[str] = [""] * len(ios)
+        hedging = self.cfg.read_hedging == "on"
+        hstats = {"hedge_fired": 0, "hedge_won": 0, "hedge_wasted": 0}
         # chain_ver stamping policy: an IO the CALLER versioned is left
         # alone; the rest are (re)stamped from routing each attempt —
         # but only when this client can refresh routing, else one chain
@@ -282,6 +368,20 @@ class StorageClient:
         # for a static-routing client)
         stamp = self._refresh_routing is not None
         caller_versioned = [io.chain_ver != 0 for io in ios]
+        if stamp and not all(caller_versioned):
+            # restamp PRIVATE clones: a caller-reused ReadIO list must not
+            # carry this call's stamped version into its next use
+            ios = [io if v else io.clone()
+                   for io, v in zip(ios, caller_versioned)]
+
+        def _install(i: int, r: IOResult, p: bytes, src: str) -> None:
+            cur = results[i]
+            if cur is not None and cur.status.code == int(StatusCode.OK):
+                return   # first OK won; the loser's duplicate is discarded
+            results[i] = r
+            payloads[i] = p
+            winner[i] = src
+
         pending = list(range(len(ios)))
         for attempt in range(self.cfg.max_retries):
             routing = self.routing()
@@ -293,7 +393,7 @@ class StorageClient:
                                                      f"chain {ios[i].chain_id}"))
                     continue
                 try:
-                    target = self._pick_read_target(chain, attempt)
+                    target = self._pick_read_target(chain, attempt, routing)
                 except StatusError as e:
                     results[i] = IOResult(WireStatus(int(e.code), str(e)))
                     continue
@@ -304,7 +404,8 @@ class StorageClient:
                     ios[i].chain_ver = chain.chain_ver
                 groups.setdefault(routing.node_address(target.node_id), []).append(i)
 
-            async def read_group(address: str, idxs: list[int]):
+            async def read_group(address: str, idxs: list[int],
+                                 src: str = "primary"):
                 group = [ios[i] for i in idxs]
                 # packed fast path: one fixed-stride blob instead of ~70
                 # nested structs per batch through the tag codec (the
@@ -335,8 +436,8 @@ class StorageClient:
                         timeout=self.cfg.request_timeout_s)
                 except StatusError as e:
                     for i in idxs:
-                        results[i] = IOResult(
-                            WireStatus(int(e.code), str(e)))
+                        _install(i, IOResult(
+                            WireStatus(int(e.code), str(e))), b"", src)
                     return
                 if packed is not None and \
                         self.client.epoch(address) != epoch:
@@ -356,8 +457,8 @@ class StorageClient:
                             timeout=self.cfg.request_timeout_s)
                     except StatusError as e:
                         for i in idxs:
-                            results[i] = IOResult(
-                                WireStatus(int(e.code), str(e)))
+                            _install(i, IOResult(
+                                WireStatus(int(e.code), str(e))), b"", src)
                         return
                 if rsp.packed_results and sver == 0:
                     # memoize under the PRE-call epoch: if the conn
@@ -369,7 +470,6 @@ class StorageClient:
                                if rsp.packed_results else rsp.results)
                 pos = 0
                 for i, r in zip(idxs, rsp_results):
-                    results[i] = r
                     # inline payloads are concatenated in request order;
                     # no_payload (verify-only) and buf-push IOs contribute
                     # zero bytes regardless of r.length
@@ -378,10 +478,72 @@ class StorageClient:
                     else:
                         n = r.length if r.status.code == int(StatusCode.OK) \
                             else 0
-                    payloads[i] = payload[pos: pos + n]
+                    _install(i, r, payload[pos: pos + n], src)
                     pos += n
 
-            await asyncio.gather(*[read_group(a, idxs) for a, idxs in groups.items()])
+            async def hedged_group(address: str, idxs: list[int]):
+                primary = asyncio.create_task(read_group(address, idxs))
+                delay = min(max(READ_STATS.p9x(address),
+                                self.cfg.hedge_delay_floor_s),
+                            self.cfg.hedge_delay_cap_s)
+                done, _ = await asyncio.wait({primary}, timeout=delay)
+                if done:
+                    primary.result()   # propagate unexpected exceptions
+                    return
+                # primary is past its p9x: plan hedges, one different
+                # serving replica per IO (skip chains with no alternative)
+                plan: list[tuple[int, str]] = []
+                for i in idxs:
+                    chain = routing.chain(ios[i].chain_id)
+                    alt = (self._pick_hedge_target(chain, routing, address)
+                           if chain is not None else None)
+                    if alt is not None:
+                        plan.append((i, routing.node_address(alt.node_id)))
+                grant = self._hedge_budget.take(len(plan))
+                if grant <= 0 or not plan:
+                    # budget exhausted / nowhere to hedge: behave exactly
+                    # like the plain path and wait out the primary (the
+                    # retry loop handles its failures)
+                    await primary
+                    return
+                plan = plan[:grant]
+                hgroups: dict[str, list[int]] = {}
+                for i, a in plan:
+                    hgroups.setdefault(a, []).append(i)
+                hedged = [i for i, _ in plan]
+                hstats["hedge_fired"] += len(hedged)
+                READ_STATS.hedge(address, fired=len(hedged))
+                hedge = asyncio.gather(*[read_group(a, his, "hedge")
+                                         for a, his in hgroups.items()])
+                tasks = {primary, hedge}
+                try:
+                    while tasks:
+                        done, tasks = await asyncio.wait(
+                            tasks, return_when=asyncio.FIRST_COMPLETED)
+                        for t in done:
+                            t.result()   # surface unexpected exceptions
+                        if all(results[i] is not None
+                               and results[i].status.code == int(StatusCode.OK)
+                               for i in idxs):
+                            break   # all settled OK: the loser is discarded
+                finally:
+                    for t in tasks:
+                        t.cancel()
+                    if tasks:
+                        await asyncio.gather(*tasks, return_exceptions=True)
+                won = sum(1 for i in hedged if winner[i] == "hedge")
+                hstats["hedge_won"] += won
+                hstats["hedge_wasted"] += len(hedged) - won
+                READ_STATS.hedge(address, won=won, wasted=len(hedged) - won)
+
+            if hedging:
+                # tokens accrue per primary read issued; hedges spend them
+                self._hedge_budget.earn(sum(len(v) for v in groups.values()))
+                await asyncio.gather(*[hedged_group(a, idxs)
+                                       for a, idxs in groups.items()])
+            else:
+                await asyncio.gather(*[read_group(a, idxs)
+                                       for a, idxs in groups.items()])
             pending = [i for i in pending
                        if results[i] is not None
                        and results[i].status.code != int(StatusCode.OK)
@@ -390,6 +552,9 @@ class StorageClient:
                 break
             await self._backoff(attempt)
             await self._maybe_refresh()
+        if stats is not None:
+            for key, v in hstats.items():
+                stats[key] = stats.get(key, 0) + v
         return [r or IOResult(WireStatus(int(StatusCode.INTERNAL), "unset"))
                 for r in results], payloads
 

@@ -74,6 +74,11 @@ def _unpack_block(blob: bytes, key: bytes) -> bytes | None:
 class KVCacheConfig:
     block_size: int = 64 << 10        # chunk allocation class for blocks
     gc_concurrency: int = 64          # parallel REMOVEs in remove_many
+    # hedged reads for the high-IOPS random-read get path: "on"/"off"
+    # override the storage client's setting; "inherit" keeps it.  The cache
+    # lookup is the first beneficiary of hedging (small IOs, tail-bound),
+    # so it opts IN by default even when the client-wide default is off.
+    read_hedging: str = "on"
 
 
 class KVCacheStore:
@@ -93,6 +98,20 @@ class KVCacheStore:
         self.cfg = config or KVCacheConfig()
         self.namespace = namespace
         self.inode = (1 << 63) | _h64(namespace.encode(), person=b"t3fs-ns")
+        # read view with this namespace's hedging policy: a shallow client
+        # copy (shared sockets, routing, channels, hedge budget) whose cfg
+        # only differs in read_hedging — writes keep using `client`.
+        # getattr: placement-only tests pass a bare client with no cfg
+        base_cfg = getattr(client, "cfg", None)
+        if (self.cfg.read_hedging != "inherit" and base_cfg is not None
+                and self.cfg.read_hedging != base_cfg.read_hedging):
+            import copy
+            rc = copy.copy(client)
+            rc.cfg = copy.copy(client.cfg)
+            rc.cfg.read_hedging = self.cfg.read_hedging
+            self._read_client = rc
+        else:
+            self._read_client = client
 
     # --- placement ---
 
@@ -120,16 +139,19 @@ class KVCacheStore:
         values = await self.get_many([key])
         return values[0]
 
-    async def get_many(self, keys: list[bytes]) -> list[bytes | None]:
+    async def get_many(self, keys: list[bytes],
+                       stats: dict | None = None) -> list[bytes | None]:
         """One batched read across all keys; None = miss (absent, collided,
-        or torn block — never wrong bytes)."""
+        or torn block — never wrong bytes).  `stats`, when provided,
+        accumulates the read's hedge_fired/hedge_won/hedge_wasted counts."""
         ios = []
         for key in keys:
             chain, cid = self.locate(key)
             ios.append(ReadIO(chunk_id=cid, chain_id=chain, offset=0,
                               length=0,
                               verify_checksum=self.client.cfg.verify_checksums))
-        results, payloads = await self.client.batch_read(ios)
+        results, payloads = await self._read_client.batch_read(ios,
+                                                               stats=stats)
         out: list[bytes | None] = []
         for key, result, payload in zip(keys, results, payloads):
             if result.status.code != int(StatusCode.OK):

@@ -10,9 +10,11 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import time
 from typing import Any
 
 from t3fs.net.conn import Connection
+from t3fs.net.rpcstats import READ_STATS
 from t3fs.net.server import build_dispatcher
 from t3fs.utils.status import StatusCode, make_error
 
@@ -91,7 +93,19 @@ class Client:
     async def call(self, address: str, method: str, body: object = None,
                    payload: bytes = b"", timeout: float = 30.0) -> tuple[object, bytes]:
         conn = await self._get_conn(address)
-        return await conn.call(method, body, payload, timeout)
+        # per-ADDRESS in-flight/latency tracker behind the adaptive read
+        # path (READ_STATS keeps latency for read methods only; in-flight
+        # counts every RPC as load).  Begins after connect so a refused
+        # connection never inflates the gauge.
+        READ_STATS.begin(address)
+        t0 = time.monotonic()
+        ok = False
+        try:
+            result = await conn.call(method, body, payload, timeout)
+            ok = True
+            return result
+        finally:
+            READ_STATS.end(address, method, time.monotonic() - t0, ok)
 
     async def post(self, address: str, method: str, body: object = None,
                    payload: bytes = b"") -> None:

@@ -138,6 +138,186 @@ class RpcStats:
 RPC_STATS = RpcStats()
 
 
+def _stream_quantile(est: float, x: float, q: float,
+                     lr: float = 0.05) -> float:
+    """One step of a scale-free streaming quantile estimate: nudge the
+    estimate up by lr*q of itself when the sample lands above it, down by
+    lr*(1-q) when below.  In steady state the fraction of samples above
+    the estimate converges to 1-q, i.e. the estimate tracks the
+    q-quantile — O(1) state per (address, quantile), no reservoir on the
+    hot path."""
+    if est <= 0.0:
+        return x
+    step = lr * est
+    return est + step * q if x > est else max(0.0, est - step * (1.0 - q))
+
+
+_ADDR_RESERVOIR = 512
+
+
+class _AddrReadStats:
+    __slots__ = ("count", "ewma_s", "p50_s", "p9x_s", "inflight",
+                 "hedge_fired", "hedge_won", "hedge_wasted", "samples")
+
+    def __init__(self):
+        self.count = 0
+        self.ewma_s = 0.0
+        self.p50_s = 0.0          # streaming median (adaptive selection)
+        self.p9x_s = 0.0          # streaming tail quantile (hedge delay)
+        self.inflight = 0         # ALL in-flight RPCs to the address
+        self.hedge_fired = 0
+        self.hedge_won = 0
+        self.hedge_wasted = 0
+        # bounded reservoir for exact report-time quantiles (read-stats CLI)
+        self.samples: list[float] = []
+
+    def add(self, elapsed: float, tail_q: float) -> None:
+        self.count += 1
+        alpha = 0.2
+        self.ewma_s = (elapsed if self.count == 1
+                       else (1 - alpha) * self.ewma_s + alpha * elapsed)
+        self.p50_s = _stream_quantile(self.p50_s, elapsed, 0.5)
+        self.p9x_s = _stream_quantile(self.p9x_s, elapsed, tail_q)
+        if len(self.samples) < _ADDR_RESERVOIR:
+            self.samples.append(elapsed)
+        else:
+            i = random.randrange(self.count)
+            if i < _ADDR_RESERVOIR:
+                self.samples[i] = elapsed
+
+
+class ReadStats:
+    """Per-address latency / in-flight tracker behind the adaptive read
+    path (TargetSelection.ADAPTIVE + hedged batch reads,
+    docs/design_notes.md "Adaptive read path").
+
+    Fed from Client.call: every RPC counts toward the address's in-flight
+    gauge (a pure load signal), while LATENCY samples are restricted to
+    the read-path methods in `read_methods` — a head's Storage.write
+    latency includes the whole chain's replication time and would make
+    every head look degraded to a read picker."""
+
+    read_methods = frozenset({"Storage.batch_read"})
+    tail_quantile = 0.95   # the "p9x" the hedge delay keys off
+
+    def __init__(self):
+        self._addrs: dict[str, _AddrReadStats] = {}
+        self._lock = threading.Lock()
+
+    def _get(self, address: str) -> _AddrReadStats:
+        st = self._addrs.get(address)
+        if st is None:
+            with self._lock:
+                st = self._addrs.setdefault(address, _AddrReadStats())
+        return st
+
+    def begin(self, address: str) -> None:
+        self._get(address).inflight += 1
+
+    def end(self, address: str, method: str, elapsed: float,
+            ok: bool) -> None:
+        st = self._get(address)
+        st.inflight = max(0, st.inflight - 1)
+        # failures are excluded from latency: a dead node failing fast
+        # must not look like the FASTEST replica
+        if ok and method in self.read_methods:
+            st.add(elapsed, self.tail_quantile)
+
+    def inflight(self, address: str) -> int:
+        st = self._addrs.get(address)
+        return st.inflight if st is not None else 0
+
+    def p50(self, address: str) -> float:
+        """Streaming read-latency median; 0.0 = no samples yet (callers
+        treat unknown addresses optimistically, so new nodes get probed)."""
+        st = self._addrs.get(address)
+        return st.p50_s if st is not None else 0.0
+
+    def p9x(self, address: str) -> float:
+        st = self._addrs.get(address)
+        return st.p9x_s if st is not None else 0.0
+
+    def hedge(self, address: str, fired: int = 0, won: int = 0,
+              wasted: int = 0) -> None:
+        """Hedge counters accrue to the PRIMARY address whose slowness
+        triggered the hedge — that is the node the operator wants named."""
+        st = self._get(address)
+        st.hedge_fired += fired
+        st.hedge_won += won
+        st.hedge_wasted += wasted
+
+    def snapshot(self) -> dict:
+        def pct(vals: list[float], q: float) -> float:
+            if not vals:
+                return 0.0
+            s = sorted(vals)
+            return s[min(len(s) - 1, int(q * len(s)))]
+
+        with self._lock:
+            items = list(self._addrs.items())
+        out = {}
+        for addr, st in items:
+            vals = list(st.samples)
+            out[addr] = {
+                "count": st.count, "inflight": st.inflight,
+                "ewma_ms": round(st.ewma_s * 1e3, 3),
+                "p50_ms": round(st.p50_s * 1e3, 3),
+                "p9x_ms": round(st.p9x_s * 1e3, 3),
+                "q50_ms": round(pct(vals, 0.50) * 1e3, 3),
+                "q90_ms": round(pct(vals, 0.90) * 1e3, 3),
+                "q99_ms": round(pct(vals, 0.99) * 1e3, 3),
+                "hedge_fired": st.hedge_fired,
+                "hedge_won": st.hedge_won,
+                "hedge_wasted": st.hedge_wasted,
+            }
+        return out
+
+    def dump(self, path: str) -> None:
+        with open(path, "w") as f:
+            json.dump(self.snapshot(), f, indent=1, sort_keys=True)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._addrs.clear()
+
+
+READ_STATS = ReadStats()
+
+
+def render_read_stats(snapshots: list[dict], limit: int = 40) -> str:
+    """Merge per-process read-stats snapshots and render the table the
+    admin `read-stats` command prints."""
+    merged: dict[str, dict] = {}
+    for snap in snapshots:
+        for addr, row in snap.items():
+            cur = merged.get(addr)
+            if cur is None:
+                merged[addr] = dict(row)
+                continue
+            n1, n2 = cur["count"], row["count"]
+            tot = n1 + n2 or 1
+            for k in cur:
+                if k in ("count", "inflight") or k.startswith("hedge_"):
+                    cur[k] += row[k]
+                elif k in ("q90_ms", "q99_ms", "p9x_ms"):
+                    cur[k] = max(cur[k], row[k])     # upper bound
+                else:                                 # count-weighted
+                    cur[k] = round((cur[k] * n1 + row[k] * n2) / tot, 3)
+    rows = sorted(merged.items(), key=lambda kv: -kv[1].get("q99_ms", 0))
+    hdr = (f"{'address':<22}{'reads':>8}{'infl':>6}{'ewma':>8}"
+           f"{'p50~':>8}{'p9x~':>8}{'q50':>8}{'q90':>8}{'q99':>8}"
+           f"{'fired':>7}{'won':>6}{'waste':>7}  (ms)")
+    lines = [hdr, "-" * len(hdr)]
+    for addr, r in rows[:limit]:
+        lines.append(
+            f"{addr:<22}{r['count']:>8}{r['inflight']:>6}"
+            f"{r['ewma_ms']:>8.2f}{r['p50_ms']:>8.2f}{r['p9x_ms']:>8.2f}"
+            f"{r['q50_ms']:>8.2f}{r['q90_ms']:>8.2f}{r['q99_ms']:>8.2f}"
+            f"{r['hedge_fired']:>7}{r['hedge_won']:>6}"
+            f"{r['hedge_wasted']:>7}")
+    return "\n".join(lines)
+
+
 def _autodump() -> None:
     path = os.environ.get("T3FS_RPC_STATS")
     if path and RPC_STATS._methods:
@@ -146,6 +326,14 @@ def _autodump() -> None:
             RPC_STATS.dump(f"{path}.{os.getpid()}"
                            if os.path.isdir(path) or path.endswith("/")
                            else path)
+        except OSError:
+            pass
+    rpath = os.environ.get("T3FS_READ_STATS")
+    if rpath and READ_STATS._addrs:
+        try:
+            READ_STATS.dump(f"{rpath}.{os.getpid()}"
+                            if os.path.isdir(rpath) or rpath.endswith("/")
+                            else rpath)
         except OSError:
             pass
 

@@ -133,6 +133,9 @@ class StorageNode:
         self.stream_threshold = 512 << 10
         self.stream_frag_bytes = 256 << 10
         self.stream_window = 4
+        # test/bench hook: injected per-read latency (seconds), making this
+        # node a deterministic straggler for the adaptive read path
+        self.read_delay_s = 0.0
         self.frag_store = FragmentStore(combine=self.codec.combine)
         self._read_sem: asyncio.Semaphore | None = None
         # io_uring read pipeline (AioReadWorker.h:21-44 analog); started by
@@ -632,6 +635,8 @@ class StorageService:
         node = self.node
         if req.debug.server_should_fail():
             raise make_error(StatusCode.INTERNAL, "injected server error")
+        if node.read_delay_s:
+            await asyncio.sleep(node.read_delay_s)   # injected straggler
         if node._read_sem is None:
             node._read_sem = asyncio.Semaphore(node.read_concurrency)
         ios = (unpack_readios(req.packed_ios, req.packed_ver)

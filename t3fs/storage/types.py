@@ -122,6 +122,12 @@ class ReadIO:
     # Appended last so positional construction stays stable.
     chain_ver: int = 0
 
+    def clone(self, **overrides) -> "ReadIO":
+        """Copy for a derived attempt: batch_read restamps chain_ver per
+        attempt and must do so on a PRIVATE copy, or a caller-reused
+        ReadIO list carries a stale stamped version into its next call."""
+        return _dc_replace(self, **overrides)
+
 
 @serde_struct
 @dataclass

@@ -20,7 +20,13 @@ from t3fs.storage.service import StorageNode, StorageService
 
 
 class StorageFabric:
-    """N storage nodes, one chain of `replicas` targets (extendable)."""
+    """N storage nodes, `num_chains` chains of `replicas` targets each.
+
+    num_chains=1 (the default) keeps the historical single-chain shape:
+    every node hosts a target, the chain spans the first `replicas` nodes.
+    num_chains>1 rotates chain c's replica r onto node (c+r) % num_nodes —
+    EC tests get one chain per node (replicas=1) so each shard has an
+    independently delayable/killable home."""
 
     # class-level defaults so suites can parameterize every test at once
     # (UnitTestFabric SystemSetupConfig analog, tests/lib/UnitTestFabric.h:86)
@@ -34,11 +40,13 @@ class StorageFabric:
                  checksum_backend=None, engine_backend: str | None = None,
                  aio_read: bool | None = None,
                  write_pipeline: str | None = None,
-                 stream_threshold: int | None = None):
+                 stream_threshold: int | None = None,
+                 num_chains: int = 1):
         assert replicas <= num_nodes
         self.num_nodes = num_nodes
         self.replicas = replicas
         self.chain_id = chain_id
+        self.num_chains = num_chains
         self.aio_read = (aio_read if aio_read is not None
                          else self.default_aio_read)
         self.checksum_backend = (checksum_backend if checksum_backend is not None
@@ -56,8 +64,12 @@ class StorageFabric:
         self.client.add_service(self.bufs)
         self._tmp = tempfile.TemporaryDirectory(prefix="t3fs-fabric-")
 
-    def target_id(self, node_idx: int) -> int:
-        return (node_idx + 1) * 100 + 1
+    def target_id(self, node_idx: int, chain: int = 0) -> int:
+        return (node_idx + 1) * 100 + chain + 1
+
+    @property
+    def chain_ids(self) -> list[int]:
+        return [self.chain_id + c for c in range(self.num_chains)]
 
     async def start(self) -> None:
         for i in range(self.num_nodes):
@@ -74,20 +86,39 @@ class StorageFabric:
                     node.aio = AioReadWorker()
                     node.aio.start()
             node.client.add_service(BufferRegistry())  # forwarding conns
-            node.add_target(self.target_id(i), f"{self._tmp.name}/n{node_id}",
-                            engine_backend=self.engine_backend)
+            if self.num_chains == 1:
+                node.add_target(self.target_id(i),
+                                f"{self._tmp.name}/n{node_id}",
+                                engine_backend=self.engine_backend)
             server = Server()
             server.add_service(StorageService(node))
             await server.start()
             self.routing.nodes[node_id] = NodeInfo(node_id, server.address)
             self.servers.append(server)
             self.nodes.append(node)
-        self.routing.chains[self.chain_id] = ChainInfo(
-            chain_id=self.chain_id, chain_ver=1,
-            targets=[ChainTargetInfo(self.target_id(i), i + 1,
-                                     PublicTargetState.SERVING)
-                     for i in range(self.replicas)])
-        self.routing.chain_tables[1] = ChainTable(1, [self.chain_id])
+        if self.num_chains == 1:
+            self.routing.chains[self.chain_id] = ChainInfo(
+                chain_id=self.chain_id, chain_ver=1,
+                targets=[ChainTargetInfo(self.target_id(i), i + 1,
+                                         PublicTargetState.SERVING)
+                         for i in range(self.replicas)])
+        else:
+            # chain c replica r -> node (c+r) % num_nodes: chains spread
+            # round-robin so shard homes are independent
+            for c in range(self.num_chains):
+                cid = self.chain_id + c
+                targets = []
+                for r in range(self.replicas):
+                    idx = (c + r) % self.num_nodes
+                    tid = self.target_id(idx, c)
+                    self.nodes[idx].add_target(
+                        tid, f"{self._tmp.name}/n{idx + 1}c{cid}",
+                        engine_backend=self.engine_backend)
+                    targets.append(ChainTargetInfo(tid, idx + 1,
+                                                   PublicTargetState.SERVING))
+                self.routing.chains[cid] = ChainInfo(
+                    chain_id=cid, chain_ver=1, targets=targets)
+        self.routing.chain_tables[1] = ChainTable(1, self.chain_ids)
 
     def chain(self) -> ChainInfo:
         return self.routing.chains[self.chain_id]

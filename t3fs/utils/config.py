@@ -27,6 +27,18 @@ def citem(default: Any = None, *, hot: bool = True,
     return field(default=default, metadata=meta)
 
 
+def cchoice(*options: str) -> Callable[[Any], bool]:
+    """Validator factory for enumerated string items: accepts exactly the
+    given options.  The option list rides on the validator (`.options`) so
+    error messages and docs can render it."""
+    allowed = frozenset(options)
+
+    def check(v: Any) -> bool:
+        return isinstance(v, str) and v in allowed
+    check.options = tuple(options)  # type: ignore[attr-defined]
+    return check
+
+
 def cobj(cls: type, **overrides):
     """Declare a nested config object (CONFIG_OBJ analog)."""
     if overrides:
