@@ -73,11 +73,16 @@ class CheckpointReader:
     """Restores and audits checkpoints from one directory."""
 
     def __init__(self, ec: ECStorageClient, fs, directory: str,
-                 window: int = 8):
+                 window: int = 8, read_hedging: str = "inherit"):
         self.ec = ec
         self.fs = fs
         self.store = CheckpointStore(fs, directory)
         self.window = window
+        # "on"/"off" opts the healthy-path restore reads in/out of hedged
+        # batch reads per call; "inherit" keeps the storage client's
+        # setting (degraded stripes already tolerate stragglers via
+        # first-k reads, so only the healthy fan-out needs this)
+        self.read_hedging = read_hedging
 
     # --- restore ---
 
@@ -148,7 +153,10 @@ class CheckpointReader:
                 range_leaf.append(lf)
 
         if ranges:
-            out = await self.ec.sc.read_file_ranges(flayout, ranges)
+            out = await self.ec.sc.read_file_ranges(
+                flayout, ranges,
+                hedging=None if self.read_hedging == "inherit"
+                else self.read_hedging)
             for (inode, offset, length), lf, (data, results) in zip(
                     ranges, range_leaf, out):
                 pieces = flayout.chunk_span(offset, length)

@@ -337,6 +337,28 @@ async def read_stats(ctx: AdminContext, args) -> None:
     print(render_read_stats(snaps, limit=args.limit))
 
 
+@command("kvcache-stats", "per-namespace KVCache tier stats (hit-rate, "
+                          "dirty bytes, eviction counters) merged from "
+                          "T3FS_KVCACHE_STATS dump files")
+@args_(("paths", {"nargs": "+",
+                  "help": "kvcache-stats JSON files (one per process; "
+                          "set T3FS_KVCACHE_STATS=<prefix> on a "
+                          "fleet/bench run to produce them at exit)"}))
+async def kvcache_stats(ctx: AdminContext, args) -> None:
+    import glob as _glob
+    import json as _json
+    from t3fs.kvcache import render_kvcache_stats
+    snaps = []
+    for pat in args.paths:
+        for path in sorted(_glob.glob(pat)) or [pat]:
+            try:
+                with open(path) as f:
+                    snaps.append(_json.load(f))
+            except (OSError, ValueError) as e:
+                print(f"skipping {path}: {e}")
+    print(render_kvcache_stats(snaps))
+
+
 @command("kv-publish-map", "bootstrap the versioned shard map from a "
                            "shards spec (group;hexsplit;group;...)")
 @args_(("spec", {"help": "same grammar as the 'shards:' engine spec, "

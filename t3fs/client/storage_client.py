@@ -227,13 +227,19 @@ class StorageClient:
                           data: bytes, chunk_size: int,
                           update_type: UpdateType = UpdateType.WRITE,
                           truncate_len: int = 0,
-                          checksum: int | None = None) -> IOResult:
+                          checksum: int | None = None,
+                          remove_fence_ver: int = 0) -> IOResult:
         """One chunk-granular CRAQ write (retries are seq-stable).
 
         `checksum` is an optional precomputed CRC32C of `data` (e.g. the EC
         client's fused device decode+verify step): when given, the host-side
         crc32c is skipped — the caller vouches for the bytes it computed
-        the CRC over."""
+        the CRC over.
+
+        `remove_fence_ver` (REMOVE only): the update fails with
+        CHUNK_STALE_UPDATE instead of removing when the chunk's version
+        advanced past the fence — the conditional delete KVCache eviction
+        uses so a concurrently re-put block survives its own GC."""
         channel, seq = await self.channels.acquire()
         try:
             io = UpdateIO(
@@ -246,6 +252,7 @@ class StorageClient:
                           if (self.cfg.generate_checksums and data) else 0),
                 channel=channel, channel_seq=seq,
                 client_id=self.client_id, inline=True,
+                remove_fence_ver=remove_fence_ver,
                 debug=self.cfg.debug)
             release = None
             handle = None
@@ -340,17 +347,23 @@ class StorageClient:
     # --- batched ops ---
 
     async def batch_read(self, ios: list[ReadIO], *,
-                         stats: dict | None = None
+                         stats: dict | None = None,
+                         hedging: str | None = None
                          ) -> tuple[list[IOResult], list[bytes]]:
         """Group by serving node, dispatch per-node batches in parallel,
         retry failed IOs with target failover.
 
-        With cfg.read_hedging == "on", IOs still pending after an
-        adaptive delay (the primary address's tracked read p9x, clamped
-        to [hedge_delay_floor_s, hedge_delay_cap_s]) are re-issued to a
-        different serving replica under the token-bucket hedge budget;
-        the first OK result wins, the loser is discarded.  "off" is
-        byte-for-byte the unhedged path (same RPC sequence).
+        With read hedging on, IOs still pending after an adaptive delay
+        (the primary address's tracked read p9x for this batch's
+        SIZE CLASS, clamped to [hedge_delay_floor_s, hedge_delay_cap_s])
+        are re-issued to a different serving replica under the
+        token-bucket hedge budget; the first OK result wins, the loser
+        is discarded.  "off" is byte-for-byte the unhedged path (same
+        RPC sequence).
+
+        `hedging` ("on"/"off") overrides cfg.read_hedging for THIS call —
+        the per-call opt-in checkpoint restores and KVCache reads use
+        instead of cloning the client with a different config.
 
         `stats`, when provided, accumulates this call's
         hedge_fired/hedge_won/hedge_wasted counts (kvcache get_many
@@ -358,7 +371,7 @@ class StorageClient:
         results: list[IOResult | None] = [None] * len(ios)
         payloads: list[bytes] = [b""] * len(ios)
         winner: list[str] = [""] * len(ios)
-        hedging = self.cfg.read_hedging == "on"
+        hedging = (hedging or self.cfg.read_hedging) == "on"
         hstats = {"hedge_fired": 0, "hedge_won": 0, "hedge_wasted": 0}
         # chain_ver stamping policy: an IO the CALLER versioned is left
         # alone; the rest are (re)stamped from routing each attempt —
@@ -483,7 +496,12 @@ class StorageClient:
 
             async def hedged_group(address: str, idxs: list[int]):
                 primary = asyncio.create_task(read_group(address, idxs))
-                delay = min(max(READ_STATS.p9x(address),
+                # size-class-aware delay: a large batch must not hedge on
+                # small-read tail estimates.  length 0 = whole chunk,
+                # unknown a priori — assume a small-IO nominal (the
+                # KVCache block-get shape that dominates 0-length reads).
+                expect = sum(ios[i].length or (64 << 10) for i in idxs)
+                delay = min(max(READ_STATS.p9x(address, expect),
                                 self.cfg.hedge_delay_floor_s),
                             self.cfg.hedge_delay_cap_s)
                 done, _ = await asyncio.wait({primary}, timeout=delay)
@@ -575,19 +593,25 @@ class StorageClient:
         return list(await asyncio.gather(*tasks))
 
     async def read_file_range(self, layout: FileLayout, inode: int,
-                              offset: int, length: int) -> tuple[bytes, list[IOResult]]:
-        out = await self.read_file_ranges(layout, [(inode, offset, length)])
+                              offset: int, length: int,
+                              hedging: str | None = None
+                              ) -> tuple[bytes, list[IOResult]]:
+        out = await self.read_file_ranges(layout, [(inode, offset, length)],
+                                          hedging=hedging)
         return out[0]
 
     async def read_file_ranges(
             self, layout: FileLayout,
             ranges: list[tuple[int, int, int]],
+            hedging: str | None = None,
     ) -> list[tuple[bytes, list[IOResult]]]:
         """Many (inode, offset, length) ranges in ONE batch_read fan-out —
         the coalescing the reference gets from PioV gathering a ring's
         sqes into one StorageClient batch op (src/fuse/PioV.h:14-37).
         Holes and short chunks zero-fill, same contract as
-        read_file_range."""
+        read_file_range.  `hedging` opts this call in/out of hedged reads
+        (healthy-path checkpoint restores and KVCache ledger scans ride
+        the hedged path without a hedging-on client)."""
         all_pieces: list[list[tuple[int, int, int]]] = []
         ios: list[ReadIO] = []
         bounds: list[tuple[int, int]] = []
@@ -601,7 +625,7 @@ class StorageClient:
                               verify_checksum=self.cfg.verify_checksums)
                        for idx, coff, span in pieces)
             bounds.append((start, len(ios)))
-        results, payloads = await self.batch_read(ios)
+        results, payloads = await self.batch_read(ios, hedging=hedging)
         out: list[tuple[bytes, list[IOResult]]] = []
         for pieces, (lo, hi) in zip(all_pieces, bounds):
             data = bytearray()

@@ -1,0 +1,25 @@
+"""KVCache serving tier: sessions, TTL/capacity eviction, write-behind
+batching — layered on the raw block store (t3fs/lib/kvcache.py).
+
+See docs/kvcache.md for the design; benchmarks/kvcache_fleet_bench.py
+drives it at inference-fleet scale.
+"""
+
+from t3fs.kvcache.gc import EvictionConfig, EvictionWorker
+from t3fs.kvcache.ledger import (
+    DEFAULT_LANES, OP_DEL, OP_HIT, OP_PUT, LedgerReader, LedgerRecord,
+    LedgerTable, LedgerWriter, ledger_inode, segment_chunk,
+)
+from t3fs.kvcache.tier import (
+    AdmissionController, KVCacheTier, KVCacheTierConfig,
+    render_kvcache_stats,
+)
+from t3fs.kvcache.writebehind import WriteBehind, WriteBehindConfig
+
+__all__ = [
+    "AdmissionController", "DEFAULT_LANES", "EvictionConfig",
+    "EvictionWorker", "KVCacheTier", "KVCacheTierConfig", "LedgerReader",
+    "LedgerRecord", "LedgerTable", "LedgerWriter", "OP_DEL", "OP_HIT",
+    "OP_PUT", "WriteBehind", "WriteBehindConfig", "ledger_inode",
+    "render_kvcache_stats", "segment_chunk",
+]

@@ -98,20 +98,14 @@ class KVCacheStore:
         self.cfg = config or KVCacheConfig()
         self.namespace = namespace
         self.inode = (1 << 63) | _h64(namespace.encode(), person=b"t3fs-ns")
-        # read view with this namespace's hedging policy: a shallow client
-        # copy (shared sockets, routing, channels, hedge budget) whose cfg
-        # only differs in read_hedging — writes keep using `client`.
-        # getattr: placement-only tests pass a bare client with no cfg
-        base_cfg = getattr(client, "cfg", None)
-        if (self.cfg.read_hedging != "inherit" and base_cfg is not None
-                and self.cfg.read_hedging != base_cfg.read_hedging):
-            import copy
-            rc = copy.copy(client)
-            rc.cfg = copy.copy(client.cfg)
-            rc.cfg.read_hedging = self.cfg.read_hedging
-            self._read_client = rc
-        else:
-            self._read_client = client
+
+    @property
+    def _hedging(self) -> str | None:
+        """Per-call hedging override for this namespace's reads.  Derived
+        lazily on every call — the old construction-time copy.copy(cfg)
+        view went stale when the caller mutated client.cfg afterwards."""
+        return None if self.cfg.read_hedging == "inherit" \
+            else self.cfg.read_hedging
 
     # --- placement ---
 
@@ -122,7 +116,9 @@ class KVCacheStore:
 
     # --- data path ---
 
-    async def put(self, key: bytes, value: bytes) -> None:
+    async def put(self, key: bytes, value: bytes) -> int:
+        """Store one block; returns the chunk's assigned update version —
+        the fence a later conditional remove can use."""
         blob = _pack_block(key, value)
         if len(blob) > self.cfg.block_size:
             raise make_error(
@@ -134,6 +130,7 @@ class KVCacheStore:
         st = Status(StatusCode(result.status.code), result.status.message)
         if not st.ok:
             raise StatusError(st.code, st.message)
+        return result.update_ver
 
     async def get(self, key: bytes) -> bytes | None:
         values = await self.get_many([key])
@@ -150,8 +147,8 @@ class KVCacheStore:
             ios.append(ReadIO(chunk_id=cid, chain_id=chain, offset=0,
                               length=0,
                               verify_checksum=self.client.cfg.verify_checksums))
-        results, payloads = await self._read_client.batch_read(ios,
-                                                               stats=stats)
+        results, payloads = await self.client.batch_read(
+            ios, stats=stats, hedging=self._hedging)
         out: list[bytes | None] = []
         for key, result, payload in zip(keys, results, payloads):
             if result.status.code != int(StatusCode.OK):
@@ -160,36 +157,74 @@ class KVCacheStore:
                 out.append(_unpack_block(payload, key))
         return out
 
-    async def remove_many(self, keys: list[bytes]) -> int:
-        """GC path: REMOVE each key's block via its chain head (removing an
-        absent block is acked like the reference's idempotent removes).
-        Returns the number of acknowledged removals; the first hard error
-        raises.  Bounded-concurrent."""
-        sem = asyncio.Semaphore(self.cfg.gc_concurrency)
-        removed = 0
-
-        async def one(key: bytes) -> None:
-            nonlocal removed
+    async def probe_many(self, keys: list[bytes]
+                         ) -> list[tuple[bool, int]]:
+        """Eviction's verify-read: for each key, (block stores this key,
+        chunk update_ver) — reading only the header + key prefix, never
+        the value bytes.  (False, 0) = absent; (False, ver) = an index
+        collision overwrote this key's block (another key lives in the
+        chunk).  The version is the fence a subsequent conditional
+        remove_keys uses so a put racing the probe wins."""
+        ios = []
+        for key in keys:
             chain, cid = self.locate(key)
+            ios.append(ReadIO(chunk_id=cid, chain_id=chain, offset=0,
+                              length=_HDR.size + len(key)))
+        results, payloads = await self.client.batch_read(
+            ios, hedging=self._hedging)
+        out: list[tuple[bool, int]] = []
+        for key, result, payload in zip(keys, results, payloads):
+            if result.status.code != int(StatusCode.OK) \
+                    or len(payload) < _HDR.size:
+                out.append((False, 0))
+                continue
+            magic, klen, _vlen = _HDR.unpack_from(payload)
+            match = (magic == _MAGIC and klen == len(key)
+                     and payload[_HDR.size:_HDR.size + klen] == key)
+            out.append((match, result.update_ver))
+        return out
+
+    async def remove_keys(self, keys: list[bytes],
+                          fences: list[int] | None = None) -> list[bool]:
+        """REMOVE each key's block via its chain head; returns a per-key
+        removed flag.  Removing an absent block is acked (idempotent GC).
+        With `fences` (per-key expected update versions from probe_many),
+        a remove answered CHUNK_STALE_UPDATE — the chunk was re-put past
+        the fence — reports False and the newer block survives.
+        Bounded-concurrent; the first hard error raises after every
+        in-flight task settles."""
+        sem = asyncio.Semaphore(self.cfg.gc_concurrency)
+        flags = [False] * len(keys)
+
+        async def one(i: int, key: bytes) -> None:
+            chain, cid = self.locate(key)
+            fence = fences[i] if fences is not None else 0
             async with sem:
                 result = await self.client.write_chunk(
                     chain, cid, 0, b"", self.cfg.block_size,
-                    update_type=UpdateType.REMOVE)
+                    update_type=UpdateType.REMOVE, remove_fence_ver=fence)
             code = StatusCode(result.status.code)
             if code in (StatusCode.OK, StatusCode.CHUNK_NOT_FOUND):
-                removed += 1
+                flags[i] = True
+            elif fence and code == StatusCode.CHUNK_STALE_UPDATE:
+                flags[i] = False     # newer block won the race: keep it
             else:
                 raise StatusError(code, result.status.message)
 
         # return_exceptions so a failing chain doesn't leave the other
         # in-flight REMOVE tasks running detached; first error raises after
         # every task has settled
-        settled = await asyncio.gather(*(one(k) for k in keys),
+        settled = await asyncio.gather(*(one(i, k)
+                                         for i, k in enumerate(keys)),
                                        return_exceptions=True)
         for r in settled:
             if isinstance(r, BaseException):
                 raise r
-        return removed
+        return flags
+
+    async def remove_many(self, keys: list[bytes]) -> int:
+        """Unfenced bulk GC: number of acknowledged removals."""
+        return sum(await self.remove_keys(keys))
 
     # --- LLM prefix-caching helpers ---
 

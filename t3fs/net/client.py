@@ -100,12 +100,17 @@ class Client:
         READ_STATS.begin(address)
         t0 = time.monotonic()
         ok = False
+        nbytes = 0
         try:
             result = await conn.call(method, body, payload, timeout)
             ok = True
+            # response payload size drives the read-size-class tail
+            # estimate (per-(address, size-class) hedge delay)
+            nbytes = len(result[1])
             return result
         finally:
-            READ_STATS.end(address, method, time.monotonic() - t0, ok)
+            READ_STATS.end(address, method, time.monotonic() - t0, ok,
+                           nbytes)
 
     async def post(self, address: str, method: str, body: object = None,
                    payload: bytes = b"") -> None:

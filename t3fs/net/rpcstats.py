@@ -154,10 +154,30 @@ def _stream_quantile(est: float, x: float, q: float,
 
 _ADDR_RESERVOIR = 512
 
+# read-size classes for the hedge delay: a 4 MiB checkpoint read and a
+# 16 KiB KVCache block get have order-of-magnitude different latency
+# distributions, and ONE per-address p9x conflates them — large reads
+# would hedge on small-read tail estimates (ROADMAP carry-over from
+# PR 5).  Classes key off the RPC's TOTAL payload bytes (a batch is one
+# latency sample today, so the class must describe the whole batch too).
+SIZE_CLASS_BOUNDS = (128 << 10, 2 << 20)      # < 128 KiB | < 2 MiB | rest
+SIZE_CLASS_NAMES = ("small", "medium", "large")
+# per-class streaming estimates need a few samples before they beat the
+# class-agnostic fallback
+_CLASS_MIN_SAMPLES = 8
+
+
+def read_size_class(nbytes: int) -> int:
+    for cls, bound in enumerate(SIZE_CLASS_BOUNDS):
+        if nbytes < bound:
+            return cls
+    return len(SIZE_CLASS_BOUNDS)
+
 
 class _AddrReadStats:
     __slots__ = ("count", "ewma_s", "p50_s", "p9x_s", "inflight",
-                 "hedge_fired", "hedge_won", "hedge_wasted", "samples")
+                 "hedge_fired", "hedge_won", "hedge_wasted", "samples",
+                 "cls_count", "cls_p9x_s")
 
     def __init__(self):
         self.count = 0
@@ -170,14 +190,22 @@ class _AddrReadStats:
         self.hedge_wasted = 0
         # bounded reservoir for exact report-time quantiles (read-stats CLI)
         self.samples: list[float] = []
+        # per-size-class tail estimates (hedge delay); the class-agnostic
+        # p9x above stays as the fallback until a class has samples
+        self.cls_count = [0] * (len(SIZE_CLASS_BOUNDS) + 1)
+        self.cls_p9x_s = [0.0] * (len(SIZE_CLASS_BOUNDS) + 1)
 
-    def add(self, elapsed: float, tail_q: float) -> None:
+    def add(self, elapsed: float, tail_q: float, nbytes: int = 0) -> None:
         self.count += 1
         alpha = 0.2
         self.ewma_s = (elapsed if self.count == 1
                        else (1 - alpha) * self.ewma_s + alpha * elapsed)
         self.p50_s = _stream_quantile(self.p50_s, elapsed, 0.5)
         self.p9x_s = _stream_quantile(self.p9x_s, elapsed, tail_q)
+        cls = read_size_class(nbytes)
+        self.cls_count[cls] += 1
+        self.cls_p9x_s[cls] = _stream_quantile(self.cls_p9x_s[cls],
+                                               elapsed, tail_q)
         if len(self.samples) < _ADDR_RESERVOIR:
             self.samples.append(elapsed)
         else:
@@ -215,13 +243,13 @@ class ReadStats:
         self._get(address).inflight += 1
 
     def end(self, address: str, method: str, elapsed: float,
-            ok: bool) -> None:
+            ok: bool, nbytes: int = 0) -> None:
         st = self._get(address)
         st.inflight = max(0, st.inflight - 1)
         # failures are excluded from latency: a dead node failing fast
         # must not look like the FASTEST replica
         if ok and method in self.read_methods:
-            st.add(elapsed, self.tail_quantile)
+            st.add(elapsed, self.tail_quantile, nbytes)
 
     def inflight(self, address: str) -> int:
         st = self._addrs.get(address)
@@ -233,9 +261,19 @@ class ReadStats:
         st = self._addrs.get(address)
         return st.p50_s if st is not None else 0.0
 
-    def p9x(self, address: str) -> float:
+    def p9x(self, address: str, nbytes: int | None = None) -> float:
+        """Streaming tail estimate; with `nbytes` (the planned RPC's total
+        payload bytes) the estimate is size-class-specific once that class
+        has enough samples, else the class-agnostic fallback — a cold
+        class must not hedge at delay 0."""
         st = self._addrs.get(address)
-        return st.p9x_s if st is not None else 0.0
+        if st is None:
+            return 0.0
+        if nbytes is not None:
+            cls = read_size_class(nbytes)
+            if st.cls_count[cls] >= _CLASS_MIN_SAMPLES:
+                return st.cls_p9x_s[cls]
+        return st.p9x_s
 
     def hedge(self, address: str, fired: int = 0, won: int = 0,
               wasted: int = 0) -> None:
@@ -263,6 +301,9 @@ class ReadStats:
                 "ewma_ms": round(st.ewma_s * 1e3, 3),
                 "p50_ms": round(st.p50_s * 1e3, 3),
                 "p9x_ms": round(st.p9x_s * 1e3, 3),
+                **{f"p9x_{name}_ms": round(st.cls_p9x_s[cls] * 1e3, 3)
+                   for cls, name in enumerate(SIZE_CLASS_NAMES)
+                   if st.cls_count[cls]},
                 "q50_ms": round(pct(vals, 0.50) * 1e3, 3),
                 "q90_ms": round(pct(vals, 0.90) * 1e3, 3),
                 "q99_ms": round(pct(vals, 0.99) * 1e3, 3),
@@ -296,13 +337,16 @@ def render_read_stats(snapshots: list[dict], limit: int = 40) -> str:
                 continue
             n1, n2 = cur["count"], row["count"]
             tot = n1 + n2 or 1
-            for k in cur:
+            for k in set(cur) | set(row):
                 if k in ("count", "inflight") or k.startswith("hedge_"):
-                    cur[k] += row[k]
-                elif k in ("q90_ms", "q99_ms", "p9x_ms"):
-                    cur[k] = max(cur[k], row[k])     # upper bound
+                    cur[k] = cur.get(k, 0) + row.get(k, 0)
+                elif k in ("q90_ms", "q99_ms") or k.startswith("p9x"):
+                    # upper bound; per-size-class p9x columns are sparse
+                    # (a process only reports classes it has samples for)
+                    cur[k] = max(cur.get(k, 0.0), row.get(k, 0.0))
                 else:                                 # count-weighted
-                    cur[k] = round((cur[k] * n1 + row[k] * n2) / tot, 3)
+                    cur[k] = round((cur.get(k, 0.0) * n1
+                                    + row.get(k, 0.0) * n2) / tot, 3)
     rows = sorted(merged.items(), key=lambda kv: -kv[1].get("q99_ms", 0))
     hdr = (f"{'address':<22}{'reads':>8}{'infl':>6}{'ewma':>8}"
            f"{'p50~':>8}{'p9x~':>8}{'q50':>8}{'q90':>8}{'q99':>8}"

@@ -48,6 +48,18 @@ class ChunkReplica:
         meta = self.engine.get_meta(io.chunk_id)
 
         if io.update_type == UpdateType.REMOVE:
+            if io.remove_fence_ver and meta is not None \
+                    and meta.update_ver > io.remove_fence_ver:
+                # fenced remove (KVCache eviction vs concurrent re-put):
+                # the chunk moved past the version the remover verified —
+                # the NEWER block must survive.  Versions advance only
+                # under the head's per-chunk lock, so this check at the
+                # head is authoritative and forwarded hops (which see the
+                # same serialized history) agree.
+                raise make_error(
+                    StatusCode.CHUNK_STALE_UPDATE,
+                    f"{io.chunk_id}: remove fenced at v{io.remove_fence_ver}"
+                    f", chunk at v{meta.update_ver}")
             if io.is_sync and meta is not None:
                 # resync removes are CAS-gated on the snapshot state the
                 # worker diffed against: a live write that touched the chunk

@@ -88,6 +88,14 @@ class UpdateIO:
     # non-empty names an UPDATE_FRAG stream the receiver reassembles instead
     # of reading the frame payload.  Appended last (serde add-only).
     stream_id: str = ""
+    # REMOVE fence (KVCache eviction): nonzero means "remove only if the
+    # chunk's update_ver is still <= this" — a racing write that bumped
+    # the version past the fence answers CHUNK_STALE_UPDATE and the newer
+    # block survives.  Checked under the head's per-chunk lock, so
+    # verify-read -> fenced-remove is race-free end to end.  Serde
+    # add-only; fenced removes ride the struct wire path (pack_updateio
+    # declines them), which is fine — GC removes are paced, not IOPS-hot.
+    remove_fence_ver: int = 0
 
     def clone(self, **overrides) -> "UpdateIO":
         """Copy for a forwarded/derived hop.  The old
@@ -389,7 +397,8 @@ def pack_updateio(io: UpdateIO) -> bytes | None:
     """None when the IO needs the full struct (RemoteBuf pull, fault
     injection flags, oversized client_id, out-of-range field)."""
     d = io.debug
-    if io.buf is not None or io.stream_id or d.inject_server_error_prob or \
+    if io.buf is not None or io.stream_id or io.remove_fence_ver or \
+            d.inject_server_error_prob or \
             d.inject_client_error_prob or d.num_points_before_fail:
         return None
     cid = io.client_id.encode()

@@ -83,6 +83,25 @@ class ValueRecorder(Recorder):
         return {"name": self.name, "type": "value", "value": v, **self.tags}
 
 
+class CallbackGauge(Recorder):
+    """Gauge whose value is pulled from a callable at collect time —
+    for state that lives elsewhere (queue depths, buffer occupancy)
+    where pushing on the hot path would be wasted work."""
+
+    def __init__(self, name: str, fn: Callable[[], float],
+                 tags: dict[str, str] | None = None):
+        super().__init__(name, tags)
+        self._fn = fn
+
+    def collect(self) -> dict[str, Any]:
+        try:
+            v = float(self._fn())
+        except Exception:
+            log.exception("callback gauge %s failed", self.name)
+            v = 0.0
+        return {"name": self.name, "type": "value", "value": v, **self.tags}
+
+
 class DistributionRecorder(Recorder):
     """Windowed distribution: count/sum/min/max/mean + p50/p90/p99 estimates
     via a fixed reservoir."""

@@ -82,6 +82,69 @@ def test_block_size_enforced():
     run(body())
 
 
+def test_hedging_override_tracks_live_client_config():
+    """Regression: the store once kept a construction-time copy.copy of the
+    client config for its reads, so flipping client.cfg afterwards silently
+    had no effect.  The override is now derived per call."""
+    from t3fs.lib.kvcache import KVCacheConfig
+
+    class _Recorder:
+        class cfg:
+            verify_checksums = False
+        async def batch_read(self, ios, *, stats=None, hedging=None):
+            self.hedging = hedging
+            from t3fs.storage.types import IOResult
+            from t3fs.utils.status import Status, StatusCode
+            r = IOResult(status=Status(StatusCode.CHUNK_NOT_FOUND, ""))
+            return [r] * len(ios), [b""] * len(ios)
+
+    rec = _Recorder()
+    kv = KVCacheStore(rec, chains=[1],
+                      config=KVCacheConfig(read_hedging="inherit"))
+    run(kv.get_many([b"k"]))
+    assert rec.hedging is None          # inherit: client setting governs
+    kv.cfg.read_hedging = "off"         # flipped AFTER construction...
+    run(kv.get_many([b"k"]))
+    assert rec.hedging == "off"         # ...and the next call sees it
+    kv.cfg.read_hedging = "on"
+    run(kv.get_many([b"k"]))
+    assert rec.hedging == "on"
+
+
+def test_fenced_remove_loses_to_concurrent_put():
+    """GC probes a victim, then a put of the same key lands before the
+    REMOVE: the fence (probed update_ver) must make the remove a no-op so
+    the newer block survives."""
+    async def body():
+        fab = StorageFabric(num_nodes=3, replicas=3)
+        await fab.start()
+        try:
+            sc = StorageClient(lambda: fab.routing, client=fab.client)
+            kv = KVCacheStore(sc, chains=[fab.chain_id], namespace="fence")
+            await kv.put(b"victim", b"old-bytes")
+            [(match, fence)] = await kv.probe_many([b"victim"])
+            assert match and fence >= 1
+            # the race: a fresh put lands between probe and remove
+            await kv.put(b"victim", b"new-bytes")
+            assert await kv.remove_keys([b"victim"], fences=[fence]) \
+                == [False]
+            assert await kv.get(b"victim") == b"new-bytes"
+            # re-probe picks up the new version; the fenced remove now wins
+            [(match, fence2)] = await kv.probe_many([b"victim"])
+            assert match and fence2 > fence
+            assert await kv.remove_keys([b"victim"], fences=[fence2]) \
+                == [True]
+            assert await kv.get(b"victim") is None
+            # probing an absent key is a clean (False, 0)
+            assert await kv.probe_many([b"victim"]) == [(False, 0)]
+            # fenced remove of an absent chunk still acks (idempotent GC)
+            assert await kv.remove_keys([b"victim"], fences=[fence2]) \
+                == [True]
+        finally:
+            await fab.stop()
+    run(body())
+
+
 def test_prefix_chain_semantics():
     blocks_a = [b"tok0", b"tok1", b"tok2"]
     blocks_b = [b"tok0", b"tok1", b"DIVERGES"]

@@ -457,8 +457,7 @@ def test_kvcache_get_many_hedges_and_reports_stats():
                                  hedge_delay_cap_s=0.05))
             kv = KVCacheStore(sc, [fab.chain_id],
                               config=KVCacheConfig(read_hedging="on"))
-            assert kv._read_client is not sc
-            assert kv._read_client.cfg.read_hedging == "on"
+            assert kv._hedging == "on"
             assert sc.cfg.read_hedging == "off"
             keys = [f"k{i}".encode() for i in range(6)]
             for key in keys:
@@ -472,10 +471,10 @@ def test_kvcache_get_many_hedges_and_reports_stats():
             assert stats["hedge_fired"] >= 1
             assert stats["hedge_won"] >= 1
             assert elapsed < 0.18, "hedges should beat the straggler"
-            # inherit mode shares the client verbatim
+            # inherit mode passes no per-call override
             kv2 = KVCacheStore(sc, [fab.chain_id], namespace="n2",
                                config=KVCacheConfig(read_hedging="inherit"))
-            assert kv2._read_client is sc
+            assert kv2._hedging is None
         finally:
             fab.nodes[0].read_delay_s = 0.0
             await fab.stop()
