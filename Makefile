@@ -17,11 +17,17 @@ SAN_TESTS := tests/test_native_engine.py tests/test_usrbio.py \
              tests/test_storage_service.py tests/test_native_net.py
 SAN_FILTER := -k "not device"
 
-.PHONY: test sanitize sanitize-thread sanitize-address probe on-device ci \
-        ckpt-bench write-bench read-bench kvcache-fleet-bench
+.PHONY: test lint sanitize sanitize-thread sanitize-address probe \
+        on-device ci ckpt-bench write-bench read-bench kvcache-fleet-bench
 
 test:
 	$(PY) -m pytest tests/ -x -q
+
+# t3fslint: protocol-aware static analysis for the asyncio data plane
+# (docs/static_analysis.md) — the Python-side twin of `make sanitize`.
+# Exits non-zero on any unsuppressed finding; pure stdlib, no jax.
+lint:
+	$(PY) -m t3fs.analysis
 
 # Checkpoint save/restore throughput (median of --runs fresh clusters
 # per docs/bench_protocol.md); add --kill for the degraded-restore phase.
@@ -67,6 +73,7 @@ on-device:
 # on slow child startup under load, plus fixed sleeps racing the
 # heartbeat timeout — both replaced with event-driven waits.
 ci:
+	$(MAKE) lint
 	$(PY) -m t3fs.native.build
 	$(PY) -m pytest tests/ -x -q
 	$(MAKE) sanitize

@@ -1,0 +1,7 @@
+"""``python -m t3fs.analysis`` — run t3fslint over the tree."""
+
+import sys
+
+from t3fs.analysis.engine import main
+
+sys.exit(main())

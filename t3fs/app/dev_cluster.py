@@ -114,14 +114,17 @@ class DevCluster:
         load: every failure was this exact TimeoutError)."""
         port_path = self._path(f"{name}.port")
         deadline = time.time() + timeout_s
+        # t3fslint: allow(blocking-in-async) — startup poll of tiny local port files, loop serves nothing yet
         while not os.path.exists(port_path) or not open(port_path).read():
             proc = self.procs.get(name)
             if proc is not None and proc.poll() is not None:
+                # t3fslint: allow(blocking-in-async) — reading a dead child's log tail while failing startup
                 out = open(self._path(f"{name}.out")).read()[-2000:]
                 raise RuntimeError(f"{name} died at startup:\n{out}")
             if time.time() > deadline:
                 raise TimeoutError(f"{name} did not write {port_path}")
             await asyncio.sleep(0.05)
+        # t3fslint: allow(blocking-in-async) — startup poll of tiny local port files
         address = f"127.0.0.1:{open(port_path).read().strip()}"
         while True:
             try:

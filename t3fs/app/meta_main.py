@@ -107,6 +107,7 @@ async def serve(cfg: MetaMainConfig, app: ApplicationBase) -> None:
                           cfg.metrics_period_s)
         state["meta"], state["sc"] = meta, sc
         if cfg.port_file:
+            # t3fslint: allow(blocking-in-async) — one-shot port-file write at startup
             with open(cfg.port_file, "w") as f:
                 f.write(str(rpc.port))
 

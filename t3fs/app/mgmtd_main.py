@@ -46,6 +46,7 @@ async def serve(cfg: MgmtdMainConfig, app: ApplicationBase) -> None:
         app.start_metrics(cfg.monitor_address, cfg.node_id,
                           cfg.metrics_period_s)
         if cfg.port_file:
+            # t3fslint: allow(blocking-in-async) — one-shot port-file write at startup
             with open(cfg.port_file, "w") as f:
                 f.write(str(rpc.port))
 

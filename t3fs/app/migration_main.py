@@ -35,6 +35,7 @@ async def serve(cfg: MigrationMainConfig, app: ApplicationBase) -> None:
     async def start():
         await srv.start()
         if cfg.port_file:
+            # t3fslint: allow(blocking-in-async) — one-shot port-file write at startup
             with open(cfg.port_file, "w") as f:
                 f.write(str(srv.port))
 

@@ -27,6 +27,7 @@ async def serve(cfg: MonitorMainConfig, app: ApplicationBase) -> None:
     async def start():
         await srv.start()
         if cfg.port_file:
+            # t3fslint: allow(blocking-in-async) — one-shot port-file write at startup
             with open(cfg.port_file, "w") as f:
                 f.write(str(srv.server.port))
 

@@ -289,9 +289,17 @@ class CheckpointReader:
         for s in corrupt:
             cid = (lay.data_chunk(lf.inode, stripe, s) if s < k
                    else lay.parity_chunk(lf.inode, stripe, s - k))
-            await self.ec.sc.write_chunk(
+            r = await self.ec.sc.write_chunk(
                 lay.shard_chain(stripe, s), cid, 0, b"", chunk_size=cs,
                 update_type=UpdateType.REMOVE)
+            if r.status.code not in (int(StatusCode.OK),
+                                     int(StatusCode.CHUNK_NOT_FOUND)):
+                # the corrupt shard is still serving reads — repairing
+                # around it is fine (it's in `bad`), but leaving it in
+                # place silently would mask the failed remove
+                log.warning("ckpt scrub %r stripe %d shard %d: remove of "
+                            "corrupt shard failed: %s", lf.path, stripe, s,
+                            r.status.message)
         bad = tuple(sorted(missing + corrupt))
         try:
             outcomes = await self.ec.repair_stripe(lay, lf.inode, stripe,

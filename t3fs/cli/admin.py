@@ -300,6 +300,7 @@ async def rpc_top(ctx: AdminContext, args) -> None:
         for pat in args.paths:
             for path in sorted(_glob.glob(pat)) or [pat]:
                 try:
+                    # t3fslint: allow(blocking-in-async) — single-shot CLI tool, no served traffic on this loop
                     with open(path) as f:
                         snaps.append(_json.load(f))
                 except (OSError, ValueError) as e:
@@ -327,6 +328,7 @@ async def read_stats(ctx: AdminContext, args) -> None:
     for pat in args.paths:
         for path in sorted(_glob.glob(pat)) or [pat]:
             try:
+                # t3fslint: allow(blocking-in-async) — single-shot CLI tool
                 with open(path) as f:
                     snaps.append(_json.load(f))
             except (OSError, ValueError) as e:
@@ -352,6 +354,7 @@ async def kvcache_stats(ctx: AdminContext, args) -> None:
     for pat in args.paths:
         for path in sorted(_glob.glob(pat)) or [pat]:
             try:
+                # t3fslint: allow(blocking-in-async) — single-shot CLI tool
                 with open(path) as f:
                     snaps.append(_json.load(f))
             except (OSError, ValueError) as e:
@@ -600,6 +603,7 @@ async def gen_chains(ctx: AdminContext, args) -> None:
 @command("set-config-template", "store a node-type config template in mgmtd")
 @args_(("node_type", {}), ("file", {"help": "TOML file"}))
 async def set_config_template(ctx: AdminContext, args) -> None:
+    # t3fslint: allow(blocking-in-async) — single-shot CLI tool
     with open(args.file) as f:
         toml_text = f.read()
     await ctx.cli.call(ctx.mgmtd_address, "Mgmtd.set_config_template",
@@ -769,6 +773,7 @@ async def mv(ctx: AdminContext, args) -> None:
        ("--chunk-size", {"type": int, "default": 0}))
 async def put(ctx: AdminContext, args) -> None:
     fs = await ctx.fs()
+    # t3fslint: allow(blocking-in-async) — single-shot CLI tool
     with open(args.local, "rb") as f:
         data = f.read()
     await fs.write_file(args.remote, data, chunk_size=args.chunk_size)
@@ -780,6 +785,7 @@ async def put(ctx: AdminContext, args) -> None:
 async def get(ctx: AdminContext, args) -> None:
     fs = await ctx.fs()
     data = await fs.read_file(args.remote)
+    # t3fslint: allow(blocking-in-async) — single-shot CLI tool
     with open(args.local, "wb") as f:
         f.write(data)
     print(f"read {len(data)} bytes from {args.remote}")

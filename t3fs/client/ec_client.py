@@ -363,6 +363,7 @@ class ECStorageClient:
                     pending, return_when=asyncio.FIRST_COMPLETED)
                 for t in done:
                     try:
+                        # t3fslint: allow(blocking-in-async) — t is a member of asyncio.wait's done set — result() cannot block
                         results, payloads = t.result()
                     except StatusError:
                         continue   # transport failure == shard missing

@@ -67,6 +67,7 @@ def _set_exception_safe(fut: asyncio.Future, err) -> None:
 
 
 from t3fs.ops.blocks import pick_block as _pick_block
+from t3fs.utils.aio import reap_task
 
 
 class ECCodec:
@@ -138,10 +139,7 @@ class ECCodec:
         self._closed = True
         if self._worker is not None:
             self._worker.cancel()
-            try:
-                await self._worker
-            except (asyncio.CancelledError, Exception):
-                pass
+            await reap_task(self._worker, log, "ECCodec submit worker")
             self._worker = None
         err = RuntimeError("ECCodec closed")
         while not self._q.empty():

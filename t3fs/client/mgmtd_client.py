@@ -19,6 +19,7 @@ from t3fs.mgmtd.types import (
     ClientSession, LocalTargetState, NodeInfo, RoutingInfo,
 )
 from t3fs.net.client import Client
+from t3fs.utils.aio import reap_task
 from t3fs.utils.status import StatusError
 
 log = logging.getLogger("t3fs.client.mgmtd")
@@ -92,10 +93,7 @@ class MgmtdClient:
         self._stopped.set()
         if self._task:
             self._task.cancel()
-            try:
-                await self._task
-            except (asyncio.CancelledError, Exception):
-                pass
+            await reap_task(self._task, log, "mgmtd refresh loop")
         await self.client.close()
 
 
@@ -171,8 +169,5 @@ class MgmtdClientForServer(MgmtdClient):
     async def stop(self) -> None:
         if self._hb_task:
             self._hb_task.cancel()
-            try:
-                await self._hb_task
-            except (asyncio.CancelledError, Exception):
-                pass
+            await reap_task(self._hb_task, log, "mgmtd heartbeat loop")
         await super().stop()

@@ -506,6 +506,7 @@ class StorageClient:
                             self.cfg.hedge_delay_cap_s)
                 done, _ = await asyncio.wait({primary}, timeout=delay)
                 if done:
+                    # t3fslint: allow(blocking-in-async) — primary is in asyncio.wait's done set — result() cannot block
                     primary.result()   # propagate unexpected exceptions
                     return
                 # primary is past its p9x: plan hedges, one different
@@ -539,6 +540,7 @@ class StorageClient:
                         done, tasks = await asyncio.wait(
                             tasks, return_when=asyncio.FIRST_COMPLETED)
                         for t in done:
+                            # t3fslint: allow(blocking-in-async) — t is in asyncio.wait's done set — result() cannot block
                             t.result()   # surface unexpected exceptions
                         if all(results[i] is not None
                                and results[i].status.code == int(StatusCode.OK)
@@ -716,10 +718,18 @@ class StorageClient:
                                 begin_index=begin),
                 check_result=True)
         if boundary_off:
-            await self.write_chunk(
+            r = await self.write_chunk(
                 layout.chain_of(boundary), ChunkId(inode, boundary), 0, b"",
                 chunk_size=layout.chunk_size, update_type=UpdateType.TRUNCATE,
                 truncate_len=boundary_off)
+            if r.status.code not in (int(StatusCode.OK),
+                                     int(StatusCode.CHUNK_NOT_FOUND)):
+                # a failed boundary truncate silently left the old tail
+                # bytes readable past new_length (CHUNK_NOT_FOUND is fine:
+                # nothing was ever written there, so there is no tail)
+                raise make_error(StatusCode(r.status.code),
+                                 f"truncate boundary chunk {boundary} of "
+                                 f"inode {inode}: {r.status.message}")
 
     async def _backoff(self, attempt: int) -> None:
         await asyncio.sleep(self.cfg.retry_backoff_s * (2 ** min(attempt, 6))

@@ -160,6 +160,9 @@ class FuseKernelMount:
         self._open_count: dict[int, int] = {}
         self._buf = bytearray(max_write + (16 << 10))
         self._closed = asyncio.Event()
+        # in-flight request handlers: asyncio only weak-refs spawned
+        # tasks, so an untracked dispatch could be GC'd mid-request
+        self._dispatch_tasks: set[asyncio.Task] = set()
         self.request_count = 0
 
     # ---- mount / unmount ----
@@ -217,7 +220,10 @@ class FuseKernelMount:
                 raise
             if not msg:
                 return
-            asyncio.get_running_loop().create_task(self._dispatch(msg))
+            task = asyncio.get_running_loop().create_task(
+                self._dispatch(msg))
+            self._dispatch_tasks.add(task)
+            task.add_done_callback(self._dispatch_tasks.discard)
 
     async def _dispatch(self, msg: bytes) -> None:
         (length, opcode, unique, nodeid, uid, gid, pid,
@@ -575,8 +581,15 @@ class FuseKernelMount:
             if h is None or not h.writable:
                 raise OSError(errno.EBADF, "bad handle")
             data = body[_WRITE_IN.size:_WRITE_IN.size + size]
-            await self.sc.write_file_range(h.inode.layout, h.inode.inode_id,
-                                           off, data)
+            results = await self.sc.write_file_range(
+                h.inode.layout, h.inode.inode_id, off, data)
+            for r in results:
+                if r.status.code != int(StatusCode.OK):
+                    # per-chunk failures ride in the IOResult, not as an
+                    # exception — without this the caller got a success
+                    # reply for bytes that never landed
+                    raise OSError(errno.EIO,
+                                  f"write failed: {r.status.message}")
             ino = h.inode.inode_id
             self._open_len[ino] = max(self._open_len.get(ino, 0),
                                       off + len(data))

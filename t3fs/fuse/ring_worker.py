@@ -18,6 +18,7 @@ import threading
 from t3fs.client.meta_client import MetaClient
 from t3fs.client.storage_client import StorageClient
 from t3fs.lib.usrbio import Completion, CSqe, IoRing, IoVec, OP_READ
+from t3fs.utils.aio import reap_task
 from t3fs.utils.status import StatusCode, StatusError
 
 MAX_INFLIGHT = 256
@@ -177,12 +178,9 @@ class RingWorker:
                 None, self._thread.join)
         if self._drainer is not None:
             self._drainer.cancel()
-            try:
-                # run its CancelledError handler (which error-completes
-                # any half-gathered wave) BEFORE the ring closes below
-                await self._drainer
-            except (asyncio.CancelledError, Exception):
-                pass
+            # run its CancelledError handler (which error-completes
+            # any half-gathered wave) BEFORE the ring closes below
+            await reap_task(self._drainer, what="usrbio ring drainer")
         # sqes already popped from the shm ring but still queued would
         # otherwise vanish without a cqe — error-complete them
         if self._queue is not None:

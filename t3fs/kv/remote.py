@@ -49,7 +49,9 @@ class RemoteTransaction:
         (r4 verdict weak #2: per-read version RPCs halved sharded
         batch_stat throughput)."""
         if self.read_version is None:
-            async with self._pin_lock:
+            # exactly-one pin RPC per txn: waiters queue on the lock
+            # while the first reader fetches the snapshot version
+            async with self._pin_lock:  # t3fslint: allow(async-lock-await-discipline)
                 if self.read_version is None:
                     rsp = await self.engine._call("Kv.get_version", None)
                     self.read_version = rsp.version

@@ -536,7 +536,10 @@ class KvService:
                 f"range [{begin!r},{end!r}) not fully owned here")
 
     async def _put_record(self, key: bytes, value: bytes | None) -> None:
-        async with self._commit_lock:
+        # replication order MUST equal commit order: the 2PC pipeline
+        # admits under _commit_lock by design, so the replicate+apply
+        # awaits below deliberately hold it (see _replicate_and_apply)
+        async with self._commit_lock:  # t3fslint: allow(async-lock-await-discipline)
             rec = Transaction(self.engine,
                               read_version=self.engine.current_version())
             rec._writes[key] = value
@@ -594,7 +597,7 @@ class KvService:
         bypasses the owned/frozen gates — the target does not own the
         range until the map flips."""
         self._require_primary()
-        async with self._commit_lock:
+        async with self._commit_lock:  # t3fslint: allow(async-lock-await-discipline)
             rec = Transaction(self.engine,
                               read_version=self.engine.current_version())
             for k, v in zip(req.keys, req.values):
@@ -609,7 +612,7 @@ class KvService:
     @rpc_method
     async def shard_delete_range(self, req: KvShardRangeReq, payload, conn):
         self._require_primary()
-        async with self._commit_lock:
+        async with self._commit_lock:  # t3fslint: allow(async-lock-await-discipline)
             rec = Transaction(self.engine,
                               read_version=self.engine.current_version())
             rec._range_clears.append((max(req.begin, self._USER_FLOOR),
@@ -826,7 +829,7 @@ class KvService:
         if self._refuse_stale_prepare(req.txn_id):
             return KvOkRsp(seq=self.seq), b""
         txn = self._txn_from_req(req.body)
-        async with self._commit_lock:
+        async with self._commit_lock:  # t3fslint: allow(async-lock-await-discipline)
             # re-check under the lock: phase 2 / an abort may have raced
             # this prepare while it sat queued on the lock — registering
             # now would re-apply an already-committed slice via the
@@ -915,7 +918,7 @@ class KvService:
             stale.append(k)
         if not stale:
             return 0
-        async with self._commit_lock:
+        async with self._commit_lock:  # t3fslint: allow(async-lock-await-discipline)
             drop = Transaction(self.engine,
                                read_version=self.engine.current_version())
             for k in stale:
@@ -1017,7 +1020,7 @@ class KvService:
             # late coordinator commit_prepared cannot resurrect the txn
             self._resolving.add(txn_id)
             try:
-                async with self._commit_lock:
+                async with self._commit_lock:  # t3fslint: allow(async-lock-await-discipline)
                     drop = Transaction(
                         self.engine,
                         read_version=self.engine.current_version())
@@ -1050,13 +1053,13 @@ class KvService:
                 txn._read_keys.clear()
                 txn._read_ranges.clear()
                 self._finish_txn(txn, req, None)
-                async with self._commit_lock:
+                async with self._commit_lock:  # t3fslint: allow(async-lock-await-discipline)
                     await self._replicate_and_apply(txn)
                 self._resolved_tombstones.set(txn_id, b"C")
                 log.warning("2pc %s: decider says COMMITTED -> applied",
                             txn_id)
             else:                           # "A" or no trace: abort
-                async with self._commit_lock:
+                async with self._commit_lock:  # t3fslint: allow(async-lock-await-discipline)
                     drop = Transaction(
                         self.engine,
                         read_version=self.engine.current_version())
@@ -1139,7 +1142,7 @@ class KvService:
         # landed — a duplicate prepare/abort must not slip in
         self._resolving.add(req.txn_id)
         try:
-            async with self._commit_lock:
+            async with self._commit_lock:  # t3fslint: allow(async-lock-await-discipline)
                 await self._replicate_and_apply(txn)
             self._resolved_tombstones.set(req.txn_id, b"C")
             # verdict applied: the slice is ordinary committed state now
@@ -1179,7 +1182,7 @@ class KvService:
             timer.cancel()
             self._resolving.add(req.txn_id)
             try:
-                async with self._commit_lock:
+                async with self._commit_lock:  # t3fslint: allow(async-lock-await-discipline)
                     drop = Transaction(
                         self.engine,
                         read_version=self.engine.current_version())
@@ -1264,7 +1267,7 @@ class KvService:
         lock = self._push_locks.setdefault(addr, asyncio.Lock())
         last: StatusError | None = None
         for round_ in range(3):
-            async with lock:
+            async with lock:  # t3fslint: allow(async-lock-await-discipline)
                 try:
                     # a predecessor's push may have healed us already
                     await self.client.call(addr, "Kv.apply_replica", req,

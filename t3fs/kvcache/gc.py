@@ -28,6 +28,7 @@ for chain IOPS — the knob the reference tunes as "GC removal IOPS".
 from __future__ import annotations
 
 import asyncio
+import logging
 import time
 from dataclasses import dataclass
 
@@ -35,6 +36,8 @@ from t3fs.kvcache.ledger import (
     OP_DEL, LedgerReader, LedgerTable, LedgerWriter,
 )
 from t3fs.lib.kvcache import KVCacheStore
+
+log = logging.getLogger("t3fs.kvcache")
 
 
 @dataclass
@@ -174,7 +177,12 @@ class EvictionWorker:
 
     async def _loop(self) -> None:
         while not self._stop.is_set():
-            await self.run_pass()
+            try:
+                await self.run_pass()
+            except Exception:
+                # a transient store/ledger error must not kill eviction
+                # for the life of the process — retry next interval
+                log.exception("kvcache gc pass failed; retrying")
             try:
                 await asyncio.wait_for(self._stop.wait(),
                                        self.cfg.interval_s)

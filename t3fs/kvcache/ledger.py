@@ -187,7 +187,9 @@ class LedgerWriter:
         if self.seq is None:
             raise make_error(StatusCode.INVALID_ARG,
                              "LedgerWriter.flush before attach()")
-        async with self._flush_lock:
+        # serialized by design (see docstring): two flushers racing
+        # would write different segments at the same seq
+        async with self._flush_lock:  # t3fslint: allow(async-lock-await-discipline)
             return await self._flush_locked()
 
     async def _flush_locked(self) -> int:

@@ -197,7 +197,18 @@ class WriteBehind:
             # serialize the rest); bounded so a burst can't open
             # unbounded write channels
             sem = asyncio.Semaphore(self.cfg.flush_concurrency)
-            await asyncio.gather(*(self._flush_one(e, sem) for e in batch))
+            results = await asyncio.gather(
+                *(self._flush_one(e, sem) for e in batch),
+                return_exceptions=True)
+            for r in results:
+                if isinstance(r, asyncio.CancelledError):
+                    raise r
+                if isinstance(r, Exception):
+                    # _flush_one handles expected failures itself; anything
+                    # escaping it is a bug — log it rather than killing the
+                    # flusher (a dead flusher wedges every flush() barrier)
+                    log.error("kvcache write-behind flush crashed",
+                              exc_info=r)
 
     async def _flush_one(self, entry: _Dirty,
                          sem: asyncio.Semaphore) -> None:
@@ -231,7 +242,14 @@ class WriteBehind:
             self._retire(entry)
             self._cond.notify_all()
         if self.on_flushed is not None:
-            self.on_flushed(entry.key, len(entry.value), entry.expiry, ver)
+            try:
+                self.on_flushed(entry.key, len(entry.value), entry.expiry,
+                                ver)
+            except Exception:
+                # the durability callback (the tier's ledger hook) must not
+                # take the flusher down with it: the data IS durable
+                log.exception("kvcache on_flushed callback failed for %r",
+                              entry.key[:32])
 
     def _retire(self, entry: _Dirty) -> None:
         # caller holds the condition lock

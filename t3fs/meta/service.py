@@ -21,6 +21,7 @@ from t3fs.meta.schema import DirEntry, FileSession, Inode, InodeType
 from t3fs.meta.store import ChainAllocator, MetaStore
 from t3fs.net.server import rpc_method, service
 from t3fs.net.wire import OkRsp
+from t3fs.utils.aio import reap_task
 from t3fs.utils.config import ConfigBase as _ConfigBase, citem as _citem
 from t3fs.utils.serde import serde_struct
 from t3fs.utils.status import StatusCode, StatusError, make_error
@@ -624,10 +625,7 @@ class MetaServer:
         self._stopped.set()
         if self._task:
             self._task.cancel()
-            try:
-                await self._task
-            except (asyncio.CancelledError, Exception):
-                pass
+            await reap_task(self._task, log, "meta gc loop")
 
     async def _gc_loop(self) -> None:
         log.info("meta gc loop started (period %.2fs)", self.gc_period_s)

@@ -28,6 +28,7 @@ from t3fs.mgmtd.types import NodeStatus as NodeStatusEnum
 from t3fs.net.server import rpc_method, service
 from t3fs.net.wire import OkRsp
 from t3fs.utils import serde
+from t3fs.utils.aio import reap_task
 from t3fs.utils.config import ConfigBase, citem
 from t3fs.utils.serde import serde_struct
 from t3fs.utils.status import StatusCode, make_error
@@ -1130,10 +1131,7 @@ class MgmtdServer:
         for t in self._tasks:
             t.cancel()
         for t in self._tasks:
-            try:
-                await t
-            except (asyncio.CancelledError, Exception):
-                pass
+            await reap_task(t, log, t.get_name())
 
     async def _lease_extender(self) -> None:
         while not self._stopped.is_set():

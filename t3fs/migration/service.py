@@ -29,6 +29,7 @@ from dataclasses import dataclass, field
 from enum import Enum
 
 from t3fs.net.server import rpc_method, service
+from t3fs.utils.aio import reap_task
 from t3fs.utils.serde import serde_struct
 from t3fs.utils.status import StatusCode, StatusError, make_error
 
@@ -145,10 +146,7 @@ class MigrationService:
         for t in tasks:
             t.cancel()
         for t in tasks:
-            try:
-                await t
-            except (asyncio.CancelledError, Exception):
-                pass
+            await reap_task(t, log, t.get_name())
 
     # ---- driver ----
 

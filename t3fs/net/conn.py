@@ -129,7 +129,9 @@ class Connection:
 
     async def _send_frame(self, packet: MessagePacket, payload: bytes, flags: int) -> None:
         head, msg, payload = await self._prep_frame(packet, payload, flags)
-        async with self._send_lock:
+        # frame atomicity: header+payload must hit the stream without
+        # interleaving, so drain() deliberately runs under the lock
+        async with self._send_lock:  # t3fslint: allow(async-lock-await-discipline)
             if self._closed:
                 raise make_error(StatusCode.RPC_SEND_FAILED, "connection closed")
             try:

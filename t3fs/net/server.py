@@ -102,8 +102,10 @@ class Server:
         while True:
             try:
                 sock, peer = await loop.sock_accept(self._lsock)
-            except (asyncio.CancelledError, OSError):
-                return
+            except asyncio.CancelledError:
+                return          # stop() cancelled the accept loop
+            except OSError:
+                return          # listener closed under us
             try:
                 sock.setsockopt(_socket.IPPROTO_TCP,
                                 _socket.TCP_NODELAY, 1)

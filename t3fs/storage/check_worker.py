@@ -13,6 +13,7 @@ import logging
 import os
 
 from t3fs.mgmtd.types import LocalTargetState
+from t3fs.utils.aio import reap_task
 
 log = logging.getLogger("t3fs.storage.check")
 
@@ -54,10 +55,7 @@ class CheckWorker:
         self._stopped.set()
         if self._task:
             self._task.cancel()
-            try:
-                await self._task
-            except (asyncio.CancelledError, Exception):
-                pass
+            await reap_task(self._task, log, "chunk check worker")
 
     async def _loop(self) -> None:
         while not self._stopped.is_set():
@@ -115,10 +113,7 @@ class MaintenanceWorker:
         self._stopped.set()
         if self._task:
             self._task.cancel()
-            try:
-                await self._task
-            except (asyncio.CancelledError, Exception):
-                pass
+            await reap_task(self._task, log, "maintenance worker")
 
     async def _loop(self) -> None:
         while not self._stopped.is_set():

@@ -34,6 +34,7 @@ import numpy as np
 
 from t3fs.ops.codec import crc32c as cpu_crc32c, crc32c_combine
 from t3fs.ops.crc32c import default_matrices
+from t3fs.utils.aio import reap_task
 
 log = logging.getLogger("t3fs.storage.codec")
 
@@ -143,10 +144,7 @@ class DeviceChecksumBackend(ChecksumBackend):
         self._closed = True
         if self._worker is not None:
             self._worker.cancel()
-            try:
-                await self._worker
-            except (asyncio.CancelledError, Exception):
-                pass
+            await reap_task(self._worker, log, "device codec worker")
             self._worker = None
         # fail anything still queued so in-flight payload_crc() awaits don't
         # hang a node shutdown under write load

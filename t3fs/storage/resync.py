@@ -20,6 +20,7 @@ from t3fs.storage.chunk_engine import size_class_of
 from t3fs.storage.types import (
     ChunkState, SyncDoneReq, SyncStartReq, UpdateIO, UpdateType,
 )
+from t3fs.utils.aio import reap_task
 from t3fs.utils.status import StatusCode, StatusError
 
 log = logging.getLogger("t3fs.storage.resync")
@@ -40,10 +41,7 @@ class ResyncWorker:
         self._stopped.set()
         if self._task:
             self._task.cancel()
-            try:
-                await self._task
-            except (asyncio.CancelledError, Exception):
-                pass
+            await reap_task(self._task, log, "resync worker")
 
     async def _loop(self) -> None:
         while not self._stopped.is_set():

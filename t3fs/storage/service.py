@@ -409,7 +409,10 @@ class StorageService:
             if cached is not None:
                 return cached
 
-        async with target.chunk_lock(io.chunk_id):
+        # CRAQ: per-chunk update order must match forward order down
+        # the chain, so _locked_update's forward RPC deliberately
+        # holds the chunk lock (docs/design_notes.md §3)
+        async with target.chunk_lock(io.chunk_id):  # t3fslint: allow(async-lock-await-discipline)
             if node.audit is not None:
                 # sanitizer hook (t3fs/testing/race.py): the region from
                 # here to return must be per-chunk mutually exclusive —

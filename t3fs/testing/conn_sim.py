@@ -188,6 +188,7 @@ async def run_schedule(seed: int, calls: int = 20, cut_after: int | None = None,
             await asyncio.sleep(0)
         if steps > 100_000:
             raise AssertionError("schedule did not quiesce (hang)")  # C3
+    # t3fslint: allow(blocking-in-async) — the quiesce loop above completed every worker task
     results = [t.result() for t in tasks]
     await pair.settle()
     await pair.close()

@@ -25,10 +25,12 @@ them enabled — the same division as the reference's sanitizer builds.
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import sys
 import threading
 import time
 import traceback
+import warnings
 from dataclasses import dataclass, field
 from typing import Any
 
@@ -177,3 +179,66 @@ class _AuditedSection:
 
     def __exit__(self, *exc):
         self.auditor.exit(self.key)
+
+
+@contextlib.contextmanager
+def race_audit(stall_threshold_s: float = 0.25):
+    """Install the runtime detectors tree-wide for the enclosed scope.
+
+    The cross-check companion to t3fslint (tests/conftest.py enables this
+    under ``T3FS_RACE_AUDIT=1``): where the static rules reason about
+    lock/await shapes, this watches the same invariants at runtime —
+
+      * every ``StorageFabric`` node gets a shared
+        ``CriticalSectionAuditor`` on its ``audit`` hook, so the CRAQ
+        chunk-lock section (the async-lock-await-discipline pragma site)
+        raises ``RaceError`` the moment two updates overlap on one chunk;
+      * every ``ChunkReplica.apply_update`` — the storage service AND the
+        CRAQ step simulator both funnel through it — runs inside a sync
+        audited section keyed by (replica, chunk);
+      * each fabric's lifetime runs under a ``LoopStallDetector``
+        (generous threshold: CI machines jitter); stalls surface as
+        warnings, not failures, since the *blocking-in-async* static rule
+        is the enforced twin.
+
+    Yields the shared auditor; ``auditor.entries`` > 0 proves coverage.
+    """
+    from t3fs.storage.chunk_replica import ChunkReplica
+    from t3fs.testing.fabric import StorageFabric
+
+    auditor = CriticalSectionAuditor()
+    orig_start = StorageFabric.start
+    orig_stop = StorageFabric.stop
+    orig_apply = ChunkReplica.apply_update
+
+    async def start(self) -> None:
+        det = LoopStallDetector(threshold_s=stall_threshold_s)
+        await det.__aenter__()
+        self._race_stall_det = det
+        await orig_start(self)
+        for node in self.nodes:
+            node.audit = auditor
+
+    async def stop(self) -> None:
+        await orig_stop(self)
+        det = getattr(self, "_race_stall_det", None)
+        if det is not None:
+            self._race_stall_det = None
+            await det.__aexit__(None, None, None)
+            if det.stalls:
+                warnings.warn("T3FS_RACE_AUDIT: " + det.report(),
+                              stacklevel=2)
+
+    def apply_update(self, io, payload, *args, **kwargs):
+        with auditor.section((id(self), io.chunk_id), "apply_update"):
+            return orig_apply(self, io, payload, *args, **kwargs)
+
+    StorageFabric.start = start
+    StorageFabric.stop = stop
+    ChunkReplica.apply_update = apply_update
+    try:
+        yield auditor
+    finally:
+        StorageFabric.start = orig_start
+        StorageFabric.stop = orig_stop
+        ChunkReplica.apply_update = orig_apply

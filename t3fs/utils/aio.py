@@ -1,0 +1,44 @@
+"""Small asyncio teardown helpers shared across the data plane.
+
+`reap_task` is the canonical "cancel-then-await" tail for background
+workers: it distinguishes expected cancellation (silent) from a task
+that had already crashed (logged) — the distinction t3fslint's
+swallowed-cancellation rule enforces.  A combined
+``except (CancelledError, Exception): pass`` hides both, which means a
+worker that died hours before stop() was called leaves no trace.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+_fallback_log = logging.getLogger("t3fs.aio")
+
+
+async def reap_task(task: asyncio.Task | None,
+                    log: logging.Logger | None = None,
+                    what: str = "task") -> None:
+    """Await a (typically just-cancelled) background task to completion.
+
+    Cancellation is the expected outcome and stays silent; any other
+    exception means the worker crashed at some point and is logged with
+    its traceback.  If the *caller* is cancelled while reaping, that
+    cancellation propagates normally.
+    """
+    if task is None:
+        return
+    try:
+        # shield: a bare `await task` links the awaiter's cancellation to
+        # the task (Task.cancel cancels its _fut_waiter), which would make
+        # the task look self-cancelled and swallow the awaiter's cancel.
+        # The shield keeps the two cancellations apart; callers follow the
+        # cancel-then-reap idiom, so the task is already stopping.
+        await asyncio.shield(task)
+    except asyncio.CancelledError:
+        # the task's own cancellation is the expected outcome; if the
+        # *awaiter* was cancelled instead (task still running), propagate
+        if not task.cancelled():
+            raise
+    except Exception:
+        (log or _fallback_log).exception("%s crashed before teardown", what)

@@ -36,3 +36,22 @@ if not ON_DEVICE and not SANITIZE:
     import jax  # noqa: E402
 
     jax.config.update("jax_platforms", "cpu")
+
+# Runtime race-detector tier (`T3FS_RACE_AUDIT=1`): every StorageFabric
+# node gets a CriticalSectionAuditor on its audit hook, every
+# ChunkReplica.apply_update runs in an audited section (covers the CRAQ
+# step simulator too), and fabric lifetimes run under a LoopStallDetector
+# — the runtime cross-check of t3fslint's static rules
+# (docs/static_analysis.md).  Off by default: the hooks add per-update
+# overhead and stall warnings would be noise on loaded CI machines.
+RACE_AUDIT = os.environ.get("T3FS_RACE_AUDIT") == "1" and not SANITIZE
+
+if RACE_AUDIT:
+    import pytest  # noqa: E402
+
+    @pytest.fixture(autouse=True)
+    def _t3fs_race_audit():
+        from t3fs.testing.race import race_audit
+
+        with race_audit() as auditor:
+            yield auditor

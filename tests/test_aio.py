@@ -1,0 +1,83 @@
+"""reap_task: the canonical teardown await for background tasks.
+
+The idiom it replaces — ``except (asyncio.CancelledError, Exception):
+pass`` — swallowed the awaiter's OWN cancellation (t3fslint rule
+swallowed-cancellation), so a shutdown racing a teardown could wedge the
+caller's cancel.  The contract under test:
+
+- the task's own cancellation (normal stop path) is silent;
+- a task that crashed before teardown is logged, never re-raised;
+- cancellation aimed at the AWAITER propagates out.
+"""
+
+import asyncio
+import logging
+
+from t3fs.utils.aio import reap_task
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def test_reap_task_silent_on_tasks_own_cancellation():
+    async def body():
+        async def forever():
+            await asyncio.Event().wait()
+
+        t = asyncio.create_task(forever())
+        await asyncio.sleep(0)
+        t.cancel()
+        await reap_task(t)          # must not raise
+        assert t.cancelled()
+    run(body())
+
+
+def test_reap_task_logs_crashed_task(caplog):
+    async def body():
+        async def boom():
+            raise RuntimeError("worker died")
+
+        t = asyncio.create_task(boom())
+        await asyncio.sleep(0)
+        log = logging.getLogger("test.reap")
+        with caplog.at_level(logging.ERROR, logger="test.reap"):
+            await reap_task(t, log, "boom worker")   # must not raise
+        assert any("boom worker" in r.getMessage()
+                   for r in caplog.records)
+    run(body())
+
+
+def test_reap_task_propagates_awaiter_cancellation():
+    async def body():
+        started = asyncio.Event()
+
+        async def slow():
+            started.set()
+            await asyncio.Event().wait()
+
+        t = asyncio.create_task(slow())
+
+        async def reaper():
+            await started.wait()
+            await reap_task(t)
+
+        r = asyncio.create_task(reaper())
+        await started.wait()
+        await asyncio.sleep(0)
+        r.cancel()
+        try:
+            await r
+        except asyncio.CancelledError:
+            pass
+        else:
+            raise AssertionError(
+                "awaiter cancellation was swallowed by reap_task")
+        assert r.cancelled()
+        t.cancel()
+        await reap_task(t)
+    run(body())
+
+
+def test_reap_task_accepts_none():
+    run(reap_task(None))

@@ -1,0 +1,68 @@
+"""Handler-level FUSE kernel tests that need no /dev/fuse mount.
+
+Regression coverage for the status-discarded fix in the WRITE op: per-chunk
+write failures ride in the returned IOResult list, and the handler used to
+drop that list on the floor — FUSE callers got a success reply for bytes
+that never landed (found by t3fslint's status-discarded rule).
+"""
+
+import asyncio
+import errno
+import types
+
+import pytest
+
+from t3fs.fuse.kernel import WRITE, FuseKernelMount, _Handle, _WRITE_IN
+from t3fs.net.wire import WireStatus
+from t3fs.storage.types import IOResult
+from t3fs.utils.status import StatusCode
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+class _FakeSC:
+    def __init__(self, statuses):
+        self.statuses = statuses
+        self.calls = []
+
+    async def write_file_range(self, layout, inode_id, off, data):
+        self.calls.append((inode_id, off, len(data)))
+        return [IOResult(status=WireStatus(int(code), msg))
+                for code, msg in self.statuses]
+
+
+def _kernel_with_handle(sc, fh=3):
+    k = FuseKernelMount(None, sc, "/tmp/unused-mnt")
+    inode = types.SimpleNamespace(layout=None, inode_id=7)
+    k._handles[fh] = _Handle(inode, writable=True)
+    return k
+
+
+def _write_body(fh, off, data):
+    return _WRITE_IN.pack(fh, off, len(data), 0, 0, 0, 0) + data
+
+
+def test_write_ioresult_failure_surfaces_as_eio():
+    async def body():
+        sc = _FakeSC([(StatusCode.OK, ""),
+                      (StatusCode.CHUNK_STALE_UPDATE, "replica lost")])
+        k = _kernel_with_handle(sc)
+        with pytest.raises(OSError) as ei:
+            await k._handle(WRITE, 7, _write_body(3, 0, b"x" * 100))
+        assert ei.value.errno == errno.EIO
+        # the failed write must NOT advance the open-handle length
+        assert k._open_len.get(7, 0) == 0
+    run(body())
+
+
+def test_write_all_ok_replies_with_full_length():
+    async def body():
+        sc = _FakeSC([(StatusCode.OK, "")])
+        k = _kernel_with_handle(sc)
+        out = await k._handle(WRITE, 7, _write_body(3, 0, b"y" * 100))
+        assert out is not None
+        assert sc.calls == [(7, 0, 100)]
+        assert k._open_len[7] == 100
+    run(body())

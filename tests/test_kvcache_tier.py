@@ -406,3 +406,79 @@ def test_render_kvcache_stats_merges_processes():
     assert "ns" in out and "70.0" in out        # 105 hits / 150 gets
     assert "6000" in out                        # max across views, not sum
     assert render_kvcache_stats([]) == "no kvcache stats"
+
+
+# ---------------- background-loop resilience (t3fslint fixes) ----------------
+
+def test_write_behind_survives_crashing_on_flushed_callback():
+    """The ledger hook raising must not kill the flusher: the data IS
+    durable, and a dead flusher wedges every later flush() barrier."""
+    async def body():
+        fab = StorageFabric(num_nodes=3, replicas=3)
+        await fab.start()
+        sc = StorageClient(lambda: fab.routing, client=fab.client)
+        try:
+            store = KVCacheStore(sc, [fab.chain_id], namespace="cbx")
+            fired = []
+
+            def bad_hook(key, size, expiry, ver):
+                fired.append(key)
+                raise RuntimeError("ledger hook blew up")
+
+            wb = WriteBehind(store,
+                             WriteBehindConfig(flush_interval_s=0.002),
+                             on_flushed=bad_hook)
+            await wb.start()
+            await wb.put(b"a", b"v1")
+            # pre-fix this barrier hung forever (flusher task dead);
+            # the timeout is the regression tripwire
+            await asyncio.wait_for(wb.flush(), 5.0)
+            assert fired == [b"a"]
+            await wb.put(b"b", b"v2")
+            await asyncio.wait_for(wb.flush(), 5.0)
+            assert await store.get(b"b") == b"v2"
+            assert sorted(fired) == [b"a", b"b"]
+            await wb.stop()
+        finally:
+            await sc.close()
+            await fab.stop()
+    run(body())
+
+
+def test_eviction_loop_survives_crashing_pass():
+    """One failed GC pass (transient store/ledger error) must not end
+    eviction for the life of the process."""
+    async def body():
+        fab = StorageFabric(num_nodes=3, replicas=3)
+        await fab.start()
+        sc = StorageClient(lambda: fab.routing, client=fab.client)
+        try:
+            store = KVCacheStore(sc, [fab.chain_id], namespace="gcx")
+            writer = LedgerWriter(store, writer_id=9, lanes=2)
+            await writer.attach()
+            reader = LedgerReader(store, lanes=2)
+            gc_ = EvictionWorker(store, reader, LedgerTable(), writer,
+                                 EvictionConfig(interval_s=0.01))
+            real_pass = gc_.run_pass
+            crashes = []
+
+            async def flaky_pass(now=None):
+                if not crashes:
+                    crashes.append(1)
+                    raise RuntimeError("transient scan failure")
+                return await real_pass(now)
+
+            gc_.run_pass = flaky_pass
+            await gc_.start()
+            for _ in range(200):
+                await asyncio.sleep(0.01)
+                if gc_.stats["passes"] > 0:
+                    break
+            # the loop outlived the crash and completed a real pass
+            assert crashes and gc_.stats["passes"] > 0
+            assert not gc_._task.done()
+            await gc_.stop()
+        finally:
+            await sc.close()
+            await fab.stop()
+    run(body())

@@ -105,3 +105,50 @@ def test_storage_write_path_is_race_and_stall_clean(tmp_path):
         finally:
             await fab.stop()
     run(body())
+
+
+# --- race_audit: the tree-wide installer (T3FS_RACE_AUDIT=1 tier) ---
+
+def test_race_audit_covers_fabric_and_restores_patches(tmp_path):
+    """The conftest hook's contract: inside the context every fabric node
+    is audited (entries accumulate on real writes), outside the context
+    the classes are back to their originals."""
+    async def body():
+        from t3fs.client.storage_client import StorageClient
+        from t3fs.storage.chunk_replica import ChunkReplica
+        from t3fs.storage.types import ChunkId
+        from t3fs.testing.fabric import StorageFabric
+        from t3fs.testing.race import race_audit
+
+        orig_start = StorageFabric.start
+        orig_apply = ChunkReplica.apply_update
+        with race_audit() as auditor:
+            assert StorageFabric.start is not orig_start
+            assert ChunkReplica.apply_update is not orig_apply
+            fab = StorageFabric(num_nodes=3, replicas=3)
+            await fab.start()
+            try:
+                assert all(n.audit is auditor for n in fab.nodes)
+                sc = StorageClient(lambda: fab.routing, client=fab.client)
+                await sc.write_chunk(fab.chain_id, ChunkId(9, 0), 0,
+                                     b"z" * 4096, chunk_size=4096)
+                # one write -> replicas hops, each an audited section
+                assert auditor.entries >= 3
+            finally:
+                await fab.stop()
+        assert StorageFabric.start is orig_start
+        assert ChunkReplica.apply_update is orig_apply
+    run(body())
+
+
+def test_race_audit_covers_craq_step_simulator():
+    """ChunkReplica.apply_update is the funnel the CRAQ schedule explorer
+    shares with the real service, so the simulator's interleavings run
+    audited too — no separate hook needed."""
+    from t3fs.testing.craq_sim import run_schedules
+    from t3fs.testing.race import race_audit
+
+    with race_audit() as auditor:
+        failures = run_schedules(2, crashes=0)
+    assert failures == {}
+    assert auditor.entries > 0

@@ -618,3 +618,36 @@ def test_read_file_ranges_retry_exhaustion_surfaces_errors():
         finally:
             await fabric.stop()
     run(body())
+
+
+def test_truncate_boundary_failure_raises_instead_of_silent_success():
+    """The boundary-chunk TRUNCATE returns its failure in the IOResult, not
+    as an exception; truncate_file used to discard it, so a failed truncate
+    left the old tail bytes readable past new_length while the caller saw
+    success (found by t3fslint's status-discarded rule)."""
+    async def body():
+        from t3fs.net.wire import WireStatus
+        from t3fs.storage.types import IOResult, UpdateType
+        from t3fs.utils.status import StatusError
+
+        fabric = StorageFabric(num_nodes=2, replicas=2)
+        await fabric.start()
+        try:
+            sc = StorageClient(lambda: fabric.routing, client=fabric.client)
+            lay = FileLayout(chunk_size=4096, chains=[fabric.chain_id])
+            await sc.write_file_range(lay, 46, 0, b"z" * 10000)
+
+            orig = sc.write_chunk
+
+            async def failing_write_chunk(*args, **kwargs):
+                if kwargs.get("update_type") == UpdateType.TRUNCATE:
+                    return IOResult(status=WireStatus(
+                        int(StatusCode.CHUNK_STALE_UPDATE), "injected"))
+                return await orig(*args, **kwargs)
+
+            sc.write_chunk = failing_write_chunk
+            with pytest.raises(StatusError):
+                await sc.truncate_file(lay, 46, 5000)
+        finally:
+            await fabric.stop()
+    run(body())

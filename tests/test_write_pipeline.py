@@ -217,12 +217,14 @@ def test_successor_death_mid_stream_is_retryable_and_retry_converges():
             mid = fab.nodes[1]
             mid_server = fab.servers[1]
             seen = []
+            stop_tasks = []
             orig_put = mid.frag_store.put
 
             def dying_put(frag, payload):
                 seen.append(frag.seq)
                 if len(seen) >= 2:   # "crash" mid-stream: drop the rest
-                    asyncio.ensure_future(mid_server.stop())
+                    stop_tasks.append(
+                        asyncio.ensure_future(mid_server.stop()))
                     raise StatusError(StatusCode.TARGET_OFFLINE,
                                       "injected: successor died mid-stream")
                 return orig_put(frag, payload)
@@ -255,6 +257,7 @@ def test_successor_death_mid_stream_is_retryable_and_retry_converges():
                 target = fab.nodes[i].targets[fab.target_id(i)]
                 assert target.engine.read(cid) == data
                 assert target.engine.get_meta(cid).commit_ver == 1
+            await asyncio.gather(*stop_tasks)
         finally:
             await fab.stop()
     run(body())
