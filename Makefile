@@ -18,7 +18,8 @@ SAN_TESTS := tests/test_native_engine.py tests/test_usrbio.py \
 SAN_FILTER := -k "not device"
 
 .PHONY: test lint sanitize sanitize-thread sanitize-address probe \
-        on-device ci ckpt-bench write-bench read-bench kvcache-fleet-bench
+        on-device ci ckpt-bench write-bench read-bench \
+        kvcache-fleet-bench repair-drill
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -53,6 +54,14 @@ read-bench:
 kvcache-fleet-bench:
 	JAX_PLATFORMS=cpu $(PY) -m benchmarks.kvcache_fleet_bench \
 		--procs 4 --sessions 256 --turns 2 --json
+
+# Repair drill (ISSUE 9): kill one node under live first-k read traffic,
+# A/B full-k vs reduced-read (LRC sub-shard) rebuild on identical damage,
+# paced and unpaced; headline = survivor bytes moved per lost byte ratio
+# (target < 0.5) + foreground p99 per cell, one JSON blob.
+repair-drill:
+	JAX_PLATFORMS=cpu $(PY) -m benchmarks.repair_drill_bench \
+		--stripes 12 --chunk-size 65536 --repair-mode both --json
 
 # Bounded TPU-tunnel probe; ALWAYS appends a dated record to
 # DEVICE_PROBE_LOG.jsonl (proof the chip was retried, r3 verdict #1).

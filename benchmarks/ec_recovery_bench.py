@@ -170,6 +170,14 @@ async def _run(args, cluster: LocalCluster, k: int, m: int,
         "degraded_read_MB_s": round(total / t_degraded / 1e6, 2),
         "repaired_shards": n_shards,
         "repair_MB_s": round(repaired_bytes / t_repair / 1e6, 2),
+        # IO accounting from RepairReport (ISSUE 9): survivor bytes pulled
+        # per rebuilt byte — the number the reduced-read drill bench drives
+        # below 0.5 with LRC locals (repair_drill_bench.py)
+        "repair_bytes_read": report.bytes_read,
+        "repair_bytes_repaired": report.bytes_repaired,
+        "repair_stripes_failed": report.stripes_failed,
+        "repair_read_amplification": round(
+            report.bytes_read / max(report.bytes_repaired, 1), 3),
         # survivor-read balance achieved by the k-subset planner
         # (1.0 = perfectly flat; VERDICT r2 asked this to drop toward 1)
         "survivor_read_imbalance": round(

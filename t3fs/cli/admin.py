@@ -117,6 +117,14 @@ def _fmt_table(rows: list[list], headers: list[str]) -> str:
     return "\n".join(out)
 
 
+def _fmt_bytes(n: int) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if n < 1024 or unit == "GiB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{n}B"
+        n /= 1024
+    return f"{n}B"
+
+
 # ---------------- cluster ----------------
 
 @command("list-nodes", "registered nodes + liveness (ListNodes)")
@@ -128,6 +136,26 @@ async def list_nodes(ctx: AdminContext, args) -> None:
              else "never"]
             for s in rsp.nodes]
     print(_fmt_table(rows, ["id", "type", "address", "state", "hb-age"]))
+
+
+@command("repair-status", "scrub/repair health pushed by scrub schedulers")
+async def repair_status(ctx: AdminContext, args) -> None:
+    rsp, _ = await ctx.cli.call(ctx.mgmtd_address, "Mgmtd.repair_status",
+                                None)
+    if not rsp.rows:
+        print("no scrub schedulers have reported")
+        return
+    now = time.time()
+    rows = [[r.source, f"{now - r.ts:.1f}s", r.repair_mode,
+             f"{r.budget_mbps:g}" if r.budget_mbps else "off",
+             r.stripes_scanned, r.shards_lost + r.shards_corrupt,
+             r.repaired_shards, r.stripes_failed,
+             _fmt_bytes(r.bytes_read), _fmt_bytes(r.bytes_repaired),
+             f"{r.paced_wait_s:.2f}s"]
+            for r in rsp.rows]
+    print(_fmt_table(rows, ["source", "age", "mode", "MB/s", "scanned",
+                            "damaged", "repaired", "failed", "read",
+                            "rebuilt", "paced"]))
 
 
 @command("lease", "current mgmtd primary lease")

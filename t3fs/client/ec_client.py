@@ -35,6 +35,19 @@ from t3fs.utils.status import StatusCode, StatusError, make_error
 log = logging.getLogger("t3fs.client.ec")
 
 PARITY_NS = 1 << 62   # parity chunk-id namespace bit
+LOCAL_NS = 1 << 61    # local-group (LRC) parity chunk-id namespace bit
+
+
+def subshard_r(chunk_size: int, r_max: int = 4) -> int:
+    """Sub-shard split factor for reduced-read repair: the largest r <= r_max
+    with chunk_size % r == 0 and a 512-multiple slice (so every sub-shard
+    stays on the fused word-kernel path and CRC segment grid).  r > 1 frames
+    each helper read as r smaller ReadIOs — finer pacing quanta for the
+    scrub budget and natural micro-batch shape for the repair kernel."""
+    r = r_max
+    while r > 1 and (chunk_size % r or (chunk_size // r) % 512):
+        r -= 1
+    return r
 
 
 # Format id assumed for layouts serialized before code_id existed: the
@@ -57,19 +70,72 @@ class ECLayout:
     # (= what a pre-versioning serialized layout deserializes to) is the
     # LEGACY id; new layouts get the current id via create().
     code_id: str = LEGACY_CODE_ID
+    # Opt-in LRC local parities (ROADMAP item 4, "regenerating/LRC-style"):
+    # "" = pure RS(k+m) (every pre-existing layout deserializes to this);
+    # "lrc-xor" partitions the k+m base shards into contiguous groups of
+    # ~local_group_size and stores one XOR parity chunk per group (in the
+    # LOCAL_NS namespace, rotated onto chains like any other shard).  A
+    # single lost shard then rebuilds from its GROUP (group_size reads)
+    # instead of k survivors — the repair-bandwidth trade bought with
+    # G/(k+m) extra storage.  Scalar-MDS information theory forces the
+    # trade: ANY (k+m, k) MDS code needs >= k full shards' worth of bytes
+    # per single-shard repair under raw reads (see docs/codec_economics.md).
+    local_scheme: str = ""
+    local_group_size: int = 3
 
     def __post_init__(self):
-        if len(self.chains) < self.k + self.m:
+        if len(self.chains) < self.slots:
             raise make_error(
                 StatusCode.INVALID_ARG,
-                f"EC({self.k}+{self.m}) needs >= {self.k + self.m} chains")
+                f"EC({self.k}+{self.m}"
+                f"{'+' + str(self.num_local_groups) + 'l' if self.local_scheme else ''}"
+                f") needs >= {self.slots} chains")
+        if self.local_scheme not in ("", "lrc-xor"):
+            raise make_error(StatusCode.INVALID_ARG,
+                             f"unknown local scheme {self.local_scheme!r}")
 
     @classmethod
     def create(cls, k: int = 8, m: int = 2, chunk_size: int = 1 << 20,
-               chains: list[int] | None = None) -> "ECLayout":
+               chains: list[int] | None = None, local_scheme: str = "",
+               local_group_size: int = 3) -> "ECLayout":
         """Layout-creation factory: stamps the CURRENT parity format id."""
         return cls(k=k, m=m, chunk_size=chunk_size, chains=chains or [],
-                   code_id=default_rs(k, m).code_id)
+                   code_id=default_rs(k, m).code_id,
+                   local_scheme=local_scheme,
+                   local_group_size=local_group_size)
+
+    @property
+    def num_local_groups(self) -> int:
+        if not self.local_scheme:
+            return 0
+        return -(-(self.k + self.m) // self.local_group_size)
+
+    @property
+    def slots(self) -> int:
+        """Chain-rotation period: base shards + one slot per local parity."""
+        return self.k + self.m + self.num_local_groups
+
+    def local_groups(self) -> list[tuple[int, ...]]:
+        """Balanced contiguous partition of the k+m base shards, e.g.
+        10 shards at group size 3 -> (0,1,2) (3,4,5) (6,7) (8,9)."""
+        n, g = self.k + self.m, self.num_local_groups
+        if not g:
+            return []
+        base, rem = divmod(n, g)
+        out, at = [], 0
+        for i in range(g):
+            size = base + (1 if i < rem else 0)
+            out.append(tuple(range(at, at + size)))
+            at += size
+        return out
+
+    def group_of(self, shard: int) -> int:
+        """Local group index of a base shard (0..k+m-1)."""
+        for g, members in enumerate(self.local_groups()):
+            if shard in members:
+                return g
+        raise make_error(StatusCode.INVALID_ARG,
+                         f"shard {shard} has no local group")
 
     def check_code(self, rs) -> None:
         if rs.code_id != self.code_id:
@@ -80,9 +146,10 @@ class ECLayout:
                 f"formats")
 
     def shard_chain(self, stripe: int, shard: int) -> int:
-        """Chain of shard (0..k+m-1) of a stripe; rotates per stripe."""
+        """Chain of slot `shard` (0..slots-1: base shards, then one slot per
+        local-group parity) of a stripe; rotates per stripe."""
         n = len(self.chains)
-        return self.chains[(stripe * (self.k + self.m) + shard) % n]
+        return self.chains[(stripe * self.slots + shard) % n]
 
     def data_chunk(self, inode: int, stripe: int, j: int) -> ChunkId:
         return ChunkId(inode, stripe * self.k + j)
@@ -90,17 +157,28 @@ class ECLayout:
     def parity_chunk(self, inode: int, stripe: int, p: int) -> ChunkId:
         return ChunkId(inode | PARITY_NS, stripe * self.m + p)
 
+    def local_chunk(self, inode: int, stripe: int, g: int) -> ChunkId:
+        return ChunkId(inode | LOCAL_NS,
+                       stripe * self.num_local_groups + g)
+
+    def shard_chunk(self, inode: int, stripe: int, s: int) -> ChunkId:
+        """ChunkId of slot s: data, RS parity, or local-group parity."""
+        if s < self.k:
+            return self.data_chunk(inode, stripe, s)
+        if s < self.k + self.m:
+            return self.parity_chunk(inode, stripe, s - self.k)
+        return self.local_chunk(inode, stripe, s - self.k - self.m)
+
     def data_file_layout(self):
         """A FileLayout whose chain_of() reproduces THIS layout's data-chunk
         placement: data chunk idx (= stripe*k + j) lives on
-        chains[((idx//k)*(k+m) + idx%k) % n], which is periodic in idx with
+        chains[((idx//k)*slots + idx%k) % n], which is periodic in idx with
         period k*n — so plain StorageClient.read_file_ranges serves healthy
         EC reads (e.g. resharded checkpoint restore) with no EC-aware
         plumbing; only stripes with failed shards need read_stripe."""
         from t3fs.client.layout import FileLayout
         n = len(self.chains)
-        chains = [self.chains[((i // self.k) * (self.k + self.m)
-                               + i % self.k) % n]
+        chains = [self.chains[((i // self.k) * self.slots + i % self.k) % n]
                   for i in range(self.k * n)]
         return FileLayout(chunk_size=self.chunk_size, chains=chains)
 
@@ -109,12 +187,25 @@ class ECLayout:
 class StripeEncoding:
     """One encoded stripe, ready to write shard-by-shard: the k data shards
     (tail-trimmed to their true lengths; b"" for zero holes) followed by the
-    m full-size parity shards, with the CRC32C each chunk will carry once
-    stored (device-computed by the fused encode+CRC step for full shards;
-    host crc32c only for the at-most-one trimmed tail shard; 0 for holes)."""
+    m full-size parity shards — and, when the layout carries a local scheme,
+    one full-size XOR local parity per group — with the CRC32C each chunk
+    will carry once stored (device-computed by the fused encode+CRC step for
+    full shards; host crc32c only for the at-most-one trimmed tail shard;
+    0 for holes)."""
     lens: list[int]             # per data shard true length (0 = hole)
-    contents: list[bytes]       # k+m stored contents in shard order
+    contents: list[bytes]       # `slots` stored contents in slot order
     crcs: list[int]             # CRC32C of contents[i]; 0 for holes
+
+
+@dataclass
+class RepairIOStats:
+    """Per-run repair IO accounting (RepairDriver/scrub surface): how many
+    bytes came off the wire to rebuild how many, and which path served."""
+    bytes_read: int = 0         # survivor/helper payload bytes fetched
+    bytes_repaired: int = 0     # rebuilt bytes written back
+    sub_reads: int = 0          # sub-range helper ReadIOs issued
+    reduced_shards: int = 0     # shards rebuilt by the reduced-read path
+    fallback_shards: int = 0    # shards that fell back to full-k decode
 
 
 class ChainAdmission:
@@ -256,6 +347,28 @@ class ECStorageClient:
             contents.append(bytes(parity[p]))
             crcs.append(int(dev_crcs[k + p]) if dev_crcs is not None
                         else crc32c(contents[-1]))
+        if layout.local_scheme:
+            # local XOR parities over the PADDED member buffers (consistent
+            # with absent == zeros on the repair side); the all-ones repair
+            # program is exactly an XOR fold + CRC, so the device path
+            # reuses it — local groups micro-batch alongside stripe encodes
+            full = np.concatenate([arr, parity], axis=0)     # (k+m, cs)
+
+            async def one_local(members: tuple[int, ...]) -> tuple[bytes, int]:
+                rows = np.ascontiguousarray(full[list(members)])
+                if self.codec is not None:
+                    out, crc = await self.codec.repair(
+                        rows, (1,) * len(members), k, m)
+                    return bytes(out), int(crc)
+                buf = rows[0].copy()
+                for extra in rows[1:]:
+                    buf ^= extra
+                return bytes(buf), crc32c(buf.tobytes())
+
+            for content, crc in await asyncio.gather(
+                    *(one_local(g) for g in layout.local_groups())):
+                contents.append(content)
+                crcs.append(crc)
         return StripeEncoding(lens=lens, contents=contents, crcs=crcs)
 
     async def write_stripe(self, layout: ECLayout, inode: int, stripe: int,
@@ -287,12 +400,11 @@ class ECStorageClient:
         reason (absent == zeros is the decode contract)."""
         k, m, cs = layout.k, layout.m, layout.chunk_size
         if shards is None:
-            shards = tuple(range(k + m))
+            shards = tuple(range(layout.slots))
 
         async def one(s: int) -> IOResult:
             chain = layout.shard_chain(stripe, s)
-            cid = (layout.data_chunk(inode, stripe, s) if s < k
-                   else layout.parity_chunk(inode, stripe, s - k))
+            cid = layout.shard_chunk(inode, stripe, s)
             if s < k and enc.lens[s] == 0:
                 kwargs = dict(update_type=UpdateType.REMOVE)
                 content: bytes = b""
@@ -413,7 +525,8 @@ class ECStorageClient:
                                   stripe: int, want: tuple[int, ...],
                                   zero_shards: frozenset[int],
                                   known: dict[int, bytes] | None = None,
-                                  prefer: tuple[int, ...] | None = None
+                                  prefer: tuple[int, ...] | None = None,
+                                  stats: RepairIOStats | None = None
                                   ) -> tuple[list[bytes], list[int | None]]:
         """Fetch enough surviving shards (data we already have + parity +
         other data) and decode the wanted shard indices (0..k+m-1 space).
@@ -461,6 +574,8 @@ class ECStorageClient:
             results, payloads = await self._fast.batch_read(ios)
             for s, r, p in zip(ids, results, payloads):
                 if r.status.code == int(StatusCode.OK):
+                    if stats is not None:
+                        stats.bytes_read += len(p)
                     buf = np.zeros(cs, dtype=np.uint8)
                     buf[: len(p)] = np.frombuffer(p, dtype=np.uint8)
                     have[s] = buf
@@ -483,6 +598,8 @@ class ECStorageClient:
                 results2, payloads2 = await self.sc.batch_read(ios2)
                 for s, r, p in zip(ids2, results2, payloads2):
                     if r.status.code == int(StatusCode.OK):
+                        if stats is not None:
+                            stats.bytes_read += len(p)
                         buf = np.zeros(cs, dtype=np.uint8)
                         buf[: len(p)] = np.frombuffer(p, dtype=np.uint8)
                         have[s] = buf
@@ -526,6 +643,147 @@ class ECStorageClient:
                  for s in want],
                 [crc_of.get(s) for s in want])
 
+    # --- reduced-read repair (the ISSUE 9 bandwidth path) ---
+
+    def hot_repair_programs(self, layout: ECLayout) -> list[tuple[int, ...]]:
+        """The coefficient rows single-shard repair will actually run under
+        this layout — the warmup set.  With a local scheme: one all-ones
+        program per group size (member and local rebuilds share it).
+        Without: the k+m scheduled single-row programs over the canonical
+        (no-holes, no-preference) survivor pick _plan_reduced makes."""
+        rows: dict[tuple[int, ...], None] = {}
+        if layout.local_scheme:
+            for members in layout.local_groups():
+                rows[(1,) * len(members)] = None
+        else:
+            base = layout.k + layout.m
+            for s in range(base):
+                plan = self._plan_reduced(layout, s, frozenset((s,)),
+                                          frozenset(), None)
+                if plan:
+                    rows[tuple(c for _slot, c in plan)] = None
+        return list(rows)
+
+    def warmup_repair(self, layout: ECLayout,
+                      batch_sizes: tuple[int, ...] = (1,)) -> None:
+        """Precompile this layout's repair programs at the sub-shard length
+        the reduced path uses (and, with a local scheme, at full chunk size
+        for the encode-side local XOR) — RepairDriver-setup hook, so the
+        first drill stripe never eats the Mosaic compile (satellite of the
+        same bug class warmup_decode fixed for degraded reads)."""
+        if self.codec is None:
+            return
+        k, m, cs = layout.k, layout.m, layout.chunk_size
+        rows = self.hot_repair_programs(layout)
+        sub = cs // subshard_r(cs)
+        self.codec.warmup_repair(rows, sub, k, m, batch_sizes)
+        if layout.local_scheme and sub != cs:
+            self.codec.warmup_repair(rows, cs, k, m, batch_sizes)
+
+    def _plan_reduced(self, layout: ECLayout, s: int,
+                      lost: frozenset[int], zero_shards: frozenset[int],
+                      read_shards: tuple[int, ...] | None
+                      ) -> list[tuple[int, int]] | None:
+        """Helper plan [(slot, gf_coeff), ...] rebuilding lost slot s with
+        fewer than k full-chunk reads, or None when only the full-k decode
+        applies.  Zero-hole members are pre-dropped (they contribute zero
+        bytes for free); an empty plan means the rebuilt content is zeros.
+
+        With a local scheme, a shard whose group (incl. its local parity)
+        holds no OTHER loss rebuilds from the group — group_size reads
+        instead of k.  Without one, a SINGLE lost shard still rides the
+        scheduled single-row program over k survivors: same bytes as full-k,
+        but sub-range framed (pacing quanta) and far fewer device ops."""
+        k, m = layout.k, layout.m
+        base = k + m
+        if layout.local_scheme:
+            groups = layout.local_groups()
+            if s >= base:                      # lost local parity
+                members = groups[s - base]
+                if lost & set(members):
+                    return None
+                return [(x, 1) for x in members if x not in zero_shards]
+            g = layout.group_of(s)
+            local_slot = base + g
+            others = set(groups[g]) - {s} | {local_slot}
+            if lost & others:
+                return None                    # second loss in the group
+            return [(x, 1) for x in sorted(others) if x not in zero_shards]
+        if len(lost) > 1:
+            return None                        # multi-loss: joint decode
+        survivors = [x for x in range(base) if x not in lost]
+        # zero holes first (free), then the planner's balanced pick
+        pref = set(read_shards or ())
+
+        def rank(x: int) -> tuple:
+            return (x not in zero_shards, x not in pref, x)
+        present = sorted(survivors, key=rank)[:k]
+        row = default_rs(k, m).reconstruct_gfmatrix(sorted(present), [s])[0]
+        return [(p, int(c)) for p, c in zip(sorted(present), row)
+                if c and p not in zero_shards]
+
+    async def _repair_eval(self, rows: np.ndarray, coeffs: tuple[int, ...],
+                           k: int, m: int) -> tuple[bytes, int]:
+        if self.codec is not None:
+            out, crc = await self.codec.repair(rows, coeffs, k, m)
+            return bytes(out), int(crc)
+        from t3fs.ops.codec import crc32c
+        from t3fs.ops.repair_program import (eval_program_np,
+                                             schedule_repair_program)
+        rs = default_rs(k, m)
+
+        def run():
+            out = eval_program_np(schedule_repair_program(coeffs), rows, rs)
+            return bytes(out), crc32c(out.tobytes())
+        return await asyncio.to_thread(run)
+
+    async def _repair_reduced(self, layout: ECLayout, inode: int,
+                              stripe: int, s: int,
+                              plan: list[tuple[int, int]],
+                              stats: RepairIOStats
+                              ) -> tuple[bytes, int | None] | None:
+        """Execute one reduced-repair plan: fetch each helper as r sub-range
+        ReadIOs (existing offset/len wire fields — no new format), evaluate
+        the scheduled program per sub-shard through the batched codec, and
+        stitch the full-chunk CRC with crc32c_combine.  Returns None when
+        any helper read fails — the caller falls back to full-k decode."""
+        from t3fs.ops.codec import crc32c_combine
+        k, m, cs = layout.k, layout.m, layout.chunk_size
+        if not plan:
+            return bytes(cs), None             # all-holes group: zeros
+        r = subshard_r(cs)
+        sub = cs // r
+        ios = []
+        for slot, _c in plan:
+            for i in range(r):
+                ios.append(ReadIO(
+                    chunk_id=layout.shard_chunk(inode, stripe, slot),
+                    chain_id=layout.shard_chain(stripe, slot),
+                    offset=i * sub, length=sub))
+        try:
+            results, payloads = await self._fast.batch_read(ios)
+        except StatusError:
+            return None
+        h = len(plan)
+        bufs = np.zeros((r, h, sub), dtype=np.uint8)
+        for j, (res, p) in enumerate(zip(results, payloads)):
+            if res.status.code != int(StatusCode.OK):
+                return None                    # helper lost too: fall back
+            # the server clamps reads past the stored length to SHORT
+            # payloads (trimmed tails): zero-pad, absent == zeros
+            stats.bytes_read += len(p)
+            stats.sub_reads += 1
+            hi, i = divmod(j, r)
+            bufs[i, hi, : len(p)] = np.frombuffer(p, dtype=np.uint8)
+        coeffs = tuple(c for _slot, c in plan)
+        parts = await asyncio.gather(
+            *(self._repair_eval(bufs[i], coeffs, k, m) for i in range(r)))
+        content = b"".join(p for p, _crc in parts)
+        crc = parts[0][1]
+        for _p, sub_crc in parts[1:]:
+            crc = crc32c_combine(crc, sub_crc, sub)
+        return content, crc
+
     async def repair_chunk(self, layout: ECLayout, inode: int, stripe: int,
                            shard: int, stripe_len: int) -> IOResult:
         """Decode-reconstruct one lost shard and write it back to its chain
@@ -537,19 +795,26 @@ class ECStorageClient:
 
     async def repair_stripe(self, layout: ECLayout, inode: int, stripe: int,
                             shards: tuple[int, ...], stripe_len: int,
-                            read_shards: tuple[int, ...] | None = None
+                            read_shards: tuple[int, ...] | None = None,
+                            mode: str = "subshard",
+                            stats: RepairIOStats | None = None
                             ) -> list[IOResult]:
-        """Repair ALL of a stripe's lost shards in one pass: survivors are
-        read once and one decode produces every wanted shard (repairing a
-        double loss shard-by-shard would read the k survivors twice and
-        decode twice — the per-stripe batch halves recovery traffic, which
-        is the quantity the BIBD placement solver balances).
+        """Repair a stripe's lost shards (slot indices: base shards and,
+        with a local scheme, local parities).
 
-        `read_shards` (RepairDriver's balanced pick) restricts the FAST
-        survivor pass to those shard indices — decode needs only k, and
-        which k determines where the read load lands.  Shortfalls still
-        fall through to the unrestricted patient wave."""
+        mode="subshard" (default) tries the reduced-read path per shard
+        first — LRC group rebuild (group_size reads instead of k) or, lacking
+        a scheme, the scheduled single-row program — falling back per shard
+        to the joint full-k decode on any helper failure or multi-loss in a
+        group.  mode="full" is the classic path: survivors read once, one
+        decode produces every wanted shard.
+
+        `read_shards` (RepairDriver's balanced pick) orders the no-scheme
+        survivor choice and restricts the full-k FAST pass to those shard
+        indices; shortfalls still fall through to the unrestricted patient
+        wave.  `stats` accrues bytes_read / bytes_repaired / path counts."""
         k, cs = layout.k, layout.chunk_size
+        stats = stats if stats is not None else RepairIOStats()
         lens = [max(0, min(cs, stripe_len - j * cs)) for j in range(k)]
         zero_shards = frozenset(j for j in range(k) if lens[j] == 0)
         # zero-hole data shards are never materialized — absent == zeros is
@@ -557,21 +822,64 @@ class ECStorageClient:
         # one means ensuring absence, not REPLACE-writing an empty chunk
         holes = [s for s in shards if s in zero_shards]
         lost = tuple(s for s in shards if s not in zero_shards)
-        rec, crcs = (await self._reconstruct_shards(layout, inode, stripe,
-                                                    lost, zero_shards,
-                                                    prefer=read_shards)
-                     if lost else ([], []))
+        rebuilt: dict[int, tuple[bytes, int | None]] = {}
+        if mode == "subshard" and lost:
+            lost_set = frozenset(lost)
+
+            async def try_one(s: int) -> None:
+                plan = self._plan_reduced(layout, s, lost_set, zero_shards,
+                                          read_shards)
+                if plan is None:
+                    return
+                res = await self._repair_reduced(layout, inode, stripe, s,
+                                                 plan, stats)
+                if res is not None:
+                    rebuilt[s] = res
+                    stats.reduced_shards += 1
+
+            await asyncio.gather(*(try_one(s) for s in lost))
+        remaining = tuple(s for s in lost if s not in rebuilt)
+        if remaining:
+            stats.fallback_shards += len(remaining)
+            # local-parity slots can't ride the RS joint decode: rebuild
+            # their group members' XOR directly once the base decode ran
+            base_remaining = tuple(s for s in remaining if s < k + layout.m)
+            rec, crcs = (await self._reconstruct_shards(
+                layout, inode, stripe, base_remaining, zero_shards,
+                prefer=read_shards, stats=stats)
+                if base_remaining else ([], []))
+            for s, c, crc in zip(base_remaining, rec, crcs):
+                rebuilt[s] = (c, crc)
+            for s in remaining:
+                if s in rebuilt:
+                    continue
+                # lost local parity whose group ALSO lost a member: XOR the
+                # group back together from the decode output + survivors
+                members = layout.local_groups()[s - k - layout.m]
+                plan = [(x, 1) for x in members if x not in zero_shards]
+                known = {x: rebuilt[x][0] for x, _ in plan if x in rebuilt}
+                need = tuple(x for x, _ in plan if x not in known)
+                if need:
+                    more, _ = await self._reconstruct_shards(
+                        layout, inode, stripe, need, zero_shards,
+                        known=known, stats=stats)
+                    known.update(dict(zip(need, more)))
+                buf = np.zeros(cs, dtype=np.uint8)
+                for x, _ in plan:
+                    row = np.frombuffer(known[x], dtype=np.uint8)
+                    buf[: len(row)] ^= row
+                rebuilt[s] = (bytes(buf), None)
 
         async def write_back(shard: int, content: bytes,
                              crc: int | None) -> IOResult:
-            cid = (layout.data_chunk(inode, stripe, shard) if shard < k
-                   else layout.parity_chunk(inode, stripe, shard - k))
+            cid = layout.shard_chunk(inode, stripe, shard)
             if shard < k:
                 content = content[: lens[shard]]
             if len(content) != cs:
                 # truncated data shard: the device CRC covers the full
                 # chunk, not the tail-trimmed bytes — let the client re-CRC
                 crc = None
+            stats.bytes_repaired += len(content)
             return await self.sc.write_chunk(
                 layout.shard_chain(stripe, shard), cid, 0, bytes(content),
                 chunk_size=cs, update_type=UpdateType.REPLACE,
@@ -584,7 +892,7 @@ class ECStorageClient:
                 chunk_size=cs, update_type=UpdateType.REMOVE)
 
         done = dict(zip(lost, await asyncio.gather(
-            *(write_back(s, c, crc) for s, c, crc in zip(lost, rec, crcs)))))
+            *(write_back(s, *rebuilt[s]) for s in lost))))
         done.update(zip(holes, await asyncio.gather(
             *(remove_hole(s) for s in holes))))
         return [done[s] for s in shards]

@@ -73,8 +73,9 @@ from t3fs.utils.aio import reap_task
 class ECCodec:
     """Batched device codec for EC stripes with a per-shape jit cache.
 
-    kind keys: ("enc", k, m, L), ("rec", present, want, k, m, L) and
-    ("recv", present, want, k, m, L) — the fused decode+verify step;
+    kind keys: ("enc", k, m, L), ("rec", present, want, k, m, L),
+    ("recv", present, want, k, m, L) — the fused decode+verify step — and
+    ("rep", coeffs, k, m, L) — the scheduled single-row repair program;
     requests under one key stack into a single kernel call.
     """
 
@@ -134,6 +135,23 @@ class ECCodec:
         L = present_rows.shape[-1]
         return await self._submit(("recv", present, want, k, m, L),
                                   present_rows)
+
+    async def repair(self, helper_rows: np.ndarray, coeffs: tuple[int, ...],
+                     k: int = 8, m: int = 2
+                     ) -> tuple[np.ndarray, np.ndarray]:
+        """(h, L) uint8 helper rows -> (rebuilt (L,) uint8, crc uint32).
+
+        Evaluates one scheduled GF(2^8) repair program (coeffs[i] is helper
+        i's coefficient; see ops/repair_program.py) — the reduced-read
+        single-erasure path: helpers are whatever slices the read path
+        fetched (sub-chunk ranges of survivors, or an LRC local group), NOT
+        necessarily k full shards.  The returned CRC32C of the rebuilt
+        bytes feeds crc32c_combine on the write-back path.  Requests with
+        the same (coeffs, L) micro-batch into one launch, which is exactly
+        the drill shape: many sub-shards of one lost chunk, one program."""
+        L = helper_rows.shape[-1]
+        key = ("rep", tuple(int(c) for c in coeffs), k, m, L)
+        return await self._submit(key, helper_rows)
 
     async def close(self) -> None:
         self._closed = True
@@ -249,6 +267,8 @@ class ECCodec:
             fn = self._build_encode_verified(key)
         elif key[0] == "recv":
             fn = self._build_reconstruct_verified(key)
+        elif key[0] == "rep":
+            fn = self._build_repair(key)
         else:
             fn = self._build_reconstruct(key)
         self._fns[key] = fn
@@ -435,6 +455,72 @@ class ECCodec:
             return np.asarray(rebuilt), np.asarray(crcs)
         return decode_xla
 
+    def _build_repair(self, key: tuple) -> Callable:
+        """Scheduled single-row repair + CRC of the rebuilt bytes.  Pallas
+        word kernel on 512-multiple lengths (the fused repair step);
+        otherwise the SAME schedule as a plain-jnp word program — identical
+        op structure, so CPU fabrics and odd tail lengths share one code
+        path with the device kernel."""
+        _kind, coeffs, k, m, L = key
+        import jax
+
+        from t3fs.ops.repair_program import schedule_repair_program
+        from t3fs.ops.rs import default_rs
+
+        rs = default_rs(k, m)
+        prog = schedule_repair_program(coeffs)
+        h = prog.num_helpers
+        if self._use_pallas and L % 512 == 0:
+            from t3fs.ops.pallas_codec import make_repair_step_words
+            step = jax.jit(make_repair_step_words(
+                L // 4, prog, interpret=self._interpret))
+
+            def repair_words(stacked: np.ndarray
+                             ) -> tuple[np.ndarray, np.ndarray]:
+                self._count("pallas-repair-words")
+                words = stacked.view(np.uint32).reshape(
+                    stacked.shape[0], h, L // 4)
+                rebuilt, crcs = step(words)
+                rebuilt = np.asarray(rebuilt).view(np.uint8).reshape(
+                    stacked.shape[0], L)
+                return rebuilt, np.asarray(crcs)
+            return repair_words
+
+        from t3fs.ops.jax_codec import crc32c_batch_jit
+        from t3fs.ops.pallas_codec import _xtimes_u32
+
+        low = rs.gf.poly & 0xFF
+        shifts = tuple(b for b in range(8) if (low >> b) & 1)
+        planes = prog.planes
+        top = len(planes) - 1
+        pad = (-L) % 4
+        Wp = (L + pad) // 4
+
+        @jax.jit
+        def run(words):                          # (n, h, Wp) -> (n, Wp)
+            acc = None
+            for i in planes[top]:
+                acc = words[:, i] if acc is None else acc ^ words[:, i]
+            for b in range(top - 1, -1, -1):
+                acc = _xtimes_u32(acc, shifts)
+                for i in planes[b]:
+                    acc = acc ^ words[:, i]
+            return acc
+
+        crcf = crc32c_batch_jit(L)
+
+        def repair_xla(stacked: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+            self._count("xla-repair-words")
+            n = stacked.shape[0]
+            rows = (np.pad(stacked, ((0, 0), (0, 0), (0, pad))) if pad
+                    else stacked)
+            words = np.ascontiguousarray(rows).view(np.uint32).reshape(
+                n, h, Wp)
+            out = np.asarray(run(words)).view(np.uint8).reshape(n, -1)[:, :L]
+            out = np.ascontiguousarray(out)
+            return out, np.asarray(crcf(out))
+        return repair_xla
+
     # --- decode warmup (DeviceChecksumBackend.warmup analog) ---
 
     def warmup_decode(self, patterns: list[tuple[tuple[int, ...],
@@ -469,6 +555,46 @@ class ECCodec:
         futs = []
         for present, want in patterns:
             key = ("recv", tuple(present), tuple(want), k, m, L)
+            for nb in batch_sizes:
+                if self._closed:
+                    return
+                try:
+                    futs.append(self._pool.submit(one, key, nb))
+                except RuntimeError:   # pool already shut down
+                    return
+        for f in futs:
+            try:
+                f.result()
+            except CancelledError:
+                return
+
+    def warmup_repair(self, coeff_rows: list[tuple[int, ...]], L: int,
+                      k: int = 8, m: int = 2,
+                      batch_sizes: tuple[int, ...] = (1,)) -> None:
+        """Precompile the hot repair programs off-path — the repair twin of
+        warmup_decode, called from RepairDriver setup so the FIRST drill
+        iteration doesn't eat a Mosaic compile mid-rebuild.  coeff_rows are
+        the per-program coefficient tuples (e.g. the all-ones local-group
+        programs plus the decode rows the scrub plan will actually run)."""
+        from concurrent.futures import CancelledError
+
+        from t3fs.storage.codec_backend import _enable_persistent_cache
+
+        _enable_persistent_cache()
+
+        def one(key: tuple, nb: int) -> None:
+            if self._closed:
+                return
+            try:
+                arr = np.zeros((nb, len(key[1]), key[4]), dtype=np.uint8)
+                self._fn(key)(arr)
+            except Exception:
+                log.exception("EC repair warmup compile failed "
+                              "(key=%s, n=%d)", key, nb)
+
+        futs = []
+        for coeffs in coeff_rows:
+            key = ("rep", tuple(int(c) for c in coeffs), k, m, L)
             for nb in batch_sizes:
                 if self._closed:
                     return

@@ -26,10 +26,57 @@ import logging
 from collections import defaultdict
 from dataclasses import dataclass, field
 
-from t3fs.client.ec_client import ECLayout, ECStorageClient
+from t3fs.client.ec_client import ECLayout, ECStorageClient, RepairIOStats
 from t3fs.utils.status import StatusCode
 
 log = logging.getLogger("t3fs.repair")
+
+
+class TokenBucketPacer:
+    """Byte-rate token bucket for repair pacing (the _HedgeBudget shape, in
+    bytes/s): acquire(nbytes) WAITS until the budget earns enough tokens —
+    exhaustion is backpressure, never an error, so rebuild under a tight
+    `storage.repair_budget_mbps` slows down instead of failing stripes.
+
+    `burst_bytes` caps the idle accumulation (default one second of rate);
+    `floor_bytes` is the minimum grant capacity, so a single request larger
+    than the burst (one big stripe) clamps to the capacity and proceeds
+    after draining it rather than deadlocking on tokens that can never
+    accrue.  rate_mbps <= 0 disables pacing entirely."""
+
+    def __init__(self, rate_mbps: float, burst_bytes: int | None = None,
+                 floor_bytes: int = 1 << 20):
+        self.rate = rate_mbps * 1e6                    # bytes per second
+        self.capacity = max(int(burst_bytes if burst_bytes is not None
+                                else self.rate), floor_bytes)
+        self.tokens = float(self.capacity)
+        self._last: float | None = None
+        self._lock = asyncio.Lock()
+        self.waits = 0
+        self.waited_s = 0.0
+
+    def _refill(self) -> None:
+        import time
+        now = time.monotonic()
+        if self._last is not None:
+            self.tokens = min(float(self.capacity),
+                              self.tokens + (now - self._last) * self.rate)
+        self._last = now
+
+    async def acquire(self, nbytes: int) -> None:
+        if self.rate <= 0:
+            return
+        take = float(min(nbytes, self.capacity))
+        # serialized: FIFO fairness, and one sleeper computes exact deficit
+        async with self._lock:
+            self._refill()
+            if self.tokens < take:
+                wait = (take - self.tokens) / self.rate
+                self.waits += 1
+                self.waited_s += wait
+                await asyncio.sleep(wait)
+                self._refill()
+            self.tokens -= take       # may dip below 0 on clock skew: debt
 
 
 @dataclass
@@ -48,21 +95,53 @@ class RepairReport:
     failed: list[tuple[int, int]] = field(default_factory=list)  # (inode, stripe)
     max_chain_reads: int = 0
     min_chain_reads: int = 0
+    # IO accounting (ISSUE 9): what rebuilding cost the fabric.  The drill
+    # metric is bytes_read / bytes_repaired — full-k repair pays ~k, the
+    # reduced-read path ~group_size.
+    bytes_read: int = 0
+    bytes_repaired: int = 0
+    stripes_failed: int = 0
+    reduced_shards: int = 0
+    fallback_shards: int = 0
+    sub_reads: int = 0
+    paced_waits: int = 0
+    paced_wait_s: float = 0.0
 
 
 class RepairDriver:
     """Schedules `ECStorageClient.repair_stripe` calls across many files,
-    survivor-read-balanced."""
+    survivor-read-balanced; optionally paced by a byte-rate token bucket
+    and routed down the reduced-read sub-shard path."""
 
     def __init__(self, ec: ECStorageClient, concurrency: int = 8,
-                 initial_load: dict[int, int] | None = None):
+                 initial_load: dict[int, int] | None = None,
+                 repair_mode: str = "subshard",
+                 budget_mbps: float = 0.0,
+                 budget_burst_bytes: int | None = None):
+        assert repair_mode in ("subshard", "full"), repair_mode
         self.ec = ec
         self.concurrency = concurrency
+        self.repair_mode = repair_mode
+        self.pacer = (TokenBucketPacer(budget_mbps, budget_burst_bytes)
+                      if budget_mbps > 0 else None)
         # exact placement weights (mgmtd.placement.chain_recovery_weights):
         # chains the failure already loaded (resync sources, degraded-read
         # targets) start with their standing weight, so the survivor picks
         # steer around them instead of discovering the hotspot online
         self.initial_load = dict(initial_load or {})
+        self._warmed: set[tuple] = set()
+
+    async def warmup(self, layouts: list[ECLayout]) -> None:
+        """Precompile each distinct layout's repair programs (off the event
+        loop — compiles run on the codec thread) so the first repaired
+        stripe doesn't eat the jit stall; run() calls this itself."""
+        for lay in layouts:
+            key = (lay.k, lay.m, lay.chunk_size, lay.code_id,
+                   lay.local_scheme, lay.local_group_size)
+            if key in self._warmed:
+                continue
+            self._warmed.add(key)
+            await asyncio.to_thread(self.ec.warmup_repair, lay)
 
     def plan(self, jobs: list[RepairJob]
              ) -> tuple[list[tuple["RepairJob", int, tuple[int, ...]]],
@@ -124,8 +203,25 @@ class RepairDriver:
                             tuple(shard for shard, _c in chosen)))
         return ordered, unrepairable
 
+    def _estimate_read_bytes(self, lay: ECLayout,
+                             lost: tuple[int, ...]) -> int:
+        """Pacing charge for one stripe: what its survivor reads should
+        cost.  The bucket meters intent, so the estimate errs high (holes
+        and short tails read fewer bytes than charged) — pacing must bound
+        fabric load, not track it exactly."""
+        cs = lay.chunk_size
+        if self.repair_mode == "subshard" and lay.local_scheme:
+            groups = lay.local_groups()
+            base = lay.k + lay.m
+            return sum(
+                len(groups[s - base if s >= base else lay.group_of(s)]) * cs
+                for s in lost)
+        return lay.k * cs
+
     async def run(self, jobs: list[RepairJob]) -> RepairReport:
+        await self.warmup([j.layout for j in jobs])
         ordered, unrepairable = self.plan(jobs)
+        stats = RepairIOStats()
         report = RepairReport()
         report.failed.extend(unrepairable)
         for inode, stripe in unrepairable:
@@ -149,12 +245,16 @@ class RepairDriver:
                       read_shards: tuple[int, ...]) -> None:
             lost = job.losses[stripe]
             async with sem:
+                if self.pacer is not None:
+                    await self.pacer.acquire(
+                        self._estimate_read_bytes(job.layout, lost))
                 try:
                     results = await self.ec.repair_stripe(
                         job.layout, job.inode, stripe, lost,
                         stripe_len=job.stripe_len_of.get(
                             stripe, job.layout.k * job.layout.chunk_size),
-                        read_shards=read_shards)
+                        read_shards=read_shards, mode=self.repair_mode,
+                        stats=stats)
                 except Exception as e:
                     log.warning("repair inode %d stripe %d failed: %s",
                                 job.inode, stripe, e)
@@ -173,4 +273,13 @@ class RepairDriver:
         if chain_reads:
             report.max_chain_reads = max(chain_reads.values())
             report.min_chain_reads = min(chain_reads.values())
+        report.bytes_read = stats.bytes_read
+        report.bytes_repaired = stats.bytes_repaired
+        report.reduced_shards = stats.reduced_shards
+        report.fallback_shards = stats.fallback_shards
+        report.sub_reads = stats.sub_reads
+        report.stripes_failed = len(report.failed)
+        if self.pacer is not None:
+            report.paced_waits = self.pacer.waits
+            report.paced_wait_s = self.pacer.waited_s
         return report

@@ -162,6 +162,9 @@ class MgmtdState:
         # which node last reported each target (live info from heartbeats;
         # feeds listOrphanTargets — not persisted, best-effort by design)
         self.target_reporter: dict[int, int] = {}
+        # latest scrub/repair health per reporting source (pushed by
+        # report_repair_status; in-memory like last_heartbeat)
+        self.repair_statuses: dict[str, "RepairStatus"] = {}
         # startup grace: a restarted mgmtd has an empty liveness map — treat
         # every node as alive until one full heartbeat window has passed, or
         # the first updater tick would demote the whole healthy cluster
@@ -671,6 +674,62 @@ class ListOrphanTargetsRsp:
     targets: list[OrphanTarget] = field(default_factory=list)
 
 
+@serde_struct
+@dataclass
+class RepairStatus:
+    """One scrub scheduler's health report (`admin repair-status` row).
+
+    Scrub runs cluster-side (storage/scrub_scheduler.py), so its health
+    reaches mgmtd by PUSH: the scheduler's owner posts status() after
+    each tick via report_repair_status; mgmtd keeps the latest row per
+    source in memory (liveness-style — re-learned after a restart, same
+    contract as last_heartbeat).  Append-only for serde compat."""
+    source: str = ""
+    ts: float = 0.0                 # mgmtd receive time (server-stamped)
+    repair_mode: str = ""
+    budget_mbps: float = 0.0
+    targets: int = 0
+    ticks: int = 0
+    stripes_scanned: int = 0
+    shards_probed: int = 0
+    shards_lost: int = 0
+    shards_corrupt: int = 0
+    flagged_enqueued: int = 0
+    flagged_unresolved: int = 0
+    flagged_pending: int = 0
+    repaired_stripes: int = 0
+    repaired_shards: int = 0
+    stripes_failed: int = 0
+    bytes_read: int = 0
+    bytes_repaired: int = 0
+    reduced_shards: int = 0
+    fallback_shards: int = 0
+    paced_waits: int = 0
+    paced_wait_s: float = 0.0
+
+    @classmethod
+    def from_status(cls, source: str, status: dict) -> "RepairStatus":
+        """Build a row from ScrubScheduler.status(); unknown keys are
+        dropped so scheduler and mgmtd can rev independently."""
+        row = cls(source=source)
+        for k, v in status.items():
+            if k not in ("source", "ts") and hasattr(row, k):
+                setattr(row, k, v)
+        return row
+
+
+@serde_struct
+@dataclass
+class ReportRepairStatusReq:
+    status: RepairStatus = field(default_factory=RepairStatus)
+
+
+@serde_struct
+@dataclass
+class RepairStatusRsp:
+    rows: list[RepairStatus] = field(default_factory=list)
+
+
 @service("Mgmtd")
 class MgmtdService:
     """RPC surface (fbs/mgmtd/MgmtdServiceDef.h:3-26 subset)."""
@@ -768,6 +827,26 @@ class MgmtdService:
         """Who is primary (MgmtdLeaseInfo analog)."""
         lease = await self.state.lease_info()
         return lease, b""
+
+    @rpc_method
+    async def report_repair_status(self, req: ReportRepairStatusReq,
+                                   payload, conn):
+        """Scrub scheduler health push (ISSUE 9): keep the latest row
+        per source; ts is server-stamped so skewed client clocks can't
+        make a live scrubber look stale."""
+        await self._require_primary()
+        row = req.status
+        row.source = row.source or "scrub"
+        row.ts = time.time()
+        self.state.repair_statuses[row.source] = row
+        return OkRsp(), b""
+
+    @rpc_method
+    async def repair_status(self, req, payload, conn):
+        """Admin op: latest scrub/repair health rows, one per source."""
+        rows = sorted(self.state.repair_statuses.values(),
+                      key=lambda r: r.source)
+        return RepairStatusRsp(rows=rows), b""
 
     # ---- chain surgery (admin ops) ----
 

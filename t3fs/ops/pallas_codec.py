@@ -571,6 +571,92 @@ def make_rs_reconstruct_words_pallas(present: tuple[int, ...],
     return reconstruct
 
 
+# --- word-packed sub-shard repair (reduced-read single-erasure path) --------
+#
+# Single-shard repair is ONE decode-matrix row evaluated over whatever helper
+# set the read path fetched (k survivors, or just an LRC local group), and the
+# read path hands us SUB-chunk slices (chunk_size/r bytes per helper), so the
+# kernel is "many small rows" rather than "few big stripes".  The coefficient
+# row is pre-scheduled host-side by repair_program.schedule_repair_program
+# into bit planes + one Horner ladder (<= 7 xtimes TOTAL vs a private ladder
+# per helper) and baked into the kernel, exactly like the reconstruct kernel
+# bakes its constant chain.  All-ones programs (P-row / LRC-local repair)
+# compile to a pure XOR fold — the XOR-scheduled fast path.
+
+
+def _repair_words_kernel(x_ref, out_ref, *,
+                         planes: tuple[tuple[int, ...], ...],
+                         shifts: tuple[int, ...]):
+    x = x_ref[0]                                         # (h, R, C) uint32
+    top = len(planes) - 1
+    acc = None
+    for i in planes[top]:                                # top plane is nonempty
+        acc = x[i] if acc is None else acc ^ x[i]
+    for b in range(top - 1, -1, -1):
+        acc = _xtimes_u32(acc, shifts)
+        for i in planes[b]:
+            acc = acc ^ x[i]
+    out_ref[0] = acc
+
+
+def make_repair_subshard_words(program, rs: RSCode | None = None,
+                               block_w: int = 16384,
+                               interpret: bool = False):
+    """(n, h, W) uint32 helper sub-shard words -> (n, W) uint32 rebuilt words.
+
+    `program` is a repair_program.RepairProgram over h helpers; words are the
+    little-endian uint32 view of the helper byte slices (same packing contract
+    as the encode/reconstruct word kernels).  Each grid cell evaluates the
+    scheduled Horner-over-bit-planes program on full (8, 128)-lane vregs."""
+    rs = rs or default_rs()
+    low = rs.gf.poly & 0xFF
+    shifts = tuple(b for b in range(8) if (low >> b) & 1)
+    h = program.num_helpers
+    planes = program.planes
+
+    def repair(words: jax.Array) -> jax.Array:
+        n, hh, W = words.shape
+        assert hh == h, (words.shape, h)
+        bw = min(block_w, W)
+        assert W % bw == 0, (W, bw)
+        COLS = 2048 if bw % 2048 == 0 else bw
+        rows = bw // COLS
+        v = words.reshape(n, h, W // COLS, COLS)
+        out = pl.pallas_call(
+            functools.partial(_repair_words_kernel,
+                              planes=planes, shifts=shifts),
+            out_shape=jax.ShapeDtypeStruct((n, W // COLS, COLS), jnp.uint32),
+            grid=(n, W // bw),
+            in_specs=[pl.BlockSpec((1, h, rows, COLS),
+                                   lambda i, j: (i, 0, j, 0))],
+            out_specs=pl.BlockSpec((1, rows, COLS),
+                                   lambda i, j: (i, j, 0)),
+            interpret=interpret,
+        )(v)
+        return out.reshape(n, W)
+
+    return repair
+
+
+def make_repair_step_words(sub_words: int, program,
+                           interpret: bool = False):
+    """Fused sub-shard repair + CRC: (n, h, sub_words) uint32 helper words ->
+    rebuilt (n, sub_words) uint32, crcs (n,) uint32 (CRC32C of each rebuilt
+    sub-shard).  The client stitches the r per-sub-shard CRCs into the
+    full-chunk write-back checksum with crc32c_combine, so repair pays no
+    host CRC pass.  sub_words must be a multiple of 128 (512-byte segments)."""
+    from t3fs.ops.blocks import pick_block
+    rep = make_repair_subshard_words(
+        program, block_w=pick_block(sub_words, 131072), interpret=interpret)
+    crc = make_crc32c_words(sub_words, block_r=2048, interpret=interpret)
+
+    def step(words: jax.Array):
+        rebuilt = rep(words)
+        return rebuilt, crc(rebuilt)
+
+    return step
+
+
 def make_stripe_decode_step_words(chunk_words: int, present: tuple[int, ...],
                                   want: tuple[int, ...], k: int = 8,
                                   m: int = 2, interpret: bool = False):

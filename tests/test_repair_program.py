@@ -1,0 +1,192 @@
+"""Repair programs + reduced-read repair kernels (ISSUE 9 tentpole).
+
+The pin chain has three links, so a failure isolates the broken layer:
+  schedule  — eval_program_np vs a direct gf.mul row application
+              (scheduling bugs);
+  kernel    — make_repair_subshard_words vs eval_program_np under the
+              interpreter (word-packing bugs);
+  codec     — ECCodec.repair vs the reconstruct oracle, byte-identical
+              for ALL k+m single-erasure masks at two chunk lengths, on
+              both the fused-Pallas and XLA-fallback dispatch paths.
+"""
+
+import asyncio
+import os
+
+import numpy as np
+import pytest
+
+INTERPRET = not bool(os.environ.get("T3FS_ON_DEVICE"))
+
+from t3fs.ops.crc32c import crc32c_ref
+from t3fs.ops.repair_program import (
+    eval_program_np, schedule_repair_program, single_row_program,
+    xor_program)
+from t3fs.ops.rs import default_rs
+
+rng = np.random.default_rng(13)
+
+
+@pytest.fixture
+def interpret_env(monkeypatch):
+    monkeypatch.setenv("T3FS_FORCE_PALLAS_INTERPRET", "1")
+
+
+def _oracle_row(rs, coeffs, helpers):
+    """Direct GF row application: sum_i c_i * helper_i via gf.mul."""
+    out = np.zeros(helpers.shape[1], dtype=np.uint8)
+    for c, row in zip(coeffs, helpers):
+        out ^= np.array([rs.gf.mul(int(c), int(b)) for b in row],
+                        dtype=np.uint8)
+    return out
+
+
+def test_schedule_shapes_and_op_counts():
+    """All-ones rows collapse to pure XOR; the Q-row Horner schedule
+    caps xtimes at the top bit (<= 7) regardless of helper count."""
+    p = xor_program(9)
+    assert p.is_xor and p.xtimes_ops == 0 and p.xor_ops == 8
+
+    rs = default_rs(8, 2)
+    q = single_row_program(rs, list(range(8)), 9)      # rebuild Q from data
+    assert not q.is_xor
+    assert q.xtimes_ops <= 7 < q.naive_xtimes_ops
+    # rebuilding a DATA shard with sorted-survivors-first-k always holds P
+    # (slot 8), so the row is all-ones — the pure-XOR fast path
+    for lost in range(9):
+        present = [s for s in range(10) if s != lost][:8]
+        assert single_row_program(rs, present, lost).is_xor, lost
+
+    with pytest.raises(ValueError):
+        schedule_repair_program([3, 0, 5])              # zero coeff = bug
+    with pytest.raises(ValueError):
+        schedule_repair_program([])
+
+
+def test_program_matches_gf_oracle_all_masks():
+    """eval_program_np == direct gf.mul row for every single-erasure row
+    (data AND parity) at two lengths — the scheduling layer is exact."""
+    rs = default_rs(8, 2)
+    for L in (256, 300):
+        helpers = rng.integers(0, 256, (8, L), dtype=np.uint8)
+        for lost in range(10):
+            present = [s for s in range(10) if s != lost][:8]
+            prog = single_row_program(rs, present, lost)
+            got = eval_program_np(prog, helpers[:prog.num_helpers], rs)
+            want = _oracle_row(rs, prog.coeffs,
+                               helpers[:prog.num_helpers])
+            assert np.array_equal(got, want), (L, lost)
+
+
+def test_repair_subshard_kernel_matches_reference():
+    """The word-packed kernel == eval_program_np, for a pure-XOR row and
+    a multi-plane Horner row, batched."""
+    import jax.numpy as jnp
+
+    from t3fs.ops.pallas_codec import make_repair_subshard_words
+
+    rs = default_rs(8, 2)
+    L = 2048
+    for prog in (xor_program(5),
+                 single_row_program(rs, list(range(8)), 9)):
+        h = prog.num_helpers
+        helpers = rng.integers(0, 256, (3, h, L), dtype=np.uint8)
+        words = helpers.reshape(3, h, L // 4, 4).view(np.uint32) \
+                       .reshape(3, h, L // 4)
+        fn = make_repair_subshard_words(prog, rs, interpret=INTERPRET)
+        got = np.asarray(fn(jnp.asarray(words))) \
+                .view(np.uint8).reshape(3, L)
+        for i in range(3):
+            want = eval_program_np(prog, helpers[i], rs)
+            assert np.array_equal(got[i], want), (prog.is_xor, i)
+
+
+def test_repair_step_fuses_crc(interpret_env):
+    """Fused rebuild+CRC launch: rebuilt bytes match the reference and
+    the device CRC matches crc32c_ref of those bytes."""
+    import jax.numpy as jnp
+
+    from t3fs.ops.pallas_codec import make_repair_step_words
+
+    rs = default_rs(8, 2)
+    L = 1024
+    prog = single_row_program(rs, [0, 2, 3, 4, 5, 6, 7, 8], 1)
+    h = prog.num_helpers
+    helpers = rng.integers(0, 256, (2, h, L), dtype=np.uint8)
+    words = helpers.reshape(2, h, L // 4, 4).view(np.uint32) \
+                   .reshape(2, h, L // 4)
+    fn = make_repair_step_words(L // 4, prog, interpret=True)
+    rebuilt_w, crcs = fn(jnp.asarray(words))
+    rebuilt = np.asarray(rebuilt_w).view(np.uint8).reshape(2, L)
+    for i in range(2):
+        want = eval_program_np(prog, helpers[i], rs)
+        assert np.array_equal(rebuilt[i], want), i
+        assert int(crcs[i]) == crc32c_ref(want), i
+
+
+def _codec_repair_all_masks(L: int, expect_count_key: str):
+    """ECCodec.repair == reconstruct oracle for all k+m=10 single-erasure
+    masks, byte-identical with a correct CRC, on the expected dispatch."""
+    from t3fs.client.ec_codec import ECCodec
+
+    k, m = 8, 2
+    rs = default_rs(k, m)
+    data = rng.integers(0, 256, (k, L), dtype=np.uint8)
+    full = np.concatenate([data, rs.encode_ref(data)], axis=0)
+
+    async def body():
+        codec = ECCodec()
+        try:
+            for lost in range(k + m):
+                present = [s for s in range(k + m) if s != lost][:k]
+                prog = single_row_program(rs, present, lost)
+                # helpers in `present` order, zero-coeff rows dropped the
+                # way the read path drops them
+                coeffs, rows = [], []
+                row = rs.reconstruct_gfmatrix(present, [lost])[0]
+                for c, s in zip(row, present):
+                    if int(c):
+                        coeffs.append(int(c))
+                        rows.append(full[s])
+                rebuilt, crc = await codec.repair(
+                    np.stack(rows), tuple(coeffs), k, m)
+                assert np.array_equal(rebuilt, full[lost]), (L, lost)
+                assert int(crc) == crc32c_ref(full[lost]), (L, lost)
+                assert prog.num_helpers == len(coeffs)
+            assert codec.codec_counts.get(expect_count_key), \
+                dict(codec.codec_counts)
+        finally:
+            await codec.close()
+
+    asyncio.run(body())
+
+
+def test_ec_codec_repair_all_masks_pallas_words(interpret_env):
+    """L % 512 == 0 routes the fused Pallas repair+CRC launch."""
+    _codec_repair_all_masks(1024, "pallas-repair-words")
+
+
+def test_ec_codec_repair_all_masks_xla_fallback(interpret_env):
+    """Odd L falls back to the jitted XLA word program — same bytes."""
+    _codec_repair_all_masks(1000, "xla-repair-words")
+
+
+def test_warmup_repair_precompiles(interpret_env):
+    """warmup_repair compiles the hot (coeffs, batch) keys up front so
+    the first drill stripe never eats the compile stall."""
+    from t3fs.client.ec_codec import ECCodec
+
+    async def body():
+        codec = ECCodec()
+        try:
+            rows = [(1, 1, 1), (1, 2, 4, 8, 16, 32, 64, 141)]
+            codec.warmup_repair(rows, 1024, 8, 2, batch_sizes=(1, 2))
+            for coeffs in rows:
+                assert ("rep", coeffs, 8, 2, 1024) in codec._fns
+            compiled = sum(v for key, v in codec.codec_counts.items()
+                           if "repair" in key)
+            assert compiled >= len(rows), dict(codec.codec_counts)
+        finally:
+            await codec.close()
+
+    asyncio.run(body())

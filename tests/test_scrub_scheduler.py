@@ -1,0 +1,237 @@
+"""Paced cluster scrub (ISSUE 9 tentpole part 3): token-bucket pacing,
+scrub detect/remove/repair, CheckWorker corrupt_sink wiring, mgmtd
+health surfacing, and the slow-marked repair drill smoke."""
+
+import asyncio
+import os
+import time
+
+import numpy as np
+import pytest
+
+from t3fs.client.ec_client import ECLayout, ECStorageClient
+from t3fs.client.repair import TokenBucketPacer
+from t3fs.storage.scrub_scheduler import ScrubScheduler
+from t3fs.storage.types import ChunkId, RemoveChunksReq
+from t3fs.testing.cluster import LocalCluster
+from t3fs.utils.status import StatusCode
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+# ---------------------------------------------------------------- pacer
+
+def test_token_bucket_exhaustion_waits_never_errors():
+    """Draining the bucket makes acquire WAIT (counted), never raise;
+    a request bigger than capacity clamps instead of deadlocking."""
+    async def body():
+        pacer = TokenBucketPacer(rate_mbps=1.0, burst_bytes=100_000,
+                                 floor_bytes=1)
+        await pacer.acquire(100_000)            # drains the whole burst
+        t0 = time.monotonic()
+        await pacer.acquire(50_000)             # must wait ~0.05 s
+        waited = time.monotonic() - t0
+        assert pacer.waits == 1
+        assert waited >= 0.03, waited
+        # single request far above capacity: clamps to capacity, proceeds
+        await pacer.acquire(10**9)
+        assert pacer.waits == 2
+
+    run(body())
+
+
+def test_token_bucket_disabled_and_floor():
+    async def body():
+        off = TokenBucketPacer(rate_mbps=0.0)
+        await off.acquire(10**12)               # no-op, instant
+        assert off.waits == 0
+        # floor keeps a tiny-rate bucket grantable
+        tiny = TokenBucketPacer(rate_mbps=0.001, floor_bytes=1 << 20)
+        assert tiny.capacity >= 1 << 20
+
+    run(body())
+
+
+# -------------------------------------------------- resolve / note_corrupt
+
+def _layout(chains=8):
+    return ECLayout.create(k=4, m=2, chunk_size=2048,
+                           chains=list(range(1, chains + 1)),
+                           local_scheme="lrc-xor", local_group_size=3)
+
+
+def test_resolve_chunk_inverts_layout_naming():
+    """ChunkId -> (target, stripe, slot) for data, RS parity, and local
+    parity namespaces; unknown inodes resolve to None (counted drop)."""
+    lay = _layout()
+    sched = ScrubScheduler.__new__(ScrubScheduler)   # registry-only use
+    sched._targets = {}
+    sched._cursor = {}
+    from t3fs.storage.scrub_scheduler import ScrubStats
+    sched.stats = ScrubStats()
+    sched._flagged = set()
+    sched.add_target("f", lay, 77, {0: 8192, 3: 8192})
+    for stripe in (0, 3):
+        for slot in range(lay.slots):
+            cid = lay.shard_chunk(77, stripe, slot)
+            hit = sched.resolve_chunk(cid)
+            assert hit is not None, (stripe, slot)
+            t, got_stripe, got_slot = hit
+            assert (t.name, got_stripe, got_slot) == ("f", stripe, slot)
+    assert sched.resolve_chunk(ChunkId(999, 0)) is None
+    assert sched.note_corrupt(lay.shard_chunk(77, 3, 1))
+    assert ("f", 3) in sched._flagged
+    assert not sched.note_corrupt(ChunkId(999, 0))
+    assert sched.stats.flagged_unresolved == 1
+
+
+# ------------------------------------------------------- cluster e2e
+
+def test_scrub_detects_repairs_and_restart_is_idempotent():
+    """Lost shards (node-side removes) + a disk-corrupted shard flagged
+    through CheckWorker's corrupt_sink: one scan tick repairs everything
+    on the reduced path; a FRESH scheduler (crash/restart) rescans from
+    zero and finds nothing to repair; mgmtd round-trips the health row."""
+    async def body():
+        cluster = LocalCluster(num_nodes=4, replicas=1, num_chains=8)
+        await cluster.start()
+        try:
+            lay = _layout()
+            ec = ECStorageClient(cluster.sc)
+            data = {}
+            for s in range(6):
+                payload = bytes([65 + s]) * (4 * 2048 - s * 700)
+                data[s] = payload
+                res = await ec.write_stripe(lay, 77, s, payload)
+                assert all(r.status.code == int(StatusCode.OK)
+                           for r in res), s
+            stripe_lens = {s: len(data[s]) for s in range(6)}
+            routing = cluster.mgmtd.state.routing()
+
+            # lose slot 2 of every stripe (chain 3; slots == chains here
+            # so placement doesn't rotate)
+            for s in range(6):
+                cid = lay.shard_chunk(77, s, 2)
+                chain_id = lay.shard_chain(s, 2)
+                head = routing.chains[chain_id].head()
+                await cluster.admin.call(
+                    routing.node_address(head.node_id),
+                    "Storage.remove_chunks",
+                    RemoveChunksReq(chain_id=chain_id, inode=cid.inode,
+                                    begin_index=cid.index,
+                                    end_index=cid.index + 1))
+
+            # bit-rot stripe 1 slot 5 ON DISK (bypasses the CRC update)
+            cor_cid = lay.shard_chunk(77, 1, 5)
+            head = routing.chains[lay.shard_chain(1, 5)].head()
+            target = cluster.storage[head.node_id].node.targets[
+                head.target_id]
+            fd, off, _n, _gen = target.engine.locate(cor_cid, 0, 2048)
+            os.pwrite(fd, b"\xde\xad\xbe\xef" * 16, off)
+
+            sched = ScrubScheduler(ec, repair_mode="subshard",
+                                   budget_mbps=50.0)
+            sched.add_target("file77", lay, 77, stripe_lens)
+
+            # CheckWorker local verify -> corrupt_sink -> flagged stripe
+            cw = cluster.storage[head.node_id].check
+            cw.corrupt_sink = sched.note_corrupt
+            cw.verify_chunks_per_tick = 10_000
+            await cw.check_once()
+            assert cw.corrupt_found == 1, cw.corrupt_found
+            assert cw.chunks_verified > 0
+            assert ("file77", 1) in sched._flagged
+
+            report = await sched.scan_once()
+            assert sched.stats.shards_lost == 6, sched.stats
+            assert sched.stats.shards_corrupt == 1, sched.stats
+            assert report.repaired_shards == 7, report
+            assert report.stripes_failed == 0
+            assert report.reduced_shards == 7, report
+            for s in range(6):
+                got = await ec.read_stripe(lay, 77, s, len(data[s]))
+                assert got == data[s], s
+
+            # crash/restart: a NEW scheduler with no cursor state scans
+            # the whole file and repairs nothing (idempotence)
+            sched2 = ScrubScheduler(ec)
+            sched2.add_target("file77", lay, 77, stripe_lens)
+            rep2 = await sched2.scan_once()
+            assert sched2.stats.stripes_scanned == 6
+            assert sched2.stats.shards_lost == 0
+            assert sched2.stats.shards_corrupt == 0
+            assert rep2.repaired_shards == 0
+
+            # health surfacing: push the row to mgmtd, read it back the
+            # way `admin repair-status` does
+            from t3fs.mgmtd.service import (
+                RepairStatus, ReportRepairStatusReq)
+            await cluster.admin.call(
+                cluster.mgmtd_rpc.address, "Mgmtd.report_repair_status",
+                ReportRepairStatusReq(status=RepairStatus.from_status(
+                    "scrub-test", sched.status())))
+            rsp, _ = await cluster.admin.call(
+                cluster.mgmtd_rpc.address, "Mgmtd.repair_status", None)
+            row = rsp.rows[0]
+            assert row.source == "scrub-test" and row.ts > 0
+            assert row.repaired_shards == 7
+            assert row.repair_mode == "subshard"
+        finally:
+            await cluster.stop()
+
+    run(body())
+
+
+def test_scrub_cursor_paces_scan_and_wraps():
+    """stripes_per_tick bounds probes per tick; the cursor resumes where
+    it left off and wraps for the next full pass."""
+    async def body():
+        cluster = LocalCluster(num_nodes=4, replicas=1, num_chains=8)
+        await cluster.start()
+        try:
+            lay = _layout()
+            ec = ECStorageClient(cluster.sc, use_device_codec=False)
+            data = bytes(8192)
+            for s in range(5):
+                res = await ec.write_stripe(lay, 77, s, data)
+                assert all(r.status.code == int(StatusCode.OK)
+                           for r in res)
+            sched = ScrubScheduler(ec, stripes_per_tick=2)
+            sched.add_target("f", lay, 77, {s: 8192 for s in range(5)})
+            await sched.scan_once()
+            assert sched.stats.stripes_scanned == 2
+            assert sched._cursor["f"] == 2
+            await sched.scan_once()
+            await sched.scan_once()
+            assert sched.stats.stripes_scanned == 5   # 2+2+1: pass done
+            await sched.scan_once()                   # wrapped: rescans
+            assert sched.stats.stripes_scanned == 7
+        finally:
+            await cluster.stop()
+
+    run(body())
+
+
+# ------------------------------------------------------------ drill smoke
+
+@pytest.mark.slow
+def test_repair_drill_bench_smoke():
+    """The drill end to end, tiny budget: kill a node under live reads,
+    A/B subshard vs full on identical damage — reduced repair must move
+    < 0.5x the survivor bytes of full-k, everything verified."""
+    from benchmarks.repair_drill_bench import parse_args, run_bench
+
+    res = asyncio.run(run_bench(parse_args(
+        ["--stripes", "6", "--chunk-size", "16384", "--readers", "1",
+         "--warm-s", "0.2", "--budget-mbps", "1.0"])))
+    assert res["verified"]
+    assert res["lost_shards"] > 0
+    assert res["repair_traffic_ratio"] is not None
+    assert res["repair_traffic_ratio"] < 0.5, res["repair_traffic_ratio"]
+    cells = {(c["mode"], c["budget_mbps"]): c for c in res["cells"]}
+    assert cells[("subshard", 0.0)]["fallback_shards"] == 0
+    assert cells[("full", 0.0)]["reduced_shards"] == 0
+    for c in res["cells"]:
+        assert c["bytes_repaired"] == res["lost_bytes"]
