@@ -210,6 +210,31 @@ def write_pipeline_ab(value_size: int = 4 << 20, num_ops: int = 16,
     return out
 
 
+def trace_ab(value_size: int = 1 << 20, num_ops: int = 24,
+             replicas: int = 3) -> dict:
+    """ISSUE-11 acceptance: distributed-tracing overhead on the chain
+    write p50 at head sampling off / 1% / 100% (export=tail, the
+    production shape — spans buffer and expire, nothing exports on a
+    clean run).  The 1% column is the always-on production rate and must
+    stay under a few percent of the off column."""
+    from t3fs.utils import tracing
+
+    out = {}
+    for label, rate in (("off", 0.0), ("rate_0.01", 0.01),
+                        ("rate_1.0", 1.0)):
+        tracing.configure(tracing.TraceConfig(sample_rate=rate))
+        try:
+            out[label] = run_write_bench(value_size, num_ops,
+                                         concurrency=1, replicas=replicas)
+        finally:
+            tracing.reset_tracing()
+        out[label]["sample_rate"] = rate
+    base = out["off"]["p50_ms"] or 1.0
+    for label in ("rate_0.01", "rate_1.0"):
+        out[label]["p50_vs_off"] = round(out[label]["p50_ms"] / base, 3)
+    return out
+
+
 async def _read_bench_once(chunk_size: int, num_ops: int, *,
                            replicas: int = 3, read_hedging: str = "off",
                            read_selection: str = "load_balance",
@@ -377,6 +402,9 @@ def parse_args(argv=None):
     ap.add_argument("--read-ab", dest="read_ab", action="store_true",
                     help="run the hedged-vs-off read A/B under an "
                          "injected straggler and print one JSON line")
+    ap.add_argument("--trace-ab", dest="trace_ab", action="store_true",
+                    help="run the tracing-overhead A/B (head sampling "
+                         "off / 1%% / 100%%) and print one JSON line")
     ap.add_argument("--straggler-delay-ms", dest="straggler_delay_ms",
                     type=float, default=10.0,
                     help="injected per-read delay on one node for "
@@ -389,6 +417,11 @@ def main(argv=None) -> None:
     args = parse_args(argv)
     if args.write_ab:
         print(json.dumps(write_pipeline_ab(
+            value_size=args.chunk_size, num_ops=args.num_ops,
+            replicas=args.replicas)))
+        return
+    if args.trace_ab:
+        print(json.dumps(trace_ab(
             value_size=args.chunk_size, num_ops=args.num_ops,
             replicas=args.replicas)))
         return

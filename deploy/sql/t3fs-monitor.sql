@@ -23,3 +23,24 @@ CREATE TABLE IF NOT EXISTS metrics (
   payload TEXT NOT NULL
 );
 CREATE INDEX IF NOT EXISTS metrics_name_ts ON metrics (name, ts);
+
+-- Span rows from the distributed tracer (t3fs/utils/tracing.py), pushed
+-- by MonitorReporter via Monitor.report_spans.  One row per finished
+-- span; `payload` is the full JSON span (tags, events, remote_parent).
+CREATE TABLE IF NOT EXISTS spans (
+  ts REAL NOT NULL,
+  node_id INTEGER NOT NULL,
+  node_type TEXT NOT NULL,
+  trace_id INTEGER NOT NULL,
+  span_id INTEGER NOT NULL,
+  parent_id INTEGER NOT NULL,
+  name TEXT NOT NULL,
+  kind TEXT NOT NULL,
+  t0 REAL NOT NULL,
+  dur_s REAL NOT NULL,
+  status INTEGER NOT NULL,
+  root INTEGER NOT NULL,
+  payload TEXT NOT NULL
+);
+CREATE INDEX IF NOT EXISTS spans_trace ON spans (trace_id);
+CREATE INDEX IF NOT EXISTS spans_name_dur ON spans (name, dur_s);

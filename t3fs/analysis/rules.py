@@ -42,6 +42,13 @@ Rule catalog (failure stories in docs/static_analysis.md):
                               fuse/ring_worker.py pattern) — untracked
                               spawns dodge the teardown cancel/complete
                               machinery.
+  span-not-closed             a tracing ``Span(...)`` constructed directly,
+                              or a manual ``start_span(...)`` in a function
+                              that never calls ``.finish()`` — an unfinished
+                              span never reaches the SpanBuffer, so its
+                              whole trace silently loses a leg.  Use the
+                              ``span()``/``start_root()`` scopes, which
+                              finish on exit.
 """
 
 from __future__ import annotations
@@ -98,6 +105,7 @@ ALL_RULES = (
     "status-discarded",
     "naked-wait",
     "bare-create-task-in-handler",
+    "span-not-closed",
 )
 DEFAULT_RULES = frozenset(ALL_RULES)
 # benchmarks/ and tests/ run a subset: they legitimately block, hold
@@ -391,6 +399,8 @@ class FileLinter(ast.NodeVisitor):
         self.generic_visit(node)
 
     def visit_Call(self, node: ast.Call) -> None:
+        if "span-not-closed" in self.rules:
+            self._check_span_closed(node)
         if _is_spawn_call(node) and self._class \
                 and self._class[-1] in self.facts.spawn_classes:
             fn_node = self._fn[-1][0] if self._fn else None
@@ -419,6 +429,46 @@ class FileLinter(ast.NodeVisitor):
                                    ast.AsyncFunctionDef, ast.Module)):
                 return False
             parent = getattr(parent, "_t3fs_parent", None)
+        return False
+
+    # -- span-not-closed --
+
+    def _check_span_closed(self, node: ast.Call) -> None:
+        """Two shapes leak spans: constructing ``Span(...)`` directly
+        (nothing ever finishes it — the scope helpers exist precisely to
+        pair construction with finish), and calling the manual
+        ``start_span(...)`` API in a function that never calls
+        ``.finish()`` on anything (the span sits in the buffer's trace
+        state until TTL eviction and the trace loses the leg).  Handing
+        the span across functions is the pragma path."""
+        tail = _dotted(node.func).rsplit(".", 1)[-1]
+        if tail == "Span":
+            self._emit(
+                node, "span-not-closed",
+                "bare Span(...) constructed: nothing finishes it, so it "
+                "never reaches the SpanBuffer and its trace silently "
+                "loses this leg — use tracing.span()/start_root() scopes "
+                "(finish on exit) or start_span() + finish()")
+            return
+        if tail != "start_span":
+            return
+        fn_node = self._fn[-1][0] if self._fn else None
+        if fn_node is not None and self._fn_calls_finish(fn_node):
+            return
+        self._emit(
+            node, "span-not-closed",
+            "start_span(...) without a .finish() in the same function: "
+            "the span never completes, so it is dropped at TTL expiry "
+            "and its trace loses this leg — call finish() on every path "
+            "(try/finally), or use the tracing.span() scope")
+
+    @staticmethod
+    def _fn_calls_finish(fn_node: ast.AST) -> bool:
+        for n in ast.walk(fn_node):
+            if isinstance(n, ast.Call) \
+                    and isinstance(n.func, ast.Attribute) \
+                    and n.func.attr == "finish":
+                return True
         return False
 
     # -- swallowed-cancellation --

@@ -41,6 +41,7 @@ from t3fs.ckpt.store import CheckpointStore
 from t3fs.client.ec_client import ECLayout, ECStorageClient
 from t3fs.ops.codec import crc32c
 from t3fs.storage.types import ReadIO, UpdateType
+from t3fs.utils import tracing
 from t3fs.utils.status import StatusCode, make_error
 
 log = logging.getLogger("t3fs.ckpt")
@@ -118,6 +119,13 @@ class CheckpointReader:
     async def _read_leaves(self, manifest: CheckpointManifest,
                            selected: list[CkptLeaf]
                            ) -> dict[str, np.ndarray]:
+        with tracing.start_root("ckpt.restore", step=manifest.step,
+                                leaves=len(selected)):
+            return await self._read_leaves_inner(manifest, selected)
+
+    async def _read_leaves_inner(self, manifest: CheckpointManifest,
+                                 selected: list[CkptLeaf]
+                                 ) -> dict[str, np.ndarray]:
         lay = manifest.layout
         k, m, cs = lay.k, lay.m, lay.chunk_size
         flayout = lay.data_file_layout()
@@ -201,6 +209,8 @@ class CheckpointReader:
         stripe_len = lf.stripe_len(lay, stripe)
         lens = [max(0, min(cs, stripe_len - j * cs)) for j in range(k)]
         want_crcs = lf.stripe_crcs(lay, stripe)
+        tracing.add_event("ckpt.stripe.degraded",
+                          f"path={lf.path} stripe={stripe}")
         data, got_crcs = await self.ec.read_stripe_with_crcs(
             lay, lf.inode, stripe, stripe_len)
 

@@ -33,6 +33,7 @@ from t3fs.ckpt.manifest import (CheckpointManifest, CkptLeaf, ckpt_inode,
 from t3fs.ckpt.store import CheckpointStore
 from t3fs.client.ec_client import ChainAdmission, ECLayout, ECStorageClient
 from t3fs.storage.types import ReadIO
+from t3fs.utils import tracing
 from t3fs.utils.status import StatusCode, make_error
 
 log = logging.getLogger("t3fs.ckpt")
@@ -109,23 +110,27 @@ class CheckpointWriter:
         async def one(plan: _LeafPlan, stripe: int) -> None:
             nonlocal done
             async with window:
-                await self._write_stripe(plan, stripe, resume, admission,
-                                         stats)
+                with tracing.span("ckpt.write_stripe", path=plan.path,
+                                  stripe=stripe):
+                    await self._write_stripe(plan, stripe, resume, admission,
+                                             stats)
             if on_stripe is not None:
                 async with lock:
                     done += 1
                     on_stripe(done, stats.stripes_total)
 
-        # deterministic order so an interrupt leaves a contiguous-ish
-        # prefix; the window keeps `window` stripes in flight regardless
-        await asyncio.gather(*(one(plan, s) for plan, s in work))
+        with tracing.start_root("ckpt.save", step=step,
+                                stripes=stats.stripes_total):
+            # deterministic order so an interrupt leaves a contiguous-ish
+            # prefix; the window keeps `window` stripes in flight regardless
+            await asyncio.gather(*(one(plan, s) for plan, s in work))
 
-        manifest = CheckpointManifest(
-            version=1, directory=self.store.directory, step=step,
-            treedef=treedef, layout=lay,
-            leaves=[plan.entry for plan in plans],
-            created_at=time.time())
-        stats.manifest_path = await self.store.commit(manifest)
+            manifest = CheckpointManifest(
+                version=1, directory=self.store.directory, step=step,
+                treedef=treedef, layout=lay,
+                leaves=[plan.entry for plan in plans],
+                created_at=time.time())
+            stats.manifest_path = await self.store.commit(manifest)
         return stats
 
     async def _write_stripe(self, plan: _LeafPlan, stripe: int, resume: bool,

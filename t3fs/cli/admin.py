@@ -35,7 +35,7 @@ from t3fs.mgmtd.service import (
 from t3fs.mgmtd.types import (
     ChainInfo, ChainTable, ChainTargetInfo, PublicTargetState,
 )
-from t3fs.monitor.service import QueryMetricsReq
+from t3fs.monitor.service import QueryMetricsReq, QuerySpansReq
 from t3fs.net.client import Client
 from t3fs.ops.codec import crc32c
 from t3fs.storage.types import SyncStartReq
@@ -1142,6 +1142,87 @@ async def metrics(ctx: AdminContext, args) -> None:
                                                 args.limit))
     for s in rsp.samples:
         print(json.dumps(s, default=str))
+
+
+def render_trace(spans: list[dict]) -> str:
+    """Render one trace's spans (Monitor.query_spans rows) as an indented
+    cross-node tree: per hop the serving node, offset from the trace
+    start, duration, status, the wire/queue decomposition tags the server
+    span carries, and the span's events.  Spans whose parent was never
+    exported (tail-dropped on another node) root at top level."""
+    if not spans:
+        return "(no spans)"
+    by_id = {s["span_id"]: s for s in spans}
+    kids: dict[int, list[dict]] = {}
+    roots: list[dict] = []
+    for s in spans:
+        if s.get("parent_id") and s["parent_id"] in by_id:
+            kids.setdefault(s["parent_id"], []).append(s)
+        else:
+            roots.append(s)
+    t_min = min(s["t0"] for s in spans)
+    out: list[str] = [f"trace {spans[0]['trace_id']:#x} "
+                      f"({len(spans)} spans)"]
+
+    def fmt(s: dict) -> str:
+        tags = s.get("tags") or {}
+        bits = [f"{s['name']} [{s.get('kind', '?')}]"]
+        where = tags.get("addr") or f"node{s.get('node_id', '?')}"
+        bits.append(f"@{where}")
+        bits.append(f"+{(s['t0'] - t_min) * 1e3:.2f}ms")
+        bits.append(f"{s['dur_s'] * 1e3:.2f}ms")
+        if s.get("status"):
+            bits.append(f"status={s['status']}")
+        for k in ("wire_s", "queue_s", "apply_s", "forward_s"):
+            if k in tags:
+                bits.append(f"{k[:-2]}={tags[k] * 1e3:.2f}ms")
+        return "  ".join(bits)
+
+    def walk(s: dict, depth: int) -> None:
+        out.append("  " * depth + fmt(s))
+        for rel, event, detail in s.get("events") or []:
+            out.append("  " * (depth + 1)
+                       + f". +{rel * 1e3:.2f}ms {event}"
+                       + (f" {detail}" if detail else ""))
+        for c in sorted(kids.get(s["span_id"], []), key=lambda x: x["t0"]):
+            walk(c, depth + 1)
+
+    for r in sorted(roots, key=lambda x: x["t0"]):
+        walk(r, 0)
+    return "\n".join(out)
+
+
+@command("trace-show", "cross-node span tree for one trace_id "
+                       "(wire/queue/apply/forward decomposition)")
+@args_(("trace_id", {"help": "trace id (decimal or 0x hex)"}),
+       ("--limit", {"type": int, "default": 1000}))
+async def trace_show(ctx: AdminContext, args) -> None:
+    if not ctx.monitor_address:
+        raise SystemExit("trace-show needs --monitor ADDR")
+    tid = int(args.trace_id, 0)
+    rsp, _ = await ctx.cli.call(ctx.monitor_address, "Monitor.query_spans",
+                                QuerySpansReq(trace_id=tid,
+                                              limit=args.limit))
+    print(render_trace(rsp.spans))
+
+
+@command("trace-slow", "top-N slow exported traces (local roots) per method")
+@args_(("--method", {"default": "", "help": "span name prefix filter"}),
+       ("--min-ms", {"type": float, "default": 0.0}),
+       ("--limit", {"type": int, "default": 20}))
+async def trace_slow(ctx: AdminContext, args) -> None:
+    if not ctx.monitor_address:
+        raise SystemExit("trace-slow needs --monitor ADDR")
+    rsp, _ = await ctx.cli.call(ctx.monitor_address, "Monitor.query_spans",
+                                QuerySpansReq(name_prefix=args.method,
+                                              min_dur_s=args.min_ms / 1e3,
+                                              roots_only=True,
+                                              limit=args.limit))
+    rows = [[f"{s['trace_id']:#x}", s["name"],
+             s.get("tags", {}).get("addr") or f"node{s.get('node_id', '?')}",
+             f"{s['dur_s'] * 1e3:.2f}", s.get("status", 0)]
+            for s in rsp.spans]
+    print(_fmt_table(rows, ["trace", "root", "node", "ms", "status"]))
 
 
 @command("bench", "quick write+read bench through meta+storage")

@@ -29,6 +29,7 @@ import numpy as np
 from t3fs.client.ec_codec import ECCodec
 from t3fs.ops.rs import default_rs
 from t3fs.storage.types import ChunkId, IOResult, ReadIO, UpdateType
+from t3fs.utils import tracing
 from t3fs.utils.serde import serde_struct
 from t3fs.utils.status import StatusCode, StatusError, make_error
 
@@ -761,7 +762,9 @@ class ECStorageClient:
                     chain_id=layout.shard_chain(stripe, slot),
                     offset=i * sub, length=sub))
         try:
-            results, payloads = await self._fast.batch_read(ios)
+            with tracing.span("ec.repair.subshard_read", helpers=len(plan),
+                              sub_reads=len(ios)):
+                results, payloads = await self._fast.batch_read(ios)
         except StatusError:
             return None
         h = len(plan)
@@ -813,6 +816,19 @@ class ECStorageClient:
         survivor choice and restricts the full-k FAST pass to those shard
         indices; shortfalls still fall through to the unrestricted patient
         wave.  `stats` accrues bytes_read / bytes_repaired / path counts."""
+        with tracing.start_root("ec.repair_stripe", inode=inode,
+                                stripe=stripe, shards=len(shards)):
+            return await self._repair_stripe_inner(
+                layout, inode, stripe, shards, stripe_len, read_shards,
+                mode, stats)
+
+    async def _repair_stripe_inner(self, layout: ECLayout, inode: int,
+                                   stripe: int, shards: tuple[int, ...],
+                                   stripe_len: int,
+                                   read_shards: tuple[int, ...] | None,
+                                   mode: str,
+                                   stats: RepairIOStats | None
+                                   ) -> list[IOResult]:
         k, cs = layout.k, layout.chunk_size
         stats = stats if stats is not None else RepairIOStats()
         lens = [max(0, min(cs, stripe_len - j * cs)) for j in range(k)]

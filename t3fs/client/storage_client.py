@@ -31,6 +31,7 @@ from t3fs.storage.types import (
     TruncateChunkReq, UpdateIO, UpdateType, WriteReq, pack_readios,
     unpack_ioresults, update_rpc,
 )
+from t3fs.utils import tracing
 from t3fs.utils.fault_injection import DebugFlags
 from t3fs.utils.status import Status, StatusCode, StatusError, make_error
 
@@ -240,6 +241,20 @@ class StorageClient:
         CHUNK_STALE_UPDATE instead of removing when the chunk's version
         advanced past the fence — the conditional delete KVCache eviction
         uses so a concurrently re-put block survives its own GC."""
+        with tracing.start_root("storage_client.write_chunk",
+                                chunk=str(chunk_id), nbytes=len(data)) as sp:
+            result = await self._write_chunk_inner(
+                chain_id, chunk_id, offset, data, chunk_size, update_type,
+                truncate_len, checksum, remove_fence_ver)
+            if result.status.code:
+                sp.set_status(result.status.code)
+            return result
+
+    async def _write_chunk_inner(self, chain_id: int, chunk_id: ChunkId,
+                                 offset: int, data: bytes, chunk_size: int,
+                                 update_type: UpdateType,
+                                 truncate_len: int, checksum: int | None,
+                                 remove_fence_ver: int) -> IOResult:
         channel, seq = await self.channels.acquire()
         try:
             io = UpdateIO(
@@ -368,6 +383,19 @@ class StorageClient:
         `stats`, when provided, accumulates this call's
         hedge_fired/hedge_won/hedge_wasted counts (kvcache get_many
         surfaces them to its callers)."""
+        with tracing.start_root("storage_client.batch_read",
+                                ios=len(ios)) as sp:
+            results, payloads = await self._batch_read_inner(
+                ios, stats=stats, hedging=hedging)
+            bad = next((r.status.code for r in results if r.status.code), 0)
+            if bad:
+                sp.set_status(bad)
+            return results, payloads
+
+    async def _batch_read_inner(self, ios: list[ReadIO], *,
+                                stats: dict | None = None,
+                                hedging: str | None = None
+                                ) -> tuple[list[IOResult], list[bytes]]:
         results: list[IOResult | None] = [None] * len(ios)
         payloads: list[bytes] = [b""] * len(ios)
         winner: list[str] = [""] * len(ios)
@@ -531,6 +559,8 @@ class StorageClient:
                     hgroups.setdefault(a, []).append(i)
                 hedged = [i for i, _ in plan]
                 hstats["hedge_fired"] += len(hedged)
+                tracing.add_event("hedge.fired",
+                                  f"n={len(hedged)} primary={address}")
                 READ_STATS.hedge(address, fired=len(hedged))
                 hedge = asyncio.gather(*[read_group(a, his, "hedge")
                                          for a, his in hgroups.items()])
@@ -554,6 +584,11 @@ class StorageClient:
                 won = sum(1 for i in hedged if winner[i] == "hedge")
                 hstats["hedge_won"] += won
                 hstats["hedge_wasted"] += len(hedged) - won
+                if won:
+                    tracing.add_event("hedge.won", f"n={won}")
+                if len(hedged) - won:
+                    tracing.add_event("hedge.cancelled",
+                                      f"n={len(hedged) - won}")
                 READ_STATS.hedge(address, won=won, wasted=len(hedged) - won)
 
             if hedging:

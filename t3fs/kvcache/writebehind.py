@@ -39,6 +39,7 @@ from dataclasses import dataclass, field
 
 from t3fs.lib.kvcache import KVCacheStore, _pack_block
 from t3fs.storage.types import ChunkId
+from t3fs.utils import tracing
 from t3fs.utils.status import StatusCode, StatusError, make_error
 
 log = logging.getLogger("t3fs.kvcache")
@@ -157,11 +158,13 @@ class WriteBehind:
     async def flush(self) -> None:
         """Barrier: every put enqueued before this call is durable (or
         superseded by a later put to the same chunk) on return."""
-        async with self._cond:
-            target = self._seq
-            self._cond.notify_all()     # wake the flusher immediately
-            await self._cond.wait_for(
-                lambda: self.durable_through >= target)
+        with tracing.start_root("kvcache.flush") as sp:
+            async with self._cond:
+                target = self._seq
+                sp.set_tag("target_seq", target)
+                self._cond.notify_all()     # wake the flusher immediately
+                await self._cond.wait_for(
+                    lambda: self.durable_through >= target)
 
     async def stop(self) -> None:
         if self._task is None:
@@ -197,9 +200,10 @@ class WriteBehind:
             # serialize the rest); bounded so a burst can't open
             # unbounded write channels
             sem = asyncio.Semaphore(self.cfg.flush_concurrency)
-            results = await asyncio.gather(
-                *(self._flush_one(e, sem) for e in batch),
-                return_exceptions=True)
+            with tracing.start_root("kvcache.flush_batch", n=len(batch)):
+                results = await asyncio.gather(
+                    *(self._flush_one(e, sem) for e in batch),
+                    return_exceptions=True)
             for r in results:
                 if isinstance(r, asyncio.CancelledError):
                     raise r

@@ -16,8 +16,9 @@ import queue
 import threading
 import time
 
-from t3fs.monitor.service import ReportMetricsReq
+from t3fs.monitor.service import ReportMetricsReq, ReportSpansReq
 from t3fs.net.client import Client
+from t3fs.utils import tracing
 
 log = logging.getLogger("t3fs.monitor")
 
@@ -39,7 +40,9 @@ class MonitorReporter:
 
     def __call__(self, snapshot: list[dict]) -> None:
         try:
-            self._q.put_nowait(list(snapshot))
+            # error=True rows are failed CallbackGauge pulls: their 0.0 is
+            # not a measurement, so they never reach the sink
+            self._q.put_nowait([s for s in snapshot if not s.get("error")])
         except queue.Full:
             self.dropped += 1
 
@@ -53,19 +56,38 @@ class MonitorReporter:
                 try:
                     snap = self._q.get(timeout=0.2)
                 except queue.Empty:
-                    continue
+                    snap = ()   # idle tick: still drain promoted spans
                 if snap is None:
                     break
-                try:
-                    await cli.call(
-                        self.address, "Monitor.report",
-                        ReportMetricsReq(self.node_id, self.node_type,
-                                         time.time(), snap),
-                        timeout=5.0)
-                except Exception as e:
-                    log.warning("metric push to %s failed: %s", self.address, e)
+                if snap:
+                    try:
+                        await cli.call(
+                            self.address, "Monitor.report",
+                            ReportMetricsReq(self.node_id, self.node_type,
+                                             time.time(), list(snap)),
+                            timeout=5.0)
+                    except Exception as e:
+                        log.warning("metric push to %s failed: %s",
+                                    self.address, e)
+                await self._push_spans(cli)
         finally:
             await cli.close()
+
+    async def _push_spans(self, cli: Client) -> None:
+        """Drain tail-promoted spans (tracing.BUFFER) to the collector;
+        the queue tick bounds push latency at ~0.2s."""
+        spans = tracing.BUFFER.drain()
+        while spans:
+            try:
+                await cli.call(
+                    self.address, "Monitor.report_spans",
+                    ReportSpansReq(self.node_id, self.node_type,
+                                   time.time(), spans),
+                    timeout=5.0)
+            except Exception as e:
+                log.warning("span push to %s failed: %s", self.address, e)
+                return   # spans dropped; next tick starts fresh
+            spans = tracing.BUFFER.drain()
 
     def close(self) -> None:
         self._stop.set()

@@ -30,18 +30,57 @@ CREATE TABLE IF NOT EXISTS metrics (
   payload TEXT NOT NULL
 );
 CREATE INDEX IF NOT EXISTS metrics_name_ts ON metrics (name, ts);
+CREATE TABLE IF NOT EXISTS spans (
+  ts REAL NOT NULL,
+  node_id INTEGER NOT NULL,
+  node_type TEXT NOT NULL,
+  trace_id INTEGER NOT NULL,
+  span_id INTEGER NOT NULL,
+  parent_id INTEGER NOT NULL,
+  name TEXT NOT NULL,
+  kind TEXT NOT NULL,
+  t0 REAL NOT NULL,
+  dur_s REAL NOT NULL,
+  status INTEGER NOT NULL,
+  root INTEGER NOT NULL,
+  payload TEXT NOT NULL
+);
+CREATE INDEX IF NOT EXISTS spans_trace ON spans (trace_id);
+CREATE INDEX IF NOT EXISTS spans_name_dur ON spans (name, dur_s);
 """
 
 
 class MetricsDB:
-    """sqlite sink (the ClickHouse-table analog, deploy/sql/3fs-monitor.sql)."""
+    """sqlite sink (the ClickHouse-table analog, deploy/sql/3fs-monitor.sql).
 
-    def __init__(self, path: str = ":memory:"):
+    Retention: max_age_s drops rows older than that; max_rows caps each
+    table, oldest-first.  Both prune on insert (0 = unbounded) so long
+    dev-cluster runs don't grow the file without bound."""
+
+    def __init__(self, path: str = ":memory:", max_age_s: float = 0.0,
+                 max_rows: int = 0):
         self.path = path
+        self.max_age_s = max_age_s
+        self.max_rows = max_rows
         self._conn = sqlite3.connect(path, check_same_thread=False)
         self._lock = threading.Lock()
         with self._lock:
             self._conn.executescript(_SCHEMA)
+
+    def _prune_locked(self, table: str) -> None:
+        """Apply retention to one table; caller holds the lock."""
+        if self.max_age_s > 0:
+            self._conn.execute(
+                f"DELETE FROM {table} WHERE ts < ?",
+                (time.time() - self.max_age_s,))
+        if self.max_rows > 0:
+            (n,) = self._conn.execute(
+                f"SELECT COUNT(*) FROM {table}").fetchone()
+            if n > self.max_rows:
+                self._conn.execute(
+                    f"DELETE FROM {table} WHERE rowid IN ("
+                    f"SELECT rowid FROM {table} ORDER BY ts ASC LIMIT ?)",
+                    (n - self.max_rows,))
 
     def insert(self, node_id: int, node_type: str, ts: float,
                samples: list[dict]) -> int:
@@ -55,8 +94,51 @@ class MetricsDB:
         with self._lock:
             self._conn.executemany(
                 "INSERT INTO metrics VALUES (?,?,?,?,?,?,?)", rows)
+            self._prune_locked("metrics")
             self._conn.commit()
         return len(rows)
+
+    def insert_spans(self, node_id: int, node_type: str, ts: float,
+                     spans: list[dict]) -> int:
+        rows = []
+        for s in spans:
+            rows.append((ts, node_id, node_type,
+                         int(s.get("trace_id", 0)), int(s.get("span_id", 0)),
+                         int(s.get("parent_id", 0)), s.get("name", ""),
+                         s.get("kind", ""), float(s.get("t0", 0.0)),
+                         float(s.get("dur_s", 0.0)), int(s.get("status", 0)),
+                         1 if s.get("root") else 0,
+                         json.dumps(s, default=str)))
+        with self._lock:
+            self._conn.executemany(
+                "INSERT INTO spans VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?)", rows)
+            self._prune_locked("spans")
+            self._conn.commit()
+        return len(rows)
+
+    def query_spans(self, trace_id: int = 0, name_prefix: str = "",
+                    min_dur_s: float = 0.0, roots_only: bool = False,
+                    limit: int = 1000) -> list[dict]:
+        conds, params = ["dur_s >= ?"], [min_dur_s]
+        if trace_id:
+            conds.append("trace_id = ?")
+            params.append(trace_id)
+        if name_prefix:
+            conds.append("name >= ? AND name < ?")
+            params += [name_prefix, name_prefix + chr(0x10FFFF)]
+        if roots_only:
+            conds.append("root = 1")
+        q = ("SELECT node_id, node_type, payload FROM spans WHERE "
+             + " AND ".join(conds) + " ORDER BY dur_s DESC LIMIT ?")
+        params.append(limit)
+        with self._lock:
+            rows = self._conn.execute(q, params).fetchall()
+        out = []
+        for node_id, node_type, payload in rows:
+            d = json.loads(payload)
+            d.update(node_id=node_id, node_type=node_type)
+            out.append(d)
+        return out
 
     def query(self, name_prefix: str = "", since_ts: float = 0.0,
               limit: int = 1000) -> list[dict]:
@@ -110,6 +192,37 @@ class QueryMetricsRsp:
     samples: list[dict] = field(default_factory=list)
 
 
+@serde_struct
+@dataclass
+class ReportSpansReq:
+    node_id: int = 0
+    node_type: str = ""
+    ts: float = 0.0
+    spans: list[dict] = field(default_factory=list)
+
+
+@serde_struct
+@dataclass
+class ReportSpansRsp:
+    accepted: int = 0
+
+
+@serde_struct
+@dataclass
+class QuerySpansReq:
+    trace_id: int = 0
+    name_prefix: str = ""
+    min_dur_s: float = 0.0
+    roots_only: bool = False
+    limit: int = 1000
+
+
+@serde_struct
+@dataclass
+class QuerySpansRsp:
+    spans: list[dict] = field(default_factory=list)
+
+
 @service("Monitor")
 class MonitorCollectorService:
     def __init__(self, db: MetricsDB | None = None, clickhouse=None):
@@ -135,16 +248,28 @@ class MonitorCollectorService:
         return QueryMetricsRsp(
             self.db.query(req.name_prefix, req.since_ts, req.limit)), b""
 
+    @rpc_method
+    async def report_spans(self, req: ReportSpansReq, payload, conn):
+        n = self.db.insert_spans(req.node_id, req.node_type,
+                                 req.ts or time.time(), req.spans)
+        return ReportSpansRsp(n), b""
+
+    @rpc_method
+    async def query_spans(self, req: QuerySpansReq, payload, conn):
+        return QuerySpansRsp(self.db.query_spans(
+            req.trace_id, req.name_prefix, req.min_dur_s,
+            req.roots_only, req.limit)), b""
+
 
 class MonitorCollectorServer:
     """monitor_collector_main analog: the aggregation service as a server."""
 
     def __init__(self, db_path: str = ":memory:", host: str = "127.0.0.1",
-                 port: int = 0):
+                 port: int = 0, max_age_s: float = 0.0, max_rows: int = 0):
         from t3fs.core.service import AppInfo, CoreService
         from t3fs.net.server import Server
 
-        self.db = MetricsDB(db_path)
+        self.db = MetricsDB(db_path, max_age_s=max_age_s, max_rows=max_rows)
         self.service = MonitorCollectorService(self.db)
         self.server = Server(host, port)
         self.server.add_service(self.service)

@@ -19,6 +19,9 @@ import platform
 
 _CXXFLAGS = ["-std=c++20", "-O2", "-g", "-fPIC", "-shared", "-Wall",
              "-pthread"]
+# shm_open/shm_unlink (usrbio.cpp) live in librt before glibc 2.34;
+# harmless stub library on newer glibc, required on e.g. Debian 11
+_LDLIBS = ["-lrt"]
 if platform.machine() in ("x86_64", "AMD64"):
     _CXXFLAGS.append("-msse4.2")  # hw CRC32C; other arches use the sw path
 
@@ -58,7 +61,7 @@ def build(force: bool = False) -> str:
         if all(os.path.getmtime(s) <= lib_mtime for s in srcs):
             return lib
     tmp = lib + f".tmp.{os.getpid()}"
-    cmd = ["g++", *flags, "-o", tmp, *srcs]
+    cmd = ["g++", *flags, "-o", tmp, *srcs, *_LDLIBS]
     try:
         subprocess.run(cmd, check=True, capture_output=True, text=True)
     except subprocess.CalledProcessError as e:

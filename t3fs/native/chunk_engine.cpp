@@ -774,8 +774,18 @@ const char* t3fs_ce_last_error(void* h) {
   return g_error.c_str();
 }
 
+// NULL-handle guard: a request that raced t3fs_ce_close must come back
+// as an orderly error, never a nullptr member call (segfault observed
+// when a straggler read drained after its node's engine closed)
+static bool ce_null(void* h) {
+  if (h) return false;
+  g_error = "engine closed (null handle)";
+  return true;
+}
+
 int t3fs_ce_put(void* h, const uint8_t* cid, const uint8_t* data,
                 uint64_t len, uint64_t chunk_size, const CeMeta* meta) {
+  if (ce_null(h)) return 0;
   auto* e = static_cast<Engine*>(h);
   Meta m;
   memcpy(&m, meta, sizeof m);
@@ -784,17 +794,20 @@ int t3fs_ce_put(void* h, const uint8_t* cid, const uint8_t* data,
 
 int t3fs_ce_read(void* h, const uint8_t* cid, uint64_t off, uint64_t len,
                  uint8_t* out, uint64_t* out_len) {
+  if (ce_null(h)) return -1;
   return static_cast<Engine*>(h)->read(to_cid(cid), off, len, out, out_len);
 }
 
 int t3fs_ce_locate(void* h, const uint8_t* cid, uint64_t off, uint64_t want,
                    int32_t* fd, uint64_t* abs_off, uint64_t* n,
                    uint64_t* gen) {
+  if (ce_null(h)) return 0;
   return static_cast<Engine*>(h)->locate(to_cid(cid), off, want, fd,
                                          abs_off, n, gen);
 }
 
 int t3fs_ce_get_meta(void* h, const uint8_t* cid, CeMeta* out) {
+  if (ce_null(h)) return 0;
   Meta m;
   int r = static_cast<Engine*>(h)->get_meta(to_cid(cid), &m);
   if (r == 1) memcpy(out, &m, sizeof m);
@@ -802,31 +815,37 @@ int t3fs_ce_get_meta(void* h, const uint8_t* cid, CeMeta* out) {
 }
 
 int t3fs_ce_set_meta(void* h, const uint8_t* cid, const CeMeta* meta) {
+  if (ce_null(h)) return 0;
   Meta m;
   memcpy(&m, meta, sizeof m);
   return static_cast<Engine*>(h)->set_meta(to_cid(cid), m) ? 1 : 0;
 }
 
 int t3fs_ce_remove(void* h, const uint8_t* cid) {
+  if (ce_null(h)) return 0;
   return static_cast<Engine*>(h)->remove(to_cid(cid));
 }
 
 uint64_t t3fs_ce_query_range(void* h, const uint8_t* lo, const uint8_t* hi,
                              uint8_t* rows, uint64_t cap) {
+  if (ce_null(h)) return 0;
   return static_cast<Engine*>(h)->query_range(to_cid(lo), to_cid(hi), rows,
                                               cap, T3FS_CE_ROW_BYTES);
 }
 
 void t3fs_ce_stats(void* h, uint64_t* chunks, uint64_t* used,
                    uint64_t* allocated) {
+  if (ce_null(h)) return;
   static_cast<Engine*>(h)->stats(chunks, used, allocated);
 }
 
 int t3fs_ce_compact(void* h) {
+  if (ce_null(h)) return 0;
   return static_cast<Engine*>(h)->compact() ? 1 : 0;
 }
 
 uint64_t t3fs_ce_punch_freed(void* h, uint64_t max_blocks) {
+  if (ce_null(h)) return 0;
   return static_cast<Engine*>(h)->punch_freed(max_blocks);
 }
 

@@ -16,6 +16,7 @@ from typing import Any
 from t3fs.net.conn import Connection
 from t3fs.net.rpcstats import READ_STATS
 from t3fs.net.server import build_dispatcher
+from t3fs.utils import tracing
 from t3fs.utils.status import StatusCode, make_error
 
 log = logging.getLogger("t3fs.net")
@@ -102,7 +103,11 @@ class Client:
         ok = False
         nbytes = 0
         try:
-            result = await conn.call(method, body, payload, timeout)
+            # per-hop client span (no-op scope when unsampled): the wire
+            # context Connection.call stamps parents under it, so every
+            # downstream server span hangs off this hop
+            with tracing.span(f"rpc.{method}", kind="client", addr=address):
+                result = await conn.call(method, body, payload, timeout)
             ok = True
             # response payload size drives the read-size-class tail
             # estimate (per-(address, size-class) hedge delay)

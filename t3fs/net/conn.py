@@ -22,7 +22,7 @@ from t3fs.net.wire import (
 )
 from t3fs.net.rpcstats import RPC_STATS
 from t3fs.ops.codec import crc32c
-from t3fs.utils import serde
+from t3fs.utils import serde, tracing
 from t3fs.utils.status import Status, StatusCode, StatusError, make_error
 
 log = logging.getLogger("t3fs.net")
@@ -50,6 +50,10 @@ class Connection:
         # always understood regardless of this setting.
         self.compress_threshold = compress_threshold
         self.compress_level = compress_level
+        # serving address, set by Server on accepted conns: tags server
+        # spans with the node that ran the handler (multi-node-in-one-
+        # process fabrics can't use a global for this)
+        self.local_address = ""
         self._waiters: dict[int, asyncio.Future] = {}
         self._send_lock = asyncio.Lock()
         self._closed = False
@@ -155,6 +159,16 @@ class Connection:
                 raise make_error(StatusCode.RPC_SEND_FAILED,
                                  f"send on {self.name}: {e}") from None
 
+    def _stamp_trace(self, packet: MessagePacket) -> None:
+        """Propagate the active span's context onto the envelope.  When no
+        span is active (head sampling said no, or tracing is off) the
+        fields keep their serde defaults — zero extra state on the wire."""
+        sp = tracing.current_span()
+        if sp is not None:
+            packet.trace_id = sp.trace_id
+            packet.parent_span_id = sp.span_id
+            packet.sampled = True
+
     async def post(self, method: str, body: object = None,
                    payload: bytes = b"") -> None:
         """One-way request: uuid 0 means the peer runs the handler but
@@ -164,6 +178,7 @@ class Connection:
         uuid counter starts at 1, so 0 can never collide with a waiter.)"""
         packet = MessagePacket(uuid=0, method=method, is_req=True).stamp_called()
         packet.body = body
+        self._stamp_trace(packet)
         await self._send_frame(packet, payload, FLAG_IS_REQ)
 
     async def call(self, method: str, body: object = None, payload: bytes = b"",
@@ -173,6 +188,7 @@ class Connection:
         uuid = next(self._uuid_counter)
         packet = MessagePacket(uuid=uuid, method=method, is_req=True).stamp_called()
         packet.body = body
+        self._stamp_trace(packet)
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._waiters[uuid] = fut
         try:
@@ -252,15 +268,29 @@ class Connection:
         rsp.ts_server_started = time.time()   # gap = server-side queueing
         rsp_payload = b""
         handler = self.dispatcher.get(packet.method)
-        try:
-            if handler is None:
-                raise make_error(StatusCode.RPC_METHOD_NOT_FOUND, packet.method)
-            rsp.body, rsp_payload = await handler(packet.body, payload, self)
-        except StatusError as e:
-            rsp.status = WireStatus.from_status(e.status)
-        except Exception as e:
-            log.exception("handler %s failed", packet.method)
-            rsp.status = WireStatus(int(StatusCode.INTERNAL), f"{type(e).__name__}: {e}")
+        if packet.sampled and packet.trace_id:
+            # server span: the handler (and anything it calls, including
+            # downstream RPCs) runs inside it.  wire_s spans both clocks
+            # (skew rides in it); queue_s is same-clock loop queueing.
+            scope = tracing.server_scope(
+                packet.method, packet.trace_id, packet.parent_span_id,
+                addr=self.local_address,
+                wire_s=max(0.0, rsp.ts_server_received - packet.ts_client_called),
+                queue_s=rsp.ts_server_started - rsp.ts_server_received)
+        else:
+            scope = tracing.server_scope(packet.method, 0, 0)   # no-op
+        with scope as sp:
+            try:
+                if handler is None:
+                    raise make_error(StatusCode.RPC_METHOD_NOT_FOUND, packet.method)
+                rsp.body, rsp_payload = await handler(packet.body, payload, self)
+            except StatusError as e:
+                rsp.status = WireStatus.from_status(e.status)
+                sp.set_status(int(e.status.code))
+            except Exception as e:
+                log.exception("handler %s failed", packet.method)
+                rsp.status = WireStatus(int(StatusCode.INTERNAL), f"{type(e).__name__}: {e}")
+                sp.set_status(int(StatusCode.INTERNAL))
         rsp.ts_server_replied = time.time()
         if packet.uuid == 0:
             return  # one-way post(): no response frame (errors logged above)

@@ -113,6 +113,7 @@ class Server:
                     sock, NativePump.get(), self.dispatcher,
                     name=f"srv<-{peer}", on_close=self._conns.discard,
                     compress_threshold=self.compress_threshold)
+                conn.local_address = self.address
                 self._conns.add(conn)
                 conn.start()
             except Exception:
@@ -125,6 +126,8 @@ class Server:
         conn = Connection(reader, writer, self.dispatcher, name=f"srv<-{peer}",
                           on_close=self._conns.discard,
                           compress_threshold=self.compress_threshold)
+        # server spans carry the serving node's address (tracing)
+        conn.local_address = self.address
         self._conns.add(conn)
         conn.start()
 

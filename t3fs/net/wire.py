@@ -194,6 +194,13 @@ class MessagePacket:
     # the gap is server-side queueing.  Appended last (serde add-only);
     # reference carries 8 such stamps (serde/MessagePacket.h:43-50)
     ts_server_started: float = 0.0
+    # distributed-tracing context (t3fs/utils/tracing.py): stamped by
+    # Connection.call/post when a sampled span is active, re-opened as a
+    # server span in dispatch.  Appended after ts_server_started — same
+    # add-only compat rule (old peers drop them, missing ones default off)
+    trace_id: int = 0
+    parent_span_id: int = 0
+    sampled: bool = False
 
     def stamp_called(self) -> "MessagePacket":
         self.ts_client_called = time.time()

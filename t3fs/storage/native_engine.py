@@ -130,6 +130,14 @@ class NativeChunkEngine:
     def _err(self) -> str:
         return (self._lib.t3fs_ce_last_error(self._h) or b"").decode()
 
+    def _handle(self):
+        """Live engine handle, or a typed error after close().  A request
+        that drains after its node shut down (straggler/hedged read) must
+        fail orderly — passing NULL into the C ABI segfaulted here."""
+        if not self._h:
+            raise make_error(StatusCode.INTERNAL, "native engine closed")
+        return self._h
+
     def _io_error(self, prefix: str):
         """Typed disk-error for engine I/O failures: the service offlines
         the target on DISK_ERROR instead of parsing message strings.  Pure
@@ -141,7 +149,7 @@ class NativeChunkEngine:
 
     def get_meta(self, chunk_id: ChunkId) -> ChunkMeta | None:
         cm = _CeMeta()
-        r = self._lib.t3fs_ce_get_meta(self._h, chunk_id.encode(), C.byref(cm))
+        r = self._lib.t3fs_ce_get_meta(self._handle(), chunk_id.encode(), C.byref(cm))
         return _meta_from_c(chunk_id, cm) if r == 1 else None
 
     def locate(self, chunk_id: ChunkId, offset: int,
@@ -156,7 +164,7 @@ class NativeChunkEngine:
         abs_off = C.c_uint64()
         n = C.c_uint64()
         gen = C.c_uint64()
-        r = self._lib.t3fs_ce_locate(self._h, chunk_id.encode(), offset,
+        r = self._lib.t3fs_ce_locate(self._handle(), chunk_id.encode(), offset,
                                      length, C.byref(fd), C.byref(abs_off),
                                      C.byref(n), C.byref(gen))
         if r != 1:
@@ -174,7 +182,7 @@ class NativeChunkEngine:
             return b""
         buf = C.create_string_buffer(length)
         out_len = C.c_uint64()
-        r = self._lib.t3fs_ce_read(self._h, chunk_id.encode(), offset, length,
+        r = self._lib.t3fs_ce_read(self._handle(), chunk_id.encode(), offset, length,
                                    buf, C.byref(out_len))
         if r < 0:
             raise self._io_error("read")
@@ -185,26 +193,26 @@ class NativeChunkEngine:
     def put(self, chunk_id: ChunkId, content: bytes, meta: ChunkMeta,
             chunk_size: int) -> None:
         cm = _meta_to_c(meta, length=len(content))
-        r = self._lib.t3fs_ce_put(self._h, chunk_id.encode(), bytes(content),
+        r = self._lib.t3fs_ce_put(self._handle(), chunk_id.encode(), bytes(content),
                                   len(content), chunk_size, C.byref(cm))
         if r != 1:
             raise self._io_error("put failed")
 
     def set_meta(self, chunk_id: ChunkId, meta: ChunkMeta) -> None:
         cm = _meta_to_c(meta)
-        r = self._lib.t3fs_ce_set_meta(self._h, chunk_id.encode(), C.byref(cm))
+        r = self._lib.t3fs_ce_set_meta(self._handle(), chunk_id.encode(), C.byref(cm))
         if r != 1:
             raise make_error(StatusCode.CHUNK_NOT_FOUND, str(chunk_id))
 
     def remove(self, chunk_id: ChunkId) -> bool:
-        return self._lib.t3fs_ce_remove(self._h, chunk_id.encode()) == 1
+        return self._lib.t3fs_ce_remove(self._handle(), chunk_id.encode()) == 1
 
     def _query(self, lo: bytes, hi: bytes) -> list[ChunkMeta]:
-        n = self._lib.t3fs_ce_query_range(self._h, lo, hi, None, 0)
+        n = self._lib.t3fs_ce_query_range(self._handle(), lo, hi, None, 0)
         if n == 0:
             return []
         buf = C.create_string_buffer(int(n) * _ROW_BYTES)
-        n2 = self._lib.t3fs_ce_query_range(self._h, lo, hi, buf, n)
+        n2 = self._lib.t3fs_ce_query_range(self._handle(), lo, hi, buf, n)
         out = []
         for i in range(min(int(n), int(n2))):
             row = buf.raw[i * _ROW_BYTES:(i + 1) * _ROW_BYTES]
@@ -228,17 +236,17 @@ class NativeChunkEngine:
         chunks = C.c_uint64()
         used = C.c_uint64()
         alloc = C.c_uint64()
-        self._lib.t3fs_ce_stats(self._h, C.byref(chunks), C.byref(used),
+        self._lib.t3fs_ce_stats(self._handle(), C.byref(chunks), C.byref(used),
                                 C.byref(alloc))
         return EngineStats(chunks.value, used.value, alloc.value)
 
     def compact(self) -> None:
-        self._lib.t3fs_ce_compact(self._h)
+        self._lib.t3fs_ce_compact(self._handle())
 
     def punch_freed(self, max_blocks: int = 1024) -> int:
         """Hole-punch freed blocks; returns bytes reclaimed
         (PunchHoleWorker analog)."""
-        return self._lib.t3fs_ce_punch_freed(self._h, max_blocks)
+        return self._lib.t3fs_ce_punch_freed(self._handle(), max_blocks)
 
     def close(self) -> None:
         if self._h:

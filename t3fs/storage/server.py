@@ -20,7 +20,8 @@ from t3fs.net.client import Client
 from t3fs.net.server import Server
 from t3fs.storage.resync import ResyncWorker
 from t3fs.storage.service import StorageNode, StorageService
-from t3fs.utils.config import ConfigBase, citem
+from t3fs.utils.config import ConfigBase, citem, cobj
+from t3fs.utils.tracing import TraceConfig, configure as configure_tracing
 
 log = logging.getLogger("t3fs.storage")
 
@@ -53,6 +54,9 @@ class StorageConfig(ConfigBase):
     # unacknowledged in-flight fragments per stream (every window-th frame
     # is a call() whose response is the cumulative ack)
     stream_window: int = citem(4, validator=lambda v: v > 0)
+    # distributed tracing (t3fs/utils/tracing.py): sampling + buffer knobs;
+    # installed process-wide on start and on every hot update
+    trace: TraceConfig = cobj(TraceConfig)
 
 
 class StorageServer:
@@ -142,8 +146,10 @@ class StorageServer:
         self.node.stream_threshold = self.cfg.stream_threshold
         self.node.stream_frag_bytes = self.cfg.stream_frag_bytes
         self.node.stream_window = self.cfg.stream_window
+        configure_tracing(self.cfg.trace)
 
     async def start(self) -> None:
+        configure_tracing(self.cfg.trace)
         if self.cfg.aio_read:
             from t3fs.storage.aio import AioReadWorker
             if AioReadWorker.available():

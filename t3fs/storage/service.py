@@ -44,6 +44,7 @@ from t3fs.analytics.trace_log import StorageEventTrace
 from t3fs.utils.fault_injection import fault_raise
 from t3fs.utils.metrics import CountRecorder, LatencyRecorder
 from t3fs.utils.status import Status, StatusCode, StatusError, make_error
+from t3fs.utils import tracing
 from t3fs.utils.tracing import add_event as trace_add
 
 log = logging.getLogger("t3fs.storage")
@@ -346,8 +347,11 @@ class StorageService:
                              conn: Connection, require_head: bool) -> IOResult:
         """Trace-wrapped update: one StorageEventTrace row per update hop
         (reference: StorageOperator writes a StorageEventTrace per update,
-        StorageOperator.cc:356-361,399,461-462,509)."""
-        if self.node.trace_log is None:
+        StorageOperator.cc:356-361,399,461-462,509).  When a distributed
+        span is active (sampled request), the same trace dict also tags the
+        hop's server span with the apply/forward decomposition."""
+        sp = tracing.current_span()
+        if self.node.trace_log is None and sp is None:
             return await self._handle_update_inner(io, payload, conn, require_head)
         t0 = _time.perf_counter()
         result: IOResult | None = None
@@ -357,7 +361,22 @@ class StorageService:
                                                      require_head, trace)
             return result
         finally:
-            self.node.trace_log.append(StorageEventTrace(
+            if sp is not None:
+                for k in ("target_id", "apply_s", "forward_s",
+                          "forward_status"):
+                    if k in trace:
+                        sp.set_tag(k, trace[k])
+                sp.set_tag("chunk", str(io.chunk_id))
+                sp.set_tag("update_ver", io.update_ver)
+                sp.set_tag("head", require_head)
+                if result is not None and result.status.code:
+                    sp.set_status(result.status.code)
+            if self.node.trace_log is not None:
+                self._append_event_trace(io, trace, result, t0)
+
+    def _append_event_trace(self, io: UpdateIO, trace: dict,
+                            result: IOResult | None, t0: float) -> None:
+        self.node.trace_log.append(StorageEventTrace(
                 ts=_time.time(), node_id=self.node.node_id,
                 target_id=trace.get("target_id", 0),
                 chain_id=io.chain_id, chunk_id=str(io.chunk_id),

@@ -98,7 +98,10 @@ class CallbackGauge(Recorder):
             v = float(self._fn())
         except Exception:
             log.exception("callback gauge %s failed", self.name)
-            v = 0.0
+            # a failed pull is NOT a zero: flag it so reporters skip the
+            # row instead of recording a fake measurement
+            return {"name": self.name, "type": "value", "value": 0.0,
+                    "error": True, **self.tags}
         return {"name": self.name, "type": "value", "value": v, **self.tags}
 
 
@@ -214,5 +217,7 @@ class Collector:
 
 def log_reporter(snapshot: list[dict]) -> None:
     for row in snapshot:
+        if row.get("error"):
+            continue   # failed callback pull, not a measurement
         if row.get("value") or row.get("count"):
             log.info("%s", json.dumps(row, default=str))

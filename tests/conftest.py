@@ -55,3 +55,51 @@ if RACE_AUDIT:
 
         with race_audit() as auditor:
             yield auditor
+
+
+# --- per-test wall-clock watchdog -----------------------------------------
+# One wedged test must cost ITS OWN failure, not the whole run: the suite
+# ships under an overall `timeout -k 10 870` (ROADMAP tier-1), and a single
+# lost-wakeup hang in a cluster test otherwise eats every remaining test's
+# budget.  SIGALRM interrupts the main thread wherever it is (asyncio's
+# select included); T3FS_TEST_TIMEOUT_S=0 disables.
+
+import signal  # noqa: E402
+import threading  # noqa: E402
+
+TEST_TIMEOUT_S = int(os.environ.get("T3FS_TEST_TIMEOUT_S", "240"))
+
+
+class TestWallclockTimeout(BaseException):
+    """Raised by the watchdog.  BaseException, NOT Exception: hung tests
+    often sit under broad `except Exception` recovery loops (mid-kill
+    writers and the like), which must not swallow the abort."""
+
+
+if TEST_TIMEOUT_S > 0 and hasattr(signal, "SIGALRM"):
+    import faulthandler
+    import sys
+
+    import pytest  # noqa: E402,F811
+
+    @pytest.hookimpl(wrapper=True)
+    def pytest_runtest_call(item):
+        if threading.current_thread() is not threading.main_thread():
+            return (yield)
+
+        def _on_alarm(signum, frame):
+            faulthandler.dump_traceback(file=sys.stderr)
+            # re-arm before raising: event-loop teardown after the abort
+            # (asyncio.run cancelling tasks, fixture finalizers) can wedge
+            # on the same condition the test did
+            signal.alarm(60)
+            raise TestWallclockTimeout(
+                f"{item.nodeid}: exceeded {TEST_TIMEOUT_S}s wall clock")
+
+        old = signal.signal(signal.SIGALRM, _on_alarm)
+        signal.alarm(TEST_TIMEOUT_S)
+        try:
+            return (yield)
+        finally:
+            signal.alarm(0)
+            signal.signal(signal.SIGALRM, old)

@@ -84,3 +84,15 @@ def test_ckpt_bench_save_restore_degraded():
     assert res["restore_MB_s"] > 0 and len(res["restore_runs"]) == 3
     assert res["degraded_restore_MB_s"] > 0
     assert res["stripes"] > 0 and res["bytes"] == 2 << 20
+
+
+def test_storage_bench_trace_ab():
+    from benchmarks.storage_bench import trace_ab
+
+    res = trace_ab(value_size=65536, num_ops=6)
+    for label in ("off", "rate_0.01", "rate_1.0"):
+        assert res[label]["ok"] == 6 and res[label]["errors"] == 0
+    assert res["rate_1.0"]["p50_vs_off"] > 0
+    # the bench must leave the process untraced
+    from t3fs.utils.tracing import get_config
+    assert get_config().sample_rate == 0.0

@@ -357,3 +357,55 @@ def test_collector_service_forwards_to_clickhouse():
             ch.close()
             await fake.stop()
     asyncio.run(body())
+
+
+def test_metrics_db_retention_max_rows_and_age():
+    db = MetricsDB(max_rows=4)
+    for i in range(10):
+        db.insert(1, "storage", float(i),
+                  [{"name": "m", "type": "value", "value": i}])
+    rows = db.query("m")
+    assert len(rows) == 4
+    # oldest-first pruning kept the newest samples
+    assert sorted(r["value"] for r in rows) == [6, 7, 8, 9]
+    db.close()
+
+    db = MetricsDB(max_age_s=3600.0)
+    db.insert(1, "storage", time.time() - 7200,
+              [{"name": "old", "type": "value", "value": 1}])
+    db.insert(1, "storage", time.time(),
+              [{"name": "new", "type": "value", "value": 2}])
+    # the stale row is swept by the insert-time prune
+    assert db.query("old") == []
+    assert len(db.query("new")) == 1
+    db.close()
+
+
+def test_callback_gauge_error_flagged_and_skipped(caplog):
+    def boom():
+        raise RuntimeError("source gone")
+
+    g = M.CallbackGauge("depth", boom)
+    row = g.collect()
+    # a failed pull is NOT a zero measurement: flagged so sinks skip it
+    assert row["error"] is True and row["value"] == 0.0
+
+    ok = M.CallbackGauge("depth", lambda: 3.0).collect()
+    assert "error" not in ok
+
+    # log_reporter drops the flagged row, keeps the real one
+    import logging
+    caplog.clear()
+    with caplog.at_level(logging.INFO, logger="t3fs.metrics"):
+        M.log_reporter([row, ok])
+    logged = [r for r in caplog.records if "depth" in r.getMessage()]
+    assert len(logged) == 1 and '"value": 3.0' in logged[0].getMessage()
+
+    # MonitorReporter's queue filter: the error row never enqueues
+    rep = MonitorReporter("127.0.0.1:1")   # never connected; queue only
+    try:
+        rep([row, ok])
+        snap = rep._q.get_nowait()
+        assert snap == [ok]
+    finally:
+        rep.close()

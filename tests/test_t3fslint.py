@@ -249,6 +249,54 @@ def test_bare_create_task_in_handler_positive_and_negative():
         neg_no_helper, rules)
 
 
+def test_span_not_closed_positive_and_negative():
+    rules = {"span-not-closed"}
+    pos_bare_ctor = """
+        from t3fs.utils.tracing import Span
+        def f():
+            sp = Span(trace_id=1, span_id=2, parent_id=0, name="x")
+            return sp
+    """
+    pos_unfinished = """
+        from t3fs.utils import tracing
+        async def f(io):
+            sp = tracing.start_span("leg")
+            await io()
+    """
+    neg_finished = """
+        from t3fs.utils import tracing
+        async def f(io):
+            sp = tracing.start_span("leg")
+            try:
+                await io()
+            finally:
+                sp.finish()
+    """
+    neg_scope = """
+        from t3fs.utils import tracing
+        async def f(io):
+            with tracing.span("leg"):
+                await io()
+    """
+    assert "span-not-closed" in _rules_fired(pos_bare_ctor, rules)
+    assert "span-not-closed" in _rules_fired(pos_unfinished, rules)
+    assert "span-not-closed" not in _rules_fired(neg_finished, rules)
+    assert "span-not-closed" not in _rules_fired(neg_scope, rules)
+
+
+def test_span_not_closed_pragma_marks_handoff():
+    # handing the span to another function to finish is the pragma path
+    src = """
+        from t3fs.utils import tracing
+        def f(ledger):
+            # t3fslint: allow(span-not-closed) — finished by ledger.close
+            sp = tracing.start_span("leg")
+            ledger.attach(sp)
+    """
+    findings, suppressed = _lint(src, {"span-not-closed"})
+    assert not findings and suppressed == 1
+
+
 # ---- suppression: pragmas ----
 
 def test_pragma_same_line_suppresses():
