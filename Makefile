@@ -19,7 +19,7 @@ SAN_FILTER := -k "not device"
 
 .PHONY: test lint sanitize sanitize-thread sanitize-address probe \
         on-device ci ckpt-bench write-bench read-bench \
-        kvcache-fleet-bench repair-drill
+        kvcache-fleet-bench repair-drill usrbio-bench
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -54,6 +54,14 @@ read-bench:
 kvcache-fleet-bench:
 	JAX_PLATFORMS=cpu $(PY) -m benchmarks.kvcache_fleet_bench \
 		--procs 4 --sessions 256 --turns 2 --json
+
+# Ring-vs-rpc data plane A/B (ISSUE 12): 4 KiB random reads at qd64
+# through the USRBIO shm ring, rpc batch path vs the registered-arena
+# ring data plane; median-of-3 trials per plane, one JSON blob
+# (acceptance: ring >= 2x rpc IOPS; see BENCH_e2e.json pr12_*).
+usrbio-bench:
+	JAX_PLATFORMS=cpu $(PY) -m benchmarks.usrbio_bench --data-plane-ab \
+		--block-size 4096 --depth 64 --seconds 5 --json
 
 # Repair drill (ISSUE 9): kill one node under live first-k read traffic,
 # A/B full-k vs reduced-read (LRC sub-shard) rebuild on identical damage,

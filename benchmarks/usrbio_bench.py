@@ -4,17 +4,20 @@ Reference analog: benchmarks/fio_usrbio/ — the fio external ioengine over
 the hf3fs USRBIO C API, used to benchmark the KVCache-style random-read
 path (README.md:45-48: peak ~40 GiB/s aggregate).  Here the app side preps
 4 KiB random reads into the shared ring with a bounded queue depth and
-measures completion IOPS while the daemon-side RingWorker drains through
-the StorageClient batch path.
+measures completion IOPS + per-IO latency while the daemon-side RingWorker
+drains through the StorageClient — via the rpc batch path or the
+registered-arena ring data plane (--data-plane ring, docs/usrbio.md).
 
     python -m benchmarks.usrbio_bench --block-size 4096 --depth 64 \
         --seconds 5 --json
+    python -m benchmarks.usrbio_bench --data-plane-ab --seconds 5 --json
 """
 
 from __future__ import annotations
 
 import argparse
 import asyncio
+import gc
 import json
 import os
 import random
@@ -24,6 +27,7 @@ from t3fs.fuse.ring_worker import RingWorker
 from t3fs.fuse.vfs import FileSystem
 from t3fs.lib import usrbio
 from t3fs.testing.cluster import LocalCluster
+from t3fs.usrbio import SlotAllocator
 
 
 async def run_bench(args) -> dict:
@@ -33,6 +37,9 @@ async def run_bench(args) -> dict:
     suffix = f"bench-{os.getpid()}-{random.getrandbits(24):06x}"
     iov = ring = worker = None
     try:
+        # data plane selection happens BEFORE the RingWorker opens the
+        # ring: the worker builds its lean ring path off storage.cfg
+        cluster.sc.cfg.data_plane = args.data_plane
         fs = FileSystem(cluster.mc, cluster.sc)
         await fs.mkdirs("/bench")
         fh = await fs.create("/bench/data", chunk_size=args.block_size)
@@ -50,6 +57,13 @@ async def run_bench(args) -> dict:
         await worker.start()
 
         rng = random.Random(0)
+        # pre-draw the random offsets: the harness tax inside the timed
+        # loop should be the ring API, not the PRNG (both planes pay the
+        # loop, so any fat here dilutes the A/B contrast)
+        OMASK = (1 << 15) - 1
+        offs = [rng.randrange(file_blocks) * args.block_size
+                for _ in range(OMASK + 1)]
+        oi = 0
         stop_at = time.perf_counter() + args.seconds
         completed = 0
         errors = 0
@@ -57,20 +71,25 @@ async def run_bench(args) -> dict:
         loop = asyncio.get_running_loop()
         inflight = 0
         userdata = 0
-        # explicit free-list of iov slots: deriving the slot from
-        # userdata % depth can hand a still-in-flight IO's slot to a new IO
-        # after out-of-order completions (torn reads)
-        free_slots = list(range(args.depth))
-        slot_of: dict[int, int] = {}
+        # iov slot discipline via the shared allocator (t3fs/usrbio/
+        # slots.py): a slot stays bound to its userdata until THAT IO
+        # completes — deriving it from userdata % depth hands a live IO's
+        # slot to a new one after out-of-order completions (torn reads)
+        alloc = SlotAllocator(args.depth, args.block_size)
+        issued_at: dict[int, float] = {}
+        lat_s: list[float] = []
         while time.perf_counter() < stop_at or inflight:
-            # top up the queue depth
-            while free_slots and time.perf_counter() < stop_at:
-                block = rng.randrange(file_blocks)
-                slot = free_slots.pop()
-                slot_of[userdata] = slot
-                ring.prep_io(True, ident, slot * args.block_size,
-                             args.block_size, block * args.block_size,
+            # top up the queue depth; one clock stamp covers the whole
+            # top-up burst (sub-100us — noise at ms-scale percentiles)
+            now = time.perf_counter()
+            while alloc.available and now < stop_at:
+                slot = alloc.acquire()
+                alloc.bind(userdata, slot)
+                ring.prep_io(True, ident, alloc.offset(slot),
+                             args.block_size, offs[oi & OMASK],
                              userdata=userdata)
+                oi += 1
+                issued_at[userdata] = now
                 userdata += 1
                 inflight += 1
             ring.submit_ios()
@@ -79,21 +98,33 @@ async def run_bench(args) -> dict:
                     max_n=args.depth, min_n=1, timeout_ms=5000))
             if not done:
                 break
+            now = time.perf_counter()
             for c in done:
                 inflight -= 1
                 completed += 1
-                free_slots.append(slot_of.pop(c.userdata))
+                alloc.release_key(c.userdata)
+                lat_s.append(now - issued_at.pop(c.userdata))
                 if c.status != 0:
                     errors += 1
         wall = time.perf_counter() - t0
 
         await fs.close(fh)
+        lat_s.sort()
+
+        def pct(q: float) -> float:
+            if not lat_s:
+                return 0.0
+            return lat_s[min(len(lat_s) - 1, int(q * len(lat_s)))]
+
         return {
+            "data_plane": args.data_plane,
             "block_size": args.block_size, "depth": args.depth,
             "file_size": args.file_size, "wall_s": round(wall, 3),
             "reads": completed, "errors": errors,
             "iops": round(completed / wall, 1),
             "MB_s": round(completed * args.block_size / wall / 1e6, 2),
+            "p50_ms": round(pct(0.50) * 1e3, 3),
+            "p99_ms": round(pct(0.99) * 1e3, 3),
         }
     finally:
         if worker:
@@ -105,6 +136,31 @@ async def run_bench(args) -> dict:
         await cluster.stop()
 
 
+def run_ab(args) -> dict:
+    """Ring-vs-rpc A/B: the same workload on two fresh clusters, one per
+    data plane — each trial in its OWN event loop (asyncio.run cancels
+    run 1's straggler tasks at loop close, so run 2 never pays for them)
+    with a GC barrier between, so neither run rides the other's arena
+    sessions, warmed caches, or heap garbage.  Each plane reports its
+    MEDIAN-IOPS trial (all trial IOPS kept alongside): a single trial is
+    hostage to episodic host noise, and a noise dip landing on either
+    plane distorts the ratio in either direction."""
+    out: dict = {}
+    for plane in ("rpc", "ring"):
+        args.data_plane = plane
+        runs = []
+        for _ in range(max(1, args.trials)):
+            gc.collect()
+            runs.append(asyncio.run(run_bench(args)))
+        runs.sort(key=lambda r: r["iops"])
+        out[plane] = runs[len(runs) // 2]
+        if len(runs) > 1:
+            out[plane]["trial_iops"] = [r["iops"] for r in runs]
+    out["ring_vs_rpc_iops"] = round(
+        out["ring"]["iops"] / max(out["rpc"]["iops"], 1e-9), 2)
+    return out
+
+
 def parse_args(argv=None):
     ap = argparse.ArgumentParser(prog="usrbio_bench")
     ap.add_argument("--nodes", type=int, default=3)
@@ -114,19 +170,37 @@ def parse_args(argv=None):
     ap.add_argument("--file-size", type=int, default=4 << 20)
     ap.add_argument("--depth", type=int, default=64)
     ap.add_argument("--seconds", type=float, default=5.0)
+    ap.add_argument("--data-plane", choices=("rpc", "ring"), default="rpc")
+    ap.add_argument("--data-plane-ab", action="store_true",
+                    help="run BOTH data planes and report the IOPS ratio")
+    ap.add_argument("--trials", type=int, default=3,
+                    help="A/B trials per plane; the median-IOPS trial is "
+                         "reported (only --data-plane-ab uses this)")
     ap.add_argument("--json", action="store_true")
     return ap.parse_args(argv)
 
 
 def main(argv=None) -> None:
     args = parse_args(argv)
+    if args.data_plane_ab:
+        result = run_ab(args)
+        if args.json:
+            print(json.dumps(result))
+        else:
+            for plane in ("rpc", "ring"):
+                r = result[plane]
+                print(f"{plane:>4}: {r['iops']} IOPS, p50 {r['p50_ms']} ms, "
+                      f"p99 {r['p99_ms']} ms, errors={r['errors']}")
+            print(f"ring/rpc IOPS: {result['ring_vs_rpc_iops']}x")
+        return
     result = asyncio.run(run_bench(args))
     if args.json:
         print(json.dumps(result))
     else:
-        print(f"randread {result['block_size']} B x depth {result['depth']}: "
-              f"{result['iops']} IOPS, {result['MB_s']} MB/s, "
-              f"errors={result['errors']}")
+        print(f"randread {result['block_size']} B x depth {result['depth']} "
+              f"[{result['data_plane']}]: {result['iops']} IOPS, "
+              f"{result['MB_s']} MB/s, p50 {result['p50_ms']} ms, "
+              f"p99 {result['p99_ms']} ms, errors={result['errors']}")
 
 
 if __name__ == "__main__":

@@ -49,6 +49,14 @@ Rule catalog (failure stories in docs/static_analysis.md):
                               whole trace silently loses a leg.  Use the
                               ``span()``/``start_root()`` scopes, which
                               finish on exit.
+  buffer-release-leak         ``handle, release = ....acquire(...)`` whose
+                              release callable is never referenced again in
+                              the enclosing function — the registered
+                              buffer never returns to the BufferPool, and
+                              a stale one-sided op can land in whoever
+                              reuses the memory.  Call release() in a
+                              finally (discard=True on failure paths) or
+                              hand it to an owner.
 """
 
 from __future__ import annotations
@@ -106,6 +114,7 @@ ALL_RULES = (
     "naked-wait",
     "bare-create-task-in-handler",
     "span-not-closed",
+    "buffer-release-leak",
 )
 DEFAULT_RULES = frozenset(ALL_RULES)
 # benchmarks/ and tests/ run a subset: they legitimately block, hold
@@ -376,6 +385,47 @@ class FileLinter(ast.NodeVisitor):
         self._fn.append((node, True, self._is_rpc_method(node)))
         self.generic_visit(node)
         self._fn.pop()
+
+    # -- buffer-release-leak --
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        if "buffer-release-leak" in self.rules:
+            self._check_buffer_release(node)
+        self.generic_visit(node)
+
+    def _check_buffer_release(self, node: ast.Assign) -> None:
+        """``handle, release = ....acquire(...)`` is the BufferPool
+        protocol (net/rdma.py): the second element is the release
+        callable that returns the registered buffer to its tier.  If the
+        enclosing function never references it again — not called, not
+        stored, not handed to anyone — the buffer leaks out of the pool
+        AND stays registered, so a stale one-sided op can land in
+        whatever reuses that memory.  Awaited acquires (channel/semaphore
+        protocols) and scalar acquires (SlotAllocator) don't match."""
+        v = node.value
+        if not (isinstance(v, ast.Call) and _call_attr_name(v) == "acquire"):
+            return
+        if len(node.targets) != 1:
+            return
+        t = node.targets[0]
+        if not (isinstance(t, ast.Tuple) and len(t.elts) == 2
+                and all(isinstance(e, ast.Name) for e in t.elts)):
+            return
+        rel = t.elts[1].id
+        fn_node = self._fn[-1][0] if self._fn else None
+        if fn_node is None:
+            return
+        for n in ast.walk(fn_node):
+            if isinstance(n, ast.Name) and n.id == rel \
+                    and isinstance(n.ctx, ast.Load):
+                return    # called, stored, or handed to an owner
+        self._emit(
+            node, "buffer-release-leak",
+            f"release callable `{rel}` from acquire() is never used in "
+            "this function: the registered buffer never returns to the "
+            "pool, and a stale one-sided op can land in whoever reuses "
+            "the memory — release() in a finally (discard=True on "
+            "failure paths), or pass it to an owner")
 
     # -- task-leak + bare-create-task-in-handler --
 

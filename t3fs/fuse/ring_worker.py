@@ -18,6 +18,7 @@ import threading
 from t3fs.client.meta_client import MetaClient
 from t3fs.client.storage_client import StorageClient
 from t3fs.lib.usrbio import Completion, CSqe, IoRing, IoVec, OP_READ
+from t3fs.usrbio.ring_client import RingArena, RingClient
 from t3fs.utils.aio import reap_task
 from t3fs.utils.status import StatusCode, StatusError
 
@@ -35,6 +36,19 @@ class RingWorker:
         self.iov = IoVec(self.ring.iov_name, create=False)
         self.meta = meta
         self.storage = storage
+        # ring-native lean path (data_plane=ring): the APP's iov is the
+        # registered arena — storage nodes write read payloads straight
+        # into it (shm alias or one-sided), SQEs pack from the CSqes with
+        # no per-IO ReadIO/IOResult objects, end-to-end zero-copy
+        self._ring_plane: RingClient | None = None
+        if getattr(storage.cfg, "data_plane", "rpc") == "ring":
+            try:
+                self._ring_plane = RingClient(
+                    storage,
+                    arena=RingArena.wrap_iov(storage.buf_registry,
+                                             self.iov))
+            except Exception:
+                self._ring_plane = None    # rpc drain path below
         self._layouts: dict[int, object] = {}        # ident -> FileLayout
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
@@ -57,16 +71,30 @@ class RingWorker:
         self._thread.start()
 
     def _pump(self) -> None:
-        """Blocking sqe drain on a plain thread; hops to the loop queue."""
+        """Blocking sqe drain on a plain thread; hops to the loop queue
+        in BURSTS: one batched native pop (one blocking wait, then the
+        whole submitted wave drains without further syscalls) and a
+        single call_soon_threadsafe per wave — not one of each per sqe.
+        The drainer then coalesces whole waves into one storage batch."""
         while not self._stop.is_set():
-            sqe = self.ring.pop_sqe(timeout_ms=100)
-            if sqe is None:
+            burst = self.ring.pop_sqes(max_n=MAX_INFLIGHT, timeout_ms=100)
+            if not burst:
                 continue
-            self._loop.call_soon_threadsafe(self._queue.put_nowait, sqe)
+            self._loop.call_soon_threadsafe(self._put_burst, burst)
+
+    def _put_burst(self, burst: list) -> None:
+        for s in burst:
+            self._queue.put_nowait(s)
 
     def _complete(self, sqe: CSqe, result: int, status: int) -> None:
         self.ring.complete(sqe.userdata, result, status)
         self._sem.release()                  # one permit per sqe
+
+    def _complete_group(self, cqes: list[tuple[int, int, int]]) -> None:
+        # one native call + one cq mutex pass for the whole group
+        self.ring.complete_many(cqes)
+        for _ in cqes:
+            self._sem.release()
 
     def _spawn(self, coro) -> None:
         # the loop only weak-refs tasks: keep a hard reference until done
@@ -121,12 +149,24 @@ class RingWorker:
         done = 0
         try:
             lay = await self._layout(group[0].ident)
+            if self._ring_plane is not None:
+                # lean path: bytes land in the app iov server-side; holes
+                # and errors zero-fill in place (the read_file_ranges
+                # contract) and every sqe completes full-length, status 0
+                lens = await self._ring_plane.read_ranges_into(
+                    lay, [(s.ident, s.file_off, s.len, s.iov_off)
+                          for s in group])
+                self._complete_group([(s.userdata, n, 0)
+                                      for s, n in zip(group, lens)])
+                done = len(group)
+                return
             outs = await self.storage.read_file_ranges(
                 lay, [(s.ident, s.file_off, s.len) for s in group])
             for s, (data, _results) in zip(group, outs):
                 self.iov.write_at(s.iov_off, data)
-                self._complete(s, len(data), 0)
-                done += 1
+            self._complete_group([(s.userdata, len(data), 0)
+                                  for s, (data, _r) in zip(group, outs)])
+            done = len(group)
         except StatusError as e:
             for s in group[done:]:
                 self._complete(s, -1, e.code)
@@ -199,5 +239,9 @@ class RingWorker:
             t.cancel()
         if pending:
             await asyncio.gather(*pending, return_exceptions=True)
+        if self._ring_plane is not None:
+            # detach sessions + deregister the iov BEFORE it unmaps below
+            await self._ring_plane.close()
+            self._ring_plane = None
         self.ring.close()
         self.iov.close(unlink=False)

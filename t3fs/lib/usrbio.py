@@ -60,9 +60,15 @@ def _bind():
     lib.t3fs_ior_submit.argtypes = [C.c_void_p, C.c_uint32]
     lib.t3fs_ior_pop_sqe.restype = C.c_int
     lib.t3fs_ior_pop_sqe.argtypes = [C.c_void_p, C.POINTER(CSqe), C.c_int]
+    lib.t3fs_ior_pop_sqes.restype = C.c_int64
+    lib.t3fs_ior_pop_sqes.argtypes = [C.c_void_p, C.POINTER(CSqe),
+                                      C.c_uint32, C.c_int]
     lib.t3fs_ior_complete.restype = C.c_int
     lib.t3fs_ior_complete.argtypes = [C.c_void_p, C.c_uint64, C.c_int64,
                                       C.c_uint32]
+    lib.t3fs_ior_complete_many.restype = C.c_int64
+    lib.t3fs_ior_complete_many.argtypes = [C.c_void_p, C.POINTER(CCqe),
+                                           C.c_uint32]
     lib.t3fs_ior_wait.restype = C.c_int64
     lib.t3fs_ior_wait.argtypes = [C.c_void_p, C.POINTER(CCqe), C.c_uint32,
                                   C.c_uint32, C.c_int]
@@ -101,6 +107,13 @@ class IoVec:
             raise OSError(f"iov {'create' if create else 'open'} failed: {name}")
         self.buf = (C.c_uint8 * size).from_address(self._base)
         self.view = np.frombuffer(self.buf, dtype=np.uint8)
+
+    @property
+    def addr(self) -> int:
+        """Raw mapping address — valid until close().  The storage node's
+        inline ring reads pread straight to `addr + iov_off` (no per-IO
+        buffer wrapping)."""
+        return self._base or 0
 
     def write_at(self, off: int, data: bytes) -> None:
         self.view[off:off + len(data)] = np.frombuffer(data, dtype=np.uint8)
@@ -178,8 +191,29 @@ class IoRing:
         r = _lib().t3fs_ior_pop_sqe(self._h, C.byref(sqe), timeout_ms)
         return sqe if r == 1 else None
 
+    def pop_sqes(self, max_n: int = 64,
+                 timeout_ms: int = 100) -> list[CSqe]:
+        """Batched pop: one blocking wait for the first sqe, then drain
+        the rest of the burst without further syscalls — one library
+        call per submission wave instead of one per sqe."""
+        arr = (CSqe * max_n)()
+        got = _lib().t3fs_ior_pop_sqes(self._h, arr, max_n, timeout_ms)
+        return [arr[i] for i in range(got)] if got > 0 else []
+
     def complete(self, userdata: int, result: int, status: int = 0) -> None:
         _lib().t3fs_ior_complete(self._h, userdata, result, status)
+
+    def complete_many(self,
+                      cqes: list[tuple[int, int, int]]) -> None:
+        """Batched complete: (userdata, result, status) triples pushed
+        under one cq mutex acquisition, one library call per wave."""
+        n = len(cqes)
+        if not n:
+            return
+        arr = (CCqe * n)()
+        for i, (u, res, st) in enumerate(cqes):
+            arr[i].userdata, arr[i].result, arr[i].status = u, res, st
+        _lib().t3fs_ior_complete_many(self._h, arr, n)
 
     def close(self) -> None:
         if self._h:

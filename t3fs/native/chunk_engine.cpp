@@ -301,6 +301,38 @@ class Engine {
     return 1;
   }
 
+  // One-call hot read for the ring data plane: meta snapshot + pread +
+  // optional full-chunk CRC verify under a SINGLE shared-lock
+  // acquisition, landing bytes straight in a caller-provided buffer
+  // (the registered arena / shm alias).  Because the pread runs under
+  // the lock, the returned meta pairs atomically with the bytes — no
+  // re-check protocol.  want == 0 means "to end of chunk".
+  // Returns 1 = ok, 0 = not found, -1 = io error, -2 = crc mismatch.
+  int read_into(const Cid& cid, uint64_t off, uint64_t want, uint8_t* out,
+                uint64_t cap, int verify, uint64_t* out_len, Meta* meta) {
+    std::shared_lock lk(mu_);
+    auto it = index_.find(cid);
+    if (it == index_.end()) return 0;
+    const Slot& s = it->second;
+    *meta = s.meta;
+    uint64_t w = want ? want : (off < s.meta.length ? s.meta.length - off : 0);
+    uint64_t n = off < s.meta.length
+                     ? std::min(w, s.meta.length - off) : 0;
+    n = std::min(n, cap);
+    *out_len = n;
+    if (n == 0) return 1;
+    uint64_t bs = 1ull << s.size_class_log2;
+    int fd = classes_.at(s.size_class_log2).fd;
+    if (::pread(fd, out, n, s.block * bs + off) != static_cast<ssize_t>(n)) {
+      g_error = std::string("pread: ") + strerror(errno);
+      return -1;
+    }
+    if (verify && off == 0 && n == s.meta.length &&
+        crc32c(out, n, 0) != s.meta.checksum)
+      return -2;
+    return 1;
+  }
+
   // Lock-free-read descriptor: where the chunk's bytes live RIGHT NOW.
   // Callers pread(fd, abs_off, n) outside any engine lock, then re-check
   // get_meta: updates are COW (a put moves the chunk to a fresh block and
@@ -796,6 +828,17 @@ int t3fs_ce_read(void* h, const uint8_t* cid, uint64_t off, uint64_t len,
                  uint8_t* out, uint64_t* out_len) {
   if (ce_null(h)) return -1;
   return static_cast<Engine*>(h)->read(to_cid(cid), off, len, out, out_len);
+}
+
+int t3fs_ce_read_into(void* h, const uint8_t* cid, uint64_t off,
+                      uint64_t want, uint8_t* out, uint64_t cap, int verify,
+                      uint64_t* out_len, CeMeta* meta) {
+  if (ce_null(h)) return -1;
+  Meta m;
+  int r = static_cast<Engine*>(h)->read_into(to_cid(cid), off, want, out,
+                                             cap, verify, out_len, &m);
+  if (r == 1 || r == -2) memcpy(meta, &m, sizeof m);
+  return r;
 }
 
 int t3fs_ce_locate(void* h, const uint8_t* cid, uint64_t off, uint64_t want,

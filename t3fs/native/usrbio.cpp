@@ -56,8 +56,8 @@ struct RingHdr {
   std::atomic<uint64_t> cq_head, cq_tail;
   pthread_mutex_t sq_mu;      // pshared, guards multi-threaded producers
   pthread_mutex_t cq_mu;      // pshared, guards multi-worker completions
-  sem_t sq_sem;               // pshared: posted per submitted sqe
-  sem_t cq_sem;               // pshared: posted per completion
+  sem_t sq_sem;               // pshared doorbell: >=1 post per submit burst
+  sem_t cq_sem;               // pshared doorbell: >=1 post per cqe burst
 };
 
 struct Ring {
@@ -243,26 +243,65 @@ int64_t t3fs_ior_prep(void* ring, uint32_t op, uint64_t ident,
   return static_cast<int64_t>(tail);
 }
 
-// App side: wake the daemon for n new sqes (reference hf3fs_submit_ios).
+// App side: wake the daemon (reference hf3fs_submit_ios).  The semaphore is
+// a DOORBELL, not a count: one post covers the whole burst (one futex wake
+// per wave instead of one per sqe) — consumers drain by head/tail and pass
+// the wakeup on (sem_post) when they leave sqes behind, so nothing strands
+// behind an already-consumed doorbell.
 void t3fs_ior_submit(void* ring, uint32_t n) {
   auto* r = static_cast<Ring*>(ring);
-  for (uint32_t i = 0; i < n; i++) sem_post(&r->hdr->sq_sem);
+  if (n) sem_post(&r->hdr->sq_sem);
 }
 
 // Daemon side: block up to timeout for one sqe; returns 1 on success,
-// 0 on timeout, -1 on error.
+// 0 on timeout, -1 on error.  Drains by head/tail first (doorbell may
+// already be consumed); hands the doorbell on when sqes remain.
 int t3fs_ior_pop_sqe(void* ring, Sqe* out, int timeout_ms) {
   auto* r = static_cast<Ring*>(ring);
   RingHdr* h = r->hdr;
   for (;;) {
+    uint64_t head = h->sq_head.load(std::memory_order_relaxed);
+    if (head != h->sq_tail.load(std::memory_order_acquire)) {
+      *out = r->sqes[head & (h->entries - 1)];
+      h->sq_head.store(head + 1, std::memory_order_release);
+      if (head + 1 != h->sq_tail.load(std::memory_order_acquire))
+        sem_post(&h->sq_sem);  // baton: more sqes behind this one
+      return 1;
+    }
     if (sem_timedwait_ms(&h->sq_sem, timeout_ms) != 0)
       return errno == ETIMEDOUT ? 0 : -1;
-    uint64_t head = h->sq_head.load(std::memory_order_relaxed);
-    if (head == h->sq_tail.load(std::memory_order_acquire))
-      continue;  // spurious (shouldn't happen: sem counts sqes)
-    *out = r->sqes[head & (h->entries - 1)];
-    h->sq_head.store(head + 1, std::memory_order_release);
-    return 1;
+    // doorbell consumed: loop back and drain whatever is visible (a stale
+    // doorbell for sqes already taken just reads as an empty ring here)
+  }
+}
+
+// Daemon side: batched pop — drain whatever is visible (no semaphore ops at
+// all when sqes are already waiting), else ONE blocking wait for the next
+// burst's doorbell.  One library call AND at most one futex op per
+// submission burst instead of one per sqe.  Returns count (0 on timeout,
+// -1 on error).
+int64_t t3fs_ior_pop_sqes(void* ring, Sqe* out, uint32_t max_n,
+                          int timeout_ms) {
+  auto* r = static_cast<Ring*>(ring);
+  RingHdr* h = r->hdr;
+  for (;;) {
+    uint32_t got = 0;
+    while (got < max_n) {
+      uint64_t head = h->sq_head.load(std::memory_order_relaxed);
+      if (head == h->sq_tail.load(std::memory_order_acquire)) break;
+      out[got++] = r->sqes[head & (h->entries - 1)];
+      h->sq_head.store(head + 1, std::memory_order_release);
+    }
+    if (got) {
+      // hit max_n with sqes still queued: pass the doorbell on so the
+      // next pop (or another worker) wakes without a fresh submit
+      if (h->sq_head.load(std::memory_order_relaxed) !=
+          h->sq_tail.load(std::memory_order_acquire))
+        sem_post(&h->sq_sem);
+      return got;
+    }
+    if (sem_timedwait_ms(&h->sq_sem, timeout_ms) != 0)
+      return errno == ETIMEDOUT ? 0 : -1;
   }
 }
 
@@ -285,21 +324,51 @@ int t3fs_ior_complete(void* ring, uint64_t userdata, int64_t result,
   return 0;
 }
 
+// Daemon side: batched complete — one mutex acquisition, one library call,
+// and ONE doorbell post for a whole wave of cqes (the app drains by
+// head/tail, so it doesn't need a token per cqe).  Returns the number
+// queued (== n unless the cq is full because the app stopped draining).
+int64_t t3fs_ior_complete_many(void* ring, const Cqe* arr, uint32_t n) {
+  auto* r = static_cast<Ring*>(ring);
+  RingHdr* h = r->hdr;
+  pthread_mutex_lock(&h->cq_mu);
+  uint32_t put = 0;
+  for (; put < n; put++) {
+    uint64_t tail = h->cq_tail.load(std::memory_order_relaxed);
+    if (tail - h->cq_head.load(std::memory_order_acquire) >= h->entries)
+      break;
+    r->cqes[tail & (h->entries - 1)] = arr[put];
+    h->cq_tail.store(tail + 1, std::memory_order_release);
+  }
+  pthread_mutex_unlock(&h->cq_mu);
+  if (put) sem_post(&h->cq_sem);
+  return put;
+}
+
 // App side: wait for >= min_n completions (reference hf3fs_wait_for_ios);
 // drains up to max_n into out.  Returns count (possibly 0 on timeout).
+// Drain-first by head/tail: cqes already landed cost zero semaphore ops;
+// the semaphore only breaks ties when the ring looks empty.  Hands the
+// doorbell on when cqes remain past max_n (another waiter may need it).
 int64_t t3fs_ior_wait(void* ring, Cqe* out, uint32_t max_n, uint32_t min_n,
                       int timeout_ms) {
   auto* r = static_cast<Ring*>(ring);
   RingHdr* h = r->hdr;
   uint32_t got = 0;
-  while (got < max_n) {
-    int rc = sem_timedwait_ms(&h->cq_sem, got < min_n ? timeout_ms : 0);
-    if (rc != 0) break;
-    uint64_t head = h->cq_head.load(std::memory_order_relaxed);
-    if (head == h->cq_tail.load(std::memory_order_acquire)) break;
-    out[got++] = r->cqes[head & (h->entries - 1)];
-    h->cq_head.store(head + 1, std::memory_order_release);
+  for (;;) {
+    while (got < max_n) {
+      uint64_t head = h->cq_head.load(std::memory_order_relaxed);
+      if (head == h->cq_tail.load(std::memory_order_acquire)) break;
+      out[got++] = r->cqes[head & (h->entries - 1)];
+      h->cq_head.store(head + 1, std::memory_order_release);
+    }
+    if (got >= min_n || got >= max_n) break;
+    if (sem_timedwait_ms(&h->cq_sem, timeout_ms) != 0) break;
+    // doorbell consumed (possibly stale): loop back and drain by head/tail
   }
+  if (got && h->cq_head.load(std::memory_order_relaxed) !=
+                 h->cq_tail.load(std::memory_order_acquire))
+    sem_post(&h->cq_sem);  // baton for the cqes we left behind
   return got;
 }
 

@@ -92,7 +92,11 @@ class Client:
         return n
 
     async def call(self, address: str, method: str, body: object = None,
-                   payload: bytes = b"", timeout: float = 30.0) -> tuple[object, bytes]:
+                   payload: bytes = b"", timeout: float = 30.0,
+                   stats_method: str | None = None) -> tuple[object, bytes]:
+        # stats_method: name reported to READ_STATS when it differs from
+        # the wire method — ring write batches share Storage.ring_rw on
+        # the wire but must not feed the adaptive READ latency estimate
         conn = await self._get_conn(address)
         # per-ADDRESS in-flight/latency tracker behind the adaptive read
         # path (READ_STATS keeps latency for read methods only; in-flight
@@ -114,8 +118,8 @@ class Client:
             nbytes = len(result[1])
             return result
         finally:
-            READ_STATS.end(address, method, time.monotonic() - t0, ok,
-                           nbytes)
+            READ_STATS.end(address, stats_method or method,
+                           time.monotonic() - t0, ok, nbytes)
 
     async def post(self, address: str, method: str, body: object = None,
                    payload: bytes = b"") -> None:

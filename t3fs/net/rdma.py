@@ -42,7 +42,8 @@ class BufferRegistry:
     that peers use to emulate one-sided access."""
 
     def __init__(self):
-        self._bufs: dict[int, bytearray] = {}
+        # bytearray (owned) or writable memoryview (register_external)
+        self._bufs: dict[int, bytearray | memoryview] = {}
         self._ids = itertools.count(1)
 
     def register(self, size_or_data: int | bytes | bytearray) -> RemoteBuf:
@@ -50,6 +51,19 @@ class BufferRegistry:
         buf_id = next(self._ids)
         self._bufs[buf_id] = buf
         return RemoteBuf(buf_id, 0, len(buf))
+
+    def register_external(self, view) -> RemoteBuf:
+        """Register caller-owned memory WITHOUT copying (the ring data
+        plane's arena iovs): one-sided Buf.read/Buf.write and local_view
+        then operate on the caller's buffer in place — the pin-don't-copy
+        registration a verbs backend performs on the same seam."""
+        mv = memoryview(view).cast("B")
+        if mv.readonly:
+            raise make_error(StatusCode.INVALID_ARG,
+                             "register_external needs writable memory")
+        buf_id = next(self._ids)
+        self._bufs[buf_id] = mv
+        return RemoteBuf(buf_id, 0, len(mv))
 
     def deregister(self, handle: RemoteBuf) -> None:
         self._bufs.pop(handle.buf_id, None)

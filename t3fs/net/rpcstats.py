@@ -225,7 +225,7 @@ class ReadStats:
     latency includes the whole chain's replication time and would make
     every head look degraded to a read picker."""
 
-    read_methods = frozenset({"Storage.batch_read"})
+    read_methods = frozenset({"Storage.batch_read", "Storage.ring_rw"})
     tail_quantile = 0.95   # the "p9x" the hedge delay keys off
 
     def __init__(self):

@@ -180,7 +180,10 @@ class ChunkEngine:
             return (self._fd(sc), block * sc + offset, n,
                     self._gens.get(chunk_id.encode(), 0))
 
-    def read(self, chunk_id: ChunkId, offset: int = 0, length: int = -1) -> bytes:
+    def read(self, chunk_id: ChunkId, offset: int = 0, length: int = -1,
+             meta: ChunkMeta | None = None) -> bytes:
+        # meta hint accepted for engine-API parity (native_engine.read);
+        # this engine needs the row under its lock regardless
         with self._lock:
             row = self._get_row(chunk_id)
             if row is None:
@@ -197,6 +200,41 @@ class ChunkEngine:
             # engine preads under its shared lock for the same reason; the
             # reference uses Arc'd chunk handles — engine.rs read safety)
             return os.pread(fd, length, block * sc + offset)
+
+    def read_into(self, chunk_id: ChunkId, offset: int, length: int,
+                  dest=None, verify: bool = False, *,
+                  addr: int = 0, cap: int = 0) -> tuple[int, ChunkMeta]:
+        """One-call hot read into a caller buffer (native_engine.read_into
+        parity): meta + pread + optional full-chunk CRC verify under the
+        engine lock — the meta pairs atomically with the landed bytes.
+        length 0 = to end of chunk; clamps to len(dest).  `addr`/`cap`
+        names a caller-bounds-checked raw destination (the ring arena)."""
+        if dest is None:
+            import ctypes
+            dest = memoryview((ctypes.c_ubyte * cap).from_address(addr))
+        with self._lock:
+            row = self._get_row(chunk_id)
+            if row is None:
+                raise make_error(StatusCode.CHUNK_NOT_FOUND, str(chunk_id))
+            meta, sc, block = self._row_to_meta(row)
+            want = length if length else meta.length - offset
+            n = (max(0, min(want, meta.length - offset, len(dest)))
+                 if offset < meta.length else 0)
+            if n:
+                got = os.preadv(self._fd(sc), [dest[:n]],
+                                block * sc + offset)
+                if got != n:
+                    raise make_error(StatusCode.DISK_ERROR,
+                                     f"{chunk_id}: short read {got}/{n}")
+                if verify and offset == 0 and n == meta.length:
+                    from t3fs.ops.codec import crc32c
+                    actual = crc32c(dest[:n])
+                    if actual != meta.checksum:
+                        raise make_error(
+                            StatusCode.CHECKSUM_MISMATCH,
+                            f"{chunk_id}: stored {meta.checksum:#x}"
+                            f" != read {actual:#x}")
+            return n, meta
 
     def put(self, chunk_id: ChunkId, content: bytes, meta: ChunkMeta,
             chunk_size: int) -> None:

@@ -43,6 +43,11 @@ def _bind():
     lib.t3fs_ce_read.argtypes = [C.c_void_p, C.c_char_p, C.c_uint64,
                                  C.c_uint64, C.c_void_p,
                                  C.POINTER(C.c_uint64)]
+    lib.t3fs_ce_read_into.restype = C.c_int
+    lib.t3fs_ce_read_into.argtypes = [C.c_void_p, C.c_char_p, C.c_uint64,
+                                      C.c_uint64, C.c_void_p, C.c_uint64,
+                                      C.c_int, C.POINTER(C.c_uint64),
+                                      C.POINTER(_CeMeta)]
     lib.t3fs_ce_locate.argtypes = [C.c_void_p, C.c_char_p, C.c_uint64,
                                    C.c_uint64, C.POINTER(C.c_int32),
                                    C.POINTER(C.c_uint64),
@@ -171,8 +176,13 @@ class NativeChunkEngine:
             return None
         return fd.value, abs_off.value, n.value, gen.value
 
-    def read(self, chunk_id: ChunkId, offset: int = 0, length: int = -1) -> bytes:
-        meta = self.get_meta(chunk_id)
+    def read(self, chunk_id: ChunkId, offset: int = 0, length: int = -1,
+             meta: "ChunkMeta | None" = None) -> bytes:
+        # meta: caller-supplied sizing hint (skips one get_meta round
+        # trip); ce_read re-validates existence, and optimistic readers
+        # (ChunkReplica.read) re-check meta after the fetch anyway
+        if meta is None:
+            meta = self.get_meta(chunk_id)
         if meta is None:
             raise make_error(StatusCode.CHUNK_NOT_FOUND, str(chunk_id))
         if length < 0:
@@ -189,6 +199,39 @@ class NativeChunkEngine:
         if r == 0:
             raise make_error(StatusCode.CHUNK_NOT_FOUND, str(chunk_id))
         return buf.raw[: out_len.value]
+
+    def read_into(self, chunk_id: ChunkId, offset: int, length: int,
+                  dest=None, verify: bool = False, *,
+                  addr: int = 0, cap: int = 0) -> tuple[int, ChunkMeta]:
+        """One-call hot read: meta snapshot + pread + optional full-chunk
+        CRC verify under a SINGLE engine lock, landing bytes directly in
+        `dest` (a writable buffer — the ring plane's registered arena).
+        length 0 = to end of chunk; the read clamps to len(dest).
+        Returns (bytes_read, meta); the meta pairs atomically with the
+        bytes (the pread ran under the same lock).  `addr`/`cap` is the
+        no-wrapper variant: a raw destination pointer the CALLER bounds-
+        checked (the ring session's pinned arena), skipping the per-IO
+        memoryview + from_buffer dance."""
+        cm = _CeMeta()
+        out_len = C.c_uint64()
+        if addr:
+            buf, nbytes = C.c_void_p(addr), cap
+        else:
+            mv = dest if isinstance(dest, memoryview) else memoryview(dest)
+            buf, nbytes = (C.c_ubyte * mv.nbytes).from_buffer(mv), mv.nbytes
+        r = self._lib.t3fs_ce_read_into(
+            self._handle(), chunk_id.encode(), offset, length, buf,
+            nbytes, 1 if verify else 0, C.byref(out_len), C.byref(cm))
+        if r == 0:
+            raise make_error(StatusCode.CHUNK_NOT_FOUND, str(chunk_id))
+        if r == -2:
+            meta = _meta_from_c(chunk_id, cm)
+            raise make_error(
+                StatusCode.CHECKSUM_MISMATCH,
+                f"{chunk_id}: stored {meta.checksum:#x} != read bytes")
+        if r < 0:
+            raise self._io_error("read_into")
+        return out_len.value, _meta_from_c(chunk_id, cm)
 
     def put(self, chunk_id: ChunkId, content: bytes, meta: ChunkMeta,
             chunk_size: int) -> None:

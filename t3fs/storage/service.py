@@ -15,8 +15,10 @@ replies to the client only after the whole chain committed
 from __future__ import annotations
 
 import asyncio
+import itertools
 import logging
 import time as _time
+from dataclasses import dataclass
 from typing import Callable
 
 from t3fs.mgmtd.types import (
@@ -35,10 +37,13 @@ from t3fs.storage.types import (
     BatchReadReq, BatchReadRsp, ChunkId, IOResult, PACKED_READIO_VER,
     PackedIOReq, PackedIORsp,
     QueryChunkReq, QueryChunkRsp, QueryLastChunkReq, QueryLastChunkRsp,
-    ReadIO, RemoveChunksReq, SpaceInfoRsp, SyncDoneReq, SyncDoneRsp,
-    SyncStartReq, SyncStartRsp, TargetOpReq, TargetOpRsp, TruncateChunkReq,
-    UpdateFragReq, UpdateFragRsp, UpdateIO, UpdateType, WriteReq, WriteRsp,
-    pack_ioresults, unpack_readios, unpack_updateio,
+    RING_F_NO_PAYLOAD, RING_F_UNCOMMITTED, RING_F_VERIFY, RING_OP_READ,
+    ReadIO, RemoveChunksReq, RingAttachReq, RingAttachRsp, RingDetachReq,
+    RingDetachRsp, RingRWReq, RingRWRsp, SpaceInfoRsp, SyncDoneReq,
+    SyncDoneRsp, SyncStartReq, SyncStartRsp, TargetOpReq, TargetOpRsp,
+    TruncateChunkReq, UpdateFragReq, UpdateFragRsp, UpdateIO, UpdateType,
+    WriteReq, WriteRsp,
+    pack_ioresults, unpack_readios, unpack_ring_sqes, unpack_updateio,
 )
 from t3fs.analytics.trace_log import StorageEventTrace
 from t3fs.utils.fault_injection import fault_raise
@@ -109,6 +114,17 @@ class StorageTarget:
         self.engine.close()
 
 
+@dataclass
+class _RingSession:
+    """One attached ring client (t3fs/usrbio RingClient): its registered
+    arena handle for one-sided delivery, plus — same host — the arena's
+    shm segment aliased by name so payloads move by plain memcpy."""
+    ring_id: int
+    client_id: str
+    buf: object          # RemoteBuf handle into the client's registry
+    shm: object | None = None   # IoVec alias of the arena, if same-host
+
+
 class StorageNode:
     """Hosts targets + the Storage RPC service on one node."""
 
@@ -161,6 +177,10 @@ class StorageNode:
         # lease tracker by StorageServer; True = this node's mgmtd lease
         # lapsed, refuse writes (reference: suicide.cc at lease/2)
         self.fence: Callable[[], bool] | None = None
+        # ring data plane sessions (Storage.ring_attach); sessions die
+        # with the node — clients re-attach on NOT_FOUND
+        self.ring_sessions: dict[int, _RingSession] = {}
+        self._ring_ids = itertools.count(1)
 
     def fenced(self) -> bool:
         return self.fence is not None and self.fence()
@@ -645,6 +665,40 @@ class StorageService:
 
     # ---- read path ----
 
+    async def _read_one(self, io: ReadIO) -> tuple[IOResult, bytes]:
+        """One chunk read to completion (shared by batch_read and ring_rw):
+        chain check, then inline / io_uring / thread-pool engine read.
+        Raises StatusError; payload delivery is the caller's business."""
+        node = self.node
+        node.read_count.add()
+        # io.chain_ver = 0 keeps CRAQ read-any semantics; a
+        # client that stamps its routing version is fenced off a
+        # node with a diverged view (incl. a self-fenced deposed
+        # head whose stale routing no longer matches fresh
+        # clients') — advisor r3 on the relaxed read guarantee
+        chain, target = node._check_chain(io.chain_id, io.chain_ver)
+        # small IOs run inline: the thread hop costs more than the
+        # read itself (KVCache-style 4-64 KiB random reads); large
+        # reads hop to a worker so they can't stall the event loop
+        meta_hint = None
+        length_hint = io.length
+        if not length_hint:
+            meta_hint = target.engine.get_meta(io.chunk_id)
+            length_hint = meta_hint.length if meta_hint else 0
+        if length_hint <= SMALL_READ_INLINE_BYTES:
+            result, data = target.replica.read(io, meta_hint)
+        elif node.aio is not None:
+            # io_uring path: disk read runs in the kernel, no
+            # thread hop, no engine lock held across the IO
+            async with node._read_sem:
+                result, data = await target.replica.read_aio(
+                    io, node.aio, meta_hint)
+        else:
+            async with node._read_sem:
+                result, data = await asyncio.to_thread(
+                    target.replica.read, io, meta_hint)
+        return result, data
+
     @rpc_method
     async def batch_read(self, req: BatchReadReq, payload: bytes, conn: Connection):
         """Reads go to ANY serving target (CRAQ read-any).
@@ -665,34 +719,8 @@ class StorageService:
                if req.packed_ios else req.ios)
 
         async def one(io: ReadIO) -> tuple[IOResult, bytes | None]:
-            node.read_count.add()
             try:
-                # io.chain_ver = 0 keeps CRAQ read-any semantics; a
-                # client that stamps its routing version is fenced off a
-                # node with a diverged view (incl. a self-fenced deposed
-                # head whose stale routing no longer matches fresh
-                # clients') — advisor r3 on the relaxed read guarantee
-                chain, target = node._check_chain(io.chain_id, io.chain_ver)
-                # small IOs run inline: the thread hop costs more than the
-                # read itself (KVCache-style 4-64 KiB random reads); large
-                # reads hop to a worker so they can't stall the event loop
-                meta_hint = None
-                length_hint = io.length
-                if not length_hint:
-                    meta_hint = target.engine.get_meta(io.chunk_id)
-                    length_hint = meta_hint.length if meta_hint else 0
-                if length_hint <= SMALL_READ_INLINE_BYTES:
-                    result, data = target.replica.read(io, meta_hint)
-                elif node.aio is not None:
-                    # io_uring path: disk read runs in the kernel, no
-                    # thread hop, no engine lock held across the IO
-                    async with node._read_sem:
-                        result, data = await target.replica.read_aio(
-                            io, node.aio, meta_hint)
-                else:
-                    async with node._read_sem:
-                        result, data = await asyncio.to_thread(
-                            target.replica.read, io, meta_hint)
+                result, data = await self._read_one(io)
                 if io.no_payload:
                     return result, b""   # verify-only: status travels, bytes don't
                 if io.buf is not None:
@@ -715,6 +743,162 @@ class StorageService:
                                      packed_ver=PACKED_READIO_VER),
                         b"".join(inline_parts))
         return BatchReadRsp(results=results), b"".join(inline_parts)
+
+    # ---- ring data plane (t3fs/usrbio RingClient; ROADMAP item 2) ----
+
+    @rpc_method
+    async def ring_attach(self, req: RingAttachReq, payload, conn):
+        """Register a client arena for ring IO.  If the client names an
+        shm segment and we can open it (same host), payloads move by
+        memcpy through the alias; otherwise every IO falls back to
+        one-sided Buf ops on the registered handle — same seam, two
+        transports, invisible to the client beyond the `aliased` bit."""
+        node = self.node
+        sess = _RingSession(ring_id=next(node._ring_ids),
+                            client_id=req.client_id, buf=req.buf)
+        if req.shm_name:
+            try:
+                from t3fs.lib.usrbio import IoVec
+                shm = IoVec(req.shm_name, create=False)
+                if shm.size >= req.shm_size:
+                    sess.shm = shm
+                else:       # stale segment from a recycled name
+                    shm.close(unlink=False)
+            except Exception:
+                pass        # different host / no native lib: one-sided
+        node.ring_sessions[sess.ring_id] = sess
+        return RingAttachRsp(ring_id=sess.ring_id,
+                             aliased=sess.shm is not None), b""
+
+    @rpc_method
+    async def ring_detach(self, req: RingDetachReq, payload, conn):
+        sess = self.node.ring_sessions.pop(req.ring_id, None)
+        if sess is not None and sess.shm is not None:
+            sess.shm.close(unlink=False)    # the client owns the segment
+        return RingDetachRsp(), b""
+
+    @rpc_method
+    async def ring_rw(self, req: RingRWReq, payload, conn):
+        """One submission batch: a packed SQE array in, a packed CQE
+        array out.  No per-IO request objects, no response payload frame
+        — read bytes land in the client's arena (shm alias or one-sided
+        write) before the CQE reports them, write bytes are pulled from
+        it.  Per-IO failures are CQE statuses; an unknown ring_id is an
+        RPC-level NOT_FOUND so the client re-attaches after our restart."""
+        node = self.node
+        sess = node.ring_sessions.get(req.ring_id)
+        if sess is None:
+            raise make_error(StatusCode.NOT_FOUND,
+                             f"ring {req.ring_id} not attached")
+        if node.read_delay_s:
+            await asyncio.sleep(node.read_delay_s)   # injected straggler
+        if node._read_sem is None:
+            node._read_sem = asyncio.Semaphore(node.read_concurrency)
+        # aliased small reads complete SYNCHRONOUSLY right here — no
+        # per-IO coroutine, no scheduler round trip; only IOs that must
+        # await (writes, large/one-sided reads) pay for a task
+        results: list[IOResult | None] = []
+        slow: list = []
+        for rec in unpack_ring_sqes(payload or req.sqes):
+            r = self._ring_read_fast(sess, rec)
+            if r is None:
+                slow.append((len(results),
+                             self._ring_one(sess, rec, req.client_id,
+                                            conn)))
+                results.append(None)
+            else:
+                results.append(r)
+        if slow:
+            done = await asyncio.gather(*(c for _, c in slow))
+            for (pos, _), r in zip(slow, done):
+                results[pos] = r
+        packed = pack_ioresults(results)
+        if packed is not None:
+            # CQEs ride the payload channel: serde sees an empty struct
+            return RingRWRsp(), packed
+        return RingRWRsp(results=results), b""   # error text must survive
+
+    def _ring_read_fast(self, sess: _RingSession,
+                        rec: tuple) -> IOResult | None:
+        """Synchronous completion for the hot shape — an aliased READ at
+        or under the inline threshold (the KVCache/FUSE 4-64 KiB random
+        read): chain check, engine read, memcpy into the client's arena.
+        Returns None when the IO needs the awaitable general path."""
+        (inode, index, chain_id, offset, length, iov_off, aux, _cksum,
+         _chan, _chanseq, chain_ver, op, flags) = rec
+        if (op != RING_OP_READ or sess.shm is None or not length
+                or length > SMALL_READ_INLINE_BYTES
+                or flags & RING_F_NO_PAYLOAD):
+            return None
+        node = self.node
+        node.read_count.add()
+        try:
+            _chain, target = node._check_chain(chain_id, chain_ver)
+            io = ReadIO(ChunkId(inode, index), chain_id, offset, length,
+                        None, bool(flags & RING_F_VERIFY),
+                        bool(flags & RING_F_UNCOMMITTED), False,
+                        chain_ver)
+            if length <= aux and iov_off + length <= sess.shm.size:
+                # true zero-copy: the disk pread lands IN the client's
+                # arena slot — no engine staging buffer, no memcpy out.
+                # Raw pointer, not a wrapped slice, so the bounds check
+                # above is load-bearing: it is the only thing keeping
+                # the pread inside the mapped arena
+                r = target.replica.read_into(
+                    io, addr=sess.shm.addr + iov_off, cap=length)
+                if r is not None:
+                    return r
+            result, data = target.replica.read(io, None)
+            if data:
+                sess.shm.write_at(
+                    iov_off, data[:aux] if len(data) > aux else data)
+            return result
+        except StatusError as e:
+            return IOResult(WireStatus(int(e.code), str(e)))
+
+    async def _ring_one(self, sess: _RingSession, rec: tuple,
+                        client_id: str, conn: Connection) -> IOResult:
+        (inode, index, chain_id, offset, length, iov_off, aux, cksum,
+         chan, chanseq, chain_ver, op, flags) = rec
+        try:
+            if op == RING_OP_READ:
+                io = ReadIO(ChunkId(inode, index), chain_id, offset,
+                            length, None, bool(flags & RING_F_VERIFY),
+                            bool(flags & RING_F_UNCOMMITTED),
+                            bool(flags & RING_F_NO_PAYLOAD), chain_ver)
+                result, data = await self._read_one(io)
+                if not io.no_payload and data:
+                    # aux = the arena slot's capacity: a chunk that grew
+                    # past it is truncated here and the CQE's true length
+                    # tells the client to re-read via the rpc path
+                    n = min(len(data), aux)
+                    if sess.shm is not None:
+                        sess.shm.write_at(iov_off, data[:n])
+                    else:
+                        await remote_write(conn,
+                                           sess.buf.slice(iov_off, n),
+                                           bytes(data[:n]))
+                return result
+            # RING_OP_WRITE: payload staged in the client arena
+            if length:
+                if sess.shm is not None:
+                    payload = sess.shm.read_at(iov_off, length)
+                else:
+                    payload = await remote_read(
+                        conn, sess.buf.slice(iov_off, length))
+            else:
+                payload = b""
+            io = UpdateIO(chunk_id=ChunkId(inode, index),
+                          chain_id=chain_id, chain_ver=chain_ver,
+                          update_type=UpdateType.WRITE, offset=offset,
+                          length=length, chunk_size=aux, checksum=cksum,
+                          channel=chan, channel_seq=chanseq,
+                          client_id=client_id, inline=True)
+            with self.node.write_latency.time():
+                return await self._update_to_result(io, payload, conn,
+                                                    require_head=True)
+        except StatusError as e:
+            return IOResult(WireStatus(int(e.code), str(e)))
 
     # ---- metadata-ish ops ----
 

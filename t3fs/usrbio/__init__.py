@@ -1,0 +1,14 @@
+"""t3fs.usrbio: the ring-native zero-copy data plane (ROADMAP item 2).
+
+The app-side shm rings live in t3fs/lib/usrbio.py; this package is the
+CLIENT side of the storage fabric: `RingClient` registers an arena with
+each storage node at attach time (shm aliasing on the same host,
+one-sided Buf ops across hosts) and moves whole submission batches as
+packed SQE arrays through `Storage.ring_rw` — one envelope, one serde
+pass, N IOs, completions carrying device CRCs.  See docs/usrbio.md.
+"""
+
+from t3fs.usrbio.ring_client import RingArena, RingClient, RingUnsupported
+from t3fs.usrbio.slots import SlotAllocator
+
+__all__ = ["RingArena", "RingClient", "RingUnsupported", "SlotAllocator"]

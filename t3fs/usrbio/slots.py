@@ -1,0 +1,84 @@
+"""Fixed-capacity iov slot allocator shared by the ring data plane.
+
+Both the usrbio bench's app loop and RingClient's staging arena carve a
+flat iov into equal slots and need the same discipline: a slot handed to
+an in-flight IO must never be reissued until that IO completes (deriving
+the slot from `userdata % depth` hands a live IO's slot to a new one
+after out-of-order completions — torn reads).  This is the explicit
+free-list both sides now share, with key binding for the common
+userdata -> slot bookkeeping.
+"""
+
+from __future__ import annotations
+
+from typing import Hashable
+
+
+class SlotAllocator:
+    """Free-list of `count` equal slots of `slot_size` bytes each.
+
+    Slots are plain indices; `offset(slot)` maps to the byte offset in
+    the backing iov.  Double release and release of a never-acquired
+    slot raise — silent corruption of the free list is exactly the bug
+    class this exists to prevent."""
+
+    def __init__(self, count: int, slot_size: int = 1):
+        if count <= 0:
+            raise ValueError(f"slot count must be positive, got {count}")
+        if slot_size <= 0:
+            raise ValueError(f"slot size must be positive, got {slot_size}")
+        self.count = count
+        self.slot_size = slot_size
+        self._free = list(range(count))
+        self._held: set[int] = set()
+        self._bound: dict[Hashable, int] = {}
+
+    @property
+    def available(self) -> int:
+        return len(self._free)
+
+    @property
+    def in_flight(self) -> int:
+        return len(self._held)
+
+    def offset(self, slot: int) -> int:
+        if not 0 <= slot < self.count:
+            raise ValueError(f"slot {slot} outside [0, {self.count})")
+        return slot * self.slot_size
+
+    def try_acquire(self) -> int | None:
+        if not self._free:
+            return None
+        slot = self._free.pop()
+        self._held.add(slot)
+        return slot
+
+    def acquire(self) -> int:
+        slot = self.try_acquire()
+        if slot is None:
+            raise RuntimeError(
+                f"no free slots ({self.count} all in flight)")
+        return slot
+
+    def release(self, slot: int) -> None:
+        if slot not in self._held:
+            raise ValueError(f"slot {slot} is not held (double release?)")
+        self._held.discard(slot)
+        self._free.append(slot)
+
+    # -- key binding: userdata -> slot for completion-driven release --
+
+    def bind(self, key: Hashable, slot: int) -> None:
+        if slot not in self._held:
+            raise ValueError(f"cannot bind free slot {slot}")
+        if key in self._bound:
+            raise ValueError(f"key {key!r} already bound to a slot")
+        self._bound[key] = slot
+
+    def release_key(self, key: Hashable) -> int:
+        """Release the slot bound to `key`; returns the slot index."""
+        slot = self._bound.pop(key, None)
+        if slot is None:
+            raise KeyError(f"key {key!r} is not bound")
+        self.release(slot)
+        return slot

@@ -1,0 +1,186 @@
+"""Unit tests for t3fs/net/rdma.py: RemoteBuf handles, the BufferRegistry
+(including pin-don't-copy register_external), and BufferPool tier
+accounting — the registered-memory seam the ring data plane rides."""
+
+import asyncio
+
+import pytest
+
+from t3fs.net.rdma import BufferPool, BufferRegistry, RemoteBuf
+from t3fs.utils.status import StatusCode, StatusError
+
+
+# ---- RemoteBuf.slice bounds ----
+
+def test_slice_within_bounds_offsets_compose():
+    h = RemoteBuf(7, 100, 50)
+    s = h.slice(10, 20)
+    assert (s.buf_id, s.offset, s.length) == (7, 110, 20)
+    # slicing a slice composes offsets against the SLICE's extent
+    s2 = s.slice(5, 15)
+    assert (s2.offset, s2.length) == (115, 15)
+
+
+@pytest.mark.parametrize("off,length", [
+    (-1, 4),        # negative offset
+    (0, -1),        # negative length
+    (60, 1),        # starts past the end
+    (40, 11),       # runs past the end
+])
+def test_slice_out_of_range_rejected(off, length):
+    h = RemoteBuf(1, 0, 50)
+    with pytest.raises(StatusError) as ei:
+        h.slice(off, length)
+    assert ei.value.code == int(StatusCode.INVALID_ARG)
+
+
+def test_slice_to_exact_end_allowed():
+    h = RemoteBuf(1, 0, 50)
+    s = h.slice(50, 0)
+    assert (s.offset, s.length) == (50, 0)
+
+
+# ---- BufferRegistry ----
+
+def test_register_and_local_view_roundtrip():
+    reg = BufferRegistry()
+    h = reg.register(b"hello world")
+    view = reg.local_view(h.slice(6, 5))
+    assert bytes(view) == b"world"
+    view[:] = b"WORLD"
+    assert bytes(reg.local_view(h)) == b"hello WORLD"
+
+
+def test_deregister_while_sliced_handle_outstanding():
+    """A handle (or any slice of it) minted before deregistration must
+    fail with NOT_FOUND afterwards — not read freed/recycled memory."""
+    reg = BufferRegistry()
+    h = reg.register(64)
+    sliced = h.slice(8, 16)
+    reg.deregister(h)
+    for stale in (h, sliced):
+        with pytest.raises(StatusError) as ei:
+            reg.local_view(stale)
+        assert ei.value.code == int(StatusCode.NOT_FOUND)
+    # deregister is idempotent
+    reg.deregister(h)
+
+
+def test_local_view_region_outside_buffer_rejected():
+    reg = BufferRegistry()
+    h = reg.register(16)
+    bad = RemoteBuf(h.buf_id, 8, 16)   # forged handle past the end
+    with pytest.raises(StatusError) as ei:
+        reg.local_view(bad)
+    assert ei.value.code == int(StatusCode.INVALID_ARG)
+
+
+def test_register_external_is_pin_not_copy():
+    reg = BufferRegistry()
+    arena = bytearray(b"\x00" * 32)
+    h = reg.register_external(arena)
+    # one-sided write lands in the CALLER's buffer, not a copy
+    reg.local_view(h.slice(4, 4))[:] = b"ring"
+    assert bytes(arena[4:8]) == b"ring"
+    # and caller mutations are visible through the registry view
+    arena[0:2] = b"OK"
+    assert bytes(reg.local_view(h.slice(0, 2))) == b"OK"
+
+
+def test_register_external_rejects_readonly():
+    reg = BufferRegistry()
+    with pytest.raises(StatusError) as ei:
+        reg.register_external(b"immutable")
+    assert ei.value.code == int(StatusCode.INVALID_ARG)
+
+
+def test_buf_service_read_write_emulation():
+    """The Buf service methods behind remote_read/remote_write: a peer's
+    one-sided ops against a registered region."""
+    reg = BufferRegistry()
+    h = reg.register(8)
+
+    async def run():
+        await reg.write(h.slice(0, 5), b"12345", None)
+        _, payload = await reg.read(h.slice(2, 3), b"", None)
+        assert bytes(payload) == b"345"
+        with pytest.raises(StatusError):   # payload/region length mismatch
+            await reg.write(h.slice(0, 5), b"too long here", None)
+    asyncio.run(run())
+
+
+# ---- BufferPool ----
+
+def test_pool_tier_accounting_hit_miss_reuse():
+    reg = BufferRegistry()
+    pool = BufferPool(reg, small_count=2, large_count=1)
+    h1, rel1 = pool.acquire(4096)
+    assert pool.misses == 1 and pool.hits == 0
+    assert pool._live[BufferPool.SMALL] == 1
+    assert h1.length == 4096               # slice of the 4 MiB tier buffer
+    rel1()
+    h2, rel2 = pool.acquire(8192)
+    assert pool.hits == 1                  # same tier buffer reused
+    assert pool._live[BufferPool.SMALL] == 1
+    assert h2.buf_id == h1.buf_id
+    rel2()
+
+
+def test_pool_tier_selection_small_vs_large():
+    reg = BufferRegistry()
+    pool = BufferPool(reg)
+    hs, rs = pool.acquire(BufferPool.SMALL)          # exactly 4 MiB: small
+    hl, rl = pool.acquire(BufferPool.SMALL + 1)      # 4 MiB + 1: large tier
+    assert pool._live[BufferPool.SMALL] == 1
+    assert pool._live[BufferPool.LARGE] == 1
+    assert len(reg.local_view(RemoteBuf(hl.buf_id, 0, BufferPool.LARGE))) \
+        == BufferPool.LARGE
+    rs()
+    rl()
+    assert len(pool._free[BufferPool.SMALL]) == 1
+    assert len(pool._free[BufferPool.LARGE]) == 1
+
+
+def test_pool_release_discard_deregisters_and_keeps_books():
+    """discard=True must drop the buffer from the registry AND decrement
+    the tier's live count — a stale one-sided op may still target it."""
+    reg = BufferRegistry()
+    pool = BufferPool(reg, small_count=2)
+    h, rel = pool.acquire(1024)
+    assert pool._live[BufferPool.SMALL] == 1
+    rel(discard=True)
+    assert pool._live[BufferPool.SMALL] == 0
+    assert pool._free[BufferPool.SMALL] == []
+    with pytest.raises(StatusError):
+        reg.local_view(h)                  # really deregistered
+
+
+def test_pool_release_past_cap_deregisters():
+    reg = BufferRegistry()
+    pool = BufferPool(reg, small_count=1)
+    (h1, r1), (h2, r2) = pool.acquire(64), pool.acquire(64)
+    assert pool.misses == 2
+    r1()                                   # fills the free list (cap 1)
+    r2()                                   # over cap: deregistered
+    assert len(pool._free[BufferPool.SMALL]) == 1
+    assert pool._live[BufferPool.SMALL] == 1
+    with pytest.raises(StatusError):
+        reg.local_view(RemoteBuf(h2.buf_id, 0, 1))
+
+
+def test_pool_oversize_is_unpooled_and_discardable():
+    reg = BufferRegistry()
+    pool = BufferPool(reg)
+    size = BufferPool.LARGE + 1
+    h, rel = pool.acquire(size)
+    assert h.length == size
+    assert pool.hits == pool.misses == 0   # bypasses the pool entirely
+    assert pool._live[BufferPool.LARGE] == 0
+    rel(discard=True)                      # oversize release takes discard
+    with pytest.raises(StatusError):
+        reg.local_view(h)
+    # plain release also deregisters (never pooled)
+    h2, rel2 = pool.acquire(size)
+    rel2()
+    with pytest.raises(StatusError):
+        reg.local_view(h2)

@@ -284,6 +284,62 @@ def test_span_not_closed_positive_and_negative():
     assert "span-not-closed" not in _rules_fired(neg_scope, rules)
 
 
+def test_buffer_release_leak_positive_and_negative():
+    rules = {"buffer-release-leak"}
+    pos = """
+        async def push(pool, sc, data):
+            handle, release = pool.acquire(len(data))
+            await sc.write(handle, data)
+    """
+    pos_discarded = """
+        def stage(pool, n):
+            h, _ = pool.acquire(n)
+            return h
+    """
+    neg_released = """
+        async def push(pool, sc, data):
+            handle, release = pool.acquire(len(data))
+            try:
+                await sc.write(handle, data)
+            finally:
+                release(discard=True)
+    """
+    neg_handed_off = """
+        def stage(pool, owner, n):
+            h, rel = pool.acquire(n)
+            owner.adopt(h, rel)
+            return h
+    """
+    # scalar/awaited acquire protocols are different contracts: no match
+    neg_scalar = """
+        def fill(alloc):
+            slot = alloc.acquire()
+            return slot
+    """
+    neg_awaited = """
+        async def send(self):
+            channel, seq = await self.channels.acquire()
+            return channel, seq
+    """
+    assert "buffer-release-leak" in _rules_fired(pos, rules)
+    assert "buffer-release-leak" in _rules_fired(pos_discarded, rules)
+    for neg in (neg_released, neg_handed_off, neg_scalar, neg_awaited):
+        assert "buffer-release-leak" not in _rules_fired(neg, rules)
+
+
+def test_buffer_release_leak_pragma_marks_long_lived_hold():
+    # an arena that lives for the process (RingClient's staging arena
+    # analog) keeps its buffer registered on purpose — pragma the site
+    src = """
+        def boot(pool):
+            # t3fslint: allow(buffer-release-leak) — arena lives forever
+            arena, release = pool.acquire(1 << 20)
+            return arena
+    """
+    findings, suppressed = _lint(src, {"buffer-release-leak"})
+    assert not findings and suppressed == 1
+
+
 def test_span_not_closed_pragma_marks_handoff():
     # handing the span to another function to finish is the pragma path
     src = """

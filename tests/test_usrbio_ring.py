@@ -1,0 +1,513 @@
+"""Ring-native data plane (ISSUE 12): RingClient over a real-TCP fabric.
+
+The contracts that make `data_plane = ring` safe to turn on:
+- smoke: one write batch + one read batch through the registered arena
+  over real TCP, bytes and CQE fields identical to the rpc plane's.
+- zero per-IO serde: a ring read batch encodes NO ReadIO/IOResult
+  structs anywhere in the process — the batch moves as packed arrays.
+- fallback: a pre-ring server (RPC_METHOD_NOT_FOUND) degrades every
+  path to rpc transparently; oversize results and arena pressure hand
+  exactly the affected IOs back to the rpc path.
+- the riders: kvcache get_many and checkpoint restore (first-k healthy
+  reads AND the degraded decode path) are byte-identical on ring.
+Plus units for the shared SlotAllocator and the batched shm-ring pops.
+"""
+
+import asyncio
+
+import pytest
+
+from t3fs.client.storage_client import StorageClient
+from t3fs.storage.types import ChunkId, IOResult, ReadIO
+from t3fs.testing.fabric import StorageFabric
+from t3fs.usrbio import SlotAllocator
+from t3fs.usrbio.ring_client import RingClient
+from t3fs.utils import serde
+from t3fs.utils.status import StatusCode
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+# ---------------- SlotAllocator ----------------
+
+def test_slot_allocator_rejects_bad_params():
+    for count, size in ((0, 1), (-1, 1), (1, 0), (1, -4)):
+        with pytest.raises(ValueError):
+            SlotAllocator(count, size)
+
+
+def test_slot_allocator_acquire_release_books():
+    a = SlotAllocator(2, 512)
+    assert (a.available, a.in_flight) == (2, 0)
+    s1, s2 = a.acquire(), a.acquire()
+    assert {a.offset(s1), a.offset(s2)} == {0, 512}
+    assert (a.available, a.in_flight) == (0, 2)
+    assert a.try_acquire() is None
+    with pytest.raises(RuntimeError):
+        a.acquire()
+    a.release(s1)
+    assert (a.available, a.in_flight) == (1, 1)
+    assert a.acquire() == s1          # free list reuses the released slot
+    a.release(s1)
+    a.release(s2)
+    assert (a.available, a.in_flight) == (2, 0)
+
+
+def test_slot_allocator_release_discipline():
+    a = SlotAllocator(2)
+    s = a.acquire()
+    a.release(s)
+    with pytest.raises(ValueError):
+        a.release(s)                  # double release
+    with pytest.raises(ValueError):
+        a.release(1 if s == 0 else 0)  # never-acquired slot
+    with pytest.raises(ValueError):
+        a.offset(2)                   # out of range
+
+
+def test_slot_allocator_key_binding():
+    a = SlotAllocator(2, 64)
+    s = a.acquire()
+    with pytest.raises(ValueError):
+        a.bind("k", (s + 1) % 2)      # cannot bind a free slot
+    a.bind("k", s)
+    with pytest.raises(ValueError):
+        a.bind("k", s)                # duplicate key
+    with pytest.raises(KeyError):
+        a.release_key("other")
+    assert a.release_key("k") == s
+    assert a.available == 2
+    with pytest.raises(KeyError):
+        a.release_key("k")            # binding consumed
+
+
+# ---------------- shm ring: batched pop/complete ----------------
+
+def test_ioring_batched_pop_and_complete_waves():
+    """pop_sqes/complete_many move whole submission waves, and the
+    doorbell re-arms across waves (a second submit after a full drain
+    still wakes the consumer)."""
+    from t3fs.lib import usrbio
+    iov = usrbio.IoVec("t3fs-test-ringbatch-iov", 16 * 4096)
+    ring = usrbio.IoRing("t3fs-test-ringbatch", entries=32, iov=iov)
+    try:
+        for wave in range(2):
+            for i in range(8):
+                ring.prep_io(True, 7, i * 4096, 4096, i * 4096,
+                             userdata=wave * 100 + i)
+            ring.submit_ios()
+            sqes = ring.pop_sqes(max_n=32, timeout_ms=2000)
+            assert [s.userdata for s in sqes] == \
+                [wave * 100 + i for i in range(8)]
+            ring.complete_many([(s.userdata, s.len, 0) for s in sqes])
+            done = ring.wait_for_ios(max_n=32, min_n=8, timeout_ms=2000)
+            assert sorted(c.userdata for c in done) == \
+                [wave * 100 + i for i in range(8)]
+            assert all(c.result == 4096 and c.status == 0 for c in done)
+        # drained ring: pop times out empty instead of blocking forever
+        assert ring.pop_sqes(max_n=4, timeout_ms=50) == []
+    finally:
+        ring.close()
+        iov.close()
+
+
+def test_ioring_partial_pop_leaves_rest_poppable():
+    """A consumer that pops fewer sqes than were submitted must not
+    strand the remainder behind a consumed doorbell (the baton-pass)."""
+    from t3fs.lib import usrbio
+    iov = usrbio.IoVec("t3fs-test-ringbaton-iov", 8 * 4096)
+    ring = usrbio.IoRing("t3fs-test-ringbaton", entries=16, iov=iov)
+    try:
+        for i in range(6):
+            ring.prep_io(True, 7, 0, 4096, 0, userdata=i)
+        ring.submit_ios()
+        first = ring.pop_sqes(max_n=2, timeout_ms=2000)
+        assert [s.userdata for s in first] == [0, 1]
+        # the leftover four are reachable without another submit
+        rest = ring.pop_sqes(max_n=16, timeout_ms=2000)
+        assert [s.userdata for s in rest] == [2, 3, 4, 5]
+    finally:
+        ring.close()
+        iov.close()
+
+
+# ---------------- fabric helpers ----------------
+
+async def _write_chunks(sc, chain_id, n, size, seed=0):
+    """n chunks of `size` bytes via write_chunk; returns {ChunkId: bytes}."""
+    import random
+    rng = random.Random(seed)
+    data = {}
+    for i in range(n):
+        cid = ChunkId(1000 + seed, i)
+        blob = bytes(rng.getrandbits(8) for _ in range(size))
+        r = await sc.write_chunk(chain_id, cid, 0, blob, size)
+        assert r.status.code == int(StatusCode.OK), r.status.message
+        data[cid] = blob
+    return data
+
+
+def _read_ios(data, chain_id, length=0):
+    return [ReadIO(chunk_id=cid, chain_id=chain_id, offset=0,
+                   length=length or len(blob))
+            for cid, blob in data.items()]
+
+
+# ---------------- smoke: the CI gate ----------------
+
+def test_ring_smoke_write_and_read_batch():
+    """One write batch + one read batch on data_plane=ring over real
+    TCP: bytes round-trip, the arena session attached, and the CQEs
+    carry the engine's CRCs."""
+    async def body():
+        fab = StorageFabric(num_nodes=3, replicas=2, num_chains=2)
+        await fab.start()
+        sc = StorageClient(lambda: fab.routing, client=fab.client)
+        sc.cfg.data_plane = "ring"
+        try:
+            data = await _write_chunks(sc, fab.chain_id, 8, 4096)
+            ring = sc._ring_state["ring"]
+            assert ring is not None and ring._sessions, \
+                "writes never attached a ring session"
+            results, payloads = await sc.batch_read(
+                _read_ios(data, fab.chain_id))
+            from t3fs.ops.codec import crc32c
+            for (cid, blob), r, p in zip(data.items(), results, payloads):
+                assert r.status.code == int(StatusCode.OK), r.status.message
+                assert p == blob, f"{cid}: wrong bytes on the ring plane"
+                assert r.length == len(blob)
+                assert r.checksum == crc32c(blob)
+        finally:
+            await sc.close()
+            await fab.stop()
+    run(body())
+
+
+def test_ring_smoke_results_field_identical_to_rpc():
+    """Every CQE field a caller can see — status, length, versions,
+    checksum — matches the rpc plane's result for the same reads."""
+    async def body():
+        fab = StorageFabric(num_nodes=3, replicas=2, num_chains=2)
+        await fab.start()
+        sc_rpc = StorageClient(lambda: fab.routing, client=fab.client)
+        sc_ring = StorageClient(lambda: fab.routing, client=fab.client)
+        sc_ring.cfg.data_plane = "ring"
+        try:
+            data = await _write_chunks(sc_rpc, fab.chain_id, 6, 8192,
+                                       seed=1)
+            ios = _read_ios(data, fab.chain_id)
+            # a short read and a miss ride along: error/edge CQEs must
+            # match the rpc plane too
+            some = next(iter(data))
+            ios.append(ReadIO(chunk_id=some, chain_id=fab.chain_id,
+                              offset=4096, length=512))
+            ios.append(ReadIO(chunk_id=ChunkId(4242, 0),
+                              chain_id=fab.chain_id, offset=0, length=64))
+            r_rpc, p_rpc = await sc_rpc.batch_read(
+                [io.clone() for io in ios])
+            r_ring, p_ring = await sc_ring.batch_read(
+                [io.clone() for io in ios])
+            assert sc_ring._ring_state["ring"]._sessions
+            for a, b, pa, pb in zip(r_rpc, r_ring, p_rpc, p_ring):
+                assert (a.status.code, a.length, a.update_ver,
+                        a.commit_ver, a.commit_chain_ver, a.checksum) == \
+                       (b.status.code, b.length, b.update_ver,
+                        b.commit_ver, b.commit_chain_ver, b.checksum)
+                assert pa == pb
+            assert r_ring[-1].status.code == int(StatusCode.CHUNK_NOT_FOUND)
+        finally:
+            await sc_rpc.close()
+            await sc_ring.close()
+            await fab.stop()
+    run(body())
+
+
+# ---------------- zero per-IO serde ----------------
+
+def _count_plan_encodes(classes, counts):
+    """Swap each class's compiled serde encoder for a counting wrapper;
+    returns the originals for restore."""
+    originals = {}
+    for cls in classes:
+        plan = serde._plan_of(cls)
+        originals[cls] = plan.enc
+
+        def wrapper(w, obj, _orig=plan.enc, _name=cls.__name__):
+            counts[_name] += 1
+            _orig(w, obj)
+        plan.enc = wrapper
+    return originals
+
+
+def test_ring_read_batch_encodes_zero_per_io_structs():
+    """The acceptance contract behind the 2x: a ring read batch crosses
+    the wire with ZERO ReadIO/IOResult serde encodes in the whole
+    process (client AND in-process server) — the batch is two packed
+    arrays.  The same batch on the rpc plane encodes per-IO structs,
+    which also proves the counter sees what it should."""
+    async def body():
+        fab = StorageFabric(num_nodes=3, replicas=2)
+        await fab.start()
+        sc_ring = StorageClient(lambda: fab.routing, client=fab.client)
+        sc_ring.cfg.data_plane = "ring"
+        sc_rpc = StorageClient(lambda: fab.routing, client=fab.client)
+        try:
+            data = await _write_chunks(sc_ring, fab.chain_id, 16, 4096,
+                                       seed=2)
+            ios = _read_ios(data, fab.chain_id)
+            counts = {"ReadIO": 0, "IOResult": 0}
+            originals = _count_plan_encodes((ReadIO, IOResult), counts)
+            try:
+                results, payloads = await sc_ring.batch_read(
+                    [io.clone() for io in ios])
+                assert all(r.status.code == int(StatusCode.OK)
+                           for r in results)
+                assert counts == {"ReadIO": 0, "IOResult": 0}, \
+                    f"per-IO serde on the ring plane: {counts}"
+                await sc_rpc.batch_read([io.clone() for io in ios])
+                assert counts["ReadIO"] >= len(ios), \
+                    "counter sanity: the rpc plane should encode ReadIOs"
+            finally:
+                for cls, enc in originals.items():
+                    serde._plan_of(cls).enc = enc
+            for (cid, blob), p in zip(data.items(), payloads):
+                assert p == blob
+        finally:
+            await sc_ring.close()
+            await sc_rpc.close()
+            await fab.stop()
+    run(body())
+
+
+# ---------------- fallback paths ----------------
+
+def test_ring_falls_back_to_rpc_on_pre_ring_server():
+    """Strip the ring methods from every server (an old binary): writes
+    and reads on data_plane=ring still complete, served by the rpc
+    path, and the address is memoized as ringless after ONE probe."""
+    async def body():
+        fab = StorageFabric(num_nodes=3, replicas=2)
+        await fab.start()
+        for srv in fab.servers:
+            for m in [m for m in srv.dispatcher
+                      if m.startswith("Storage.ring_")]:
+                del srv.dispatcher[m]
+        sc = StorageClient(lambda: fab.routing, client=fab.client)
+        sc.cfg.data_plane = "ring"
+        try:
+            data = await _write_chunks(sc, fab.chain_id, 4, 4096, seed=3)
+            results, payloads = await sc.batch_read(
+                _read_ios(data, fab.chain_id))
+            for (cid, blob), r, p in zip(data.items(), results, payloads):
+                assert r.status.code == int(StatusCode.OK)
+                assert p == blob
+            ring = sc._ring_state["ring"]
+            assert ring is not None
+            assert ring._no_ring, "pre-ring servers were not memoized"
+            assert not ring._sessions
+        finally:
+            await sc.close()
+            await fab.stop()
+    run(body())
+
+
+def test_ring_read_group_hands_back_oversize_and_ineligible():
+    """read_group's leftover contract: an IO larger than a slot never
+    goes on the wire, a whole-chunk read whose result outgrew its slot
+    cap comes back for an rpc re-read, and eligible IOs in the same
+    group still complete — with every slot released afterwards."""
+    async def body():
+        fab = StorageFabric(num_nodes=3, replicas=2)
+        await fab.start()
+        sc = StorageClient(lambda: fab.routing, client=fab.client)
+        try:
+            data = await _write_chunks(sc, fab.chain_id, 1, 4096, seed=4)
+            (cid, blob), = data.items()
+            ring = RingClient(sc, slot_size=1024, slots=4)
+            try:
+                ios = [
+                    # length 0 = whole chunk, capped at the 1 KiB slot:
+                    # the server truncates, the CQE's true length (4096)
+                    # sends it back for an rpc re-read
+                    ReadIO(chunk_id=cid, chain_id=fab.chain_id,
+                           offset=0, length=0),
+                    # bigger than a slot: ineligible, never hits the wire
+                    ReadIO(chunk_id=cid, chain_id=fab.chain_id,
+                           offset=0, length=4096),
+                    # fits a slot: completes through the ring
+                    ReadIO(chunk_id=cid, chain_id=fab.chain_id,
+                           offset=512, length=512),
+                ]
+                installed = {}
+
+                def install(i, r, p, src):
+                    installed[i] = (r, bytes(p))
+
+                leftover = await ring.read_group(
+                    fab.head_address(), [0, 1, 2], ios, install, "primary")
+                assert sorted(leftover) == [0, 1]
+                assert list(installed) == [2]
+                r, p = installed[2]
+                assert r.status.code == int(StatusCode.OK)
+                assert p == blob[512:1024]
+                assert ring.alloc.available == 4, "slot leak"
+            finally:
+                await ring.close()
+            # end to end: batch_read with a tiny arena still returns the
+            # full chunk (ring truncation -> transparent rpc re-read)
+            sc.cfg.data_plane = "ring"
+            sc.cfg.ring_slot_size = 1024
+            results, payloads = await sc.batch_read(
+                [ReadIO(chunk_id=cid, chain_id=fab.chain_id,
+                        offset=0, length=0)])
+            assert results[0].status.code == int(StatusCode.OK)
+            assert payloads[0] == blob
+        finally:
+            await sc.close()
+            await fab.stop()
+    run(body())
+
+
+def test_ring_arena_pressure_spills_to_rpc():
+    """More in-group IOs than arena slots: the overflow rides rpc, the
+    rest complete on the ring, nothing is dropped or reordered."""
+    async def body():
+        fab = StorageFabric(num_nodes=3, replicas=2)
+        await fab.start()
+        sc = StorageClient(lambda: fab.routing, client=fab.client)
+        try:
+            data = await _write_chunks(sc, fab.chain_id, 6, 2048, seed=5)
+            ios = _read_ios(data, fab.chain_id)
+            ring = RingClient(sc, slot_size=2048, slots=2)
+            try:
+                installed = {}
+
+                def install(i, r, p, src):
+                    installed[i] = bytes(p)
+
+                leftover = await ring.read_group(
+                    fab.head_address(), list(range(6)), ios, install,
+                    "primary")
+                assert len(leftover) == 4          # 2 slots served 2 IOs
+                assert len(installed) == 2
+                blobs = list(data.values())
+                for i, p in installed.items():
+                    assert p == blobs[i]
+                assert ring.alloc.available == 2
+            finally:
+                await ring.close()
+        finally:
+            await sc.close()
+            await fab.stop()
+    run(body())
+
+
+# ---------------- riders: kvcache + checkpoint ----------------
+
+def test_kvcache_get_many_byte_identical_on_ring():
+    """The serving tier on data_plane=ring: get_many after a flush
+    returns exactly the bytes put, and the reads demonstrably went
+    through the ring plane."""
+    from t3fs.kvcache import KVCacheTier, KVCacheTierConfig
+
+    async def body():
+        fab = StorageFabric(num_nodes=3, replicas=2, num_chains=4)
+        await fab.start()
+        sc = StorageClient(lambda: fab.routing, client=fab.client)
+        sc.cfg.data_plane = "ring"
+        try:
+            tier = KVCacheTier(
+                sc, fab.chain_ids, namespace="ringns",
+                config=KVCacheTierConfig(lanes=4, hit_sample=1,
+                                         flush_interval_s=0.005,
+                                         ledger_flush_interval_s=0.05),
+                writer_id=1)
+            await tier.start()
+            expected = {f"key-{i}".encode():
+                        (f"val-{i}-".encode() * 200)[:1024 + 37 * i]
+                        for i in range(24)}
+            for k, v in expected.items():
+                await tier.put(k, v)
+            await tier.flush()
+            ring = sc._ring_plane()
+            assert ring is not None
+            calls = {"n": 0}
+            orig = ring.read_group
+
+            async def counting(*a, **kw):
+                calls["n"] += 1
+                return await orig(*a, **kw)
+            ring.read_group = counting
+            keys = sorted(expected)
+            got = await tier.get_many(keys)
+            for k, v in zip(keys, got):
+                assert v == expected[k], f"{k!r}: wrong bytes on ring"
+            assert calls["n"] > 0, "get_many never used the ring plane"
+            await tier.stop()
+        finally:
+            await sc.close()
+            await fab.stop()
+    run(body())
+
+
+def test_ckpt_restore_on_ring_healthy_and_degraded(monkeypatch):
+    """Checkpoint save + restore with the WHOLE stack on data_plane=ring:
+    healthy restore (first-k shard reads) and the degraded decode path
+    after killing a data and a parity chain are both bit-identical —
+    the EC client's CRC verification (crc32c_combine over ring CQE
+    checksums) holds on the ring plane."""
+    import numpy as np
+    from t3fs.ckpt import CheckpointReader, CheckpointWriter, manifest_name
+    from t3fs.client.ec_client import ECLayout, ECStorageClient
+    from t3fs.fuse.vfs import FileSystem
+    from t3fs.testing.cluster import LocalCluster
+    from tests.test_ckpt import make_tree, trees_equal
+
+    monkeypatch.setenv("T3FS_FORCE_PALLAS_INTERPRET", "1")
+
+    async def body():
+        # 8 nodes / 8 chains, replicas=1: killing node c fail-stops
+        # exactly chain c (the degraded-restore shape from test_ckpt)
+        cluster = LocalCluster(num_nodes=8, replicas=1, num_chains=8,
+                               with_meta=True, heartbeat_timeout_s=0.6)
+        await cluster.start()
+        try:
+            cluster.sc.cfg.data_plane = "ring"   # writes AND reads
+            lay = ECLayout.create(k=4, m=2, chunk_size=2048,
+                                  chains=[1, 2, 3, 4, 5, 6])
+            ec = ECStorageClient(cluster.sc)
+            fs = FileSystem(cluster.mc, cluster.sc)
+            tree = make_tree(np.random.default_rng(9))
+            w = CheckpointWriter(ec, fs, lay, "/ckpt/ring")
+            stats = await w.save(5, tree)
+            ring = cluster.sc._ring_state["ring"]
+            assert ring is not None and ring._sessions, \
+                "the save never attached a ring session"
+
+            r = CheckpointReader(ec, fs, "/ckpt/ring")
+            trees_equal(tree, await r.restore())          # first-k reads
+
+            # kill one data + one parity chain, dodge the manifest's
+            ino = await fs.stat(stats.manifest_path)
+            used = set(ino.layout.chains)
+            data_chain = next(c for c in (2, 3, 4) if c not in used)
+            parity_chain = next(c for c in (5, 6) if c not in used)
+            for chain in (data_chain, parity_chain):
+                await cluster.kill_storage_node(chain)
+            for _ in range(100):
+                if all(c.chain_ver >= 2 for c in
+                       cluster.mgmtd.state.routing().chains.values()
+                       if any(t.node_id in (data_chain, parity_chain)
+                              for t in c.targets)):
+                    break
+                await asyncio.sleep(0.1)
+            await cluster.mgmtd_client.refresh()
+
+            trees_equal(tree, await r.restore())          # degraded decode
+            assert ec.codec.codec_counts.get("pallas-decode-words", 0) >= 1
+            await ec.close()
+        finally:
+            await cluster.stop()
+    run(body())
