@@ -19,7 +19,7 @@ SAN_FILTER := -k "not device"
 
 .PHONY: test lint sanitize sanitize-thread sanitize-address probe \
         on-device ci ckpt-bench write-bench read-bench \
-        kvcache-fleet-bench repair-drill usrbio-bench
+        kvcache-fleet-bench repair-drill usrbio-bench soak soak-smoke
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -70,6 +70,21 @@ usrbio-bench:
 repair-drill:
 	JAX_PLATFORMS=cpu $(PY) -m benchmarks.repair_drill_bench \
 		--stripes 12 --chunk-size 65536 --repair-mode both --json
+
+# Mixed-workload soak (ISSUE 13): six drivers (zipf dataloader on rpc
+# AND ring planes, EC checkpoint cycles, KVCache churn under eviction,
+# metadata scans, mini GraySort) against one live 5-node fabric for
+# 75 s per cell, faults OFF then ON (straggler, node crash + empty
+# restart, disk bit-rot).  Grades Jain fairness, zero-wrong-bytes, and
+# per-window progress; exits non-zero on any gate failure.  Minutes.
+soak:
+	JAX_PLATFORMS=cpu $(PY) -m benchmarks.soak_bench \
+		--config configs/soak.toml --cells both --json
+
+# ~20 s harness proof: 3 workloads, 1 straggler fault, same gates.
+soak-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m benchmarks.soak_bench \
+		--config configs/soak_smoke.toml --cells on --json
 
 # Bounded TPU-tunnel probe; ALWAYS appends a dated record to
 # DEVICE_PROBE_LOG.jsonl (proof the chip was retried, r3 verdict #1).

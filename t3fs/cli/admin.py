@@ -1206,6 +1206,39 @@ async def trace_show(ctx: AdminContext, args) -> None:
     print(render_trace(rsp.spans))
 
 
+@command("soak-status", "live per-workload counters from a running soak")
+@args_(("--since", {"type": float, "default": 0.0}),
+       ("--limit", {"type": int, "default": 500}))
+async def soak_status(ctx: AdminContext, args) -> None:
+    """A running SoakRunner publishes soak.<workload>.{ops,errors,p50_ms}
+    rows to its MonitorCollectorServer once a second (the address is in
+    the runner's progress output); this renders the latest row per
+    workload so a minutes-long soak can be watched from another
+    terminal."""
+    if not ctx.monitor_address:
+        raise SystemExit("soak-status needs --monitor ADDR")
+    rsp, _ = await ctx.cli.call(ctx.monitor_address, "Monitor.query",
+                                QueryMetricsReq("soak.", args.since,
+                                                args.limit))
+    latest: dict[str, dict] = {}
+    for s in rsp.samples:            # newest row per metric name wins
+        name = s.get("name", "")
+        if name not in latest or s.get("ts", 0) >= latest[name].get("ts", 0):
+            latest[name] = s
+    per_wl: dict[str, dict] = {}
+    for name, s in latest.items():
+        _, wl, field = name.split(".", 2)
+        per_wl.setdefault(wl, {})[field] = s.get("value")
+    rows = [[wl, f"{v.get('ops', 0):.0f}", f"{v.get('errors', 0):.0f}",
+             f"{v.get('p50_ms', 0.0):.2f}"]
+            for wl, v in sorted(per_wl.items())]
+    if not rows:
+        print("(no soak.* metrics — is a soak running against "
+              "this monitor?)")
+        return
+    print(_fmt_table(rows, ["workload", "ops", "errors", "p50_ms"]))
+
+
 @command("trace-slow", "top-N slow exported traces (local roots) per method")
 @args_(("--method", {"default": "", "help": "span name prefix filter"}),
        ("--min-ms", {"type": float, "default": 0.0}),

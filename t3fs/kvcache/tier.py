@@ -242,10 +242,23 @@ class KVCacheTier:
         ttl = self.cfg.default_ttl_s if ttl_s is None else ttl_s
         expiry = time.time() + ttl if ttl else 0.0
         self.counters["puts"] += 1
-        async with self.admission.admit(len(value)):
-            if self.wb is not None:
-                await self.wb.put(key, value, expiry=expiry)
-            else:
+        if self.wb is not None:
+            # buffer-space wait BEFORE the admission window.  The wait is
+            # unbounded when flushes retry against a dead chain; holding
+            # namespace/class slots across it let wedged puts starve
+            # get_many (which shares the namespace window) — the
+            # interference the mixed-workload soak's crash fault found.
+            nbytes = len(key) + len(value)
+            await self.wb.reserve(nbytes)
+            try:
+                async with self.admission.admit(len(value)):
+                    await self.wb.put(key, value, expiry=expiry,
+                                      reserved=nbytes)
+            except BaseException:
+                await self.wb.unreserve(nbytes)
+                raise
+        else:
+            async with self.admission.admit(len(value)):
                 await self.store.put(key, value)
                 self._on_flushed(key, len(value), expiry, 0)
 

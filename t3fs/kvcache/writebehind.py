@@ -86,6 +86,10 @@ class WriteBehind:
         self._pending: dict[ChunkId, _Dirty] = {}
         self._inflight: dict[ChunkId, _Dirty] = {}
         self.dirty_bytes = 0
+        # bytes promised to reserve() callers but not yet in the buffer;
+        # lets the backpressure wait happen BEFORE the caller takes any
+        # admission window (see KVCacheTier.put)
+        self.reserved_bytes = 0
         self._seq = 0
         self._outstanding: set[int] = set()
         self._cond = asyncio.Condition()
@@ -103,18 +107,45 @@ class WriteBehind:
             self._task = asyncio.create_task(self._flusher(),
                                              name="t3fs-kvcache-flusher")
 
+    async def reserve(self, nbytes: int) -> None:
+        """Wait for buffer space and claim it, WITHOUT inserting anything.
+
+        The tier calls this before taking its admission window: the
+        backpressure wait (unbounded when a chain is down and flushes
+        retry) must not happen while holding admission slots that the
+        read path shares — that is exactly the starvation the soak's
+        crash fault surfaces.  A later ``put(..., reserved=nbytes)``
+        converts the claim into a buffer entry; ``unreserve`` releases a
+        claim that won't be used (caller errored/cancelled in between)."""
+        async with self._cond:
+            if self.dirty_bytes + self.reserved_bytes \
+                    >= self.cfg.max_dirty_bytes:
+                self.stats["backpressure_waits"] += 1
+                await self._cond.wait_for(
+                    lambda: self.dirty_bytes + self.reserved_bytes
+                    < self.cfg.max_dirty_bytes or self._stopping)
+            self.reserved_bytes += nbytes
+
+    async def unreserve(self, nbytes: int) -> None:
+        async with self._cond:
+            self.reserved_bytes = max(0, self.reserved_bytes - nbytes)
+            self._cond.notify_all()
+
     async def put(self, key: bytes, value: bytes,
-                  expiry: float = 0.0) -> None:
+                  expiry: float = 0.0, reserved: int = 0) -> None:
         if len(_pack_block(key, value)) > self.store.cfg.block_size:
             # surface the size error at the call site, not from the
-            # flusher minutes later
+            # flusher minutes later (an unused reservation stays the
+            # caller's to release — they hold the except path)
             raise make_error(
                 StatusCode.INVALID_ARG,
                 f"block {len(key) + len(value)}B exceeds block_size "
                 f"{self.store.cfg.block_size}")
         chain, cid = self.store.locate(key)
         async with self._cond:
-            if self.dirty_bytes >= self.cfg.max_dirty_bytes:
+            if reserved:
+                self.reserved_bytes = max(0, self.reserved_bytes - reserved)
+            elif self.dirty_bytes >= self.cfg.max_dirty_bytes:
                 self.stats["backpressure_waits"] += 1
                 await self._cond.wait_for(
                     lambda: self.dirty_bytes < self.cfg.max_dirty_bytes

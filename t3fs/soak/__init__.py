@@ -1,0 +1,25 @@
+"""Mixed-workload soak harness (ROADMAP item 5, ISSUE 13 tentpole).
+
+One live fabric, many scenario drivers, live fault injection, graded
+output.  See docs/soak.md for the scenario-spec format, the fault
+matrix, and grading semantics.
+
+- ``spec``    — declarative scenario configs (configs/soak*.toml)
+- ``drivers`` — workload drivers (dataloader, checkpoint, kvcache,
+                metascan, graysort) with open/closed-loop rate control
+- ``faults``  — the deterministic fault schedule (straggler, crash,
+                bit-rot) driven against LocalCluster fault hooks
+- ``harvest`` — per-workload p50/p99/throughput, Jain fairness, SLO
+                gates, worst-p99 trace capture
+- ``runner``  — orchestration: build the fabric, run everything, grade
+"""
+
+from t3fs.soak.spec import (FaultSpec, SLOSpec, SoakSpec, WorkloadSpec,
+                            load_spec)
+from t3fs.soak.harvest import jain_fairness
+from t3fs.soak.runner import SoakRunner
+
+__all__ = [
+    "FaultSpec", "SLOSpec", "SoakSpec", "WorkloadSpec", "load_spec",
+    "jain_fairness", "SoakRunner",
+]

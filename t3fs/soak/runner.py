@@ -1,0 +1,216 @@
+"""SoakRunner: build one live fabric, run every driver + the fault
+schedule against it, grade the wreckage.
+
+Orchestration order matters and is documented inline: tracing first
+(drivers' client spans must be sampled from op one), then the cluster,
+then driver setup (pre-writes files/trees/keys), then the maintenance
+plane (scrub with manifest discovery + CheckWorker sinks — BEFORE
+faults, so a bit-rot injection always has a discovered registry to pick
+from), then drivers + faults concurrently, then the drain discipline,
+then harvest.
+
+The runner also feeds a MonitorCollectorServer: once a second it writes
+``soak.<workload>.{ops,errors,p50_ms}`` metric rows, so `t3fs-admin
+soak-status --monitor <addr>` can watch a run live from another
+terminal the same way `status`/`trace-slow` watch the fabric.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+
+import numpy as np
+
+from t3fs.soak.drivers import SoakContext, build_driver
+from t3fs.soak.faults import FaultSchedule, LiveInjector
+from t3fs.soak.harvest import (SoakReport, capture_worst_trace, grade,
+                               summarize)
+from t3fs.soak.spec import SoakSpec
+from t3fs.utils import tracing
+from t3fs.utils.tracing import TraceConfig
+
+log = logging.getLogger("t3fs.soak")
+
+
+class SoakRunner:
+    def __init__(self, spec: SoakSpec, progress=None):
+        self.spec = spec
+        self.progress = progress or (lambda msg: log.info("%s", msg))
+        self.cluster = None
+        self.drivers = []
+        self.scrub = None
+        self.collector = None
+        self._maint_sc = None
+        self.monitor_address: str | None = None
+
+    async def run(self, require_fairness: bool | None = None
+                  ) -> SoakReport:
+        """Run the whole scenario; returns the graded report.  By
+        default the fairness gate applies only to a faults-off spec (a
+        crash SHOULD dent the victim's share); pass require_fairness
+        explicitly to override."""
+        from t3fs.client.ec_client import ECStorageClient
+        from t3fs.monitor.service import MonitorCollectorServer
+        from t3fs.storage.scrub_scheduler import ScrubScheduler
+        from t3fs.testing.cluster import LocalCluster
+
+        spec = self.spec
+        if require_fairness is None:
+            require_fairness = not spec.faults
+
+        # 1. tracing before any client exists: tail sampling self-selects
+        # slow/errored traces into the buffer the harvest drains.  The
+        # same config goes to the cluster: storage nodes install THEIR
+        # cfg.trace process-wide on every (re)start, so without it a
+        # node start — including a crash fault's restart — would reset
+        # sampling to zero mid-run.
+        trace_cfg = TraceConfig(sample_rate=spec.trace_sample_rate,
+                                export="tail", slow_ms=spec.trace_slow_ms)
+        tracing.configure(trace_cfg)
+        tracing.BUFFER.drain(10 ** 9)        # start from an empty buffer
+
+        self.progress(f"soak '{spec.name}': {spec.nodes} nodes, "
+                      f"{len(spec.workloads)} workloads, "
+                      f"{len(spec.faults)} faults, {spec.duration_s:.0f}s")
+        cluster = self.cluster = LocalCluster(
+            num_nodes=spec.nodes, replicas=spec.replicas,
+            num_chains=spec.chains, with_meta=True,
+            ec_chains=spec.ec_chains, trace=trace_cfg)
+        await cluster.start()
+        ctx = SoakContext(
+            cluster, spec,
+            repl_chains=list(range(1, spec.chains + 1)),
+            ec_chain_ids=list(range(spec.chains + 1,
+                                    spec.chains + spec.ec_chains + 1)))
+        report: SoakReport | None = None
+        try:
+            # 2. drivers pre-write their working sets against the live
+            # fabric (zipf files, checkpoint trees, kvcache namespaces,
+            # metascan trees, sort inputs)
+            self.drivers = [build_driver(spec, wl, i, ctx)
+                            for i, wl in enumerate(spec.workloads)]
+            await asyncio.gather(*(d.setup() for d in self.drivers))
+            self.progress(f"setup done: {[d.name for d in self.drivers]}")
+
+            # 3. maintenance plane: scrub targets auto-derive from the
+            # checkpoint drivers' manifest directories (satellite 1 —
+            # nothing is manually registered), CheckWorkers feed bit-rot
+            # finds into the scheduler
+            maint_sc = self._maint_sc = ctx.make_client()
+            ec = ECStorageClient(maint_sc)
+            ckpt_dirs = [d.directory for d in self.drivers
+                         if d.wl.kind == "checkpoint"]
+            from t3fs.ckpt.scrub import manifest_discovery
+            fs = ctx.filesystem(maint_sc)
+            self.scrub = ScrubScheduler(
+                ec, repair_mode="subshard",
+                budget_mbps=spec.repair_budget_mbps,
+                period_s=spec.scrub_period_s,
+                discovery=manifest_discovery(fs, ckpt_dirs))
+
+            async def wire_check(node_id: int) -> None:
+                cw = cluster.storage[node_id].check
+                cw.corrupt_sink = self.scrub.note_corrupt
+                cw.period_s = spec.check_period_s
+                cw.verify_chunks_per_tick = 64
+
+            for node_id in list(cluster.storage):
+                await wire_check(node_id)
+            await self.scrub.refresh_targets()   # registry ready pre-fault
+            await self.scrub.start()
+
+            # 4. live-status surface for `admin soak-status`
+            self.collector = MonitorCollectorServer()
+            await self.collector.start()
+            self.monitor_address = self.collector.server.address
+            self.progress(f"monitor: {self.monitor_address}")
+
+            injector = LiveInjector(
+                cluster, self.scrub,
+                rng=np.random.default_rng(spec.seed ^ 0xB17),
+                on_restart=wire_check)
+            schedule = FaultSchedule(spec, injector)
+
+            # 5. traffic + faults, concurrently, for duration_s
+            t0 = time.monotonic()
+            for d in self.drivers:
+                d.start()
+            fault_task = asyncio.create_task(schedule.run(),
+                                             name="soak-faults")
+            reporter = asyncio.create_task(self._report_loop(t0),
+                                           name="soak-reporter")
+            await asyncio.sleep(spec.duration_s)
+
+            # 6. drain discipline: stop arrivals everywhere first, then
+            # give in-flight ops drain_timeout_s, then cancel + count
+            for d in self.drivers:
+                d.request_stop()
+            elapsed = time.monotonic() - t0
+            await asyncio.gather(
+                *(d.drain(spec.drain_timeout_s) for d in self.drivers))
+            reporter.cancel()
+            await asyncio.gather(reporter, return_exceptions=True)
+            if not fault_task.done():
+                try:
+                    await asyncio.wait_for(fault_task, spec.drain_timeout_s)
+                except asyncio.TimeoutError:
+                    fault_task.cancel()
+                    await asyncio.gather(fault_task,
+                                         return_exceptions=True)
+
+            # 7. harvest: stats, fairness, gates, worst-p99 trace
+            report = summarize(spec, self.drivers, elapsed)
+            report.fault_events = list(schedule.events)
+            report.worst_trace_root, report.worst_trace_rendered = \
+                capture_worst_trace()
+            grade(report, spec, require_fairness=require_fairness)
+            for gate, (ok, detail) in report.gates.items():
+                self.progress(f"gate {gate}: "
+                              f"{'PASS' if ok else 'FAIL'} ({detail})")
+            return report
+        finally:
+            await self._teardown()
+
+    async def _report_loop(self, t0: float) -> None:
+        """Once a second: per-workload live rows into the collector DB
+        (the soak-status query surface) + a progress line."""
+        while True:
+            await asyncio.sleep(1.0)
+            now = time.time()
+            rows = []
+            for d in self.drivers:
+                ok = [o for o in d.ops if o.ok]
+                lat = sorted(o.lat_s for o in ok[-256:])
+                p50 = lat[len(lat) // 2] * 1000 if lat else 0.0
+                rows += [
+                    {"name": f"soak.{d.name}.ops", "value": len(ok)},
+                    {"name": f"soak.{d.name}.errors", "value": d.errors},
+                    {"name": f"soak.{d.name}.p50_ms",
+                     "value": round(p50, 3)},
+                ]
+            if self.collector is not None:
+                self.collector.db.insert(0, "soak", now, rows)
+            t = time.monotonic() - t0
+            if int(t) % 10 == 0:
+                line = " ".join(
+                    f"{d.name}={len([o for o in d.ops if o.ok])}"
+                    for d in self.drivers)
+                self.progress(f"[{t:5.0f}s] {line}")
+
+    async def _teardown(self) -> None:
+        for d in self.drivers:
+            try:
+                await d.teardown()
+            except Exception:                    # noqa: BLE001
+                log.exception("soak: driver %s teardown failed", d.name)
+        if self.scrub is not None:
+            await self.scrub.stop()
+            await self.scrub.ec.close()
+        if self._maint_sc is not None:
+            await self._maint_sc.close()
+        if self.collector is not None:
+            await self.collector.stop()
+        if self.cluster is not None:
+            await self.cluster.stop()

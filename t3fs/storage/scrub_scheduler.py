@@ -79,9 +79,14 @@ class ScrubStats:
     shards_corrupt: int = 0
     flagged_enqueued: int = 0      # CheckWorker corrupt_sink arrivals
     flagged_unresolved: int = 0    # sink chunks matching no registered file
+    discovery_errors: int = 0      # failed refresh_targets pulls (kept old set)
     repaired_stripes: int = 0
     repaired_shards: int = 0
     stripes_failed: int = 0
+    # probed stripes with EVERY slot absent: the file was deleted between
+    # discovery refresh and probe (ckpt GC racing a live scan) — skipped,
+    # not failed
+    stripes_vanished: int = 0
     bytes_read: int = 0
     bytes_repaired: int = 0
     reduced_shards: int = 0
@@ -106,7 +111,8 @@ class ScrubScheduler:
                  concurrency: int = 4,
                  stripes_per_tick: int = 64,
                  period_s: float = 30.0,
-                 report_cb=None):
+                 report_cb=None,
+                 discovery=None):
         self.ec = ec
         self.driver = RepairDriver(
             ec, concurrency=concurrency, repair_mode=repair_mode,
@@ -114,6 +120,18 @@ class ScrubScheduler:
         self.stripes_per_tick = stripes_per_tick
         self.period_s = period_s
         self.report_cb = report_cb          # async callable(status_dict)
+        # async callable() -> iterable[ScrubTarget]: targets auto-derived
+        # from metadata (e.g. ckpt/scrub.py walks committed manifests) so
+        # new files enter scrub without per-file registration.  Manual
+        # add_target entries coexist; only discovery-sourced names are
+        # dropped when discovery stops returning them.
+        self.discovery = discovery
+        self._discovered: set[str] = set()
+        # corrupt_sink chunks that matched no target YET: with discovery
+        # on, a CheckWorker can flag bit-rot in a checkpoint committed
+        # after our last refresh — retried (bounded) at the next refresh
+        # instead of dropped
+        self._unresolved: list[ChunkId] = []
         self.stats = ScrubStats()
         self._targets: dict[str, ScrubTarget] = {}
         # stripes the corrupt_sink flagged for priority rescan next tick
@@ -131,6 +149,54 @@ class ScrubScheduler:
         self._targets[name] = t
         self._cursor.setdefault(name, 0)
         return t
+
+    def remove_target(self, name: str) -> None:
+        self._targets.pop(name, None)
+        self._cursor.pop(name, None)
+        self._discovered.discard(name)
+        self._flagged = {(n, s) for n, s in self._flagged if n != name}
+
+    async def refresh_targets(self) -> int:
+        """Pull the current target set from `discovery` (no-op without
+        one).  New names register fresh; retained names update their
+        layout/stripe_lens IN PLACE keeping the walk cursor (a growing
+        file keeps its scan position); discovery-sourced names that
+        vanished (GC'd steps, unlinked files) drop out so the walk never
+        probes reclaimed chunks.  Discovery failures keep the previous
+        set — a flaky meta read must not blank the scrub registry."""
+        if self.discovery is None:
+            return len(self._targets)
+        try:
+            found = list(await self.discovery())
+        except Exception:
+            self.stats.discovery_errors += 1
+            log.exception("scrub target discovery failed; keeping "
+                          "previous %d targets", len(self._targets))
+            return len(self._targets)
+        fresh_names = set()
+        for t in found:
+            fresh_names.add(t.name)
+            old = self._targets.get(t.name)
+            if old is None:
+                self.add_target(t.name, t.layout, t.inode, t.stripe_lens)
+            else:
+                old.layout, old.inode = t.layout, t.inode
+                old.stripe_lens = dict(t.stripe_lens)
+        for name in self._discovered - fresh_names:
+            self.remove_target(name)
+        self._discovered = fresh_names
+        if self._unresolved:
+            still: list[ChunkId] = []
+            for cid in self._unresolved:
+                hit = self.resolve_chunk(cid)
+                if hit is None:
+                    still.append(cid)
+                else:
+                    t, stripe, _slot = hit
+                    self.stats.flagged_enqueued += 1
+                    self._flagged.add((t.name, stripe))
+            self._unresolved = still
+        return len(self._targets)
 
     def resolve_chunk(self, chunk_id: ChunkId
                       ) -> tuple[ScrubTarget, int, int] | None:
@@ -155,8 +221,16 @@ class ScrubScheduler:
         hit = self.resolve_chunk(chunk_id)
         if hit is None:
             self.stats.flagged_unresolved += 1
-            log.warning("scrub: corrupt chunk %s matches no registered "
-                        "EC file; dropping", chunk_id)
+            if self.discovery is not None and len(self._unresolved) < 1024:
+                # discovery may simply not have seen the owner yet;
+                # park the chunk for a retry after the next refresh
+                self._unresolved.append(chunk_id)
+                log.warning("scrub: corrupt chunk %s matches no target "
+                            "yet; retrying after next discovery refresh",
+                            chunk_id)
+            else:
+                log.warning("scrub: corrupt chunk %s matches no "
+                            "registered EC file; dropping", chunk_id)
             return False
         t, stripe, _slot = hit
         self.stats.flagged_enqueued += 1
@@ -246,6 +320,7 @@ class ScrubScheduler:
                         ) -> RepairReport:
         """One tick: probe up to `max_stripes` stripes, REMOVE corrupt
         shards, repair every damaged stripe through the paced driver."""
+        await self.refresh_targets()
         picked = self._pick_stripes(max_stripes or self.stripes_per_tick)
         sem = asyncio.Semaphore(16)
 
@@ -264,6 +339,14 @@ class ScrubScheduler:
             self.stats.shards_corrupt += len(corrupt)
             bad = tuple(sorted(set(lost) | set(corrupt)))
             if not bad:
+                continue
+            if len(lost) == t.layout.slots:
+                # every slot ABSENT, none even corrupt: the file was
+                # deleted between the discovery refresh and this probe
+                # (checkpoint GC races a live scan under the soak).
+                # Repair from zero survivors is impossible — skip the
+                # doomed job; next refresh drops the target.
+                self.stats.stripes_vanished += 1
                 continue
             job = jobs.get(t.name)
             if job is None:

@@ -7,6 +7,8 @@ here in-process for tests, with fast failure-detection knobs.
 
 from __future__ import annotations
 
+import os
+import shutil
 import tempfile
 
 from t3fs.client.meta_client import MetaClient
@@ -30,15 +32,30 @@ class LocalCluster:
                  heartbeat_timeout_s: float = 0.6,
                  with_meta: bool = False,
                  write_pipeline: str = "off",
-                 stream_threshold: int | None = None):
+                 stream_threshold: int | None = None,
+                 ec_chains: int = 0,
+                 trace=None):
         self.num_nodes = num_nodes
         self.replicas = replicas
         self.num_chains = num_chains
+        # single-replica chains for EC shard placement (reference: separate
+        # CR vs EC chain-table types).  They live in chain table 2 so the
+        # meta ChainAllocator (table 1) never places replicated files on
+        # them; chain ids follow the replicated ones, each homed on one
+        # node round-robin.  A node crash loses its EC shards outright —
+        # exactly the damage the scrub/repair path exists to heal.
+        self.ec_chains = ec_chains
         # write-pipeline mode for every storage node (tests parameterize
         # resync/fault suites over it); stream_threshold lets small-chunk
         # tests exercise the fragment path
         self.write_pipeline = write_pipeline
         self.stream_threshold = stream_threshold
+        # TraceConfig every storage node installs on (re)start.  Without
+        # this, StorageServer.start()'s process-wide configure_tracing
+        # resets sampling to the zero default — including on a mid-test
+        # restart, which would silently kill tracing for a caller (the
+        # soak harness) that configured it before building the cluster.
+        self.trace = trace
         self.with_meta = with_meta
         self.meta: MetaServer | None = None
         self.meta_rpc: Server | None = None
@@ -83,10 +100,22 @@ class LocalCluster:
                     self.target_id(node_id, c), node_id,
                     PublicTargetState.SERVING))
             chains.append(ChainInfo(chain_id=c + 1, chain_ver=1, targets=targets))
+        tables = [ChainTable(1, [c.chain_id for c in chains])]
+        if self.ec_chains:
+            ec = []
+            for j in range(self.ec_chains):
+                node_id = j % self.num_nodes + 1
+                cid = self.num_chains + j + 1
+                ec.append(ChainInfo(
+                    chain_id=cid, chain_ver=1,
+                    targets=[ChainTargetInfo(
+                        self.target_id(node_id, self.num_chains + j),
+                        node_id, PublicTargetState.SERVING)]))
+            tables.append(ChainTable(2, [c.chain_id for c in ec]))
+            chains += ec
         await self.admin.call(
             self.mgmtd_rpc.address, "Mgmtd.set_chains",
-            SetChainsReq(chains=chains,
-                         tables=[ChainTable(1, [c.chain_id for c in chains])]))
+            SetChainsReq(chains=chains, tables=tables))
 
         # wait until every storage node has pulled the installed chains so
         # first writes don't race routing propagation
@@ -131,11 +160,19 @@ class LocalCluster:
         if self.stream_threshold is not None:
             ss.node.stream_threshold = self.stream_threshold
             ss.node.stream_frag_bytes = max(1, self.stream_threshold // 2)
+        if self.trace is not None:
+            ss.cfg.trace = self.trace
         try:
             for c in range(self.num_chains):
                 # every node pre-creates targets for chains it may serve
                 ss.add_target(self.target_id(node_id, c),
                               f"{self.node_root(node_id)}/t{c}")
+            for j in range(self.ec_chains):
+                # EC chains are single-replica: only the home node hosts one
+                if j % self.num_nodes + 1 == node_id:
+                    c = self.num_chains + j
+                    ss.add_target(self.target_id(node_id, c),
+                                  f"{self.node_root(node_id)}/t{c}")
             await ss.start()
         except BaseException:
             # a partial start (bound listener, open engines) must not leak:
@@ -158,6 +195,65 @@ class LocalCluster:
             # it (and its root dirs aren't deleted under a live engine)
             self.storage[node_id] = ss
             raise
+
+    # ---------------------------------------------------- fault hooks
+    # (soak harness + chaos tests, docs/soak.md: straggler / crash +
+    # empty-disk restart / disk bit-rot)
+
+    def set_read_delay(self, node_id: int, delay_s: float) -> None:
+        """Straggler: every read served by this node sleeps first."""
+        self.storage[node_id].node.read_delay_s = delay_s
+
+    def corrupt_chunk_on_disk(self, chain_id: int, chunk_id,
+                              nbytes: int = 64) -> bool:
+        """Bit-rot: scribble a chunk's on-disk bytes behind the CRC, so
+        only a disk-verify (CheckWorker) or scrub probe can see it.
+        Returns False if the chunk is not on disk (deleted, or its node
+        was wiped by a crash fault) — callers picking targets under live
+        traffic must tolerate the pick going stale."""
+        head = self.mgmtd.state.routing().chains[chain_id].head()
+        target = self.storage[head.node_id].node.targets[head.target_id]
+        loc = target.engine.locate(chunk_id, 0, nbytes)
+        if loc is None:
+            return False
+        fd, off, _n, _gen = loc
+        os.pwrite(fd, b"\xde\xad\xbe\xef" * ((nbytes + 3) // 4), off)
+        return True
+
+    async def restart_storage_node_empty(self, node_id: int,
+                                         timeout_s: float = 30.0) -> None:
+        """Crash + empty-disk restart: fail-stop the node (if still up),
+        wait for mgmtd to bump the affected chains, wipe the node's disk,
+        restart it, and wait until every affected chain has a head again.
+        Replicated chains refill via CRAQ resync; single-replica EC
+        chains come back empty — scrub/repair's job to heal."""
+        import asyncio
+        routing = self.mgmtd.state.routing()
+        affected = {c.chain_id: c.chain_ver
+                    for c in routing.chains.values()
+                    if any(t.node_id == node_id for t in c.targets)}
+        if node_id in self.storage:
+            await self.kill_storage_node(node_id)
+        steps = max(1, int(timeout_s / 0.05))
+        for _ in range(steps):
+            routing = self.mgmtd.state.routing()
+            if all(routing.chains[c].chain_ver > v
+                   for c, v in affected.items()):
+                break
+            await asyncio.sleep(0.05)
+        else:
+            raise TimeoutError("chains never noticed the node kill")
+        shutil.rmtree(self.node_root(node_id), ignore_errors=True)
+        await self.start_storage_node(node_id)
+        for _ in range(steps):
+            routing = self.mgmtd.state.routing()
+            if all(routing.chains[c].head() is not None for c in affected):
+                break
+            await asyncio.sleep(0.05)
+        else:
+            raise TimeoutError("restarted node's chains never came back")
+        if self.mgmtd_client:
+            await self.mgmtd_client.refresh()
 
     def chain(self, chain_id: int = 1) -> ChainInfo:
         return self.mgmtd.state.routing().chains[chain_id]

@@ -482,3 +482,60 @@ def test_eviction_loop_survives_crashing_pass():
             await sc.close()
             await fab.stop()
     run(body())
+
+
+def test_puts_wedged_on_backpressure_do_not_starve_gets():
+    """Interference regression (mixed-workload soak, crash fault): with
+    the flusher wedged (dead chain analog) and the dirty buffer full,
+    blocked puts must wait for buffer space OUTSIDE the admission window
+    — get_many shares the namespace window and must keep serving.
+    Before the reserve()-first fix, enough wedged puts occupied every
+    namespace slot and reads starved behind writes they never needed."""
+    async def body():
+        fab = StorageFabric(num_nodes=3, replicas=3)
+        await fab.start()
+        sc, tier = await _fabric_tier(
+            fab, "starve", max_dirty_bytes=2048,
+            admit_window=4, admit_class_windows=(4, 4, 4))
+        try:
+            unwedge = asyncio.Event()
+            orig_put = tier.store.put
+
+            async def wedged_put(key, value):
+                await unwedge.wait()
+                return await orig_put(key, value)
+
+            tier.store.put = wedged_put
+            # fill the dirty buffer past the cap (flusher is wedged, so
+            # nothing drains), then pile up MORE puts than the namespace
+            # window has slots
+            for i in range(3):
+                await tier.put(f"fill{i}".encode(), b"x" * 900)
+            puts = [asyncio.create_task(
+                tier.put(f"blocked{i}".encode(), b"y" * 900))
+                for i in range(8)]
+            await asyncio.sleep(0.1)
+            assert all(not t.done() for t in puts)  # all wedged on space
+            assert tier.wb.stats["backpressure_waits"] > 0
+
+            # reads must still make progress (miss path goes to the store
+            # via get_many, which needs the same namespace window)
+            got = await asyncio.wait_for(
+                tier.get_many([b"absent-a", b"absent-b"]), timeout=2.0)
+            assert got == [None, None]
+
+            # a cancelled waiter must not leak its reservation
+            puts[-1].cancel()
+            await asyncio.gather(puts[-1], return_exceptions=True)
+
+            unwedge.set()
+            await asyncio.gather(*puts[:-1])
+            await tier.flush()
+            assert tier.wb.reserved_bytes == 0
+            assert tier.wb.dirty_bytes == 0
+            assert await tier.get(b"blocked0") == b"y" * 900
+        finally:
+            await tier.stop()
+            await sc.close()
+            await fab.stop()
+    run(body())

@@ -72,6 +72,8 @@ def test_resolve_chunk_inverts_layout_naming():
     from t3fs.storage.scrub_scheduler import ScrubStats
     sched.stats = ScrubStats()
     sched._flagged = set()
+    sched.discovery = None
+    sched._unresolved = []
     sched.add_target("f", lay, 77, {0: 8192, 3: 8192})
     for stripe in (0, 3):
         for slot in range(lay.slots):
@@ -214,6 +216,48 @@ def test_scrub_cursor_paces_scan_and_wraps():
     run(body())
 
 
+def test_scrub_skips_stripe_deleted_between_refresh_and_probe():
+    """Checkpoint GC deleting a file between discovery refresh and the
+    stripe probe leaves a target with zero surviving slots.  Repair from
+    nothing is impossible — the scan must count it stripes_vanished and
+    move on, not burn a doomed repair attempt (stripes_failed)."""
+    async def body():
+        cluster = LocalCluster(num_nodes=4, replicas=1, num_chains=8)
+        await cluster.start()
+        try:
+            lay = _layout()
+            ec = ECStorageClient(cluster.sc, use_device_codec=False)
+            for s in range(2):
+                res = await ec.write_stripe(lay, 77, s, bytes(8192))
+                assert all(r.status.code == int(StatusCode.OK)
+                           for r in res)
+            sched = ScrubScheduler(ec, repair_mode="subshard")
+            sched.add_target("gced", lay, 77, {0: 8192, 1: 8192})
+
+            # GC races the scan: every slot of stripe 0 removed
+            routing = cluster.mgmtd.state.routing()
+            for slot in range(lay.slots):
+                cid = lay.shard_chunk(77, 0, slot)
+                chain_id = lay.shard_chain(0, slot)
+                head = routing.chains[chain_id].head()
+                await cluster.admin.call(
+                    routing.node_address(head.node_id),
+                    "Storage.remove_chunks",
+                    RemoveChunksReq(chain_id=chain_id, inode=cid.inode,
+                                    begin_index=cid.index,
+                                    end_index=cid.index + 1))
+
+            report = await sched.scan_once()
+            assert sched.stats.stripes_vanished == 1, sched.stats
+            assert report.stripes_failed == 0, report
+            assert report.repaired_shards == 0
+            assert sched.stats.stripes_scanned == 2   # intact one probed
+        finally:
+            await cluster.stop()
+
+    run(body())
+
+
 # ------------------------------------------------------------ drill smoke
 
 @pytest.mark.slow
@@ -235,3 +279,120 @@ def test_repair_drill_bench_smoke():
     assert cells[("full", 0.0)]["reduced_shards"] == 0
     for c in res["cells"]:
         assert c["bytes_repaired"] == res["lost_bytes"]
+
+
+# ------------------------------------------------- discovery (auto targets)
+
+def test_refresh_targets_add_update_remove_semantics():
+    """Discovery adds new names, updates retained ones in place (cursor
+    survives), drops only discovery-sourced names that vanish, keeps
+    manual registrations, and a discovery failure keeps the old set."""
+    from t3fs.storage.scrub_scheduler import ScrubTarget
+
+    async def body():
+        lay = _layout()
+        sched = ScrubScheduler(None, discovery=None)
+        sched.discovery = None
+        sched.add_target("manual", lay, 11, {0: 8192})
+
+        sets = [
+            [ScrubTarget("a", lay, 77, {0: 8192, 1: 8192}),
+             ScrubTarget("b", lay, 78, {0: 8192})],
+            [ScrubTarget("a", lay, 77, {0: 8192, 1: 8192, 2: 4096})],
+            RuntimeError("meta flake"),
+        ]
+        calls = {"n": 0}
+
+        async def discover():
+            out = sets[min(calls["n"], len(sets) - 1)]
+            calls["n"] += 1
+            if isinstance(out, Exception):
+                raise out
+            return out
+
+        sched.discovery = discover
+        assert await sched.refresh_targets() == 3      # manual + a + b
+        sched._cursor["a"] = 1                          # mid-walk
+        assert await sched.refresh_targets() == 2      # b dropped, manual kept
+        assert "b" not in sched._targets and "manual" in sched._targets
+        assert sched._cursor["a"] == 1                  # cursor survived
+        assert sched._targets["a"].stripe_lens[2] == 4096  # updated in place
+        # failure: registry untouched, counted
+        assert await sched.refresh_targets() == 2
+        assert sched.stats.discovery_errors == 1
+        assert "a" in sched._targets
+
+    run(body())
+
+
+def test_ckpt_manifest_discovery_heals_bitrot_end_to_end(monkeypatch):
+    """The satellite proof: NO manual add_target anywhere.  A committed
+    checkpoint is discovered from its manifest via the meta layer; disk
+    bit-rot flagged by CheckWorker BEFORE the first refresh still heals
+    (parked-unresolved retry); GC'd steps drop out of the registry."""
+    monkeypatch.setenv("T3FS_FORCE_PALLAS_INTERPRET", "1")
+    from t3fs.ckpt.reader import CheckpointReader
+    from t3fs.ckpt.scrub import manifest_discovery
+    from t3fs.ckpt.store import CheckpointStore
+    from t3fs.ckpt.writer import CheckpointWriter
+    from t3fs.fuse.vfs import FileSystem
+
+    async def body():
+        cluster = LocalCluster(num_nodes=3, replicas=1, num_chains=6,
+                               with_meta=True)
+        await cluster.start()
+        try:
+            lay = ECLayout.create(k=4, m=2, chunk_size=2048,
+                                  chains=[1, 2, 3, 4, 5, 6])
+            ec = ECStorageClient(cluster.sc)
+            fs = FileSystem(cluster.mc, cluster.sc)
+            tree = {"w": np.arange(4096, dtype=np.float32),
+                    "b": np.ones(512, dtype=np.float32)}
+            w = CheckpointWriter(ec, fs, lay, "/ckpt/auto")
+            await w.save(1, tree)
+            await w.save(2, tree)
+
+            sched = ScrubScheduler(
+                ec, discovery=manifest_discovery(fs, ["/ckpt/auto"]))
+            store = CheckpointStore(fs, "/ckpt/auto")
+            man = await store.load(2)
+            leaf = man.leaves[0]
+
+            # bit-rot a data shard of step 2 on disk, then CheckWorker
+            # verify -> corrupt_sink BEFORE any discovery refresh ran
+            cid = lay.shard_chunk(leaf.inode, 0, 0)
+            chain_id = lay.shard_chain(0, 0)
+            cluster.corrupt_chunk_on_disk(chain_id, cid)
+            head = cluster.mgmtd.state.routing().chains[chain_id].head()
+            cw = cluster.storage[head.node_id].check
+            cw.corrupt_sink = sched.note_corrupt
+            cw.verify_chunks_per_tick = 10_000
+            await cw.check_once()
+            assert cw.corrupt_found == 1
+            assert sched.stats.flagged_unresolved == 1
+            assert len(sched._unresolved) == 1          # parked, not dropped
+
+            report = await sched.scan_once()
+            assert sched.stats.shards_corrupt == 1, sched.stats
+            assert report.repaired_shards >= 1, report
+            assert not sched._unresolved
+            # both steps' leaves discovered, no add_target call anywhere
+            names = set(sched._targets)
+            assert any("step-1" in n for n in names), names
+            assert any("step-2" in n for n in names), names
+
+            r = CheckpointReader(ec, fs, "/ckpt/auto")
+            got = await r.restore(step=2)
+            assert np.array_equal(got["w"], tree["w"])
+
+            # GC step 1: next refresh drops its targets before the walk
+            # could probe reclaimed chunks
+            await store.gc(cluster.sc, keep_last=1)
+            await sched.refresh_targets()
+            assert not any("step-1" in n for n in sched._targets)
+            assert any("step-2" in n for n in sched._targets)
+            await ec.close()
+        finally:
+            await cluster.stop()
+
+    run(body())

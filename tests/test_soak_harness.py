@@ -1,0 +1,491 @@
+"""Soak harness (ISSUE 13 tentpole): driver start/stop/drain
+discipline, fault-schedule determinism on a seeded fake clock, Jain
+fairness math, crash-mid-checkpoint-cycle resume-not-restart, the
+soak-status admin surface, and the slow-marked end-to-end smoke."""
+
+import asyncio
+import time
+from argparse import Namespace
+
+import numpy as np
+import pytest
+
+from t3fs.soak.drivers import Driver, SoakContext, build_driver
+from t3fs.soak.faults import FaultSchedule
+from t3fs.soak.harvest import grade, jain_fairness, summarize
+from t3fs.soak.spec import (FaultSpec, SoakSpec, WorkloadSpec,
+                            load_spec)
+from t3fs.utils.status import StatusCode, make_error
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+# ------------------------------------------------------------- fairness
+
+def test_jain_fairness_math():
+    """Equal shares are perfectly fair; one-of-n hogging gives 1/n;
+    all-zero is defined as 0.0 (a dead fabric must not grade fair);
+    the index is scale-invariant."""
+    assert jain_fairness([0.5, 0.5, 0.5]) == pytest.approx(1.0)
+    assert jain_fairness([1.0, 0.0, 0.0, 0.0]) == pytest.approx(0.25)
+    assert jain_fairness([]) == 1.0
+    assert jain_fairness([0.0, 0.0]) == 0.0
+    assert jain_fairness([0.2, 0.4]) == pytest.approx(
+        jain_fairness([0.5, 1.0]))
+    # the gate scenario: one workload degraded to half demand
+    assert 0.8 < jain_fairness([1.0, 1.0, 0.5, 1.0]) < 1.0
+
+
+def test_grade_gates_progress_and_wrong_bytes():
+    """Progress + zero-wrong-bytes gate in every cell; fairness only
+    when asked (the faults-on cell reports it but does not gate)."""
+    spec = SoakSpec()
+    spec.workloads = [WorkloadSpec(name="a"), WorkloadSpec(name="b")]
+
+    class FakeDriver:
+        def __init__(self, name, wl, ops):
+            self.name, self.wl, self.ops = name, wl, ops
+            self.errors = self.shed = self.cancelled = 0
+            self.wrong_bytes = 0
+
+    from t3fs.soak.drivers import OpRecord
+    good = [OpRecord(t, 0.01, True, 64) for t in
+            np.linspace(0.1, 8.9, 30)]
+    starved = [OpRecord(t, 0.01, True, 64) for t in
+               np.linspace(0.1, 2.0, 10)]     # silent after window 1
+    drivers = [FakeDriver("a", spec.workloads[0], good),
+               FakeDriver("b", spec.workloads[1], starved)]
+    rep = grade(summarize(spec, drivers, 9.0), spec,
+                require_fairness=False)
+    ok, detail = rep.gates["progress"]
+    assert not ok and "b" in detail
+    assert rep.gates["zero_wrong_bytes"][0]
+    assert "fairness" not in rep.gates
+    drivers[0].wrong_bytes = 3
+    rep2 = grade(summarize(spec, drivers, 9.0), spec,
+                 require_fairness=True)
+    assert not rep2.gates["zero_wrong_bytes"][0]
+    assert "fairness" in rep2.gates
+
+
+# ------------------------------------------- driver lifecycle discipline
+
+class WedgeDriver(Driver):
+    """one_op parks on an event until released; counts completions."""
+
+    def __init__(self, spec, wl, idx, ctx):
+        super().__init__(spec, wl, idx, ctx)
+        self.gate = asyncio.Event()
+        self.started = 0
+        self.finished = 0
+
+    async def one_op(self, worker: int) -> int:
+        self.started += 1
+        await self.gate.wait()
+        self.finished += 1
+        return 1
+
+    async def teardown(self) -> None:
+        pass
+
+
+def _mini_spec(**wl_kw) -> tuple[SoakSpec, WorkloadSpec]:
+    spec = SoakSpec()
+    wl = WorkloadSpec(name="w", **wl_kw)
+    spec.workloads = [wl]
+    return spec, wl
+
+
+def test_open_loop_sheds_beyond_inflight_cap_and_drain_cancels():
+    """Open loop: arrivals beyond the in-flight cap are SHED (counted,
+    never queued — bounded memory is the contract under a fault), and
+    drain cancels whatever outlives the timeout, also counted."""
+    async def body():
+        spec, wl = _mini_spec(mode="open", demand_ops_s=200.0,
+                              concurrency=2)        # cap = max(4, 8) = 8
+        d = WedgeDriver(spec, wl, 0, None)
+        d.start()
+        t0 = time.monotonic()
+        while d.shed < 5 and time.monotonic() - t0 < 5.0:
+            await asyncio.sleep(0.01)
+        assert d.shed >= 5, "arrivals past the cap must shed"
+        assert d.started <= 8, d.started      # cap respected, no queue
+        d.request_stop()
+        await d.drain(timeout_s=0.2)          # ops still wedged: cancel
+        assert d.cancelled == d.started
+        assert d.finished == 0
+        # nothing left running after drain
+        names = {t.get_name() for t in asyncio.all_tasks()}
+        assert not any(n.startswith("soak-w") for n in names), names
+    run(body())
+
+
+def test_closed_loop_drain_waits_for_inflight_then_counts_ok():
+    """Closed loop: stop halts new issues; ops already in flight get
+    the drain window to finish and count as completed, not cancelled."""
+    async def body():
+        spec, wl = _mini_spec(mode="closed", concurrency=3)
+        d = WedgeDriver(spec, wl, 0, None)
+        d.start()
+        t0 = time.monotonic()
+        while d.started < 3 and time.monotonic() - t0 < 5.0:
+            await asyncio.sleep(0.01)
+        d.request_stop()
+        d.gate.set()                  # release mid-drain
+        await d.drain(timeout_s=5.0)
+        assert d.cancelled == 0
+        assert d.finished == 3        # one per worker, none restarted
+        assert len([o for o in d.ops if o.ok]) == 3
+    run(body())
+
+
+def test_driver_errors_counted_not_fatal():
+    """A raising one_op increments errors and the loop keeps going."""
+    async def body():
+        spec, wl = _mini_spec(mode="closed", concurrency=1)
+
+        class FlakyDriver(Driver):
+            async def one_op(self, worker):
+                if len(self.ops) % 2 == 0:
+                    raise RuntimeError("transient")
+                return 1
+
+            async def teardown(self):
+                pass
+
+        d = FlakyDriver(spec, wl, 0, None)
+        d.start()
+        t0 = time.monotonic()
+        while len(d.ops) < 10 and time.monotonic() - t0 < 5.0:
+            await asyncio.sleep(0.01)
+        d.request_stop()
+        await d.drain(timeout_s=2.0)
+        assert d.errors >= 4
+        assert len([o for o in d.ops if o.ok]) >= 4
+    run(body())
+
+
+# --------------------------------------------- fault schedule determinism
+
+class FakeClock:
+    def __init__(self):
+        self.t = 100.0               # arbitrary epoch: schedule is relative
+
+    def __call__(self):
+        return self.t
+
+    async def sleep(self, d):
+        self.t += d
+
+
+class RecordingInjector:
+    def __init__(self):
+        self.calls = []
+
+    async def straggler(self, node, delay_s):
+        self.calls.append(("straggler", node, delay_s))
+        return f"delay={delay_s}"
+
+    async def straggler_clear(self, node):
+        self.calls.append(("clear", node, 0))
+        return ""
+
+    async def crash(self, node):
+        self.calls.append(("crash", node, 0))
+        return "restarted"
+
+    async def bitrot(self, node, chunks):
+        self.calls.append(("bitrot", node, chunks))
+        return f"{chunks} shards"
+
+
+def _fault_spec(seed: int) -> SoakSpec:
+    spec = SoakSpec()
+    spec.seed = seed
+    spec.nodes = 5
+    # node=0 everywhere: every pick comes from the seeded stream
+    spec.faults = [FaultSpec(at_s=1.0, kind="crash"),
+                   FaultSpec(at_s=2.0, kind="bitrot", chunks=3),
+                   FaultSpec(at_s=3.0, kind="straggler",
+                             duration_s=2.0, delay_ms=10.0),
+                   FaultSpec(at_s=4.0, kind="crash")]
+    return spec
+
+
+def test_fault_schedule_is_deterministic_under_seeded_clock():
+    """Same seed + same clock => identical (t, kind, node) sequences,
+    including which nodes the seeded stream picks; a different seed
+    moves the picks (same kinds/times)."""
+    async def replay(seed):
+        clock = FakeClock()
+        inj = RecordingInjector()
+        sched = FaultSchedule(_fault_spec(seed), inj,
+                              clock=clock, sleep=clock.sleep)
+        events = await sched.run()
+        return [(e.t, e.kind, e.node, e.ok) for e in events], inj.calls
+
+    ev_a, calls_a = run(replay(13))
+    ev_b, calls_b = run(replay(13))
+    assert ev_a == ev_b
+    assert calls_a == calls_b
+    main_a = [e for e in ev_a if e[1] != "straggler-clear"]
+    assert [(e[0], e[1]) for e in main_a] == [
+        (1.0, "crash"), (2.0, "bitrot"), (3.0, "straggler"),
+        (4.0, "crash")]
+    assert all(1 <= e[2] <= 5 for e in ev_a)
+    assert all(e[3] for e in ev_a)
+    # the straggler got its clear, on the same node
+    strag = next(e for e in ev_a if e[1] == "straggler")
+    clear = next(e for e in ev_a if e[1] == "straggler-clear")
+    assert clear[2] == strag[2]
+    ev_c, _ = run(replay(14))
+    assert [(e[1], e[2]) for e in ev_c] != [(e[1], e[2]) for e in ev_a]
+
+
+def test_fault_schedule_survives_injector_failure():
+    """A raising injector records ok=False and later faults still run."""
+    async def body():
+        clock = FakeClock()
+
+        class Boom(RecordingInjector):
+            async def crash(self, node):
+                raise RuntimeError("node already down")
+
+        inj = Boom()
+        sched = FaultSchedule(_fault_spec(13), inj,
+                              clock=clock, sleep=clock.sleep)
+        events = await sched.run()
+        crashes = [e for e in events if e.kind == "crash"]
+        assert len(crashes) == 2 and not any(e.ok for e in crashes)
+        assert "node already down" in crashes[0].detail
+        assert any(e.kind == "bitrot" and e.ok for e in events)
+    run(body())
+
+
+def test_bitrot_skips_stale_picks_and_retries():
+    """Bit-rot picks go stale under live traffic (checkpoint GC,
+    crash-wiped disks, headless chains): the injector must oversample
+    past dead picks and only fail when NOTHING is left to rot."""
+    from types import SimpleNamespace
+
+    from t3fs.client.ec_client import ECLayout
+    from t3fs.soak.faults import LiveInjector
+
+    lay = ECLayout.create(k=4, m=2, chunk_size=2048,
+                          chains=[11, 12, 13, 14, 15, 16])
+    scrub = SimpleNamespace(_targets={
+        "ck/step-4/a": SimpleNamespace(name="ck/step-4/a", layout=lay,
+                                       inode=76,
+                                       stripe_lens={0: 8192}),
+        "ck/step-5/a": SimpleNamespace(name="ck/step-5/a", layout=lay,
+                                       inode=77,
+                                       stripe_lens={0: 8192, 1: 8192})})
+
+    class FlakyCluster:
+        def __init__(self, stale_before):
+            self.calls = 0
+            self.stale_before = stale_before
+            self.rotted_inodes = []
+
+        def corrupt_chunk_on_disk(self, chain_id, chunk_id):
+            self.calls += 1
+            if self.calls <= self.stale_before:
+                return False
+            self.rotted_inodes.append(chunk_id.inode)
+            return True
+
+    async def body():
+        # first two picks stale (GC'd / wiped), then live: succeeds
+        cl = FlakyCluster(stale_before=2)
+        inj = LiveInjector(cl, scrub=scrub,
+                           rng=np.random.default_rng(7))
+        detail = await inj.bitrot(0, chunks=2)
+        assert detail == "2 shards (2 stale picks)", detail
+        # picks restrict to the newest step (inode 77 = step-5): the
+        # older step is one GC tick from deletion
+        lay77 = {lay.shard_chunk(77, s, i).inode
+                 for s in (0, 1) for i in range(lay.k)}
+        assert set(cl.rotted_inodes) <= lay77
+
+        # everything stale forever: a clean RuntimeError, not a
+        # TypeError from scribbling a nonexistent chunk
+        cl2 = FlakyCluster(stale_before=10**9)
+        inj2 = LiveInjector(cl2, scrub=scrub,
+                            rng=np.random.default_rng(7))
+        with pytest.raises(RuntimeError, match="no live EC shard"):
+            await inj2.bitrot(0, chunks=2)
+
+    run(body())
+
+
+# ------------------------------------- checkpoint crash-cycle resume
+
+def test_crash_mid_checkpoint_cycle_resumes_same_step(monkeypatch):
+    """A save that dies partway (every write failing after the first
+    stripe's worth) leaves the step counter untouched; the NEXT cycle
+    saves the SAME step and skips the already-committed stripes
+    (CRC-probe resume), i.e. the crash cost is the tail, not the whole
+    checkpoint."""
+    monkeypatch.setenv("T3FS_FORCE_PALLAS_INTERPRET", "1")
+
+    async def body():
+        from t3fs.testing.cluster import LocalCluster
+        cluster = LocalCluster(num_nodes=3, replicas=3, num_chains=2,
+                               with_meta=True, ec_chains=6)
+        await cluster.start()
+        try:
+            spec = SoakSpec()
+            spec.nodes = 3
+            spec.chains = 2
+            spec.ec_chains = 6
+            spec.ec_k = 4
+            spec.ec_m = 2
+            spec.ec_chunk_size = 2048
+            wl = WorkloadSpec(name="ck", kind="checkpoint", tree_kb=64,
+                              keep_last=2)
+            spec.workloads = [wl]
+            ctx = SoakContext(cluster, spec,
+                              repl_chains=[1, 2],
+                              ec_chain_ids=[3, 4, 5, 6, 7, 8])
+            drv = build_driver(spec, wl, 0, ctx)
+            await drv.setup()
+            try:
+                assert await drv.one_op(0) > 0       # step 1 full cycle
+                assert drv.step == 2
+
+                # wound the fabric mid-save: first 12 writes of the
+                # next save succeed (>= one stripe of 6 shards), the
+                # rest fail hard
+                real_write = drv.sc.write_chunk
+                calls = {"n": 0}
+
+                async def flaky(*a, **kw):
+                    calls["n"] += 1
+                    if calls["n"] > 12:
+                        raise make_error(StatusCode.TIMEOUT,
+                                         "injected crash")
+                    return await real_write(*a, **kw)
+
+                drv.sc.write_chunk = flaky
+                drv.writer.shard_retries = 0
+                with pytest.raises(Exception):
+                    await drv.one_op(0)
+                assert drv.step == 2, "failed cycle must not advance"
+
+                drv.sc.write_chunk = real_write      # fabric heals
+                before = drv.resumed_stripes
+                assert await drv.one_op(0) > 0
+                assert drv.step == 3
+                assert drv.resumed_stripes > before, \
+                    "resume must skip committed stripes, not restart"
+                steps = await drv.store.list_steps()
+                assert 2 in steps
+            finally:
+                await drv.teardown()
+        finally:
+            await cluster.stop()
+    run(body())
+
+
+# ------------------------------------------------- spec loading
+
+def test_load_spec_splices_workloads_and_faults():
+    spec = load_spec("""
+name = "t"
+duration_s = 5.0
+[slo]
+min_fairness = 0.7
+[[workload]]
+kind = "dataloader"
+[[workload]]
+kind = "dataloader"
+mode = "closed"
+[[fault]]
+at_s = 3.0
+kind = "bitrot"
+[[fault]]
+at_s = 1.0
+kind = "crash"
+""")
+    assert [w.name for w in spec.workloads] == ["dataloader",
+                                                "dataloader1"]
+    assert [f.kind for f in spec.faults] == ["crash", "bitrot"]  # sorted
+    assert spec.slo.min_fairness == 0.7
+    with pytest.raises(Exception):
+        load_spec("[[workload]]\nkind = \"nope\"\n")
+
+
+def test_shipped_scenarios_parse_and_validate():
+    full = load_spec("configs/soak.toml")
+    assert len(full.workloads) >= 5
+    assert len(full.faults) >= 2
+    assert {f.kind for f in full.faults} >= {"straggler", "crash",
+                                             "bitrot"}
+    assert {w.data_plane for w in full.workloads} == {"rpc", "ring"}
+    smoke = load_spec("configs/soak_smoke.toml")
+    assert len(smoke.workloads) == 3 and len(smoke.faults) == 1
+    assert smoke.duration_s <= 15.0
+
+
+# ------------------------------------------------- admin surface
+
+def test_admin_soak_status_renders_latest_rows(capsys):
+    """soak-status collapses the metric stream to the newest row per
+    workload, over the same Monitor.query RPC the other admin verbs
+    use."""
+    async def body():
+        from t3fs.cli.admin import AdminContext, soak_status
+        from t3fs.monitor.service import MonitorCollectorServer
+        mon = MonitorCollectorServer()
+        await mon.start()
+        ctx = AdminContext("", monitor=mon.server.address)
+        try:
+            mon.db.insert(0, "soak", 100.0, [
+                {"name": "soak.loader.ops", "value": 10},
+                {"name": "soak.loader.errors", "value": 0},
+                {"name": "soak.loader.p50_ms", "value": 2.5}])
+            mon.db.insert(0, "soak", 101.0, [
+                {"name": "soak.loader.ops", "value": 25},
+                {"name": "soak.loader.errors", "value": 1},
+                {"name": "soak.loader.p50_ms", "value": 3.5},
+                {"name": "soak.ckpt.ops", "value": 4},
+                {"name": "soak.ckpt.errors", "value": 0},
+                {"name": "soak.ckpt.p50_ms", "value": 150.0}])
+            await soak_status(ctx, Namespace(since=0.0, limit=500))
+        finally:
+            await ctx.close()
+            await mon.stop()
+    run(body())
+    out = capsys.readouterr().out
+    lines = [ln for ln in out.splitlines() if ln.strip()]
+    loader = next(ln for ln in lines if "loader" in ln)
+    assert "25" in loader and "3.50" in loader     # newest row wins
+    assert any("ckpt" in ln for ln in lines)
+
+
+# ------------------------------------------------- end-to-end smoke
+
+@pytest.mark.slow
+def test_soak_smoke_end_to_end():
+    """The CI-lane scenario, shortened: 3 drivers + 1 live straggler on
+    a real fabric, graded.  Asserts the acceptance invariants at smoke
+    scale: zero wrong bytes, every driver progresses in every window,
+    the fault fired and cleared."""
+    async def body():
+        from t3fs.soak.runner import SoakRunner
+        spec = load_spec("configs/soak_smoke.toml")
+        spec.duration_s = 8.0
+        spec.faults[0].at_s = 2.0
+        spec.faults[0].duration_s = 2.0
+        rep = await SoakRunner(spec, progress=lambda m: None).run()
+        assert rep.wrong_bytes == 0
+        assert rep.gates["zero_wrong_bytes"][0]
+        assert rep.gates["progress"][0], rep.gates
+        assert all(w.ops_ok > 0 for w in rep.workloads)
+        kinds = [e.kind for e in rep.fault_events]
+        assert kinds == ["straggler", "straggler-clear"]
+        assert all(e.ok for e in rep.fault_events)
+        assert rep.passed
+    run(body())
