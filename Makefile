@@ -19,7 +19,8 @@ SAN_FILTER := -k "not device"
 
 .PHONY: test lint sanitize sanitize-thread sanitize-address probe \
         on-device ci ckpt-bench write-bench read-bench \
-        kvcache-fleet-bench repair-drill usrbio-bench soak soak-smoke
+        kvcache-fleet-bench repair-drill usrbio-bench soak soak-smoke \
+        health-smoke health-bench
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -85,6 +86,19 @@ soak:
 soak-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m benchmarks.soak_bench \
 		--config configs/soak_smoke.toml --cells on --json
+
+# Cluster health plane end-to-end (ISSUE 14): monitor + mgmtd + 3
+# storage nodes under live reads; injects a 10 ms straggler, asserts it
+# shows flagged in the mgmtd-pulled scorecard within one rollup window
+# and clears after the fault lifts.  ~10 s; exits non-zero on a miss.
+health-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m benchmarks.health_smoke
+
+# Scorecard-priors A/B (ISSUE 14): cold-client first-read p99 under a
+# known 10 ms straggler, priors on vs off (target >= 30% better), plus
+# the steady-state p50 overhead guard (within 3% of plane-off).
+health-bench:
+	JAX_PLATFORMS=cpu $(PY) -m benchmarks.health_bench --json
 
 # Bounded TPU-tunnel probe; ALWAYS appends a dated record to
 # DEVICE_PROBE_LOG.jsonl (proof the chip was retried, r3 verdict #1).

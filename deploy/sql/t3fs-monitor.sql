@@ -44,3 +44,34 @@ CREATE TABLE IF NOT EXISTS spans (
 );
 CREATE INDEX IF NOT EXISTS spans_trace ON spans (trace_id);
 CREATE INDEX IF NOT EXISTS spans_name_dur ON spans (name, dur_s);
+-- arrival-time index: the health plane's rollup pass scans half-open
+-- [high-water-mark, now-lag) windows by row ts (t3fs/monitor/rollup.py)
+CREATE INDEX IF NOT EXISTS spans_ts ON spans (ts);
+
+-- Time-bucketed per-(node, method) digests written by the continuous
+-- rollup pass (cluster health plane, docs/observability.md).  addr !=
+-- '' rows are span-sourced (exact percentiles, hop decomposition,
+-- worst-trace drill-down, per-size-class tails in payload JSON); addr
+-- == '' rows fold serving-side rpc.latency windows (unbiased, SLO
+-- input).  Own retention (rollup_max_age_s), independent of the raw
+-- tables above.
+CREATE TABLE IF NOT EXISTS rollups (
+  bucket_ts REAL NOT NULL,
+  bucket_s REAL NOT NULL,
+  node_id INTEGER NOT NULL,
+  addr TEXT NOT NULL,
+  method TEXT NOT NULL,
+  count INTEGER NOT NULL,
+  errors INTEGER NOT NULL,
+  p50_s REAL NOT NULL,
+  p99_s REAL NOT NULL,
+  wire_s REAL NOT NULL,
+  queue_s REAL NOT NULL,
+  apply_s REAL NOT NULL,
+  forward_s REAL NOT NULL,
+  worst_dur_s REAL NOT NULL,
+  worst_trace_id INTEGER NOT NULL,
+  payload TEXT NOT NULL
+);
+CREATE INDEX IF NOT EXISTS rollups_ts ON rollups (bucket_ts);
+CREATE INDEX IF NOT EXISTS rollups_key ON rollups (addr, method, bucket_ts);

@@ -37,6 +37,10 @@ async def serve(cfg: MgmtdMainConfig, app: ApplicationBase) -> None:
 
     async def start():
         await rpc.start()
+        # default the health puller at the same monitor the metrics go
+        # to, unless [service] pins its own
+        if cfg.monitor_address and not cfg.service.monitor_address:
+            cfg.service.monitor_address = cfg.monitor_address
         srv = MgmtdServer(kv, cfg.node_id, rpc.address, cfg.service,
                           admin_token=cfg.admin_token)
         for svc in srv.services:

@@ -8,6 +8,8 @@ import asyncio
 from dataclasses import dataclass
 
 from t3fs.app.base import ApplicationBase, LogConfig
+from t3fs.monitor.health import HealthConfig
+from t3fs.monitor.rollup import RollupConfig
 from t3fs.monitor.service import MonitorCollectorServer
 from t3fs.utils.config import ConfigBase, citem, cobj
 
@@ -18,11 +20,21 @@ class MonitorMainConfig(ConfigBase):
     listen_port: int = citem(0, hot=False)
     db_path: str = citem(":memory:", hot=False)
     port_file: str = citem("", hot=False)
+    # raw-table retention (0 = unbounded; rollups keep their own age cap)
+    max_age_s: float = citem(0.0, hot=False)
+    max_rows: int = citem(0, hot=False)
+    # health plane (ISSUE 14): continuous rollup pass + scorecard knobs
+    rollup: RollupConfig = cobj(RollupConfig)
+    health: HealthConfig = cobj(HealthConfig)
     log: LogConfig = cobj(LogConfig)
 
 
 async def serve(cfg: MonitorMainConfig, app: ApplicationBase) -> None:
-    srv = MonitorCollectorServer(cfg.db_path, cfg.listen_host, cfg.listen_port)
+    srv = MonitorCollectorServer(cfg.db_path, cfg.listen_host,
+                                 cfg.listen_port, max_age_s=cfg.max_age_s,
+                                 max_rows=cfg.max_rows,
+                                 rollup_cfg=cfg.rollup,
+                                 health_cfg=cfg.health)
 
     async def start():
         await srv.start()

@@ -30,12 +30,15 @@ from t3fs.core.service import (
 )
 from t3fs.fuse.vfs import FileSystem
 from t3fs.mgmtd.service import (
-    GetConfigTemplateReq, SetChainsReq, SetConfigTemplateReq,
+    ClusterHealthReq, GetConfigTemplateReq, SetChainsReq,
+    SetConfigTemplateReq,
 )
 from t3fs.mgmtd.types import (
     ChainInfo, ChainTable, ChainTargetInfo, PublicTargetState,
 )
-from t3fs.monitor.service import QueryMetricsReq, QuerySpansReq
+from t3fs.monitor.service import (
+    HealthReq, QueryMetricsReq, QuerySpansReq, SloReportReq,
+)
 from t3fs.net.client import Client
 from t3fs.ops.codec import crc32c
 from t3fs.storage.types import SyncStartReq
@@ -1237,25 +1240,116 @@ async def soak_status(ctx: AdminContext, args) -> None:
               "this monitor?)")
         return
     print(_fmt_table(rows, ["workload", "ops", "errors", "p50_ms"]))
+    # per-node health from the same monitor's scorecard: shows which
+    # node the fault schedule is currently hurting (ISSUE 14)
+    try:
+        hrsp, _ = await ctx.cli.call(ctx.monitor_address, "Monitor.health",
+                                     HealthReq())
+    except StatusError:
+        return   # pre-health monitor: workload table alone is still useful
+    if hrsp.health is not None and hrsp.health.nodes:
+        nrows = [[n.addr, n.state,
+                  f"{n.read_p99_s * 1e3:.2f}{_TREND.get(n.trend, '')}"
+                  if n.count else "-"]
+                 for n in hrsp.health.nodes]
+        print(_fmt_table(nrows, ["node", "health", "p99_ms"]))
 
 
 @command("trace-slow", "top-N slow exported traces (local roots) per method")
 @args_(("--method", {"default": "", "help": "span name prefix filter"}),
        ("--min-ms", {"type": float, "default": 0.0}),
+       ("--since", {"type": float, "default": 0.0,
+                    "help": "only spans that ARRIVED in the last N "
+                            "seconds (0 = no bound)"}),
        ("--limit", {"type": int, "default": 20}))
 async def trace_slow(ctx: AdminContext, args) -> None:
     if not ctx.monitor_address:
         raise SystemExit("trace-slow needs --monitor ADDR")
+    ts_min = (time.time() - args.since) if args.since > 0 else 0.0
     rsp, _ = await ctx.cli.call(ctx.monitor_address, "Monitor.query_spans",
                                 QuerySpansReq(name_prefix=args.method,
                                               min_dur_s=args.min_ms / 1e3,
                                               roots_only=True,
-                                              limit=args.limit))
+                                              limit=args.limit,
+                                              ts_min=ts_min))
     rows = [[f"{s['trace_id']:#x}", s["name"],
              s.get("tags", {}).get("addr") or f"node{s.get('node_id', '?')}",
              f"{s['dur_s'] * 1e3:.2f}", s.get("status", 0)]
             for s in rsp.spans]
     print(_fmt_table(rows, ["trace", "root", "node", "ms", "status"]))
+
+
+_TREND = {1: "↗", 0: "→", -1: "↘"}   # ↗ → ↘
+
+
+def render_cluster_health(health) -> str:
+    """Scorecard table (monitor/health.py ClusterHealth): per-node state,
+    p50/p99 with trend arrow, straggler/stale flags, and the worst slow
+    trace id so `trace-show` can drill straight into the tail."""
+    if health is None or not health.nodes:
+        return "(no scorecard — monitor has no rollups yet?)"
+    rows = []
+    for n in health.nodes:
+        rows.append([
+            n.addr or "?", str(n.node_id or "?"), n.state,
+            f"{n.read_p50_s * 1e3:.2f}" if n.count else "-",
+            (f"{n.read_p99_s * 1e3:.2f}{_TREND.get(n.trend, '')}"
+             if n.count else "-"),
+            f"{n.err_rate * 100:.2f}%" if n.count else "-",
+            str(n.count),
+            f"{n.worst_trace_id:#x}" if n.worst_trace_id else "-",
+        ])
+    head = (f"cluster p99 {health.cluster_read_p99_s * 1e3:.2f}ms, "
+            f"window {health.window_s:.0f}s, "
+            f"freshness bound {health.freshness_s:.1f}s")
+    return head + "\n" + _fmt_table(
+        rows, ["addr", "node", "state", "p50_ms", "p99_ms", "err",
+               "reads", "worst_trace"])
+
+
+@command("cluster-health", "per-node scorecard (rollup-derived: state, "
+                           "p50/p99 trend, straggler/stale flags)")
+@args_(("--window", {"type": float, "default": 0.0,
+                     "help": "scorecard window seconds (0 = server "
+                             "default)"}),)
+async def cluster_health(ctx: AdminContext, args) -> None:
+    """Prefers the monitor (fresh: runs a rollup pass on query); falls
+    back to mgmtd's cached copy — the same compact scorecard it
+    piggybacks on GetRoutingInfoRsp."""
+    if ctx.monitor_address:
+        rsp, _ = await ctx.cli.call(ctx.monitor_address, "Monitor.health",
+                                    HealthReq(window_s=args.window))
+        print(render_cluster_health(rsp.health))
+        return
+    rsp, _ = await ctx.cli.call(ctx.mgmtd_address, "Mgmtd.cluster_health",
+                                ClusterHealthReq())
+    print(render_cluster_health(rsp.health))
+    if rsp.health is not None:
+        print(f"(mgmtd cache, version {rsp.health_version})")
+
+
+@command("slo-report", "per-method availability + latency objectives "
+                       "over the rollup window")
+@args_(("--window", {"type": float, "default": 0.0}),)
+async def slo_report(ctx: AdminContext, args) -> None:
+    if not ctx.monitor_address:
+        raise SystemExit("slo-report needs --monitor ADDR")
+    rsp, _ = await ctx.cli.call(ctx.monitor_address, "Monitor.slo_report",
+                                SloReportReq(window_s=args.window))
+    rep = rsp.report
+    if rep is None or not rep.methods:
+        return print("(no rollups in window)")
+    rows = [[m.method, str(m.count), str(m.errors),
+             f"{m.availability * 100:.3f}%", f"{m.avail_target * 100:.1f}%",
+             f"{m.p50_s * 1e3:.2f}", f"{m.p99_s * 1e3:.2f}",
+             (f"{m.p99_target_s * 1e3:.1f}" if m.p99_target_s else "-"),
+             "PASS" if m.ok else "FAIL"]
+            for m in rep.methods]
+    print(_fmt_table(rows, ["method", "count", "errors", "avail",
+                            "target", "p50_ms", "p99_ms", "p99_tgt",
+                            "slo"]))
+    print(f"window {rep.window_s:.0f}s: "
+          f"{'ALL PASS' if rep.ok else 'VIOLATIONS'}")
 
 
 @command("bench", "quick write+read bench through meta+storage")

@@ -30,10 +30,15 @@ class MgmtdClient:
 
     def __init__(self, mgmtd_address: str, client: Client | None = None,
                  refresh_period_s: float = 0.5, client_id: str = "",
-                 description: str = ""):
+                 description: str = "", seed_read_priors: bool = True):
         self.mgmtd_address = mgmtd_address
         self.client = client or Client()
         self.refresh_period_s = refresh_period_s
+        # ISSUE 14: seed process-wide ReadStats priors from the scorecard
+        # mgmtd piggybacks on GetRoutingInfoRsp, so a COLD client's
+        # adaptive read selection and hedge clamps avoid known-slow nodes
+        # on the very first read; live local samples override the prior
+        self.seed_read_priors = seed_read_priors
         # non-empty client_id opts into mgmtd client-session tracking
         # (fbs/mgmtd/ClientSession.h); extended on its own cadence, NOT per
         # refresh tick — a KV write per 0.5s per client to maintain a 60s
@@ -43,6 +48,8 @@ class MgmtdClient:
         self.session_extend_period_s = 20.0
         self._last_extend_sent = 0.0
         self._routing = RoutingInfo(version=0)
+        self.health = None              # latest ClusterHealth piggyback
+        self._health_version = 0
         self._task: asyncio.Task | None = None
         self._stopped = asyncio.Event()
 
@@ -71,13 +78,40 @@ class MgmtdClient:
         try:
             rsp, _ = await self.client.call(
                 self.mgmtd_address, "Mgmtd.get_routing_info",
-                GetRoutingInfoReq(known_version=self._routing.version),
+                GetRoutingInfoReq(known_version=self._routing.version,
+                                  known_health_version=self._health_version),
                 timeout=5.0)
             if rsp.info is not None:
                 self._routing = rsp.info
+            # getattr: a pre-scorecard mgmtd's rsp has no health fields
+            health = getattr(rsp, "health", None)
+            if health is not None:
+                self.health = health
+                self._health_version = getattr(rsp, "health_version", 0)
+                if self.seed_read_priors:
+                    self._seed_read_priors(health)
         except StatusError as e:
             log.warning("routing refresh failed: %s", e)
         return self._routing
+
+    def _seed_read_priors(self, health) -> None:
+        """Push scorecard latency hints into the process-wide ReadStats
+        as priors.  seed_prior only takes on addresses with NO live
+        samples yet, so a warm client's own measurements always win;
+        unknown/stale nodes are skipped — an absent prior (optimistic
+        cold-start) beats a wrong one."""
+        from t3fs.net.rpcstats import READ_STATS
+        for nh in health.nodes:
+            if nh.stale or not nh.count:
+                continue
+            cls = {}
+            for cls_id, p9x_ms in (nh.cls_p9x_ms or {}).items():
+                try:
+                    cls[int(cls_id)] = float(p9x_ms) / 1e3
+                except (TypeError, ValueError):
+                    continue
+            READ_STATS.seed_prior(nh.addr, p50_s=nh.read_p50_s,
+                                  p9x_s=nh.read_p99_s, cls_p9x_s=cls)
 
     async def start(self) -> None:
         await self.refresh()

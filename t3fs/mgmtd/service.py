@@ -25,6 +25,7 @@ from t3fs.mgmtd.types import (
     NodeInfo, PublicTargetState, RoutingInfo,
 )
 from t3fs.mgmtd.types import NodeStatus as NodeStatusEnum
+from t3fs.monitor.health import ClusterHealth
 from t3fs.net.server import rpc_method, service
 from t3fs.net.wire import OkRsp
 from t3fs.utils import serde
@@ -66,12 +67,34 @@ class HeartbeatRsp:
 @dataclass
 class GetRoutingInfoReq:
     known_version: int = 0
+    # appended (serde add-only, like PR 11's trace fields): scorecard
+    # version the caller already holds; 0 asks for whatever is cached
+    known_health_version: int = 0
 
 
 @serde_struct
 @dataclass
 class GetRoutingInfoRsp:
     info: RoutingInfo | None = None   # None when caller is up to date
+    # appended (add-only): cluster health scorecard piggyback — present
+    # when the primary has pulled one from the monitor AND the caller's
+    # known_health_version is behind; old clients drop the extra fields,
+    # old servers leave them at defaults (None/0)
+    health: ClusterHealth | None = None
+    health_version: int = 0
+
+
+@serde_struct
+@dataclass
+class ClusterHealthReq:
+    pass
+
+
+@serde_struct
+@dataclass
+class ClusterHealthRsp:
+    health: ClusterHealth | None = None
+    health_version: int = 0
 
 
 @serde_struct
@@ -133,6 +156,12 @@ class MgmtdConfig(ConfigBase):
     lease_extend_period_s: float = citem(3.0, validator=lambda v: v > 0)
     client_session_ttl_s: float = citem(60.0, validator=lambda v: v > 0)
     sessions_check_period_s: float = citem(5.0, validator=lambda v: v > 0)
+    # cluster health plane (ISSUE 14): the primary pulls the scorecard
+    # from the monitor and piggybacks it on GetRoutingInfoRsp.  Empty
+    # monitor_address disables the puller (pre-health deployments)
+    monitor_address: str = citem("")
+    health_pull_period_s: float = citem(1.0, validator=lambda v: v > 0)
+    health_window_s: float = citem(30.0, validator=lambda v: v > 0)
 
 
 class MgmtdState:
@@ -165,6 +194,12 @@ class MgmtdState:
         # latest scrub/repair health per reporting source (pushed by
         # report_repair_status; in-memory like last_heartbeat)
         self.repair_statuses: dict[str, "RepairStatus"] = {}
+        # cluster health scorecard pulled from the monitor (in-memory,
+        # like liveness: re-pulled within one period after a failover).
+        # health_version bumps on every refreshed pull so clients can
+        # version-gate the GetRoutingInfoRsp piggyback
+        self.health: ClusterHealth | None = None
+        self.health_version: int = 0
         # startup grace: a restarted mgmtd has an empty liveness map — treat
         # every node as alive until one full heartbeat window has passed, or
         # the first updater tick would demote the whole healthy cluster
@@ -797,9 +832,23 @@ class MgmtdService:
     @rpc_method
     async def get_routing_info(self, req: GetRoutingInfoReq, payload, conn):
         info = self.state.routing()
-        if req.known_version >= info.version:
-            return GetRoutingInfoRsp(info=None), b""
-        return GetRoutingInfoRsp(info=info), b""
+        rsp = GetRoutingInfoRsp(
+            info=None if req.known_version >= info.version else info)
+        # scorecard piggyback rides even when routing is unchanged —
+        # health moves on its own clock (the monitor pull period)
+        st = self.state
+        if st.health is not None \
+                and req.known_health_version < st.health_version:
+            rsp.health = st.health
+            rsp.health_version = st.health_version
+        return rsp, b""
+
+    @rpc_method
+    async def cluster_health(self, req: ClusterHealthReq, payload, conn):
+        """Admin op: the scorecard the primary last pulled from the
+        monitor (what GetRoutingInfoRsp piggybacks)."""
+        return ClusterHealthRsp(health=self.state.health,
+                                health_version=self.state.health_version), b""
 
     @rpc_method
     async def set_chains(self, req: SetChainsReq, payload, conn):
@@ -1204,6 +1253,9 @@ class MgmtdServer:
             asyncio.create_task(self._sessions_checker(),
                                 name="mgmtd-sessions"),
         ]
+        if self.cfg.monitor_address:
+            self._tasks.append(asyncio.create_task(
+                self._health_puller(), name="mgmtd-health"))
 
     async def stop(self) -> None:
         self._stopped.set()
@@ -1231,6 +1283,46 @@ class MgmtdServer:
                 await self.update_chains_once()
             except Exception:
                 log.exception("chains updater failed")
+
+    async def _health_puller(self) -> None:
+        """Primary-only pull of the cluster health scorecard from the
+        monitor (ISSUE 14): Monitor.health → state.health, version-bumped
+        so GetRoutingInfoRsp piggybacks only genuinely newer scorecards.
+        Monitor down = keep the last scorecard; its freshness bound makes
+        staleness explicit to consumers."""
+        from t3fs.monitor.service import HealthReq
+        from t3fs.net.client import Client
+
+        cli = Client()
+        try:
+            while not self._stopped.is_set():
+                await asyncio.sleep(self.cfg.health_pull_period_s)
+                try:
+                    if not await self.state.is_primary():
+                        continue
+                    rsp, _ = await cli.call(
+                        self.cfg.monitor_address, "Monitor.health",
+                        HealthReq(window_s=self.cfg.health_window_s),
+                        timeout=5.0)
+                    health = getattr(rsp, "health", None)
+                    if health is None:
+                        continue
+                    # rollup rows carry the REPORTER's node id; resolve
+                    # serving addrs to routing node ids so consumers can
+                    # join the scorecard against chain targets
+                    addr_to_node = {n.address: n.node_id
+                                    for n in self.state.routing().nodes.values()}
+                    for nh in health.nodes:
+                        nh.node_id = addr_to_node.get(nh.addr, nh.node_id)
+                    self.state.health = health
+                    self.state.health_version += 1
+                except Exception as e:
+                    # warning, not exception: a briefly-unreachable
+                    # monitor is routine and re-tried next period
+                    log.warning("health pull from %s failed: %s",
+                                self.cfg.monitor_address, e)
+        finally:
+            await cli.close()
 
     async def _sessions_checker(self) -> None:
         """Prune client sessions whose lease expired

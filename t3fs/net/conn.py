@@ -20,7 +20,7 @@ from t3fs.net.wire import (
     WireStatus, check_msg_crc, decompress_frame, maybe_compress, pack_header,
     unpack_header,
 )
-from t3fs.net.rpcstats import RPC_STATS
+from t3fs.net.rpcstats import RPC_STATS, SERVER_STATS
 from t3fs.ops.codec import crc32c
 from t3fs.utils import serde, tracing
 from t3fs.utils.status import Status, StatusCode, StatusError, make_error
@@ -209,7 +209,8 @@ class Connection:
                     method, total,
                     squeue=started - rsp.ts_server_received,
                     server=rsp.ts_server_replied - started,
-                    network=max(0.0, total - server_span))
+                    network=max(0.0, total - server_span),
+                    ok=rsp.status.code == int(StatusCode.OK))
             status = rsp.status.to_status()
             status.raise_if_error()
             return rsp.body, rsp_payload
@@ -292,6 +293,15 @@ class Connection:
                 rsp.status = WireStatus(int(StatusCode.INTERNAL), f"{type(e).__name__}: {e}")
                 sp.set_status(int(StatusCode.INTERNAL))
         rsp.ts_server_replied = time.time()
+        # serving-side per-method stats: unlike the client-side record in
+        # call() (which attributes latency to the CALLER's process), this
+        # lands in the process that served the request — the per-node
+        # signal the monitor's health rollups fold (t3fs/monitor/rollup.py)
+        SERVER_STATS.record(
+            packet.method, rsp.ts_server_replied - rsp.ts_server_received,
+            squeue=rsp.ts_server_started - rsp.ts_server_received,
+            server=rsp.ts_server_replied - rsp.ts_server_started,
+            network=0.0, ok=rsp.status.code == int(StatusCode.OK))
         if packet.uuid == 0:
             return  # one-way post(): no response frame (errors logged above)
         try:

@@ -32,12 +32,13 @@ RESERVOIR = 2048
 
 
 class _MethodStats:
-    __slots__ = ("count", "total_s", "samples",
-                 "wcount", "wtotal_s", "wsamples")
+    __slots__ = ("count", "total_s", "errors", "samples",
+                 "wcount", "wtotal_s", "werrors", "wsamples")
 
     def __init__(self):
         self.count = 0
         self.total_s = 0.0
+        self.errors = 0
         # each sample: (total, squeue, server, network)
         self.samples: list[tuple[float, float, float, float]] = []
         # window tier: drained by the monitor recorder each collect tick
@@ -45,11 +46,16 @@ class _MethodStats:
         # spike at hour N must show in hour N's row)
         self.wcount = 0
         self.wtotal_s = 0.0
+        self.werrors = 0
         self.wsamples: list[tuple[float, float, float, float]] = []
 
-    def add(self, sample: tuple[float, float, float, float]) -> None:
+    def add(self, sample: tuple[float, float, float, float],
+            ok: bool = True) -> None:
         self.count += 1
         self.total_s += sample[0]
+        if not ok:
+            self.errors += 1
+            self.werrors += 1
         if len(self.samples) < RESERVOIR:
             self.samples.append(sample)
         else:
@@ -79,15 +85,16 @@ class RpcStats:
         self._lock = threading.Lock()
 
     def record(self, method: str, total: float, squeue: float,
-               server: float, network: float) -> None:
+               server: float, network: float, ok: bool = True) -> None:
         st = self._methods.get(method)
         if st is None:
             with self._lock:
                 st = self._methods.setdefault(method, _MethodStats())
-        st.add((total, squeue, server, network))
+        st.add((total, squeue, server, network), ok)
 
     @staticmethod
-    def _row(count: int, total_s: float, samples: list) -> dict:
+    def _row(count: int, total_s: float, samples: list,
+             errors: int = 0) -> dict:
         def pct(vals: list[float], q: float) -> float:
             if not vals:
                 return 0.0
@@ -95,7 +102,7 @@ class RpcStats:
             return s[min(len(s) - 1, int(q * len(s)))]
 
         cols = list(zip(*samples)) if samples else [[], [], [], []]
-        row = {"count": count,
+        row = {"count": count, "errors": errors,
                "avg_ms": round(total_s / count * 1e3, 3) if count else 0.0}
         for name, vals in zip(("total", "squeue", "server", "network"),
                               cols):
@@ -108,7 +115,7 @@ class RpcStats:
         """Cumulative since process start (rpc-top dumps/CLI)."""
         with self._lock:
             items = list(self._methods.items())
-        return {m: self._row(st.count, st.total_s, st.samples)
+        return {m: self._row(st.count, st.total_s, st.samples, st.errors)
                 for m, st in items}
 
     def window_snapshot(self) -> dict:
@@ -120,9 +127,11 @@ class RpcStats:
             for m, st in self._methods.items():
                 if not st.wcount:
                     continue
-                out[m] = self._row(st.wcount, st.wtotal_s, st.wsamples)
+                out[m] = self._row(st.wcount, st.wtotal_s, st.wsamples,
+                                   st.werrors)
                 st.wcount = 0
                 st.wtotal_s = 0.0
+                st.werrors = 0
                 st.wsamples = []
         return out
 
@@ -136,6 +145,14 @@ class RpcStats:
 
 
 RPC_STATS = RpcStats()
+
+# Serving-side twin, recorded at request dispatch (conn._handle_request):
+# total = receive->reply, squeue = receive->handler-start, server = handler
+# body, network = 0.  RPC_STATS attributes latency to the CALLING process's
+# outbound methods; this one attributes it to the process that SERVED the
+# request — which is what per-node health rollups need (the MonitorReporter
+# that ships it stamps the serving node's node_id on the row).
+SERVER_STATS = RpcStats()
 
 
 def _stream_quantile(est: float, x: float, q: float,
@@ -177,9 +194,10 @@ def read_size_class(nbytes: int) -> int:
 class _AddrReadStats:
     __slots__ = ("count", "ewma_s", "p50_s", "p9x_s", "inflight",
                  "hedge_fired", "hedge_won", "hedge_wasted", "samples",
-                 "cls_count", "cls_p9x_s")
+                 "cls_count", "cls_p9x_s", "seeded")
 
     def __init__(self):
+        self.seeded = False       # estimates start from a scorecard prior
         self.count = 0
         self.ewma_s = 0.0
         self.p50_s = 0.0          # streaming median (adaptive selection)
@@ -275,6 +293,36 @@ class ReadStats:
                 return st.cls_p9x_s[cls]
         return st.p9x_s
 
+    def seed_prior(self, address: str, p50_s: float = 0.0,
+                   p9x_s: float = 0.0,
+                   cls_p9x_s: dict[int, float] | None = None) -> bool:
+        """Seed the streaming estimates from a cluster-scorecard prior
+        (PR 14 health plane) so a COLD process's adaptive selection and
+        hedge-delay clamps know about slow nodes before its first read.
+
+        Only a cold entry (zero live samples) takes the prior — live
+        local observations always win — and counts are NOT bumped, so
+        the very first real sample starts nudging the estimate via the
+        normal streaming update.  Per-class priors get their class
+        credited with _CLASS_MIN_SAMPLES so `p9x(addr, nbytes)` uses
+        them immediately (live samples keep refining from there).
+        Returns True iff the prior was applied."""
+        st = self._get(address)
+        if st.count:
+            return False
+        st.seeded = True
+        if p50_s > 0.0:
+            st.p50_s = p50_s
+            st.ewma_s = p50_s
+        if p9x_s > 0.0:
+            st.p9x_s = p9x_s
+        for cls, est in (cls_p9x_s or {}).items():
+            if 0 <= cls < len(st.cls_p9x_s) and est > 0.0:
+                st.cls_p9x_s[cls] = est
+                st.cls_count[cls] = max(st.cls_count[cls],
+                                        _CLASS_MIN_SAMPLES)
+        return True
+
     def hedge(self, address: str, fired: int = 0, won: int = 0,
               wasted: int = 0) -> None:
         """Hedge counters accrue to the PRIMARY address whose slowness
@@ -298,6 +346,7 @@ class ReadStats:
             vals = list(st.samples)
             out[addr] = {
                 "count": st.count, "inflight": st.inflight,
+                "seeded": st.seeded,
                 "ewma_ms": round(st.ewma_s * 1e3, 3),
                 "p50_ms": round(st.p50_s * 1e3, 3),
                 "p9x_ms": round(st.p9x_s * 1e3, 3),
@@ -340,7 +389,8 @@ def render_read_stats(snapshots: list[dict], limit: int = 40) -> str:
             for k in set(cur) | set(row):
                 if k in ("count", "inflight") or k.startswith("hedge_"):
                     cur[k] = cur.get(k, 0) + row.get(k, 0)
-                elif k in ("q90_ms", "q99_ms") or k.startswith("p9x"):
+                elif k in ("q90_ms", "q99_ms", "seeded") \
+                        or k.startswith("p9x"):
                     # upper bound; per-size-class p9x columns are sparse
                     # (a process only reports classes it has samples for)
                     cur[k] = max(cur.get(k, 0.0), row.get(k, 0.0))
@@ -398,13 +448,14 @@ def render_top(snapshots: list[dict], sort_by: str = "total_p99_ms",
                 n1, n2 = cur["count"], row["count"]
                 tot = n1 + n2 or 1
                 for k in cur:
-                    if k == "count":
+                    if k in ("count", "errors"):
                         continue
                     if k.endswith("_p99_ms"):
                         cur[k] = max(cur[k], row[k])   # upper bound
                     else:                              # count-weighted
                         cur[k] = round((cur[k] * n1 + row[k] * n2) / tot, 3)
                 cur["count"] = tot
+                cur["errors"] = cur.get("errors", 0) + row.get("errors", 0)
     rows = sorted(merged.items(), key=lambda kv: -kv[1].get(sort_by, 0))
     hdr = (f"{'method':<34}{'calls':>8}{'avg':>8}"
            f"{'tot50':>8}{'tot99':>8}{'sq50':>7}{'sq99':>7}"
@@ -435,6 +486,8 @@ def register_monitor_recorder() -> None:
     class _RpcStatsRecorder(Recorder):
         def collect(self) -> dict:
             return {"name": self.name, "type": "rpc_top",
-                    "methods": RPC_STATS.window_snapshot(), **self.tags}
+                    "methods": RPC_STATS.window_snapshot(),
+                    "server_methods": SERVER_STATS.window_snapshot(),
+                    **self.tags}
 
     _RpcStatsRecorder("rpc.latency")   # Recorder.__init__ registers it

@@ -178,14 +178,19 @@ def grade(report: SoakReport, spec: SoakSpec,
     return report
 
 
-def capture_worst_trace(name_prefix: str = ""
+def capture_worst_trace(name_prefix: str = "", db=None
                         ) -> tuple[dict | None, str]:
     """Drain the tail-sampled span buffer and render the slowest root's
     full cross-node trace.  Returns (root span dict | None, rendered
-    tree).  Call once, after drain — draining consumes the buffer."""
+    tree).  Call once, after drain — draining consumes the buffer.
+
+    Pass the soak collector's MetricsDB when a MonitorReporter has been
+    shipping spans there during the run (ISSUE 14): the reporter drains
+    the process buffer continuously, so harvest time finds only a final
+    sliver locally — the full history lives in the collector's table."""
     from t3fs.cli.admin import render_trace
     from t3fs.monitor.service import MetricsDB
-    db = MetricsDB()
+    db = db or MetricsDB()
     now = time.time()
     while True:
         spans = tracing.BUFFER.drain(500)

@@ -42,6 +42,8 @@ class SoakRunner:
         self.drivers = []
         self.scrub = None
         self.collector = None
+        self.reporter = None
+        self.metrics_collector = None
         self._maint_sc = None
         self.monitor_address: str | None = None
 
@@ -126,6 +128,19 @@ class SoakRunner:
             await self.collector.start()
             self.monitor_address = self.collector.server.address
             self.progress(f"monitor: {self.monitor_address}")
+            # feed the collector's health plane: a reporter ships the
+            # rpc.latency samples + tail-promoted spans the rollup pass
+            # digests, so `admin soak-status` shows per-node health while
+            # the fault schedule runs (ISSUE 14).  Note tail sampling
+            # biases span-sourced rollups toward slow traces — exactly
+            # what straggler detection wants to see.
+            from t3fs.monitor.reporter import MonitorReporter
+            from t3fs.utils.metrics import Collector
+            self.reporter = MonitorReporter(self.monitor_address,
+                                            node_id=0, node_type="soak")
+            self.metrics_collector = Collector(period_s=1.0,
+                                               reporters=[self.reporter])
+            self.metrics_collector.start()
 
             injector = LiveInjector(
                 cluster, self.scrub,
@@ -164,7 +179,7 @@ class SoakRunner:
             report = summarize(spec, self.drivers, elapsed)
             report.fault_events = list(schedule.events)
             report.worst_trace_root, report.worst_trace_rendered = \
-                capture_worst_trace()
+                capture_worst_trace(db=self.collector.db)
             grade(report, spec, require_fairness=require_fairness)
             for gate, (ok, detail) in report.gates.items():
                 self.progress(f"gate {gate}: "
@@ -210,6 +225,12 @@ class SoakRunner:
             await self.scrub.ec.close()
         if self._maint_sc is not None:
             await self._maint_sc.close()
+        if self.metrics_collector is not None:
+            self.metrics_collector.stop()
+            self.metrics_collector = None
+        if self.reporter is not None:
+            self.reporter.close()
+            self.reporter = None
         if self.collector is not None:
             await self.collector.stop()
         if self.cluster is not None:

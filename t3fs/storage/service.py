@@ -717,6 +717,11 @@ class StorageService:
             node._read_sem = asyncio.Semaphore(node.read_concurrency)
         ios = (unpack_readios(req.packed_ios, req.packed_ver)
                if req.packed_ios else req.ios)
+        sp = tracing.current_span()
+        if sp is not None:
+            # total payload bytes: lets the health rollup bucket this
+            # span's latency into the client's read size classes
+            sp.set_tag("bytes", sum(io.length for io in ios))
 
         async def one(io: ReadIO) -> tuple[IOResult, bytes | None]:
             try:

@@ -34,7 +34,10 @@ class LocalCluster:
                  write_pipeline: str = "off",
                  stream_threshold: int | None = None,
                  ec_chains: int = 0,
-                 trace=None):
+                 trace=None,
+                 with_monitor: bool = False,
+                 rollup_cfg=None, health_cfg=None,
+                 seed_read_priors: bool = True):
         self.num_nodes = num_nodes
         self.replicas = replicas
         self.num_chains = num_chains
@@ -57,6 +60,16 @@ class LocalCluster:
         # soak harness) that configured it before building the cluster.
         self.trace = trace
         self.with_meta = with_meta
+        # ISSUE 14: with_monitor starts a MonitorCollectorServer (rollups
+        # on), a process-wide MonitorReporter feeding it, and points
+        # mgmtd's health puller at it — the full cluster health plane
+        self.with_monitor = with_monitor
+        self.rollup_cfg = rollup_cfg
+        self.health_cfg = health_cfg
+        self.seed_read_priors = seed_read_priors
+        self.monitor = None
+        self.reporter = None
+        self.collector = None
         self.meta: MetaServer | None = None
         self.meta_rpc: Server | None = None
         self.mc: MetaClient | None = None
@@ -80,6 +93,23 @@ class LocalCluster:
         return f"{self._tmp.name}/node{node_id}"
 
     async def start(self) -> None:
+        if self.with_monitor:
+            from t3fs.monitor.reporter import MonitorReporter
+            from t3fs.monitor.service import MonitorCollectorServer
+            from t3fs.utils.metrics import Collector
+            self.monitor = MonitorCollectorServer(
+                rollup_cfg=self.rollup_cfg, health_cfg=self.health_cfg)
+            await self.monitor.start()
+            # one process-wide reporter: in-process nodes share the stats
+            # registries anyway, per-node attribution comes from the
+            # server spans' addr tags (see t3fs/monitor/rollup.py)
+            self.reporter = MonitorReporter(self.monitor.address,
+                                            node_id=0, node_type="cluster")
+            self.collector = Collector(period_s=0.25,
+                                       reporters=[self.reporter])
+            self.collector.start()
+            self.mgmtd_cfg.monitor_address = self.monitor.address
+            self.mgmtd_cfg.health_pull_period_s = 0.2
         self.mgmtd = MgmtdServer(self.kv, 1, "", self.mgmtd_cfg,
                                  admin_token="local-admin")
         for svc in self.mgmtd.services:
@@ -127,8 +157,9 @@ class LocalCluster:
                 break
             await asyncio.sleep(0.05)
 
-        self.mgmtd_client = MgmtdClient(self.mgmtd_rpc.address,
-                                        refresh_period_s=0.1)
+        self.mgmtd_client = MgmtdClient(
+            self.mgmtd_rpc.address, refresh_period_s=0.1,
+            seed_read_priors=self.seed_read_priors)
         await self.mgmtd_client.start()
         self.sc = StorageClient(
             self.mgmtd_client.routing,
@@ -280,4 +311,11 @@ class LocalCluster:
         if self.mgmtd:
             await self.mgmtd.stop()
         await self.mgmtd_rpc.stop()
+        if self.reporter is not None:
+            self.collector.stop()
+            self.reporter.close()
+            self.reporter = None
+        if self.monitor is not None:
+            await self.monitor.stop()
+            self.monitor = None
         self._tmp.cleanup()

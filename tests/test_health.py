@@ -1,0 +1,419 @@
+"""Cluster health plane (ISSUE 14): rollups, scorecards, SLOs, piggyback.
+
+Covers the aggregation pass (t3fs/monitor/rollup.py), the scorecard /
+SLO math (t3fs/monitor/health.py), the monitor's health RPCs, the
+add-only GetRoutingInfoRsp wire evolution, and the end-to-end path:
+reads -> spans -> rollups -> scorecard -> mgmtd piggyback -> cold-client
+ReadStats priors.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+
+from t3fs.monitor.health import (
+    STATE_OK, STATE_STALE, STATE_STRAGGLER, STATE_UNKNOWN,
+    compute_scorecard, compute_slo,
+)
+from t3fs.monitor.rollup import RollupConfig, RollupEngine
+from t3fs.monitor.service import MetricsDB
+
+READ = "Storage.batch_read"
+
+
+def _base(bucket_s: float = 1.0) -> float:
+    """A recent bucket-aligned wall timestamp: rollup rows carry real
+    bucket_ts and the db age-prunes them against the clock, so synthetic
+    rows must not look ancient."""
+    return (time.time() // bucket_s) * bucket_s - 60.0
+
+
+# ---------------------------------------------------------------- rollups
+
+def _span(name: str, addr: str, dur_s: float, trace_id: int = 0,
+          status: int = 0, **tags) -> dict:
+    return {"trace_id": trace_id, "span_id": 1, "name": name,
+            "kind": "server", "t0": 0.0, "dur_s": dur_s, "status": status,
+            "tags": {"addr": addr, **tags}}
+
+
+def test_rollup_span_digests():
+    """Server spans fold into per-(bucket, node, addr, method) digests:
+    count/errors/percentiles, hop sums, worst (dur, trace) drill-down
+    pointer, and per-size-class tails in the JSON payload."""
+    db = MetricsDB()
+    eng = RollupEngine(db, RollupConfig(bucket_s=1.0, lag_s=0.0))
+    base = _base()
+    spans = [_span(READ, "a:1", 0.001 * (i + 1), trace_id=100 + i,
+                   wire_s=0.0001, bytes=4096) for i in range(10)]
+    spans.append(_span(READ, "a:1", 0.5, trace_id=777, status=5,
+                       bytes=1 << 21))
+    # client-kind and addr-less spans must not contribute
+    spans.append({"trace_id": 1, "span_id": 2, "name": READ,
+                  "kind": "client", "t0": 0.0, "dur_s": 9.0})
+    spans.append(_span(READ, "", 9.0))
+    db.insert_spans(7, "storage", base + 0.5, spans)
+
+    assert eng.rollup_once(now=base + 1.0) == 1
+    [row] = db.query_rollups()
+    assert (row["bucket_ts"], row["node_id"], row["addr"],
+            row["method"]) == (base, 7, "a:1", READ)
+    assert row["count"] == 11 and row["errors"] == 1
+    assert row["p50_s"] <= 0.01 < 0.5 == row["p99_s"]
+    assert (row["worst_dur_s"], row["worst_trace_id"]) == (0.5, 777)
+    assert abs(row["wire_s"] - 0.001) < 1e-9
+    cls = json.loads(row["payload"])["cls"]
+    assert len(cls) == 2            # 4 KiB class + 2 MiB class
+    assert {d["count"] for d in cls.values()} == {10, 1}
+    db.close()
+
+
+def test_rollup_incremental_no_rescan():
+    """Each pass scans only [hwm, now - lag) by ARRIVAL time: re-running
+    over the same data writes nothing, and late arrivals land in a new
+    pass without double-counting the old ones."""
+    db = MetricsDB()
+    eng = RollupEngine(db, RollupConfig(bucket_s=1.0, lag_s=0.0))
+    base = _base()
+    db.insert_spans(1, "s", base + 0.1, [_span(READ, "a:1", 0.002)])
+    assert eng.rollup_once(now=base + 1.0) == 1
+    assert eng.rollup_once(now=base + 1.0) == 0      # nothing new
+    # a new arrival in the SAME wall bucket becomes its own rollup row
+    db.insert_spans(1, "s", base + 1.5, [_span(READ, "a:1", 0.004),
+                                         _span(READ, "a:1", 0.006)])
+    assert eng.rollup_once(now=base + 2.0) == 1
+    rows = db.query_rollups(addr="a:1")
+    assert sum(r["count"] for r in rows) == 3        # never double-counted
+    db.close()
+
+
+def test_rollup_scan_cap_advances_to_last_seen():
+    """When a pass overflows max_rows_per_pass, the high-water mark
+    advances only to the last scanned row — the remainder is picked up
+    next pass, not silently skipped."""
+    db = MetricsDB()
+    eng = RollupEngine(db, RollupConfig(bucket_s=1.0, lag_s=0.0,
+                                        max_rows_per_pass=3))
+    base = _base()
+    for i in range(5):
+        db.insert_spans(1, "s", base + 0.1 * (i + 1),
+                        [_span(READ, "a:1", 0.001)])
+    for _ in range(5):                 # capped passes drain the remainder
+        eng.rollup_once(now=base + 1.0)
+    rows = db.query_rollups(addr="a:1")
+    assert sum(r["count"] for r in rows) == 5
+    # degenerate case: ONE reporter batch larger than the cap — every
+    # scanned row shares one arrival ts, folded exactly once via the
+    # whole-group fetch
+    db.insert_spans(1, "s", base + 2.5, [_span(READ, "b:1", 0.001)] * 7)
+    for _ in range(3):
+        eng.rollup_once(now=base + 3.0)
+    assert sum(r["count"]
+               for r in db.query_rollups(addr="b:1")) == 7
+    db.close()
+
+
+def test_rollup_stats_source():
+    """rpc.latency samples' server_methods fold into addr=="" rows — the
+    unbiased (non-tail-sampled) source the SLO report prefers."""
+    db = MetricsDB()
+    eng = RollupEngine(db, RollupConfig(bucket_s=1.0, lag_s=0.0))
+    base = _base()
+    smp = {"name": "rpc.latency", "type": "rpc",
+           "server_methods": {READ: {"count": 50, "errors": 2,
+                                     "total_p50_ms": 2.0,
+                                     "total_p99_ms": 9.0}}}
+    db.insert(3, "storage", base + 0.2, [smp])
+    eng.rollup_once(now=base + 1.0)
+    [row] = db.query_rollups(method=READ)
+    assert row["addr"] == "" and row["node_id"] == 3
+    assert row["count"] == 50 and row["errors"] == 2
+    assert abs(row["p50_s"] - 0.002) < 1e-9
+    assert abs(row["p99_s"] - 0.009) < 1e-9
+    db.close()
+
+
+# ------------------------------------------------------- scorecard math
+
+def _rrow(bucket: float, addr: str, p99: float, count: int = 100,
+          errors: int = 0, method: str = READ, node_id: int = 0,
+          worst_tid: int = 0) -> dict:
+    return {"bucket_ts": bucket, "bucket_s": 1.0, "node_id": node_id,
+            "addr": addr, "method": method, "count": count,
+            "errors": errors, "p50_s": p99 / 2, "p99_s": p99,
+            "worst_dur_s": p99, "worst_trace_id": worst_tid, "payload": ""}
+
+
+def test_scorecard_straggler_trigger_and_clear():
+    """p99 > k x per-bucket cluster median for m_trigger consecutive
+    buckets flags; m_clear consecutive buckets back under clears."""
+    now = 100.0
+    rows = []
+    for b in range(10):
+        slow = 0.010 if 2 <= b < 5 else 0.001     # 3 hot buckets
+        rows += [_rrow(90.0 + b, "slow:1", slow, worst_tid=42),
+                 _rrow(90.0 + b, "ok:1", 0.001),
+                 _rrow(90.0 + b, "ok:2", 0.001)]
+    flagged = compute_scorecard(
+        rows, now, window_s=30.0, k=3.0, m_trigger=3, m_clear=100,
+        freshness_s=60.0)
+    by = flagged.by_addr()
+    assert by["slow:1"].straggler and by["slow:1"].state == STATE_STRAGGLER
+    assert by["slow:1"].worst_trace_id == 42
+    assert not by["ok:1"].straggler and by["ok:1"].state == STATE_OK
+    # with m_clear=3, the 5 trailing healthy buckets clear the flag
+    cleared = compute_scorecard(
+        rows, now, window_s=30.0, k=3.0, m_trigger=3, m_clear=3,
+        freshness_s=60.0)
+    assert not cleared.by_addr()["slow:1"].straggler
+    # only 2 hot buckets never trips an m_trigger=3 detector
+    short = [r for r in rows
+             if not (r["addr"] == "slow:1" and r["bucket_ts"] == 94.0)]
+    short = compute_scorecard(short, now, window_s=30.0, k=3.0,
+                              m_trigger=3, m_clear=100, freshness_s=60.0)
+    assert not short.by_addr()["slow:1"].straggler
+
+
+def test_scorecard_single_node_buckets_not_comparable():
+    """A bucket where only one node reported has no cluster median —
+    being the only reporter must not read as being the slowest."""
+    rows = [_rrow(90.0 + b, "only:1", 0.050) for b in range(6)]
+    h = compute_scorecard(rows, 100.0, window_s=30.0, m_trigger=1,
+                          freshness_s=60.0)
+    assert not h.by_addr()["only:1"].straggler
+    assert h.by_addr()["only:1"].state == STATE_OK
+
+
+def test_scorecard_staleness_and_unknown():
+    now = 200.0
+    rows = [_rrow(180.0, "stale:1", 0.001),      # silent for ~19s
+            _rrow(198.0, "fresh:1", 0.001)]
+    h = compute_scorecard(rows, now, window_s=30.0, freshness_s=5.0,
+                          known_addrs=("fresh:1", "stale:1", "new:1"))
+    by = h.by_addr()
+    assert by["stale:1"].stale and by["stale:1"].state == STATE_STALE
+    assert not by["fresh:1"].stale and by["fresh:1"].state == STATE_OK
+    # routing knows new:1, the health plane has no rows for it yet
+    assert by["new:1"].state == STATE_UNKNOWN and by["new:1"].count == 0
+    # freshness bound is explicit in the scorecard itself
+    assert h.freshness_s == 5.0
+    assert by["fresh:1"].updated_ts == 199.0     # bucket end, not start
+
+    empty = compute_scorecard([], now, known_addrs=("a:1", "b:1"))
+    assert all(n.state == STATE_UNKNOWN for n in empty.nodes)
+    assert empty.cluster_read_p99_s == 0.0
+
+
+def test_scorecard_ignores_non_read_methods():
+    """Storage.write p99 includes whole-chain replication time; it must
+    not make a head look like a read straggler."""
+    rows = []
+    for b in range(5):
+        rows += [_rrow(90.0 + b, "head:1", 0.100, method="Storage.write"),
+                 _rrow(90.0 + b, "head:1", 0.001),
+                 _rrow(90.0 + b, "ok:1", 0.001)]
+    h = compute_scorecard(rows, 100.0, m_trigger=1, freshness_s=60.0)
+    nh = h.by_addr()["head:1"]
+    assert not nh.straggler and nh.read_p99_s < 0.01
+
+
+def test_slo_report_prefers_stats_rows():
+    now = 100.0
+    rows = [
+        # span-sourced (tail-biased): would report a lying 50% error rate
+        _rrow(95.0, "a:1", 0.200, count=2, errors=1),
+        # stats-sourced truth for the same method
+        _rrow(95.0, "", 0.005, count=1000, errors=1),
+        # a method with ONLY span coverage still gets (conservative) rows
+        _rrow(95.0, "b:1", 0.004, count=10, method="Meta.stat"),
+    ]
+    rep = compute_slo(rows, now, window_s=30.0, avail_target=0.999,
+                      p99_targets={READ: 0.010})
+    per = {m.method: m for m in rep.methods}
+    assert per[READ].count == 1000 and per[READ].availability == 0.999
+    assert per[READ].p99_s == 0.005 and per[READ].ok
+    assert per["Meta.stat"].count == 10
+    assert rep.ok
+
+    # availability violation flips both the method and the report
+    bad = compute_slo([_rrow(95.0, "", 0.005, count=100, errors=5)], now)
+    assert not bad.methods[0].ok and not bad.ok
+    # latency violation alone also fails
+    slow = compute_slo([_rrow(95.0, "", 0.500, count=100)], now,
+                       p99_targets={READ: 0.010})
+    assert not slow.ok
+
+
+# ------------------------------------------------------- monitor RPCs
+
+def test_monitor_health_rpcs():
+    """Monitor.query_rollups / Monitor.health / Monitor.slo_report over
+    a live collector with the rollup loop on."""
+    from t3fs.monitor.health import HealthConfig
+    from t3fs.monitor.service import (
+        HealthReq, MonitorCollectorServer, QueryRollupsReq, ReportSpansReq,
+        SloReportReq,
+    )
+    from t3fs.net.client import Client
+
+    async def body():
+        srv = MonitorCollectorServer(
+            rollup_cfg=RollupConfig(bucket_s=0.25, period_s=0.05,
+                                    lag_s=0.0),
+            health_cfg=HealthConfig(window_s=10.0, freshness_s=30.0,
+                                    m_trigger=1, m_clear=1))
+        await srv.start()
+        cli = Client()
+        try:
+            now = time.time()
+            spans = ([_span(READ, "fast:1", 0.001) for _ in range(20)]
+                     + [_span(READ, "fast:2", 0.001) for _ in range(20)]
+                     + [_span(READ, "slow:1", 0.050) for _ in range(20)])
+            await cli.call(srv.address, "Monitor.report_spans",
+                           ReportSpansReq(node_id=1, node_type="storage",
+                                          ts=now, spans=spans))
+            # health runs a rollup pass inline, so no sleep-for-timer
+            rsp, _ = await cli.call(srv.address, "Monitor.health",
+                                    HealthReq())
+            h = rsp.health
+            assert h is not None and len(h.nodes) == 3
+            assert h.by_addr()["slow:1"].straggler
+            assert not h.by_addr()["fast:1"].straggler
+            assert h.by_addr()["fast:1"].count == 20
+
+            rsp, _ = await cli.call(srv.address, "Monitor.query_rollups",
+                                    QueryRollupsReq(addr="slow:1"))
+            assert sum(r["count"] for r in rsp.rollups) == 20
+
+            rsp, _ = await cli.call(srv.address, "Monitor.slo_report",
+                                    SloReportReq(window_s=10.0))
+            rep = rsp.report
+            assert rep is not None and rep.window_s == 10.0
+            assert any(m.method == READ for m in rep.methods)
+        finally:
+            await cli.close()
+            await srv.stop()
+
+    asyncio.run(body())
+
+
+# ------------------------------------------------------- wire evolution
+
+def test_get_routing_info_rsp_add_only_compat():
+    """The scorecard rides GetRoutingInfoRsp as APPENDED fields: bytes
+    from a pre-scorecard server decode with defaults on a new client,
+    and a new server's extra fields are dropped by an old client's
+    field loop (serde add-only, both directions)."""
+    from t3fs.mgmtd.service import GetRoutingInfoRsp
+    from t3fs.monitor.health import ClusterHealth, NodeHealth
+    from t3fs.utils import serde
+    from t3fs.utils.serde import dumps, loads
+
+    # old server -> new client: hand-built frame with only the original
+    # field (info=None), fewer than the class now declares
+    name = b"GetRoutingInfoRsp"
+    old_bytes = (bytes([serde.T_STRUCT]) + serde._varint(len(name)) + name
+                 + serde._varint(1)         # pre-PR14 field count
+                 + bytes([serde.T_NONE]))   # info=None
+    rsp = loads(old_bytes)
+    assert isinstance(rsp, GetRoutingInfoRsp)
+    assert rsp.info is None and rsp.health is None
+    assert rsp.health_version == 0
+
+    # new server -> old client: an old field loop reads the declared
+    # count and drops trailing unknowns.  Emulate a FUTURE revision the
+    # same way (current bytes + 2 appended fields) — today's decoder
+    # must drop them identically.
+    full = GetRoutingInfoRsp(
+        info=None,
+        health=ClusterHealth(generated_ts=5.0, window_s=30.0,
+                             nodes=[NodeHealth(addr="n:1", read_p99_s=0.01,
+                                               count=9, state="ok")]),
+        health_version=7)
+    blob = bytearray(dumps(full))
+    assert blob[:len(old_bytes) - 2] == old_bytes[:-2]  # same header
+    hdr_end = 1 + 1 + len(name)
+    assert blob[hdr_end] == 3                # current field count
+    blob[hdr_end] = 5                        # ...+2 unknown appendees
+    blob += dumps(True) + dumps(1234)
+    again = loads(bytes(blob))
+    assert again.health_version == 7
+    assert again.health.nodes[0].addr == "n:1"
+    assert again.health.nodes[0].read_p99_s == 0.01
+
+    # and the plain round-trip preserves the scorecard
+    rt = loads(dumps(full))
+    assert rt.health.generated_ts == 5.0
+    assert rt.health.by_addr()["n:1"].count == 9
+
+
+# ----------------------------------------- end to end: priors for cold clients
+
+def test_health_piggyback_seeds_cold_client(tmp_path):
+    """reads -> spans -> rollups -> scorecard -> mgmtd cache ->
+    GetRoutingInfoRsp piggyback -> a COLD client's ReadStats priors
+    (ROADMAP item 3's health-signal half)."""
+    from t3fs.client.mgmtd_client import MgmtdClient
+    from t3fs.net.rpcstats import READ_STATS
+    from t3fs.storage.types import ChunkId, ReadIO
+    from t3fs.testing.cluster import LocalCluster
+    from t3fs.utils import tracing
+    from t3fs.utils.tracing import TraceConfig
+
+    async def body():
+        tracing.reset_tracing()
+        cl = LocalCluster(
+            num_nodes=3, replicas=3, with_monitor=True,
+            trace=TraceConfig(sample_rate=1.0, export="all"),
+            rollup_cfg=RollupConfig(bucket_s=0.25, period_s=0.1,
+                                    lag_s=0.05),
+            seed_read_priors=False)    # only the cold client below seeds
+        await cl.start()
+        try:
+            cid = ChunkId(0x4EA17, 0)
+            await cl.sc.write_chunk(1, cid, 0, b"\xcd" * 4096, 4096)
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline:
+                for _ in range(20):
+                    await cl.sc.batch_read(
+                        [ReadIO(chain_id=1, chunk_id=cid, offset=0,
+                                length=4096)])
+                    await asyncio.sleep(0.002)
+                h = cl.mgmtd.state.health
+                if h is not None and any(n.count for n in h.nodes):
+                    break
+            else:
+                raise AssertionError("mgmtd never cached a scorecard")
+            assert cl.mgmtd.state.health_version > 0
+            # addr -> node_id resolution against the routing table held
+            assert any(n.node_id for n in cl.mgmtd.state.health.nodes
+                       if n.count)
+
+            READ_STATS.clear()
+            mc = MgmtdClient(cl.mgmtd_rpc.address,
+                             refresh_period_s=3600.0,
+                             seed_read_priors=True)
+            try:
+                await mc.refresh()    # the ONE refresh a cold client gets
+                assert mc.health is not None and mc._health_version > 0
+                snap = READ_STATS.snapshot()
+                seeded = {a for a, s in snap.items() if s["seeded"]}
+                assert seeded, snap
+                scored = {n.addr for n in mc.health.nodes if n.count}
+                assert seeded <= scored
+                for a in seeded:
+                    assert snap[a]["p50_ms"] > 0.0
+                # version gating: up-to-date callers get no re-send
+                ver = mc._health_version
+                await mc.refresh()
+                assert mc._health_version == ver
+            finally:
+                await mc.stop()
+        finally:
+            await cl.stop()
+            READ_STATS.clear()
+            tracing.reset_tracing()
+
+    asyncio.run(body())

@@ -381,6 +381,93 @@ def test_metrics_db_retention_max_rows_and_age():
     db.close()
 
 
+def test_metrics_db_ts_bounded_queries():
+    """ts_min/ts_max (EXCLUSIVE max) + node_id filters on both tables:
+    the rollup pass and `trace-slow --since` scan half-open arrival
+    windows, so [a,b) + [b,c) must cover every row exactly once."""
+    db = MetricsDB()
+    for i in range(10):
+        db.insert(1 + (i % 2), "storage", 100.0 + i,
+                  [{"name": "m", "type": "value", "value": i}])
+        db.insert_spans(1 + (i % 2), "storage", 100.0 + i,
+                        [{"trace_id": i + 1, "span_id": 1, "name": "op",
+                          "kind": "server", "t0": 100.0 + i, "dur_s": 0.01}])
+    lo = db.query("m", since_ts=100.0, ts_max=105.0)
+    hi = db.query("m", since_ts=105.0, ts_max=110.0)
+    assert len(lo) == 5 and len(hi) == 5
+    assert {r["value"] for r in lo} | {r["value"] for r in hi} \
+        == set(range(10))
+    assert all(r["node_id"] == 2
+               for r in db.query("m", since_ts=0.0, node_id=2))
+
+    lo_s = db.query_spans(ts_min=100.0, ts_max=105.0, order="ts")
+    hi_s = db.query_spans(ts_min=105.0, ts_max=110.0, order="ts")
+    assert len(lo_s) == 5 and len(hi_s) == 5
+    # order="ts" returns ascending arrival for the incremental pass
+    assert [s["ts"] for s in lo_s] == sorted(s["ts"] for s in lo_s)
+    assert all(s["node_id"] == 1 for s in db.query_spans(node_id=1))
+    assert len(db.query_spans(node_id=1)) == 5
+    db.close()
+
+
+def test_metrics_db_retention_amortized():
+    """Age pruning is amortized (at most one DELETE per prune_every_s
+    per table) but retention bounds still hold: a forced prune or the
+    next eligible insert sweeps everything stale."""
+    db = MetricsDB(max_age_s=10.0, prune_every_s=3600.0)
+    old = time.time() - 100.0
+    db.insert(1, "s", time.time(), [{"name": "warm", "value": 1}])
+    # stale rows inserted INSIDE the amortization window survive ...
+    db.insert(1, "s", old, [{"name": "stale", "value": 1}])
+    assert len(db.query("stale")) == 1
+    # ... until a forced prune applies the retention bound
+    db.prune_now()
+    assert db.query("stale") == []
+    assert len(db.query("warm")) == 1
+
+    # prune_every_s=0 restores prune-on-every-insert semantics
+    db2 = MetricsDB(max_age_s=10.0, prune_every_s=0.0)
+    db2.insert(1, "s", old, [{"name": "stale", "value": 1}])
+    db2.insert(1, "s", time.time(), [{"name": "warm", "value": 1}])
+    assert db2.query("stale") == []
+    db.close()
+    db2.close()
+
+
+def test_metrics_db_concurrent_insert():
+    """Concurrent inserters under a row cap: the in-memory row counters
+    (what replaced COUNT(*)-per-insert) must agree with the table and the
+    cap must hold."""
+    import threading
+
+    db = MetricsDB(max_rows=50)
+    errs = []
+
+    def worker(wid: int):
+        try:
+            for i in range(40):
+                db.insert(wid, "s", time.time(),
+                          [{"name": f"c{wid}", "value": i}])
+                db.insert_spans(wid, "s", time.time(),
+                                [{"trace_id": wid * 1000 + i, "span_id": 1,
+                                  "name": "op", "kind": "server",
+                                  "dur_s": 0.001, "t0": 0.0}])
+        except Exception as e:       # noqa: BLE001
+            errs.append(e)
+
+    threads = [threading.Thread(target=worker, args=(w,)) for w in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs
+    for table in ("metrics", "spans"):
+        on_disk = db._conn.execute(
+            f"SELECT COUNT(*) FROM {table}").fetchone()[0]
+        assert on_disk == db._rows[table] == 50
+    db.close()
+
+
 def test_callback_gauge_error_flagged_and_skipped(caplog):
     def boom():
         raise RuntimeError("source gone")
