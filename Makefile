@@ -20,7 +20,7 @@ SAN_FILTER := -k "not device"
 .PHONY: test lint sanitize sanitize-thread sanitize-address probe \
         on-device ci ckpt-bench write-bench read-bench \
         kvcache-fleet-bench repair-drill usrbio-bench soak soak-smoke \
-        health-smoke health-bench
+        health-smoke health-bench rebalance-drill rebalance-smoke
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -99,6 +99,20 @@ health-smoke:
 # the steady-state p50 overhead guard (within 3% of plane-off).
 health-bench:
 	JAX_PLATFORMS=cpu $(PY) -m benchmarks.health_bench --json
+
+# Rebalance drill (ISSUE 15): node add + destination flap + graceful
+# drain against a live cluster serving write-pipeline writes and first-k
+# EC reads, A/B'd against an identical no-rebalance cell.  Gates: zero
+# wrong bytes, zero foreground errors, drill p50 <= 1.3x baseline,
+# rebalance bytes within the token-bucket budget, solver diff empty at
+# the end.  Exits non-zero on any miss; one JSON blob.
+rebalance-drill:
+	JAX_PLATFORMS=cpu $(PY) -m benchmarks.rebalance_drill_bench --json
+
+# ~1 min CI-sized drill: same storm, same gates, shorter windows.
+rebalance-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m benchmarks.rebalance_drill_bench \
+		--smoke --json
 
 # Bounded TPU-tunnel probe; ALWAYS appends a dated record to
 # DEVICE_PROBE_LOG.jsonl (proof the chip was retried, r3 verdict #1).

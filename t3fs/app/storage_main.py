@@ -36,7 +36,9 @@ class StorageMainConfig(ConfigBase):
 async def serve(cfg: StorageMainConfig, app: ApplicationBase) -> None:
     ss = StorageServer(
         cfg.node_id, cfg.mgmtd_address, cfg=cfg.service,
-        admin_token=cfg.admin_token)
+        admin_token=cfg.admin_token,
+        default_root=cfg.data_dir,
+        discover_targets=bool(cfg.data_dir))
     for tid in cfg.target_ids:
         root = os.path.join(cfg.data_dir or ".", f"t{tid}")
         ss.add_target(tid, root, engine_backend=cfg.engine_backend)

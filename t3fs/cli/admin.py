@@ -573,6 +573,33 @@ async def migrate_status(ctx: AdminContext, args) -> None:
               f"state={j.state} error={j.error!r}")
 
 
+@command("rebalance-status", "online rebalancer: planned/active/settled "
+         "chain moves, pacing counters")
+async def rebalance_status(ctx: AdminContext, args) -> None:
+    if not ctx.migration_address:
+        raise StatusError(StatusCode.INVALID_ARG,
+                          "--migration <addr> required (migration_main "
+                          "hosts the Rebalance service)")
+    import t3fs.migration.rebalancer  # noqa: F401  (registers serde structs)
+    rsp, _ = await ctx.cli.call(ctx.migration_address, "Rebalance.status",
+                                None)
+    print(f"rebalancer: {'running' if rsp.enabled else 'stopped'} "
+          f"budget={rsp.budget_mbps:g}MB/s ticks={rsp.ticks} "
+          f"resumed={rsp.resumed}")
+    print(f"moves: planned={rsp.planned} submitted={rsp.submitted} "
+          f"deferred={rsp.deferred} done={rsp.done} failed={rsp.failed}")
+    print(f"pacing: {rsp.bytes_submitted} bytes submitted, "
+          f"{rsp.paced_waits} waits ({rsp.paced_wait_s:.2f}s)")
+    rows = [[m.table_id, m.chain_id,
+             f"t{m.src_target_id}@n{m.src_node_id}",
+             f"t{m.dst_target_id}@n{m.dst_node_id}",
+             m.state, m.job_id or "-", m.reason]
+            for m in rsp.moves]
+    if rows:
+        print(_fmt_table(rows, ["table", "chain", "src", "dst", "state",
+                                "job", "reason"]))
+
+
 @command("rotate-preferred", "one rotation step toward the preferred order")
 @args_(("chain_id", {"type": int}))
 async def rotate_preferred(ctx: AdminContext, args) -> None:
@@ -594,11 +621,22 @@ async def client_sessions(ctx: AdminContext, args) -> None:
     print(_fmt_table(rows, ["client", "description", "age", "extend-age"]))
 
 
-@command("gen-chains", "generate + optionally install a chain table")
+@command("gen-chains", "generate + optionally install a chain table "
+         "(CR replicated / EC single-replica shard chains)")
 @args_(("--nodes", {"required": True,
                     "help": "comma-separated storage node ids"}),
        ("--replicas", {"type": int, "default": 3}),
        ("--chains", {"type": int, "default": 1}),
+       ("--table-type", {"choices": ("cr", "ec"), "default": "cr",
+                         "help": "cr = replicated chains (BIBD recovery-"
+                                 "balanced), ec = single-replica shard "
+                                 "chains (rendezvous-placed)"}),
+       ("--table-id", {"type": int, "default": 0,
+                       "help": "chain table id (default: 1 for cr, 2 "
+                               "for ec — the LocalCluster convention)"}),
+       ("--start-chain", {"type": int, "default": 1,
+                          "help": "first chain id (EC tables usually "
+                                  "follow the CR chains)"}),
        ("--apply", {"action": "store_true",
                     "help": "install via Mgmtd.set_chains"}))
 async def gen_chains(ctx: AdminContext, args) -> None:
@@ -606,29 +644,60 @@ async def gen_chains(ctx: AdminContext, args) -> None:
         build_chain_table, recovery_imbalance, target_id,
     )
     node_ids = [int(x) for x in args.nodes.split(",")]
-    # recovery-traffic-balanced assignment (BIBD objective; reference
-    # deploy/data_placement integer program): rows are node INDICES 1..N
-    table = build_chain_table(len(node_ids), args.chains, args.replicas)
+    table_id = args.table_id or (1 if args.table_type == "cr" else 2)
     chains = []
-    for c, row in enumerate(table):
-        targets = []
-        for idx in row:
-            node_id = node_ids[idx - 1]
-            targets.append(ChainTargetInfo(target_id(node_id, c), node_id,
-                                           PublicTargetState.SERVING))
-        chains.append(ChainInfo(chain_id=c + 1, chain_ver=1, targets=targets))
+    if args.table_type == "cr":
+        # recovery-traffic-balanced assignment (BIBD objective; reference
+        # deploy/data_placement -type CR): rows are node INDICES 1..N
+        table = build_chain_table(len(node_ids), args.chains, args.replicas)
+        for c, row in enumerate(table):
+            targets = [
+                ChainTargetInfo(target_id(node_ids[idx - 1], c),
+                                node_ids[idx - 1],
+                                PublicTargetState.SERVING)
+                for idx in row]
+            chains.append(ChainInfo(chain_id=args.start_chain + c,
+                                    chain_ver=1, targets=targets))
+        balance = (f"recovery imbalance: "
+                   f"{recovery_imbalance(table, len(node_ids)):.3f} "
+                   f"(1.0 = perfectly balanced reconstruction load)")
+    else:
+        # EC shard chains: single-replica, rendezvous-placed (reference
+        # -type EC) — membership change later moves minimally, which is
+        # what the online rebalancer banks on
+        from t3fs.mgmtd.chain_table import solve_chain_table
+        from t3fs.mgmtd.types import NodeInfo
+        chain_ids = [args.start_chain + j for j in range(args.chains)]
+        solved = solve_chain_table(
+            chain_ids, [NodeInfo(node_id=n) for n in node_ids],
+            replicas=1, table_type="ec")
+        for j, cid in enumerate(chain_ids):
+            nid = solved.nodes_of(cid)[0]
+            chains.append(ChainInfo(
+                chain_id=cid, chain_ver=1,
+                targets=[ChainTargetInfo(
+                    target_id(nid, args.start_chain - 1 + j), nid,
+                    PublicTargetState.SERVING)]))
+        load: dict[int, int] = {}
+        for c in chains:
+            load[c.targets[0].node_id] = load.get(c.targets[0].node_id,
+                                                  0) + 1
+        balance = (f"per-node shard chains: "
+                   + " ".join(f"n{n}={load.get(n, 0)}"
+                              for n in sorted(node_ids))
+                   + f" (capacity moves: {solved.capacity_moves})")
     for chain in chains:
         print(f"chain {chain.chain_id}: " + " -> ".join(
             f"t{t.target_id}@n{t.node_id}" for t in chain.targets))
-    print(f"recovery imbalance: "
-          f"{recovery_imbalance(table, len(node_ids)):.3f} "
-          f"(1.0 = perfectly balanced reconstruction load)")
+    print(balance)
     if args.apply:
         await ctx.cli.call(
             ctx.mgmtd_address, "Mgmtd.set_chains",
             SetChainsReq(chains=chains,
-                         tables=[ChainTable(1, [c.chain_id for c in chains])]))
-        print("installed")
+                         tables=[ChainTable(
+                             table_id, [c.chain_id for c in chains],
+                             table_type=args.table_type)]))
+        print(f"installed table {table_id} ({args.table_type})")
 
 
 @command("set-config-template", "store a node-type config template in mgmtd")

@@ -30,10 +30,18 @@ class MgmtdClient:
 
     def __init__(self, mgmtd_address: str, client: Client | None = None,
                  refresh_period_s: float = 0.5, client_id: str = "",
-                 description: str = "", seed_read_priors: bool = True):
+                 description: str = "", seed_read_priors: bool = True,
+                 incremental: bool = True):
         self.mgmtd_address = mgmtd_address
         self.client = client or Client()
         self.refresh_period_s = refresh_period_s
+        # ISSUE 15: ask mgmtd for RoutingDelta instead of the full map on
+        # every version bump — under rebalance churn each refresh then
+        # carries only the chains that actually moved.  Counters are the
+        # observability/test surface.
+        self.incremental = incremental
+        self.delta_refreshes = 0
+        self.full_refreshes = 0
         # ISSUE 14: seed process-wide ReadStats priors from the scorecard
         # mgmtd piggybacks on GetRoutingInfoRsp, so a COLD client's
         # adaptive read selection and hedge clamps avoid known-slow nodes
@@ -79,10 +87,16 @@ class MgmtdClient:
             rsp, _ = await self.client.call(
                 self.mgmtd_address, "Mgmtd.get_routing_info",
                 GetRoutingInfoReq(known_version=self._routing.version,
-                                  known_health_version=self._health_version),
+                                  known_health_version=self._health_version,
+                                  want_delta=self.incremental
+                                  and self._routing.version > 0),
                 timeout=5.0)
+            delta = getattr(rsp, "delta", None)
             if rsp.info is not None:
                 self._routing = rsp.info
+                self.full_refreshes += 1
+            elif delta is not None:
+                self._apply_delta(delta)
             # getattr: a pre-scorecard mgmtd's rsp has no health fields
             health = getattr(rsp, "health", None)
             if health is not None:
@@ -93,6 +107,28 @@ class MgmtdClient:
         except StatusError as e:
             log.warning("routing refresh failed: %s", e)
         return self._routing
+
+    def _apply_delta(self, delta) -> None:
+        """Merge a RoutingDelta into the cached map.  Copy-on-write: the
+        new RoutingInfo shares every unchanged ChainInfo object with the
+        old one, so concurrent readers holding the old reference see a
+        consistent snapshot.  A base-version mismatch (a raced refresh)
+        is dropped — the next tick's known_version resolves it."""
+        cur = self._routing
+        if delta.base_version != cur.version:
+            log.warning("routing delta base %d != cached %d; dropped",
+                        delta.base_version, cur.version)
+            return
+        chains = dict(cur.chains)
+        for c in delta.chains:
+            chains[c.chain_id] = c
+        for cid in delta.removed_chains:
+            chains.pop(cid, None)
+        self._routing = RoutingInfo(
+            version=delta.version, bootstrapping=delta.bootstrapping,
+            nodes=delta.nodes, chains=chains,
+            chain_tables=delta.chain_tables)
+        self.delta_refreshes += 1
 
     def _seed_read_priors(self, health) -> None:
         """Push scorecard latency hints into the process-wide ReadStats

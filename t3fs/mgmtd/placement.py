@@ -27,32 +27,81 @@ def chain_nodes(routing: RoutingInfo, chain_id: int) -> list[int]:
 
 def select_ec_chains(routing: RoutingInfo, k: int, m: int,
                      candidates: list[int] | None = None) -> list[int]:
-    """Greedily pick k+m chains such that no node appears on more than m of
-    them (single-node loss then costs <= m shards = decodable).
+    """Pick k+m chains such that no node appears on more than m of them
+    (single-node loss then costs <= m shards = decodable).
 
-    Greedy, not exhaustive: prefers chains with fewer targets so wide
-    (multi-replica) chains don't block narrow ones; a ValueError means THIS
-    heuristic failed — a different candidate ordering or the full integer
-    program (reference deploy/data_placement) may still find a placement."""
+    Solve-then-validate (ISSUE 15): the greedy pass (prefer chains with
+    fewer targets so wide multi-replica chains don't block narrow ones)
+    is tried first; when IT fails, a swap local search repairs the
+    selection instead of giving up — greedy failure is an ordering
+    artifact, not infeasibility.  The result is always checked with
+    validate_ec_chains before it is returned; ValueError now means the
+    search exhausted its effort, not that one heuristic ordering lost."""
     want = k + m
     cands = candidates if candidates is not None else sorted(routing.chains)
     cands = sorted(cands, key=lambda c: len(chain_nodes(routing, c)))
+    cands = [c for c in cands if chain_nodes(routing, c)]
     chosen: list[int] = []
     node_load: Counter = Counter()
     for cid in cands:
         nodes = chain_nodes(routing, cid)
-        if not nodes:
-            continue
         if any(node_load[n] + 1 > m for n in nodes):
             continue
         chosen.append(cid)
         node_load.update(nodes)
         if len(chosen) == want:
             return chosen
+    repaired = _repair_ec_selection(routing, cands, want, m)
+    if repaired is not None and validate_ec_chains(routing, repaired, m):
+        return repaired
     raise ValueError(
-        f"greedy EC({k}+{m}) placement failed: {len(chosen)} of {want} "
-        f"chains selected before node budgets ({m} shards each) were "
-        f"exhausted — add nodes/chains or try explicit candidates")
+        f"EC({k}+{m}) placement failed: greedy reached {len(chosen)} of "
+        f"{want} chains and swap repair found no valid selection among "
+        f"{len(cands)} candidates — add nodes/chains or relax m")
+
+
+def _repair_ec_selection(routing: RoutingInfo, cands: list[int],
+                         want: int, m: int,
+                         max_steps: int = 400) -> list[int] | None:
+    """Swap local search over chain selections: minimize the total
+    per-node overload sum(max(0, load - m)).  Starts from the first
+    `want` candidates, repeatedly swaps one selected chain for one
+    unselected chain whenever that strictly reduces overload; 0 overload
+    is exactly the validate_ec_chains invariant."""
+    if len(cands) < want:
+        return None
+    selected = list(cands[:want])
+    rest = [c for c in cands if c not in selected]
+    load: Counter = Counter()
+    for cid in selected:
+        load.update(chain_nodes(routing, cid))
+
+    def overload(cnt: Counter) -> int:
+        return sum(v - m for v in cnt.values() if v > m)
+
+    cur = overload(load)
+    for _ in range(max_steps):
+        if cur == 0:
+            return selected
+        best = (0, None, None)
+        for i, out_c in enumerate(selected):
+            out_nodes = chain_nodes(routing, out_c)
+            for j, in_c in enumerate(rest):
+                trial = Counter(load)
+                trial.subtract(out_nodes)
+                trial.update(chain_nodes(routing, in_c))
+                d = overload(trial) - cur
+                if d < best[0]:
+                    best = (d, i, j)
+        d, i, j = best
+        if i is None:
+            return None                  # local minimum with overload left
+        out_c, in_c = selected[i], rest[j]
+        load.subtract(chain_nodes(routing, out_c))
+        load.update(chain_nodes(routing, in_c))
+        selected[i], rest[j] = in_c, out_c
+        cur += d
+    return selected if cur == 0 else None
 
 
 def validate_ec_chains(routing: RoutingInfo, chains: list[int], m: int) -> bool:

@@ -70,6 +70,26 @@ class GetRoutingInfoReq:
     # appended (serde add-only, like PR 11's trace fields): scorecard
     # version the caller already holds; 0 asks for whatever is cached
     known_health_version: int = 0
+    # appended (ISSUE 15): caller can apply RoutingDelta — opt-in,
+    # because a pre-15 client interprets info=None as "up to date" and
+    # would silently drop an unsolicited delta
+    want_delta: bool = False
+
+
+@serde_struct
+@dataclass
+class RoutingDelta:
+    """Incremental routing update (ISSUE 15): only the chains that
+    changed between base_version and version, plus the (small) full node
+    and chain-table maps.  A caller whose cached version != base_version
+    must discard the delta and do a full refresh."""
+    version: int = 0
+    base_version: int = 0
+    chains: list[ChainInfo] = field(default_factory=list)
+    removed_chains: list[int] = field(default_factory=list)
+    nodes: dict[int, NodeInfo] = field(default_factory=dict)
+    chain_tables: dict[int, ChainTable] = field(default_factory=dict)
+    bootstrapping: bool = False
 
 
 @serde_struct
@@ -82,6 +102,9 @@ class GetRoutingInfoRsp:
     # old servers leave them at defaults (None/0)
     health: ClusterHealth | None = None
     health_version: int = 0
+    # appended (ISSUE 15): incremental update when the caller sent
+    # want_delta and the change log covers its version; info stays None
+    delta: RoutingDelta | None = None
 
 
 @serde_struct
@@ -204,6 +227,46 @@ class MgmtdState:
         # every node as alive until one full heartbeat window has passed, or
         # the first updater tick would demote the whole healthy cluster
         self.started_at: float = time.time()
+        # ISSUE 15: routing change log — version -> chain ids changed at
+        # that version (empty tuple = node/table-only bump).  In-memory:
+        # a restarted/failed-over mgmtd starts with an empty log and
+        # clients simply fall back to one full refresh.  Any version
+        # missing from the window forces the full path too, so the log
+        # can never serve a delta it cannot prove complete.
+        self.change_log: dict[int, tuple[int, ...]] = {}
+
+    CHANGE_LOG_CAP = 256
+
+    def _log_change(self, version: int, chain_ids) -> None:
+        prev = self.change_log.get(version, ())
+        self.change_log[version] = tuple(set(prev) | set(chain_ids))
+        while len(self.change_log) > self.CHANGE_LOG_CAP:
+            self.change_log.pop(min(self.change_log))
+
+    def build_delta(self, known_version: int) -> "RoutingDelta | None":
+        """Delta covering (known_version, current]; None when the change
+        log cannot prove completeness (gap, restart, or caller too far
+        behind) — the caller then gets the full RoutingInfo."""
+        info = self.routing()
+        if known_version <= 0 or known_version >= info.version:
+            return None
+        changed: set[int] = set()
+        for v in range(known_version + 1, info.version + 1):
+            entry = self.change_log.get(v)
+            if entry is None:
+                return None
+            changed.update(entry)
+        if len(changed) * 2 >= max(1, len(info.chains)):
+            return None            # most chains moved: full is cheaper
+        return RoutingDelta(
+            version=info.version, base_version=known_version,
+            chains=[info.chains[c] for c in sorted(changed)
+                    if c in info.chains],
+            removed_chains=sorted(c for c in changed
+                                  if c not in info.chains),
+            nodes=dict(info.nodes),
+            chain_tables=dict(info.chain_tables),
+            bootstrapping=info.bootstrapping)
 
     # --- lease (primary election) ---
 
@@ -339,8 +402,18 @@ class MgmtdState:
                 written.append(c.chain_id)
                 any_write = True
             for t in tables or ():
-                txn.set(KeyPrefix.CHAIN_TABLE.key(str(t.table_id).encode()),
-                        serde.dumps(t))
+                # table_ver advances monotonically on every re-install
+                # (ISSUE 15): read the persisted predecessor inside the
+                # txn so with_transaction retries recompute it
+                key = KeyPrefix.CHAIN_TABLE.key(str(t.table_id).encode())
+                raw = await txn.get(key)
+                prev_ver = getattr(serde.loads(raw), "table_ver", 0) \
+                    if raw else 0
+                stamped = ChainTable(
+                    table_id=t.table_id, chain_ids=list(t.chain_ids),
+                    table_ver=max(prev_ver + 1, t.table_ver),
+                    table_type=t.table_type)
+                txn.set(key, serde.dumps(stamped))
                 any_write = True
             if not skipped:
                 # node-generation records ride ONLY when every guarded chain
@@ -353,8 +426,15 @@ class MgmtdState:
                 raw = await txn.get(KeyPrefix.ROUTING_VER.key())
                 txn.set(KeyPrefix.ROUTING_VER.key(),
                         str(int(raw or 1) + 1).encode())
-        await with_transaction(self.kv, txn_fn)
+            return any_write
+        bumped = await with_transaction(self.kv, txn_fn)
         await self.load_routing()
+        if bumped:
+            # attribute the changed chains to (at least) the version the
+            # reload observed — attributing too high is safe (a caller at
+            # that version already holds the change), and a racing writer
+            # colliding on the same version merges via _log_change
+            self._log_change(self._routing_cache.version, written)
         return written
 
     def node_alive(self, node_id: int) -> bool:
@@ -832,8 +912,17 @@ class MgmtdService:
     @rpc_method
     async def get_routing_info(self, req: GetRoutingInfoReq, payload, conn):
         info = self.state.routing()
-        rsp = GetRoutingInfoRsp(
-            info=None if req.known_version >= info.version else info)
+        rsp = GetRoutingInfoRsp()
+        if req.known_version < info.version:
+            # ISSUE 15: delta-capable callers get only the changed chains
+            # when the change log covers their version; everyone else
+            # (and any log gap) gets the full map
+            delta = self.state.build_delta(req.known_version) \
+                if getattr(req, "want_delta", False) else None
+            if delta is not None:
+                rsp.delta = delta
+            else:
+                rsp.info = info
         # scorecard piggyback rides even when routing is unchanged —
         # health moves on its own clock (the monitor pull period)
         st = self.state
@@ -1060,6 +1149,7 @@ class MgmtdService:
             out[:] = [updated]
         await with_transaction(st.kv, txn_fn)
         await st.load_routing()
+        st._log_change(st.routing().version, ())   # node-only bump
         # rebase any pending restart-save on the admin result: the updater
         # flush would otherwise re-persist the PRE-admin status/tags it
         # captured at heartbeat time (keep its generation — that's the
@@ -1137,6 +1227,7 @@ class MgmtdService:
             st.target_reporter.pop(tid, None)
             st.local_states.pop(tid, None)
         await st.load_routing()
+        st._log_change(st.routing().version, ())   # node-only bump
         return OkRsp(), b""
 
     @rpc_method

@@ -100,9 +100,17 @@ class NodeInfo:
 @dataclass
 class ChainTable:
     """Ordered list of chain ids used for striping layouts
-    (fbs/mgmtd/ChainTable.h analog)."""
+    (fbs/mgmtd/ChainTable.h analog).
+
+    table_ver bumps on every re-install (ISSUE 15: clients compare it to
+    decide whether a table's membership solve moved under them without
+    re-reading every chain); table_type mirrors the reference solver's
+    -type {CR,EC} split — "cr" replicated chains, "ec" single-replica
+    shard chains.  Both are serde add-only: pre-15 peers leave defaults."""
     table_id: int = 1
     chain_ids: list[int] = field(default_factory=list)
+    table_ver: int = 1
+    table_type: str = ""
 
 
 @serde_struct

@@ -68,6 +68,32 @@ class LiveInjector:
             await self.on_restart(node)
         return "restarted empty"
 
+    async def node_add(self, node: int) -> str:
+        """Elastic membership (ISSUE 15): bring up a brand-new EMPTY
+        storage node mid-run.  With the rebalancer on, the next plan tick
+        starts moving chains onto it under live traffic; without it the
+        node just idles (still a valid scenario: registration churn)."""
+        ss = await self.cluster.add_storage_node(node)
+        if self.on_restart is not None:
+            await self.on_restart(ss.node_id)
+        return f"node {ss.node_id} up (empty)"
+
+    async def node_drain(self, node: int) -> str:
+        """Graceful drain: tag the node ``drain``.  It keeps serving
+        (disable-node would demote its targets immediately and strand
+        single-replica EC chains without a resync source) while the
+        rebalancer's solver stops assigning it chains and migrates its
+        holdings elsewhere, move by paced move."""
+        from t3fs.mgmtd.service import NodeOpReq
+        cur = self.cluster.mgmtd.state.routing().nodes.get(node)
+        tags = list(cur.tags) if cur is not None else []
+        if "drain" not in tags:
+            tags.append("drain")
+        await self.cluster.admin.call(
+            self.cluster.mgmtd_rpc.address, "Mgmtd.set_node_tags",
+            NodeOpReq(node_id=node, tags=tags))
+        return "tagged drain (rebalancer migrates its chains off)"
+
     async def bitrot(self, node: int, chunks: int) -> str:
         """Flip bytes in `chunks` live EC shards picked from the scrub
         registry (auto-discovered from checkpoint manifests — nothing
@@ -173,7 +199,11 @@ class FaultSchedule:
             delay = f.at_s - self._now()
             if delay > 0:
                 await self.sleep(delay)
-            node = self._pick_node(f.node)
+            # node_add with no explicit node: 0 = "pick a fresh id"
+            # (the injector allocates max+1); the seeded picker must not
+            # hand it an EXISTING node
+            node = 0 if (f.kind == "node_add" and not f.node) \
+                else self._pick_node(f.node)
             ev = FaultEvent(self._now(), f.kind, node)
             try:
                 if f.kind == "straggler":
@@ -186,6 +216,10 @@ class FaultSchedule:
                     ev.detail = await self.injector.crash(node)
                 elif f.kind == "bitrot":
                     ev.detail = await self.injector.bitrot(node, f.chunks)
+                elif f.kind == "node_add":
+                    ev.detail = await self.injector.node_add(node)
+                elif f.kind == "node_drain":
+                    ev.detail = await self.injector.node_drain(node)
             except Exception as e:               # noqa: BLE001
                 ev.ok = False
                 ev.detail = f"{type(e).__name__}: {e}"

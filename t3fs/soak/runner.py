@@ -46,6 +46,9 @@ class SoakRunner:
         self.metrics_collector = None
         self._maint_sc = None
         self.monitor_address: str | None = None
+        self.migration = None
+        self.rebalancer = None
+        self._mig_client = None
 
     async def run(self, require_fairness: bool | None = None
                   ) -> SoakReport:
@@ -142,10 +145,35 @@ class SoakRunner:
                                                reporters=[self.reporter])
             self.metrics_collector.start()
 
+            # 4.5 elastic membership (ISSUE 15): the online rebalancer
+            # turns node_add/node_drain faults into live chain moves,
+            # paced so they cannot starve the foreground drivers
+            if spec.rebalance:
+                from t3fs.migration.rebalancer import Rebalancer
+                from t3fs.migration.service import MigrationService
+                from t3fs.net.client import Client
+                self._mig_client = Client()
+                self.migration = MigrationService(
+                    cluster.mgmtd_rpc.address, client=self._mig_client,
+                    poll_period_s=0.1, sync_timeout_s=spec.duration_s,
+                    flap_timeout_s=5.0)
+                self.rebalancer = Rebalancer(
+                    self.migration,
+                    budget_mbps=spec.rebalance_budget_mbps,
+                    plan_period_s=spec.rebalance_period_s)
+                await self.migration.start()
+                await self.rebalancer.start()
+
+            async def wire_new_node(node_id: int) -> None:
+                # a node_add fault's fresh server needs the same
+                # CheckWorker sink wiring as a crash-restart's
+                if node_id in cluster.storage:
+                    await wire_check(node_id)
+
             injector = LiveInjector(
                 cluster, self.scrub,
                 rng=np.random.default_rng(spec.seed ^ 0xB17),
-                on_restart=wire_check)
+                on_restart=wire_new_node)
             schedule = FaultSchedule(spec, injector)
 
             # 5. traffic + faults, concurrently, for duration_s
@@ -220,6 +248,15 @@ class SoakRunner:
                 await d.teardown()
             except Exception:                    # noqa: BLE001
                 log.exception("soak: driver %s teardown failed", d.name)
+        if self.rebalancer is not None:
+            await self.rebalancer.stop()
+            self.rebalancer = None
+        if self.migration is not None:
+            await self.migration.stop()
+            self.migration = None
+        if self._mig_client is not None:
+            await self._mig_client.close()
+            self._mig_client = None
         if self.scrub is not None:
             await self.scrub.stop()
             await self.scrub.ec.close()

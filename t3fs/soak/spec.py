@@ -24,7 +24,7 @@ from t3fs.utils.config import ConfigBase, cchoice, citem, cobj
 
 WORKLOAD_KINDS = ("dataloader", "checkpoint", "kvcache", "metascan",
                   "graysort")
-FAULT_KINDS = ("straggler", "crash", "bitrot")
+FAULT_KINDS = ("straggler", "crash", "bitrot", "node_add", "node_drain")
 
 
 @dataclass
@@ -102,6 +102,12 @@ class SoakSpec(ConfigBase):
     # scrub: auto-derived targets (ckpt manifests), paced repair
     scrub_period_s: float = citem(2.0, validator=lambda v: v > 0)
     repair_budget_mbps: float = citem(8.0, validator=lambda v: v >= 0)
+    # ISSUE 15: run the online rebalancer during the soak — node_add /
+    # node_drain faults then exercise live chain moves under traffic,
+    # paced by rebalance_budget_mbps (0 = unpaced)
+    rebalance: bool = citem(False)
+    rebalance_budget_mbps: float = citem(8.0, validator=lambda v: v >= 0)
+    rebalance_period_s: float = citem(1.0, validator=lambda v: v > 0)
     check_period_s: float = citem(1.0, validator=lambda v: v > 0)
     # tail sampling (PR 11): slow/errored traces self-select into the
     # harvest so the worst p99 spike ships with its critical path

@@ -35,6 +35,9 @@ class ResyncWorker:
         self.completed: int = 0   # test observability
 
     async def start(self) -> None:
+        # clear, not assume-fresh: stop/start cycles (tests pause the
+        # pusher to hold a successor in SYNCING) must actually restart
+        self._stopped.clear()
         self._task = asyncio.create_task(self._loop(), name="resync-worker")
 
     async def stop(self) -> None:

@@ -10,6 +10,8 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
+import re
 import time as _time
 
 from dataclasses import dataclass
@@ -67,7 +69,9 @@ class StorageServer:
                  checksum_backend: str = "cpu",
                  write_pipeline: str = "off",
                  cfg: StorageConfig | None = None,
-                 admin_token: str = ""):
+                 admin_token: str = "",
+                 default_root: str = "",
+                 discover_targets: bool = False):
         self.cfg = cfg or StorageConfig(
             host=host, port=port, heartbeat_period_s=heartbeat_period_s,
             resync_period_s=resync_period_s, checksum_backend=checksum_backend,
@@ -80,6 +84,12 @@ class StorageServer:
         self.node.stream_threshold = self.cfg.stream_threshold
         self.node.stream_frag_bytes = self.cfg.stream_frag_bytes
         self.node.stream_window = self.cfg.stream_window
+        # ISSUE 15: default_root lets a remote caller (the rebalancer)
+        # create_target without knowing this node's disk layout; discovery
+        # re-adds t{id} dirs after a restart so migrated-in targets survive
+        # a crash of their new home
+        self.node.default_root = default_root
+        self.discover_targets = discover_targets
         self.service = StorageService(self.node)
         self.server.add_service(self.service)
         from t3fs.core.service import AppInfo, CoreService
@@ -148,8 +158,36 @@ class StorageServer:
         self.node.stream_window = self.cfg.stream_window
         configure_tracing(self.cfg.trace)
 
+    def _discover_targets(self) -> list[int]:
+        """Re-adopt t{target_id} chunk dirs under default_root that nobody
+        add_target()ed this boot — a target migrated onto this node by the
+        rebalancer has no config entry, so without this a restart would
+        silently drop it (routing says SERVING here, heartbeats say no
+        such target, mgmtd degrades the chain)."""
+        found = []
+        if not (self.discover_targets and self.node.default_root
+                and os.path.isdir(self.node.default_root)):
+            return found
+        for name in sorted(os.listdir(self.node.default_root)):
+            m = re.fullmatch(r"t(\d+)", name)
+            if not m:
+                continue
+            tid = int(m.group(1))
+            path = os.path.join(self.node.default_root, name)
+            if tid in self.node.targets or not os.path.isdir(path):
+                continue
+            self.node.add_target(tid, path)
+            found.append(tid)
+        if found:
+            log.info("node %d re-adopted targets %s from %s", self.node_id,
+                     found, self.node.default_root)
+        return found
+
     async def start(self) -> None:
         configure_tracing(self.cfg.trace)
+        # before the first heartbeat: local_states must cover adopted
+        # targets or mgmtd briefly sees them missing
+        self._discover_targets()
         if self.cfg.aio_read:
             from t3fs.storage.aio import AioReadWorker
             if AioReadWorker.available():

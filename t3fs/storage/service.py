@@ -17,6 +17,7 @@ from __future__ import annotations
 import asyncio
 import itertools
 import logging
+import os
 import time as _time
 from dataclasses import dataclass
 from typing import Callable
@@ -181,6 +182,11 @@ class StorageNode:
         # with the node — clients re-attach on NOT_FOUND
         self.ring_sessions: dict[int, _RingSession] = {}
         self._ring_ids = itertools.count(1)
+        # ISSUE 15: when set, create_target with an empty root provisions
+        # the chunk dir at <default_root>/t<target_id> — the node owns its
+        # disk layout, so a remote orchestrator (the rebalancer) doesn't
+        # need to know per-node paths
+        self.default_root = ""
 
     def fenced(self) -> bool:
         return self.fence is not None and self.fence()
@@ -980,14 +986,29 @@ class StorageService:
         """Provision a new target (disk dir) on this node; it joins chains
         via mgmtd update_chain + resync."""
         node = self.node
-        if not req.root:
-            raise make_error(StatusCode.INVALID_ARG, "create_target: no root")
+        root = req.root
+        if not root:
+            if not node.default_root:
+                raise make_error(StatusCode.INVALID_ARG,
+                                 "create_target: no root (and this node has "
+                                 "no default data root configured)")
+            root = os.path.join(node.default_root, f"t{req.target_id}")
         existing = node.targets.get(req.target_id)
         if existing is not None:
             # idempotent re-create: same id + same root is a no-op success
             # (a restarted orchestrator re-attaches); a different root is a
             # conflict — silently reusing the other disk would be wrong
-            if existing.engine.root == req.root:
+            if existing.engine.root == root:
+                # re-provisioning an OFFLINE target brings it back ONLINE:
+                # a rebalance that moves a chain back onto a previously
+                # drained target must not leave it wedged at local OFFLINE
+                # (the chain machine would never promote it past public
+                # OFFLINE).  Its stale chunks are reconciled by resync —
+                # ONLINE, not UPTODATE, so it re-enters via SYNCING.
+                if node.local_states.get(req.target_id) == \
+                        LocalTargetState.OFFLINE:
+                    node.local_states[req.target_id] = \
+                        LocalTargetState.ONLINE
                 return TargetOpRsp(
                     target_id=req.target_id,
                     state=int(node.local_states.get(
@@ -995,7 +1016,7 @@ class StorageService:
             raise make_error(StatusCode.INVALID_ARG,
                              f"target {req.target_id} already exists at "
                              f"{existing.engine.root}")
-        t = node.add_target(req.target_id, req.root,
+        t = node.add_target(req.target_id, root,
                             state=LocalTargetState.ONLINE,
                             engine_backend=req.engine_backend)
         return TargetOpRsp(target_id=t.target_id,

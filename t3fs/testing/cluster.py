@@ -130,7 +130,8 @@ class LocalCluster:
                     self.target_id(node_id, c), node_id,
                     PublicTargetState.SERVING))
             chains.append(ChainInfo(chain_id=c + 1, chain_ver=1, targets=targets))
-        tables = [ChainTable(1, [c.chain_id for c in chains])]
+        tables = [ChainTable(1, [c.chain_id for c in chains],
+                             table_type="cr")]
         if self.ec_chains:
             ec = []
             for j in range(self.ec_chains):
@@ -141,7 +142,8 @@ class LocalCluster:
                     targets=[ChainTargetInfo(
                         self.target_id(node_id, self.num_chains + j),
                         node_id, PublicTargetState.SERVING)]))
-            tables.append(ChainTable(2, [c.chain_id for c in ec]))
+            tables.append(ChainTable(2, [c.chain_id for c in ec],
+                                     table_type="ec"))
             chains += ec
         await self.admin.call(
             self.mgmtd_rpc.address, "Mgmtd.set_chains",
@@ -179,7 +181,8 @@ class LocalCluster:
             await self.meta.start()
             self.mc = MetaClient([self.meta_rpc.address])
 
-    async def start_storage_node(self, node_id: int) -> StorageServer:
+    async def start_storage_node(self, node_id: int,
+                                 with_targets: bool = True) -> StorageServer:
         # heartbeat at timeout/6: the lease/2 self-fence then has ~3
         # heartbeat periods of margin (the production ratio) — one stalled
         # loop iteration must not spuriously fence every node in a test
@@ -187,23 +190,27 @@ class LocalCluster:
                            heartbeat_period_s=min(
                                0.15, self.mgmtd_cfg.heartbeat_timeout_s / 6),
                            resync_period_s=0.1,
-                           write_pipeline=self.write_pipeline)
+                           write_pipeline=self.write_pipeline,
+                           default_root=self.node_root(node_id),
+                           discover_targets=True)
         if self.stream_threshold is not None:
             ss.node.stream_threshold = self.stream_threshold
             ss.node.stream_frag_bytes = max(1, self.stream_threshold // 2)
         if self.trace is not None:
             ss.cfg.trace = self.trace
         try:
-            for c in range(self.num_chains):
+            # chunk dirs are named t{target_id} (matching create_target's
+            # default-root derivation) so a restart re-adopts migrated-in
+            # targets via StorageServer._discover_targets
+            for c in range(self.num_chains) if with_targets else ():
                 # every node pre-creates targets for chains it may serve
-                ss.add_target(self.target_id(node_id, c),
-                              f"{self.node_root(node_id)}/t{c}")
-            for j in range(self.ec_chains):
+                tid = self.target_id(node_id, c)
+                ss.add_target(tid, f"{self.node_root(node_id)}/t{tid}")
+            for j in range(self.ec_chains) if with_targets else ():
                 # EC chains are single-replica: only the home node hosts one
                 if j % self.num_nodes + 1 == node_id:
-                    c = self.num_chains + j
-                    ss.add_target(self.target_id(node_id, c),
-                                  f"{self.node_root(node_id)}/t{c}")
+                    tid = self.target_id(node_id, self.num_chains + j)
+                    ss.add_target(tid, f"{self.node_root(node_id)}/t{tid}")
             await ss.start()
         except BaseException:
             # a partial start (bound listener, open engines) must not leak:
@@ -215,6 +222,61 @@ class LocalCluster:
             raise
         self.storage[node_id] = ss
         return ss
+
+    async def add_storage_node(self, node_id: int = 0) -> StorageServer:
+        """Elastic membership (ISSUE 15): bring up a brand-new empty node.
+        No pre-created targets — the rebalancer migrates chains onto it
+        via Storage.create_target (empty root → node derives the path
+        under its default_root).  Returns the started server; the node
+        registers with mgmtd via its first heartbeat."""
+        if node_id == 0:
+            node_id = max(self.storage, default=0) + 1
+        if node_id in self.storage:
+            raise ValueError(f"node {node_id} already running")
+        return await self.start_storage_node(node_id, with_targets=False)
+
+    async def kill_mgmtd(self) -> None:
+        """Fail-stop mgmtd: listener down, lease left in the KV.  Every
+        in-flight admin op (chain surgery, routing fetch) fails with a
+        transient RPC error until restart_mgmtd brings it back."""
+        self._mgmtd_addr = (self.mgmtd_rpc.host, self.mgmtd_rpc.port)
+        await self.mgmtd.stop()
+        await self.mgmtd_rpc.stop()
+        self.mgmtd = None
+
+    async def restart_mgmtd(self) -> None:
+        """(Kill +) restart mgmtd on the SAME port over the SAME KV: state
+        (chains, nodes, tables) reloads from the transactional store, the
+        restarted instance re-acquires the lease (same holder node id),
+        and every client/server reconnects on its next call.  Mid-flight
+        admin ops fail with a transient RPC error — exactly the window
+        the migration service's resumable-job path must survive."""
+        import asyncio
+        if self.mgmtd is not None:
+            await self.kill_mgmtd()
+        host, port = self._mgmtd_addr
+        self.mgmtd_rpc = Server(host, port)
+        self.mgmtd = MgmtdServer(self.kv, 1, "", self.mgmtd_cfg,
+                                 admin_token="local-admin")
+        for svc in self.mgmtd.services:
+            self.mgmtd_rpc.add_service(svc)
+        await self.mgmtd_rpc.start()
+        await self.mgmtd.start()
+        # lease re-acquire is immediate (same holder node), but wait until
+        # the instance answers as primary so callers can resume at once
+        for _ in range(100):
+            if await self.mgmtd.state.is_primary():
+                break
+            await asyncio.sleep(0.05)
+
+    async def restart_storage_node(self, node_id: int) -> StorageServer:
+        """Flap: fail-stop the node (if up) and restart it on the SAME
+        disk.  No pre-created targets — _discover_targets re-adopts every
+        t{target_id} dir it finds, including ones migrated in before the
+        crash."""
+        if node_id in self.storage:
+            await self.kill_storage_node(node_id)
+        return await self.start_storage_node(node_id, with_targets=False)
 
     async def kill_storage_node(self, node_id: int) -> None:
         """Fail-stop: the node vanishes (no clean goodbye)."""

@@ -335,8 +335,10 @@ def test_get_routing_info_rsp_add_only_compat():
     blob = bytearray(dumps(full))
     assert blob[:len(old_bytes) - 2] == old_bytes[:-2]  # same header
     hdr_end = 1 + 1 + len(name)
-    assert blob[hdr_end] == 3                # current field count
-    blob[hdr_end] = 5                        # ...+2 unknown appendees
+    # current field count: info, health, health_version + the ISSUE-15
+    # appended routing delta (still add-only: appended at the end)
+    assert blob[hdr_end] == 4
+    blob[hdr_end] = 6                        # ...+2 unknown appendees
     blob += dumps(True) + dumps(1234)
     again = loads(bytes(blob))
     assert again.health_version == 7

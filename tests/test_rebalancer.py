@@ -1,0 +1,425 @@
+"""Elastic membership (ISSUE 15): online rebalancer + flap-safe jobs.
+
+Covers the acceptance cases: node add rebalances onto the new node with
+byte-identical data; graceful drain (the ``drain`` tag) empties a node
+that keeps serving throughout; drain of a chain's last healthy replica
+is refused; and mid-migration kills (mgmtd, the migration service, the
+destination node) converge on a consistent chain table after restart
+without double-applying chain surgery.
+"""
+
+import asyncio
+import os
+import subprocess
+import sys
+
+from t3fs.client.layout import FileLayout
+from t3fs.mgmtd.chain_table import diff_table, solve_for_routing
+from t3fs.mgmtd.service import NodeOpReq
+from t3fs.mgmtd.types import PublicTargetState
+from t3fs.migration.rebalancer import Rebalancer
+from t3fs.migration.service import (
+    ACTIVE_STATES, MigrationService, ResumeMigrationReq, SubmitMigrationReq,
+)
+from t3fs.net.server import Server
+from t3fs.testing.cluster import LocalCluster
+from t3fs.utils.status import StatusCode
+
+CR_LAYOUT = FileLayout(chunk_size=4096, chains=[1])
+CR_DATA = b"cr-before-rebalance" * 800
+EC_DATA = b"ec-before-rebalance" * 500
+
+
+def make_services(cluster, **kw):
+    mig = MigrationService(cluster.mgmtd_rpc.address, client=cluster.admin,
+                           poll_period_s=0.05, sync_timeout_s=30.0,
+                           flap_timeout_s=kw.pop("flap_timeout_s", 5.0),
+                           store_path=kw.pop("store_path", ""))
+    reb = Rebalancer(mig, max_inflight=kw.pop("max_inflight", 4), **kw)
+    return mig, reb
+
+
+async def write_seed(cluster, ec_chain=0):
+    res = await cluster.sc.write_file_range(CR_LAYOUT, 9, 0, CR_DATA)
+    assert all(r.status.code == int(StatusCode.OK) for r in res)
+    if ec_chain:
+        lay = FileLayout(chunk_size=4096, chains=[ec_chain])
+        res = await cluster.sc.write_file_range(lay, 11, 0, EC_DATA)
+        assert all(r.status.code == int(StatusCode.OK) for r in res)
+
+
+async def check_seed(cluster, ec_chain=0):
+    await cluster.mgmtd_client.refresh()
+    got, _ = await cluster.sc.read_file_range(CR_LAYOUT, 9, 0, len(CR_DATA))
+    assert got == CR_DATA, "wrong bytes after rebalance (CR)"
+    if ec_chain:
+        lay = FileLayout(chunk_size=4096, chains=[ec_chain])
+        got, _ = await cluster.sc.read_file_range(lay, 11, 0, len(EC_DATA))
+        assert got == EC_DATA, "wrong bytes after rebalance (EC)"
+
+
+async def converge(reb, mig, timeout_s=90.0):
+    """Tick the planner until the solver wants nothing and no job runs.
+    A non-resumable failure is a test failure, not something to retry."""
+    loop = asyncio.get_running_loop()
+    deadline = loop.time() + timeout_s
+    while loop.time() < deadline:
+        rsp = await reb.tick()
+        bad = [j for j in mig.jobs.values()
+               if j.state == "failed" and not j.resumable]
+        assert not bad, [(j.job_id, j.error) for j in bad]
+        active = [j for j in mig.jobs.values() if j.state in ACTIVE_STATES]
+        if rsp.planned == 0 and not active:
+            return
+        await asyncio.sleep(0.2)
+    raise AssertionError("rebalance never converged")
+
+
+def node_targets(routing, node_id):
+    return [(c.chain_id, t.target_id) for c in routing.chains.values()
+            for t in c.targets if t.node_id == node_id]
+
+
+async def resume_until_done(mig, job_id, timeout_s=60.0):
+    """Re-drive a resumable job until it completes — the same loop the
+    rebalancer's plan tick runs in production.  A single resume can
+    legitimately fail transient again (e.g. routing still carries the
+    restarted node's old address for one chains-updater period)."""
+    loop = asyncio.get_running_loop()
+    deadline = loop.time() + timeout_s
+    while loop.time() < deadline:
+        await mig.resume(ResumeMigrationReq(job_id=job_id), b"", None)
+        job = await wait_job(mig, job_id)
+        if job.state == "done":
+            return job
+        assert job.resumable, job.error
+        await asyncio.sleep(0.3)
+    raise AssertionError(
+        f"job {job_id} never completed: {mig.jobs[job_id].error}")
+
+
+async def wait_job(mig, job_id, states=("done", "failed"), timeout_s=30.0):
+    for _ in range(int(timeout_s / 0.1)):
+        job = mig.jobs.get(job_id)
+        if job is not None and job.state in states:
+            return job
+        await asyncio.sleep(0.1)
+    raise AssertionError(
+        f"job {job_id} never reached {states}: "
+        f"{mig.jobs.get(job_id) and mig.jobs[job_id].state}")
+
+
+def _run_cli(args_list):
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = subprocess.run(
+        [sys.executable, "-m", "t3fs.cli.admin", *args_list],
+        capture_output=True, text=True, timeout=60,
+        env={**os.environ, "PYTHONPATH": os.pathsep.join(
+            filter(None, [repo, os.environ.get("PYTHONPATH", "")]))})
+    assert out.returncode == 0, out.stderr
+    return out.stdout
+
+
+# ---- node add: rebalance onto a fresh empty node ----
+
+def test_node_add_rebalances_onto_new_node():
+    async def body():
+        cluster = LocalCluster(num_nodes=3, replicas=2, num_chains=6,
+                               ec_chains=4)
+        await cluster.start()
+        try:
+            await write_seed(cluster, ec_chain=7)
+            ss = await cluster.add_storage_node()
+            assert ss.node_id == 4
+            # wait until mgmtd registered the empty node
+            for _ in range(100):
+                if 4 in cluster.mgmtd.state.routing().nodes:
+                    break
+                await asyncio.sleep(0.05)
+
+            mig, reb = make_services(cluster)
+            srv = Server()
+            srv.add_service(mig)
+            srv.add_service(reb)
+            await srv.start()
+            await converge(reb, mig)
+
+            routing = cluster.mgmtd.state.routing()
+            # the new node received a fair share of chains
+            assert len(node_targets(routing, 4)) >= 2
+            # every chain is back at full strength, all targets SERVING,
+            # and no chain holds two replicas on one node
+            for c in routing.chains.values():
+                want = 2 if c.chain_id <= 6 else 1
+                assert len(c.targets) == want, (c.chain_id, c.targets)
+                assert all(t.public_state == PublicTargetState.SERVING
+                           for t in c.targets)
+                nodes = [t.node_id for t in c.targets]
+                assert len(set(nodes)) == len(nodes)
+            # converged = the solver's own diff is empty for both tables
+            cands, _ = await reb._candidates()
+            for table_id in sorted(routing.chain_tables):
+                solved = solve_for_routing(routing, table_id, cands)
+                assert diff_table(routing, solved) == []
+
+            await check_seed(cluster, ec_chain=7)
+            # routing churn reached clients as deltas, not full re-fetches
+            assert cluster.mgmtd_client.delta_refreshes > 0
+
+            # operator surface: the admin CLI renders the move ledger
+            out = await asyncio.to_thread(
+                _run_cli, ["--migration", srv.address, "rebalance-status"])
+            assert "moves:" in out and "done=" in out
+            assert "pacing:" in out
+
+            await reb.stop()
+            await mig.stop()
+            await srv.stop()
+        finally:
+            await cluster.stop()
+    asyncio.run(body())
+
+
+# ---- graceful drain: the node keeps serving while it empties ----
+
+def test_drain_tag_empties_node_while_it_serves():
+    async def body():
+        cluster = LocalCluster(num_nodes=4, replicas=2, num_chains=6,
+                               ec_chains=4)
+        await cluster.start()
+        try:
+            await write_seed(cluster, ec_chain=7)
+            mig, reb = make_services(cluster)
+            # settle the installed round-robin table to the solver target
+            # first, so the drain diff is the only remaining gap
+            await converge(reb, mig)
+
+            routing = cluster.mgmtd.state.routing()
+            victim = next(n for n in (1, 2, 3, 4)
+                          if node_targets(routing, n))
+            await cluster.admin.call(
+                cluster.mgmtd_rpc.address, "Mgmtd.set_node_tags",
+                NodeOpReq(node_id=victim, tags=["drain"]))
+            await converge(reb, mig)
+
+            routing = cluster.mgmtd.state.routing()
+            assert node_targets(routing, victim) == []
+            # graceful: the node is still registered, alive and ACTIVE —
+            # it served as a resync source for its own exodus (unlike
+            # disable-node, which would have demoted its targets and
+            # stranded the single-replica EC chains)
+            rsp, _ = await cluster.admin.call(
+                cluster.mgmtd_rpc.address, "Mgmtd.list_nodes", None)
+            row = next(r for r in rsp.nodes if r.node.node_id == victim)
+            assert row.alive
+            assert "drain" in row.node.tags
+            for c in routing.chains.values():
+                assert all(t.public_state == PublicTargetState.SERVING
+                           for t in c.targets)
+            await check_seed(cluster, ec_chain=7)
+
+            await reb.stop()
+            await mig.stop()
+        finally:
+            await cluster.stop()
+    asyncio.run(body())
+
+
+# ---- drain-of-last-healthy-replica refused ----
+
+def test_drain_last_healthy_replica_refused():
+    async def body():
+        cluster = LocalCluster(num_nodes=2, replicas=1, num_chains=1)
+        await cluster.start()
+        try:
+            await write_seed(cluster)
+            mig = MigrationService(cluster.mgmtd_rpc.address,
+                                   client=cluster.admin,
+                                   poll_period_s=0.05, sync_timeout_s=30.0)
+            # every node reported dead: after the destination syncs, the
+            # DRAIN step sees no healthy survivor besides the source and
+            # must refuse rather than walk the chain to zero live copies
+            real_alive = mig._alive_nodes
+
+            async def all_dead():
+                return {}
+            mig._alive_nodes = all_dead
+
+            src = cluster.target_id(1, 0)
+            rsp, _ = await mig.submit(SubmitMigrationReq(
+                chain_id=1, src_target_id=src, dst_target_id=9400,
+                dst_node_id=2), b"", None)
+            job = await wait_job(mig, rsp.job_id)
+            assert job.state == "failed" and job.resumable, job.error
+            assert "last healthy serving replica" in job.error
+            # nothing was drained: both targets still serve
+            chain = cluster.chain()
+            assert {t.target_id for t in chain.targets} == {src, 9400}
+            assert all(t.public_state == PublicTargetState.SERVING
+                       for t in chain.targets)
+
+            # with liveness back, resume completes the move
+            mig._alive_nodes = real_alive
+            await mig.resume(ResumeMigrationReq(job_id=rsp.job_id), b"", None)
+            job = await wait_job(mig, rsp.job_id)
+            assert job.state == "done", job.error
+            chain = cluster.chain()
+            assert [t.target_id for t in chain.targets] == [9400]
+            await check_seed(cluster)
+            await mig.stop()
+        finally:
+            await cluster.stop()
+    asyncio.run(body())
+
+
+# ---- mid-migration kills: re-attach without double-applying surgery ----
+
+async def _park_in_waiting_sync(cluster, mig):
+    """Submit the node3 -> node4 move of chain 1 and hold it in
+    WAITING_SYNC by pausing the resync pusher (the chain's tail, node 3,
+    is both the move's source and the resync source)."""
+    await cluster.storage[3].resync.stop()
+    rsp, _ = await mig.submit(SubmitMigrationReq(
+        chain_id=1, src_target_id=cluster.target_id(3, 0),
+        dst_target_id=9400, dst_node_id=4), b"", None)
+    job = await wait_job(mig, rsp.job_id, states=("waiting_sync",))
+    return rsp.job_id, job
+
+
+async def _assert_chain_converged(cluster, src_target):
+    chain = cluster.chain()
+    ids = [t.target_id for t in chain.targets]
+    assert sorted(ids) == sorted(set(ids)), f"duplicate targets: {ids}"
+    assert 9400 in ids and src_target not in ids
+    assert len(ids) == 3
+    for _ in range(100):
+        chain = cluster.chain()
+        if all(t.public_state == PublicTargetState.SERVING
+               for t in chain.targets):
+            break
+        await asyncio.sleep(0.1)
+    assert all(t.public_state == PublicTargetState.SERVING
+               for t in chain.targets)
+
+
+def test_mgmtd_restart_mid_job_reattaches():
+    async def body():
+        cluster = LocalCluster(num_nodes=4, replicas=3, num_chains=1)
+        await cluster.start()
+        try:
+            await write_seed(cluster)
+            mig = MigrationService(cluster.mgmtd_rpc.address,
+                                   client=cluster.admin,
+                                   poll_period_s=0.05, sync_timeout_s=30.0)
+            job_id, _ = await _park_in_waiting_sync(cluster, mig)
+
+            # fail-stop mgmtd with the JOIN already applied: the driver's
+            # next routing poll hits a dead listener -> transient failure,
+            # marked resumable (progress is re-derivable from routing)
+            await cluster.kill_mgmtd()
+            job = await wait_job(mig, job_id)
+            assert job.state == "failed" and job.resumable, job.error
+
+            await cluster.restart_mgmtd()
+            # restarted state comes from the shared KV: the chain still
+            # holds the joined destination exactly once
+            ids = [t.target_id for t in cluster.chain().targets]
+            assert ids.count(9400) == 1
+            await cluster.storage[3].resync.start()
+            # probe until the admin client reconnected to the new listener
+            from t3fs.mgmtd.service import GetRoutingInfoReq
+            for _ in range(100):
+                try:
+                    await cluster.admin.call(
+                        cluster.mgmtd_rpc.address, "Mgmtd.get_routing_info",
+                        GetRoutingInfoReq(known_version=0))
+                    break
+                except Exception:
+                    await asyncio.sleep(0.1)
+
+            job = await resume_until_done(mig, job_id)
+            assert job.attempts >= 2
+            await _assert_chain_converged(cluster, cluster.target_id(3, 0))
+            await check_seed(cluster)
+            await mig.stop()
+        finally:
+            await cluster.stop()
+    asyncio.run(body())
+
+
+def test_migration_service_restart_mid_job_reattaches():
+    async def body():
+        cluster = LocalCluster(num_nodes=4, replicas=3, num_chains=1)
+        await cluster.start()
+        try:
+            await write_seed(cluster)
+            store = os.path.join(cluster._tmp.name, "migration-jobs.json")
+            mig = MigrationService(cluster.mgmtd_rpc.address,
+                                   client=cluster.admin,
+                                   poll_period_s=0.05, sync_timeout_s=30.0,
+                                   store_path=store)
+            job_id, _ = await _park_in_waiting_sync(cluster, mig)
+            # daemon dies mid-WAIT; the job store remembers the in-flight
+            # job in its last persisted state
+            await mig.stop()
+
+            mig2 = MigrationService(cluster.mgmtd_rpc.address,
+                                    client=cluster.admin,
+                                    poll_period_s=0.05, sync_timeout_s=30.0,
+                                    store_path=store)
+            assert mig2.jobs[job_id].state == "waiting_sync"
+            await cluster.storage[3].resync.start()
+            await mig2.start()          # re-attach re-drives active jobs
+            job = await wait_job(mig2, job_id)
+            if job.state != "done":     # a transient re-fail is resumable
+                assert job.resumable, job.error
+                job = await resume_until_done(mig2, job_id)
+            await _assert_chain_converged(cluster, cluster.target_id(3, 0))
+            await check_seed(cluster)
+            await mig2.stop()
+        finally:
+            await cluster.stop()
+    asyncio.run(body())
+
+
+def test_destination_flap_mid_sync_resumable():
+    async def body():
+        cluster = LocalCluster(num_nodes=4, replicas=3, num_chains=1)
+        await cluster.start()
+        try:
+            await write_seed(cluster)
+            mig = MigrationService(cluster.mgmtd_rpc.address,
+                                   client=cluster.admin,
+                                   poll_period_s=0.05, sync_timeout_s=60.0,
+                                   flap_timeout_s=1.0)
+            job_id, _ = await _park_in_waiting_sync(cluster, mig)
+
+            # destination dies mid-SYNCING: the WAIT step must fail the
+            # job (resumable) after flap_timeout_s, not poll out the full
+            # sync timeout
+            await cluster.kill_storage_node(4)
+            job = await wait_job(mig, job_id, timeout_s=20.0)
+            assert job.state == "failed" and job.resumable, job.error
+            assert "re-plan the move" in job.error
+
+            # node comes back on the same disk: _discover_targets
+            # re-adopts the half-created destination target, resync
+            # finishes the copy, and resume completes the surgery
+            await cluster.restart_storage_node(4)
+            rsp, _ = await cluster.admin.call(
+                cluster.mgmtd_rpc.address, "Mgmtd.list_nodes", None)
+            for _ in range(100):
+                rsp, _ = await cluster.admin.call(
+                    cluster.mgmtd_rpc.address, "Mgmtd.list_nodes", None)
+                row = next(r for r in rsp.nodes if r.node.node_id == 4)
+                if row.alive:
+                    break
+                await asyncio.sleep(0.1)
+            await cluster.storage[3].resync.start()
+            job = await resume_until_done(mig, job_id)
+            await _assert_chain_converged(cluster, cluster.target_id(3, 0))
+            await check_seed(cluster)
+            await mig.stop()
+        finally:
+            await cluster.stop()
+    asyncio.run(body())
