@@ -240,7 +240,9 @@ class DevCluster:
         await self.admin.call(
             self.mgmtd_address, "Mgmtd.set_chains",
             SetChainsReq(chains=chains,
-                         tables=[ChainTable(1, [c.chain_id for c in chains])]))
+                         tables=[ChainTable(1, [c.chain_id for c in chains],
+                                            table_type="cr",
+                                            replicas=self.replicas)]))
 
     async def kill_node(self, name: str, *, hard: bool = True) -> None:
         """hard: SIGKILL (fail-stop); soft: SIGTERM (clean shutdown)."""

@@ -696,7 +696,9 @@ async def gen_chains(ctx: AdminContext, args) -> None:
             SetChainsReq(chains=chains,
                          tables=[ChainTable(
                              table_id, [c.chain_id for c in chains],
-                             table_type=args.table_type)]))
+                             table_type=args.table_type,
+                             replicas=(args.replicas
+                                       if args.table_type == "cr" else 1))]))
         print(f"installed table {table_id} ({args.table_type})")
 
 

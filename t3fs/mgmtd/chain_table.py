@@ -26,6 +26,7 @@ table types.
 
 from __future__ import annotations
 
+from collections import Counter
 from dataclasses import dataclass, field
 
 from t3fs.mgmtd.types import ChainInfo, NodeInfo, RoutingInfo
@@ -156,18 +157,33 @@ def solve_for_routing(routing: RoutingInfo, table_id: int,
                       nodes: list[NodeInfo], *, replicas: int | None = None,
                       cap_slack: int = 1) -> SolvedTable:
     """Solve one existing chain table against a candidate node set.
-    Table 1 is CR (replicas defaults to the widest current chain),
-    any other table is EC (single-replica shard chains)."""
+    Table 1 is CR, any other table is EC (single-replica shard chains).
+
+    CR replication comes from the table's persisted ``replicas`` when
+    set; the fallback for pre-15 tables uses the MODE of live chain
+    widths, never the max — a chain mid-migration transiently carries
+    R+1 targets (dst joined, src not yet detached), and solving for the
+    inflated max would pair a second destination onto that chain and
+    ratchet the whole table to R+1 on every subsequent solve."""
     table = routing.chain_tables.get(table_id)
     if table is None:
         raise ValueError(f"chain table {table_id} not in routing")
     table_type = getattr(table, "table_type", "") or \
         ("cr" if table_id == 1 else "ec")
     if replicas is None:
-        widths = [len([t for t in c.targets])
-                  for cid in table.chain_ids
-                  if (c := routing.chain(cid)) is not None]
-        replicas = max(widths) if table_type == "cr" and widths else 1
+        if table_type != "cr":
+            replicas = 1
+        elif getattr(table, "replicas", 0) > 0:
+            replicas = table.replicas
+        else:
+            widths = Counter(
+                len(c.targets) for cid in table.chain_ids
+                if (c := routing.chain(cid)) is not None)
+            if not widths:
+                replicas = 1
+            else:
+                top = max(widths.values())
+                replicas = min(w for w, n in widths.items() if n == top)
     return solve_chain_table(list(table.chain_ids), nodes, replicas,
                              table_type=table_type, cap_slack=cap_slack)
 
@@ -185,9 +201,15 @@ class ChainMove:
 def diff_table(routing: RoutingInfo, solved: SolvedTable,
                *, target_id_of=None) -> list[ChainMove]:
     """Per-chain moves from the CURRENT membership to the solved target.
-    Pairs leaving nodes with joining nodes deterministically (sorted);
-    a chain that only shrinks or only grows is not a *move* and is left
-    to chain surgery proper (the rebalancer only swaps)."""
+    Pairs leaving nodes with joining nodes deterministically (sorted).
+    Surplus leaves beyond the joins (an over-wide chain, e.g. R+1 left
+    behind by an interrupted move whose JOIN applied but whose DETACH
+    never ran) become *shrink* moves: the src is paired with a retained
+    member already on the chain, so the migration driver sees the dst
+    SERVING and skips straight to DRAIN+DETACH of the surplus target —
+    without this the planner can never walk an over-wide chain back to
+    R and the table wedges un-converged.  A chain that only GROWS is
+    still not a move (that is repair's job, not the rebalancer's)."""
     from t3fs.mgmtd.placement import target_id as _tid
     target_id_of = target_id_of or _tid
     moves: list[ChainMove] = []
@@ -205,6 +227,16 @@ def diff_table(routing: RoutingInfo, solved: SolvedTable,
                 src_target_id=current[src_node], src_node_id=src_node,
                 dst_node_id=dst_node,
                 dst_target_id=target_id_of(dst_node, cid - 1)))
+        keep = sorted(n for n in want if n in current)
+        if keep:
+            for src_node in leave[len(join):]:
+                moves.append(ChainMove(
+                    chain_id=cid,
+                    src_target_id=current[src_node], src_node_id=src_node,
+                    dst_node_id=keep[0],
+                    # the retained member's EXISTING target: the driver
+                    # finds it SERVING and goes straight to DRAIN
+                    dst_target_id=current[keep[0]]))
     return moves
 
 

@@ -407,12 +407,17 @@ class MgmtdState:
                 # txn so with_transaction retries recompute it
                 key = KeyPrefix.CHAIN_TABLE.key(str(t.table_id).encode())
                 raw = await txn.get(key)
-                prev_ver = getattr(serde.loads(raw), "table_ver", 0) \
-                    if raw else 0
+                prev = serde.loads(raw) if raw else None
+                prev_ver = getattr(prev, "table_ver", 0) if prev else 0
                 stamped = ChainTable(
                     table_id=t.table_id, chain_ids=list(t.chain_ids),
                     table_ver=max(prev_ver + 1, t.table_ver),
-                    table_type=t.table_type)
+                    table_type=t.table_type,
+                    # desired replication is sticky: a re-install that
+                    # leaves it unset (0) must not erase the persisted
+                    # value the solver depends on
+                    replicas=getattr(t, "replicas", 0)
+                    or (getattr(prev, "replicas", 0) if prev else 0))
                 txn.set(key, serde.dumps(stamped))
                 any_write = True
             if not skipped:

@@ -106,11 +106,15 @@ class ChainTable:
     decide whether a table's membership solve moved under them without
     re-reading every chain); table_type mirrors the reference solver's
     -type {CR,EC} split — "cr" replicated chains, "ec" single-replica
-    shard chains.  Both are serde add-only: pre-15 peers leave defaults."""
+    shard chains; replicas persists the DESIRED replication so the
+    solver never has to infer it from live chain widths (which are
+    transiently R+1 mid-migration).  All serde add-only: pre-15 peers
+    leave defaults (replicas=0 = unknown, solver falls back to widths)."""
     table_id: int = 1
     chain_ids: list[int] = field(default_factory=list)
     table_ver: int = 1
     table_type: str = ""
+    replicas: int = 0
 
 
 @serde_struct

@@ -18,9 +18,18 @@ Safety/pacing (ISSUE 15):
   — e.g. just added — is allowed: absence of history is not sickness),
   and moves whose resync SOURCE (the chain head) is a straggler are
   submitted last, so healthy sources drain first;
-* a destination that flaps mid-sync fails its job *resumable*; the next
-  plan tick either resumes it (node back and healthy) or — with the node
-  gone from the candidate set — re-solves to a different destination;
+* a destination that flaps mid-sync fails its job *resumable*; a later
+  plan tick resumes it only if the node is back, healthy, AND the move
+  is still compatible with the fresh solve (dst a wanted owner of the
+  chain, src not) — otherwise the planner has re-solved (e.g. to a
+  different destination) and the stale job stays failed rather than
+  executing a move the plan already moved past; over-wide chains such a
+  stale job leaves behind (JOIN applied, DETACH never ran) are walked
+  back to R by diff_table's shrink moves;
+* chains with an in-flight job are excluded from the diff and from
+  submission (one surgeon per chain per tick): mid-surgery a chain is
+  transiently R+1 wide, and planning against that inflated membership
+  would schedule duplicate moves;
 * the drain-last-healthy-replica refusal lives in MigrationService, one
   layer down, so no planner bug can walk a chain to zero live copies.
 
@@ -223,7 +232,23 @@ class Rebalancer:
             return RebalanceTickRsp()
         health = await self._health_by_node()
 
+        # reconcile prior bookkeeping with the migration job table FIRST:
+        # a chain with an in-flight job is mid-surgery and transiently
+        # R+1 wide (dst joined, src not yet detached) — diffing it this
+        # tick would pair the same src with a second destination, so the
+        # planner leaves busy chains alone until their job settles
+        jobs_by_key = {}
+        busy_chains: set[int] = set()
+        for job in self.migration.jobs.values():
+            jobs_by_key[(job.chain_id, job.src_target_id,
+                         job.dst_target_id)] = job
+            if job.state in ACTIVE_STATES:
+                busy_chains.add(job.chain_id)
+        inflight = sum(1 for j in self.migration.jobs.values()
+                       if j.state in ACTIVE_STATES)
+
         planned: list[RebalanceMove] = []
+        want_by_chain: dict[int, set[int]] = {}
         for table_id in sorted(routing.chain_tables):
             try:
                 solved = solve_for_routing(routing, table_id, cands,
@@ -232,7 +257,11 @@ class Rebalancer:
                 # e.g. fewer healthy nodes than replicas: nothing to plan
                 log.debug("table %d unsolvable this tick: %s", table_id, e)
                 continue
+            for cid, owners in solved.assignment.items():
+                want_by_chain[cid] = set(owners)
             for m in diff_table(routing, solved):
+                if m.chain_id in busy_chains:
+                    continue
                 planned.append(RebalanceMove(
                     table_id=table_id, chain_id=m.chain_id,
                     src_target_id=m.src_target_id,
@@ -240,18 +269,31 @@ class Rebalancer:
                     dst_target_id=m.dst_target_id,
                     dst_node_id=m.dst_node_id))
 
-        # reconcile prior bookkeeping with the migration job table
-        jobs_by_key = {}
-        for job in self.migration.jobs.values():
-            jobs_by_key[(job.chain_id, job.src_target_id,
-                         job.dst_target_id)] = job
-        inflight = sum(1 for j in self.migration.jobs.values()
-                       if j.state in ACTIVE_STATES)
+        def still_wanted(job) -> bool:
+            """A flapped job is only worth re-driving if its move is
+            still compatible with THIS tick's solve: the destination is
+            a wanted owner of the chain and the source is not.  The key
+            cannot be matched against the planned move list instead —
+            a job whose JOIN already applied leaves the chain over-wide,
+            and the diff for that chain is a shrink, not the original
+            swap."""
+            want = want_by_chain.get(job.chain_id)
+            if not want or job.dst_node_id not in want:
+                return False
+            chain = routing.chain(job.chain_id)
+            src = next((t for t in (chain.targets if chain else ())
+                        if t.target_id == job.src_target_id), None)
+            return src is None or src.node_id not in want
 
-        # resume flapped jobs whose destination came back healthy: their
-        # progress re-derives from routing, so this never double-applies
+        # resume flapped jobs whose destination came back healthy AND
+        # whose move this tick's solve still wants: a stale flapped job
+        # (the planner re-solved to a different destination while the
+        # node was gone) stays failed — re-driving it would execute a
+        # move the next tick must undo
         for job in list(self.migration.jobs.values()):
             if (job.state == JobState.FAILED.value and job.resumable
+                    and job.chain_id not in busy_chains
+                    and still_wanted(job)
                     and alive.get(job.dst_node_id, False)
                     and not self._sick(health.get(job.dst_node_id))
                     and inflight < self.max_inflight):
@@ -260,6 +302,7 @@ class Rebalancer:
                 if resumed:
                     self.resumed += len(resumed)
                     inflight += len(resumed)
+                    busy_chains.add(job.chain_id)
                     log.info("rebalance: resumed flapped job %d "
                              "(chain %d -> n%d)", job.job_id,
                              job.chain_id, job.dst_node_id)
@@ -289,6 +332,11 @@ class Rebalancer:
             if job is not None and job.state in ACTIVE_STATES:
                 rec.state, rec.job_id = "submitted", job.job_id
                 continue
+            if mv.chain_id in busy_chains:
+                # one surgeon per chain per tick: a job resumed or
+                # submitted moments ago is already reshaping this chain
+                rec.state, rec.reason = "queued", "chain busy"
+                continue
             why = self._sick(health.get(mv.dst_node_id))
             if why:
                 rec.state, rec.reason = "deferred", why
@@ -311,6 +359,7 @@ class Rebalancer:
             self.bytes_submitted += rec.bytes_est
             submitted += 1
             inflight += 1
+            busy_chains.add(mv.chain_id)
             log.info("rebalance: chain %d t%d@n%d -> t%d@n%d (job %d, "
                      "~%d bytes)", mv.chain_id, mv.src_target_id,
                      mv.src_node_id, mv.dst_target_id, mv.dst_node_id,
@@ -325,6 +374,12 @@ class Rebalancer:
             elif job is not None and job.state == JobState.FAILED.value \
                     and not job.resumable:
                 rec.state, rec.reason = "failed", job.error
+            elif (job is not None and job.state == JobState.FAILED.value
+                    and not still_wanted(job)):
+                # flapped job the solver no longer wants: superseded by a
+                # re-plan, never resumed — settle its record as failed
+                rec.state = "failed"
+                rec.reason = job.error or "superseded by re-plan"
             elif key not in seen_keys and rec.state in (
                     "planned", "queued", "deferred"):
                 rec.state = "done"   # routing caught up before we acted

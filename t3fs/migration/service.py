@@ -325,6 +325,14 @@ class MigrationService:
             log.error("migration %d failed%s: %s", job.job_id,
                       " (resumable)" if job.resumable else "", e)
 
+    def _chain_vanished(self, job: MigrationJob, step: str) -> None:
+        """A mid-job routing re-fetch found the chain deleted out from
+        under the job: there is nothing left to apply surgery to, so the
+        job converges as a no-op instead of crashing the driver (log-only
+        so the job terminates DONE without an error string)."""
+        log.info("migration %d: chain %d no longer in routing at %s; "
+                 "nothing left to apply", job.job_id, job.chain_id, step)
+
     async def _run_steps(self, job: MigrationJob) -> None:
         from t3fs.mgmtd.service import ChainOpReq
         from t3fs.mgmtd.types import PublicTargetState
@@ -347,9 +355,11 @@ class MigrationService:
             # differently).  Nothing was applied and nothing safe CAN be
             # applied — converge as a no-op; the planner's next tick
             # re-diffs fresh routing and plans whatever is still needed.
-            job.error = ("stale plan: neither src nor dst in chain; "
-                         "nothing applied")
-            log.info("migration %d: %s", job.job_id, job.error)
+            # Log-only: the job terminates DONE and must not carry an
+            # error string (DONE-with-error is an ambiguous state).
+            log.info("migration %d: stale plan — neither src t%d nor dst "
+                     "t%d in chain %d; nothing applied", job.job_id,
+                     job.src_target_id, job.dst_target_id, job.chain_id)
             return
         dst_addr = routing.node_address(job.dst_node_id)
         if dst_addr is None:
@@ -376,6 +386,9 @@ class MigrationService:
             self._set_state(job, JobState.JOINING)
             routing = await self._routing()
             chain = routing.chain(job.chain_id)
+            if chain is None:
+                self._chain_vanished(job, "join")
+                return
             if not any(t.target_id == job.dst_target_id
                        for t in chain.targets):
                 await self.client.call(
@@ -406,6 +419,9 @@ class MigrationService:
         self._set_state(job, JobState.DRAINING)
         routing = await self._routing()
         chain = routing.chain(job.chain_id)
+        if chain is None:
+            self._chain_vanished(job, "drain")
+            return
         alive = await self._alive_nodes()
         survivors = [t for t in chain.serving()
                      if t.target_id != job.src_target_id
@@ -437,6 +453,9 @@ class MigrationService:
         self._set_state(job, JobState.DETACHING)
         routing = await self._routing()
         chain = routing.chain(job.chain_id)
+        if chain is None:
+            self._chain_vanished(job, "detach")
+            return
         if any(t.target_id == job.src_target_id for t in chain.targets):
             await self.client.call(
                 self.mgmtd_address, "Mgmtd.update_chain",
@@ -479,11 +498,16 @@ class MigrationService:
             if hit and hit[0].public_state in wanted:
                 return
             if watch_node:
-                try:
+                node_alive = True   # RPC failure = liveness unknown:
+                try:                # don't run the flap clock on a guess
                     alive = await self._alive_nodes()
+                    # absent from a SUCCESSFUL listing = unregistered =
+                    # dead for our purposes — it must trip flap_timeout_s,
+                    # not wedge the wait for the full sync timeout
+                    node_alive = alive.get(watch_node, False)
                 except StatusError:
-                    alive = {}
-                if alive.get(watch_node, True):
+                    pass
+                if node_alive:
                     node_dead_since = None
                 else:
                     node_dead_since = node_dead_since or loop.time()

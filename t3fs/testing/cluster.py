@@ -131,7 +131,7 @@ class LocalCluster:
                     PublicTargetState.SERVING))
             chains.append(ChainInfo(chain_id=c + 1, chain_ver=1, targets=targets))
         tables = [ChainTable(1, [c.chain_id for c in chains],
-                             table_type="cr")]
+                             table_type="cr", replicas=self.replicas)]
         if self.ec_chains:
             ec = []
             for j in range(self.ec_chains):
@@ -143,7 +143,7 @@ class LocalCluster:
                         self.target_id(node_id, self.num_chains + j),
                         node_id, PublicTargetState.SERVING)]))
             tables.append(ChainTable(2, [c.chain_id for c in ec],
-                                     table_type="ec"))
+                                     table_type="ec", replicas=1))
             chains += ec
         await self.admin.call(
             self.mgmtd_rpc.address, "Mgmtd.set_chains",

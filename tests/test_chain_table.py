@@ -158,6 +158,29 @@ def test_solve_for_routing_infers_type_and_replicas():
         solve_for_routing(r, 9, nodes_n(4))
 
 
+def test_solve_for_routing_prefers_persisted_replicas():
+    # the table's persisted desired replication wins over any width
+    # inference — even when every live chain is (transiently) wider
+    r = make_routing({1: [1, 2, 3]},
+                     tables=[ChainTable(1, [1], table_type="cr",
+                                        replicas=2)])
+    assert solve_for_routing(r, 1, nodes_n(4)).replicas == 2
+
+
+def test_solve_for_routing_width_fallback_ignores_midmigration_chain():
+    # pre-15 table (replicas unset): chain 2 is mid-move and transiently
+    # R+1 wide (dst joined, src not yet detached).  The fallback must
+    # take the modal width (R=2), not the max — solving for the inflated
+    # max would schedule a duplicate move and ratchet the table to R+1
+    r = make_routing({1: [1, 2], 2: [1, 2, 3], 3: [2, 3]},
+                     tables=[ChainTable(1, [1, 2, 3], table_type="cr")])
+    assert solve_for_routing(r, 1, nodes_n(4)).replicas == 2
+    # tie between widths: prefer the smaller (never inflate)
+    r2 = make_routing({1: [1, 2], 2: [1, 2, 3]},
+                      tables=[ChainTable(1, [1, 2], table_type="cr")])
+    assert solve_for_routing(r2, 1, nodes_n(4)).replicas == 2
+
+
 def test_diff_table_pairs_leave_with_join():
     r = make_routing({1: [1, 2]},
                      tables=[ChainTable(1, [1], table_type="cr")])
@@ -169,14 +192,36 @@ def test_diff_table_pairs_leave_with_join():
                                dst_target_id=3 * 100 + 1)]
 
 
-def test_diff_table_skips_pure_grow_or_shrink():
+def test_diff_table_skips_pure_grow_emits_shrink():
     r = make_routing({1: [1, 2]},
                      tables=[ChainTable(1, [1], table_type="cr")])
     solved = solve_chain_table([1], nodes_n(2), 2)
     solved.assignment[1] = [1, 2, 3]         # grow only: not a *move*
     assert diff_table(r, solved) == []
-    solved.assignment[1] = [1]               # shrink only
-    assert diff_table(r, solved) == []
+    # shrink: an over-wide chain (e.g. an interrupted move that joined
+    # its dst but never detached its src) must be walked back to R —
+    # the surplus src pairs with a RETAINED member's existing target so
+    # the driver skips straight to DRAIN+DETACH
+    solved.assignment[1] = [1]
+    assert diff_table(r, solved) == [
+        ChainMove(chain_id=1, src_target_id=201, src_node_id=2,
+                  dst_node_id=1, dst_target_id=101)]
+
+
+def test_diff_table_shrinks_midmigration_leftover():
+    # chain 1 is R+1 wide at [1, 2, 3] and the solver wants [1, 2]: one
+    # shrink move removing node 3, alongside a normal swap on chain 2
+    r = make_routing({1: [1, 2, 3], 2: [1, 4]},
+                     tables=[ChainTable(1, [1, 2], table_type="cr")])
+    solved = solve_chain_table([1, 2], nodes_n(4), 2)
+    solved.assignment[1] = [1, 2]
+    solved.assignment[2] = [1, 2]            # node 4 out, node 2 in
+    moves = diff_table(r, solved)
+    assert ChainMove(chain_id=1, src_target_id=301, src_node_id=3,
+                     dst_node_id=1, dst_target_id=101) in moves
+    assert ChainMove(chain_id=2, src_target_id=402, src_node_id=4,
+                     dst_node_id=2, dst_target_id=2 * 100 + 2) in moves
+    assert len(moves) == 2
 
 
 def test_diff_table_converged_is_empty():

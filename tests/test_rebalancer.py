@@ -382,6 +382,91 @@ def test_migration_service_restart_mid_job_reattaches():
     asyncio.run(body())
 
 
+def test_unregistered_destination_fast_fails_wait():
+    async def body():
+        cluster = LocalCluster(num_nodes=4, replicas=3, num_chains=1)
+        await cluster.start()
+        try:
+            await write_seed(cluster)
+            mig = MigrationService(cluster.mgmtd_rpc.address,
+                                   client=cluster.admin,
+                                   poll_period_s=0.05, sync_timeout_s=60.0,
+                                   flap_timeout_s=1.0)
+            job_id, _ = await _park_in_waiting_sync(cluster, mig)
+
+            # destination vanishes from list_nodes ENTIRELY (unregistered,
+            # not merely dead): absent-from-a-successful-listing must count
+            # as dead so the flap timeout trips, instead of wedging the
+            # WAIT for the full sync timeout
+            real = mig._alive_nodes
+
+            async def without_dst():
+                alive = await real()
+                alive.pop(4, None)
+                return alive
+            mig._alive_nodes = without_dst
+            job = await wait_job(mig, job_id, timeout_s=10.0)
+            assert job.state == "failed" and job.resumable, job.error
+            assert "re-plan the move" in job.error
+
+            # with the node visible again, resume completes the surgery
+            mig._alive_nodes = real
+            await cluster.storage[3].resync.start()
+            job = await resume_until_done(mig, job_id)
+            await _assert_chain_converged(cluster, cluster.target_id(3, 0))
+            await check_seed(cluster)
+            await mig.stop()
+        finally:
+            await cluster.stop()
+    asyncio.run(body())
+
+
+def test_planner_skips_chain_with_inflight_job():
+    async def body():
+        cluster = LocalCluster(num_nodes=4, replicas=3, num_chains=1)
+        await cluster.start()
+        try:
+            await write_seed(cluster)
+            mig, reb = make_services(cluster)
+            # park a move mid-surgery: chain 1 is now transiently R+1
+            # wide (dst 9400 joined, src not yet detached)
+            job_id, _ = await _park_in_waiting_sync(cluster, mig)
+            assert len(cluster.chain().targets) == 4
+
+            # the planner must leave the busy chain alone: no duplicate
+            # move may be planned or submitted against its inflated
+            # membership, tick after tick
+            for _ in range(3):
+                rsp = await reb.tick()
+                assert rsp.planned == 0, vars(rsp)
+                assert set(mig.jobs) == {job_id}
+
+            # let the parked move finish and the cluster converge; the
+            # solver may keep reshaping the chain, so assert consistency
+            # (R targets, distinct nodes, all SERVING), not membership
+            await cluster.storage[3].resync.start()
+            await converge(reb, mig)
+            for _ in range(100):
+                chain = cluster.chain()
+                if all(t.public_state == PublicTargetState.SERVING
+                       for t in chain.targets):
+                    break
+                await asyncio.sleep(0.1)
+            ids = [t.target_id for t in chain.targets]
+            assert sorted(ids) == sorted(set(ids)), f"duplicates: {ids}"
+            assert len(ids) == 3, ids
+            nodes = [t.node_id for t in chain.targets]
+            assert len(set(nodes)) == len(nodes), nodes
+            assert all(t.public_state == PublicTargetState.SERVING
+                       for t in chain.targets)
+            await check_seed(cluster)
+            await reb.stop()
+            await mig.stop()
+        finally:
+            await cluster.stop()
+    asyncio.run(body())
+
+
 def test_destination_flap_mid_sync_resumable():
     async def body():
         cluster = LocalCluster(num_nodes=4, replicas=3, num_chains=1)
