@@ -11,6 +11,15 @@ registered-arena ring data plane (--data-plane ring, docs/usrbio.md).
     python -m benchmarks.usrbio_bench --block-size 4096 --depth 64 \
         --seconds 5 --json
     python -m benchmarks.usrbio_bench --data-plane-ab --seconds 5 --json
+
+--cross-host disables the shm alias (ring_no_shm), so every ring payload
+rides the batched one-sided Buf.batch plane over real TCP — the
+cross-host transport, measured on a same-host pair.  --cross-host-ab
+runs the ISSUE-16 acceptance matrix: same-host shm cell, cross-host
+batched cell, and cross-host per-op cell (ONE_SIDED_BATCH kill switch),
+reporting the batched/shm and batched/per-op IOPS ratios.
+
+    python -m benchmarks.usrbio_bench --cross-host-ab --seconds 5 --json
 """
 
 from __future__ import annotations
@@ -26,6 +35,7 @@ import time
 from t3fs.fuse.ring_worker import RingWorker
 from t3fs.fuse.vfs import FileSystem
 from t3fs.lib import usrbio
+from t3fs.net import rdma
 from t3fs.testing.cluster import LocalCluster
 from t3fs.usrbio import SlotAllocator
 
@@ -40,6 +50,12 @@ async def run_bench(args) -> dict:
         # data plane selection happens BEFORE the RingWorker opens the
         # ring: the worker builds its lean ring path off storage.cfg
         cluster.sc.cfg.data_plane = args.data_plane
+        if getattr(args, "cross_host", False):
+            # withhold the shm alias: the server can never memcpy, so
+            # every ring payload rides the batched one-sided plane —
+            # the cross-host transport, forced on a same-host pair
+            cluster.sc.cfg.ring_no_shm = True
+        batch_before = rdma.BATCH_STATS.snapshot()
         fs = FileSystem(cluster.mc, cluster.sc)
         await fs.mkdirs("/bench")
         fh = await fs.create("/bench/data", chunk_size=args.block_size)
@@ -116,7 +132,7 @@ async def run_bench(args) -> dict:
                 return 0.0
             return lat_s[min(len(lat_s) - 1, int(q * len(lat_s)))]
 
-        return {
+        out = {
             "data_plane": args.data_plane,
             "block_size": args.block_size, "depth": args.depth,
             "file_size": args.file_size, "wall_s": round(wall, 3),
@@ -126,6 +142,18 @@ async def run_bench(args) -> dict:
             "p50_ms": round(pct(0.50) * 1e3, 3),
             "p99_ms": round(pct(0.99) * 1e3, 3),
         }
+        if getattr(args, "cross_host", False):
+            ba, bb = rdma.BATCH_STATS.snapshot(), batch_before
+            doorbells = ba["doorbells"] - bb["doorbells"]
+            ops = ba["batched_ops"] - bb["batched_ops"]
+            out["cross_host"] = True
+            out["batched"] = rdma.ONE_SIDED_BATCH
+            out["doorbells"] = doorbells
+            out["batched_ops"] = ops
+            out["fallback_ops"] = ba["fallback_ops"] - bb["fallback_ops"]
+            out["ops_per_doorbell"] = round(ops / doorbells, 2) \
+                if doorbells else 0.0
+        return out
     finally:
         if worker:
             await worker.stop()
@@ -161,6 +189,44 @@ def run_ab(args) -> dict:
     return out
 
 
+def run_crosshost_ab(args) -> dict:
+    """ISSUE-16 acceptance matrix, same trial discipline as run_ab (fresh
+    loop + fresh cluster per trial, GC barrier, median-IOPS trial):
+      shm               ring plane, same-host shm alias (the PR-12 cell)
+      crosshost_batched ring plane, no shm alias, Buf.batch transport
+      crosshost_perop   ring plane, no shm alias, per-op Buf RPCs
+                        (ONE_SIDED_BATCH kill switch: the pre-batch wire)
+    The acceptance ratio is crosshost_batched vs shm (within 2x); the
+    batched-vs-perop ratio is what the doorbell coalescing bought."""
+    cells = (("shm", False, True),
+             ("crosshost_batched", True, True),
+             ("crosshost_perop", True, False))
+    out: dict = {}
+    batch_was = rdma.ONE_SIDED_BATCH
+    try:
+        for name, cross, batched in cells:
+            args.data_plane = "ring"
+            args.cross_host = cross
+            rdma.ONE_SIDED_BATCH = batched
+            runs = []
+            for _ in range(max(1, args.trials)):
+                gc.collect()
+                runs.append(asyncio.run(run_bench(args)))
+            runs.sort(key=lambda r: r["iops"])
+            out[name] = runs[len(runs) // 2]
+            if len(runs) > 1:
+                out[name]["trial_iops"] = [r["iops"] for r in runs]
+    finally:
+        rdma.ONE_SIDED_BATCH = batch_was
+        args.cross_host = False
+    out["crosshost_batched_vs_shm_iops"] = round(
+        out["crosshost_batched"]["iops"] / max(out["shm"]["iops"], 1e-9), 3)
+    out["batched_vs_perop_iops"] = round(
+        out["crosshost_batched"]["iops"]
+        / max(out["crosshost_perop"]["iops"], 1e-9), 3)
+    return out
+
+
 def parse_args(argv=None):
     ap = argparse.ArgumentParser(prog="usrbio_bench")
     ap.add_argument("--nodes", type=int, default=3)
@@ -171,8 +237,14 @@ def parse_args(argv=None):
     ap.add_argument("--depth", type=int, default=64)
     ap.add_argument("--seconds", type=float, default=5.0)
     ap.add_argument("--data-plane", choices=("rpc", "ring"), default="rpc")
+    ap.add_argument("--cross-host", action="store_true",
+                    help="disable the shm alias (ring_no_shm): every ring "
+                         "payload rides the batched one-sided transport")
     ap.add_argument("--data-plane-ab", action="store_true",
                     help="run BOTH data planes and report the IOPS ratio")
+    ap.add_argument("--cross-host-ab", action="store_true",
+                    help="run the shm / cross-host-batched / cross-host-"
+                         "per-op matrix and report the IOPS ratios")
     ap.add_argument("--trials", type=int, default=3,
                     help="A/B trials per plane; the median-IOPS trial is "
                          "reported (only --data-plane-ab uses this)")
@@ -182,6 +254,21 @@ def parse_args(argv=None):
 
 def main(argv=None) -> None:
     args = parse_args(argv)
+    if args.cross_host_ab:
+        result = run_crosshost_ab(args)
+        if args.json:
+            print(json.dumps(result))
+        else:
+            for cell in ("shm", "crosshost_batched", "crosshost_perop"):
+                r = result[cell]
+                extra = (f", {r.get('ops_per_doorbell', 0)} ops/doorbell"
+                         if r.get("cross_host") else "")
+                print(f"{cell:>17}: {r['iops']} IOPS, p50 {r['p50_ms']} ms, "
+                      f"p99 {r['p99_ms']} ms, errors={r['errors']}{extra}")
+            print(f"crosshost-batched/shm IOPS: "
+                  f"{result['crosshost_batched_vs_shm_iops']}x  "
+                  f"batched/per-op IOPS: {result['batched_vs_perop_iops']}x")
+        return
     if args.data_plane_ab:
         result = run_ab(args)
         if args.json:

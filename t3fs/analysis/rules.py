@@ -71,6 +71,7 @@ from dataclasses import dataclass
 # leading underscores are ignored when matching (self._forward -> forward)
 RPC_CALL_NAMES = frozenset({
     "call", "post", "forward", "relay_frag", "remote_read", "remote_write",
+    "batched_read", "batched_write", "submit_batched_write",
     "batch_read", "write_chunk", "read_chunk", "update_rpc", "drain",
     "sock_connect", "sock_accept",
 })

@@ -1218,6 +1218,31 @@ async def metrics(ctx: AdminContext, args) -> None:
         print(json.dumps(s, default=str))
 
 
+@command("buf-stats", "registered-memory plane: BufferPool hits/misses/live "
+                      "and batched one-sided transport counters (doorbells, "
+                      "ops-per-doorbell, batched vs fallback ops) per node, "
+                      "from the monitor collector")
+@args_(("--since", {"type": float, "default": 0.0}),
+       ("--limit", {"type": int, "default": 500}))
+async def buf_stats(ctx: AdminContext, args) -> None:
+    if not ctx.monitor_address:
+        raise SystemExit("buf-stats needs --monitor ADDR")
+    rsp, _ = await ctx.cli.call(
+        ctx.monitor_address, "Monitor.query",
+        QueryMetricsReq("rdma.", args.since, args.limit))
+    # query returns newest-first: keep the latest sample per (metric, node)
+    latest: dict[tuple, dict] = {}
+    for s in rsp.samples:
+        latest.setdefault((s.get("name", ""), s.get("node_id", 0)), s)
+    if not latest:
+        print("no rdma.* samples at the collector (yet)")
+        return
+    rows = [[name, node, f"{s.get('value', 0):g}",
+             time.strftime("%H:%M:%S", time.localtime(s.get("ts", 0)))]
+            for (name, node), s in sorted(latest.items())]
+    print(_fmt_table(rows, ["metric", "node", "value", "at"]))
+
+
 def render_trace(spans: list[dict]) -> str:
     """Render one trace's spans (Monitor.query_spans rows) as an indented
     cross-node tree: per hop the serving node, offset from the trace

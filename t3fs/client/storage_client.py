@@ -88,6 +88,10 @@ class StorageClientConfig:
     data_plane: str = "rpc"
     ring_slot_size: int = 256 << 10    # staging arena slot (per IO cap)
     ring_slots: int = 64               # arena depth (qd the ring absorbs)
+    # suppress the shm-alias offer on ring attach so every IO takes the
+    # one-sided (cross-host) transport even against a same-host server —
+    # the bench/CI knob behind the cross-host cells
+    ring_no_shm: bool = False
 
 
 class _HedgeBudget:

@@ -45,17 +45,56 @@ def native_enabled() -> bool:
     return os.environ.get("T3FS_NATIVE_NET") == "1"
 
 
+class _Py_buffer(ctypes.Structure):
+    # CPython's Py_buffer (stable since 3.x); only .buf/.obj/.len matter here
+    _fields_ = [("buf", ctypes.c_void_p), ("obj", ctypes.py_object),
+                ("len", ctypes.c_ssize_t), ("itemsize", ctypes.c_ssize_t),
+                ("readonly", ctypes.c_int), ("ndim", ctypes.c_int),
+                ("format", ctypes.c_char_p), ("shape", ctypes.c_void_p),
+                ("strides", ctypes.c_void_p), ("suboffsets", ctypes.c_void_p),
+                ("internal", ctypes.c_void_p)]
+
+
+class _BufferPin:
+    """PyObject_GetBuffer pin on any buffer (readonly included): holds the
+    exporter alive and its address stable until this object is dropped —
+    how the pump borrows READONLY memoryview slices (the batched one-sided
+    plane's scatter/gather parts) without a staging copy, which ctypes
+    from_buffer refuses for readonly exporters."""
+
+    __slots__ = ("_pb", "ptr")
+
+    def __init__(self, obj):
+        self._pb = _Py_buffer()
+        if ctypes.pythonapi.PyObject_GetBuffer(
+                ctypes.py_object(obj), ctypes.byref(self._pb), 0) != 0:
+            ctypes.pythonapi.PyErr_Clear()
+            raise BufferError("PyObject_GetBuffer failed")
+        self.ptr = self._pb.buf
+
+    def __del__(self):
+        try:
+            ctypes.pythonapi.PyBuffer_Release(ctypes.byref(self._pb))
+        except Exception:
+            pass
+
+
 def _payload_ptr(buf):
     """(pointer, keepalive) for a bytes-like payload WITHOUT copying.
     bytes pin directly; writable buffers (bytearray, mutable memoryview
-    — the BufferPool/RemoteBuf path) pin through a ctypes view; a
-    readonly non-bytes view falls back to one copy."""
+    — the BufferPool/RemoteBuf path) pin through a ctypes view; readonly
+    views (batched scatter/gather slices over an RX frame or an engine
+    read) pin through the buffer protocol, with one copy as last resort."""
     if isinstance(buf, bytes):
         return ctypes.cast(ctypes.c_char_p(buf), ctypes.c_void_p), buf
     mv = memoryview(buf)
     if mv.readonly:
-        b = bytes(mv)
-        return ctypes.cast(ctypes.c_char_p(b), ctypes.c_void_p), b
+        try:
+            pin = _BufferPin(mv)
+            return ctypes.c_void_p(pin.ptr), (pin, mv)
+        except BufferError:
+            b = bytes(mv)
+            return ctypes.cast(ctypes.c_char_p(b), ctypes.c_void_p), b
     arr = (ctypes.c_ubyte * mv.nbytes).from_buffer(mv)
     # keep BOTH: the ctypes view (address) and the exporting buffer
     return ctypes.cast(arr, ctypes.c_void_p), (arr, buf)

@@ -163,6 +163,32 @@ def unpack_update_frag(blob: bytes) -> UpdateFrag:
                       relay=bool(flags & FRAG_RELAY))
 
 
+# ---- Buf.batch scatter/gather descriptors (net/rdma.py) ----
+#
+# Same packed-stride-in-a-bytes-field discipline as UPDATE_FRAG and the ring
+# SQE array: N one-sided work elements ride ONE serde envelope, their bulk
+# bytes ride the raw payload channel concatenated in descriptor order.
+
+BUF_OP_READ = 0    # issuer pulls peer bytes (RDMA READ)
+BUF_OP_WRITE = 1   # issuer pushes bytes into peer memory (RDMA WRITE)
+
+BUF_DESC = struct.Struct("<QqqQB")   # buf_id, offset, length, rkey, opcode
+BUF_RES = struct.Struct("<qq")       # per-op status code, payload bytes
+
+
+def pack_buf_descs(descs) -> bytes:
+    """descs: iterable of (buf_id, offset, length, rkey, opcode)."""
+    return b"".join(BUF_DESC.pack(*d) for d in descs)
+
+
+def unpack_buf_descs(blob) -> list:
+    if len(blob) % BUF_DESC.size:
+        raise FrameError(f"buf-desc blob {len(blob)}B not a multiple "
+                         f"of {BUF_DESC.size}")
+    return [BUF_DESC.unpack_from(blob, off)
+            for off in range(0, len(blob), BUF_DESC.size)]
+
+
 @serde_struct
 @dataclass
 class WireStatus:

@@ -26,7 +26,7 @@ from t3fs.mgmtd.types import (
     ChainInfo, LocalTargetState, PublicTargetState, RoutingInfo,
 )
 from t3fs.net.conn import Connection
-from t3fs.net.rdma import remote_read, remote_write
+from t3fs.net.rdma import batched_read, batched_write, submit_batched_write
 from t3fs.net.server import rpc_method, service
 from t3fs.net.wire import UpdateFrag, WireStatus, unpack_update_frag
 from t3fs.storage.chunk_engine import ChunkEngine
@@ -484,7 +484,7 @@ class StorageService:
         frags_relayed_to: str | None = None
         stream_crc: int | None = None
         if io.buf is not None and not io.inline:
-            payload = await remote_read(conn, io.buf)
+            payload = await batched_read(conn, io.buf)
             trace_add("storage.update.pulled", f"len={len(payload)}")
         elif io.stream_id and not payload:
             payload, stream_crc, frags_relayed_to = \
@@ -735,7 +735,8 @@ class StorageService:
                 if io.no_payload:
                     return result, b""   # verify-only: status travels, bytes don't
                 if io.buf is not None:
-                    await remote_write(conn, io.buf.slice(0, len(data)), data)
+                    await batched_write(conn, io.buf.slice(0, len(data)),
+                                        data)
                     return result, None
                 return result, data
             except StatusError as e:
@@ -807,11 +808,24 @@ class StorageService:
             node._read_sem = asyncio.Semaphore(node.read_concurrency)
         # aliased small reads complete SYNCHRONOUSLY right here — no
         # per-IO coroutine, no scheduler round trip; only IOs that must
-        # await (writes, large/one-sided reads) pay for a task
+        # await (writes, large/one-sided reads) pay for a task.  The
+        # same shape WITHOUT an alias stages synchronously too: engine
+        # reads run inline, then the whole wave's payloads post as
+        # one-sided work elements (zero per-op tasks) and settle in one
+        # batch flush — the cross-host mirror of the fast path
         results: list[IOResult | None] = []
         slow: list = []
+        pushes: list = []    # (cqe pos, iov_off, payload view)
         for rec in unpack_ring_sqes(payload or req.sqes):
             r = self._ring_read_fast(sess, rec)
+            if r is None and sess.shm is None:
+                staged = self._ring_read_stage(rec)
+                if staged is not None:
+                    r, iov_off, view = staged
+                    if view is not None:
+                        pushes.append((len(results), iov_off, view))
+                    results.append(r)
+                    continue
             if r is None:
                 slow.append((len(results),
                              self._ring_one(sess, rec, req.client_id,
@@ -819,6 +833,25 @@ class StorageService:
                 results.append(None)
             else:
                 results.append(r)
+        if pushes:
+            futs, idxs = [], []
+            for pos, iov_off, view in pushes:
+                try:
+                    futs.append(submit_batched_write(
+                        conn, sess.buf.slice(iov_off, len(view)), view))
+                    idxs.append(pos)
+                except StatusError as e:   # slot outside the arena
+                    results[pos] = IOResult(WireStatus(int(e.code),
+                                                       str(e)))
+            acks = await asyncio.gather(*futs, return_exceptions=True)
+            for pos, ack in zip(idxs, acks):
+                if isinstance(ack, StatusError):
+                    # delivery failed (stale rkey, dead registration):
+                    # the CQE must not claim bytes the client never got
+                    results[pos] = IOResult(WireStatus(int(ack.code),
+                                                       str(ack)))
+                elif isinstance(ack, BaseException):
+                    raise ack
         if slow:
             done = await asyncio.gather(*(c for _, c in slow))
             for (pos, _), r in zip(slow, done):
@@ -867,6 +900,35 @@ class StorageService:
         except StatusError as e:
             return IOResult(WireStatus(int(e.code), str(e)))
 
+    def _ring_read_stage(self, rec: tuple):
+        """Synchronous engine read for the NON-aliased hot shape (the
+        cross-host 4-64 KiB random read): same gate as _ring_read_fast
+        minus the alias.  Returns (result, iov_off, view | None) with
+        the payload truncated to the slot cap, or None when the IO
+        needs the general awaitable path; delivery is the caller's
+        batched one-sided flush."""
+        (inode, index, chain_id, offset, length, iov_off, aux, _cksum,
+         _chan, _chanseq, chain_ver, op, flags) = rec
+        if (op != RING_OP_READ or not length
+                or length > SMALL_READ_INLINE_BYTES
+                or flags & RING_F_NO_PAYLOAD):
+            return None
+        node = self.node
+        node.read_count.add()
+        try:
+            _chain, target = node._check_chain(chain_id, chain_ver)
+            io = ReadIO(ChunkId(inode, index), chain_id, offset, length,
+                        None, bool(flags & RING_F_VERIFY),
+                        bool(flags & RING_F_UNCOMMITTED), False,
+                        chain_ver)
+            result, data = target.replica.read(io, None)
+            n = min(len(data), aux) if data else 0
+            # view, not bytes(): the staged wave ships straight from the
+            # engine's buffer through the batch frame
+            return result, iov_off, (memoryview(data)[:n] if n else None)
+        except StatusError as e:
+            return IOResult(WireStatus(int(e.code), str(e))), iov_off, None
+
     async def _ring_one(self, sess: _RingSession, rec: tuple,
                         client_id: str, conn: Connection) -> IOResult:
         (inode, index, chain_id, offset, length, iov_off, aux, cksum,
@@ -886,16 +948,18 @@ class StorageService:
                     if sess.shm is not None:
                         sess.shm.write_at(iov_off, data[:n])
                     else:
-                        await remote_write(conn,
-                                           sess.buf.slice(iov_off, n),
-                                           bytes(data[:n]))
+                        # view, not bytes(): the staging queue ships it in
+                        # the batch frame without an intermediate copy
+                        await batched_write(conn,
+                                            sess.buf.slice(iov_off, n),
+                                            memoryview(data)[:n])
                 return result
             # RING_OP_WRITE: payload staged in the client arena
             if length:
                 if sess.shm is not None:
                     payload = sess.shm.read_at(iov_off, length)
                 else:
-                    payload = await remote_read(
+                    payload = await batched_read(
                         conn, sess.buf.slice(iov_off, length))
             else:
                 payload = b""

@@ -153,8 +153,13 @@ class RingClient:
             memo = self._sessions.get(address)
             if memo is not None and memo[1] == client.epoch(address):
                 return memo[0], memo[2]
+            # ring_no_shm withholds the segment name, so the server can
+            # never alias and every IO rides the one-sided batch plane —
+            # the cross-host transport, forced on a same-host pair
             req = RingAttachReq(client_id=self.sc.client_id,
-                                shm_name=self.arena.shm_name,
+                                shm_name=("" if getattr(
+                                    self.sc.cfg, "ring_no_shm", False)
+                                    else self.arena.shm_name),
                                 shm_size=self.arena.size,
                                 buf=self.arena.handle)
             try:

@@ -31,6 +31,10 @@ class StatusCode(enum.IntEnum):
     RPC_CONNECT_FAILED = 3003
     RPC_BAD_MESSAGE = 3004
     RPC_METHOD_NOT_FOUND = 3005
+    STALE_RKEY = 3006                # one-sided op with a dead capability:
+                                     # the registration behind the handle's
+                                     # rkey token is gone (re-registered /
+                                     # re-attached session); fail closed
 
     # kv/transaction (reference: TransactionCode)
     TXN_CONFLICT = 4001

@@ -1,0 +1,501 @@
+"""Batched one-sided transport (ISSUE 16): the Buf.batch scatter/gather
+frame, doorbell coalescing, zero-copy receive, rkey capability handles,
+and the per-op fallback that keeps mixed-version clusters whole.
+
+The contracts:
+- framing: N packed (buf_id, offset, length, rkey, opcode) descriptors
+  ride ONE serde envelope; malformed blobs fail closed.
+- rkey: every registration mints an unguessable capability; a handle
+  held across a re-registration fails with a typed STALE_RKEY, never a
+  silent read/write of whoever owns the recycled buf_id now.  rkey=0
+  (pre-rkey peer) stays accepted unchecked for wire compat.
+- doorbell: everything enqueued in one event-loop tick on one
+  connection flushes as ONE Buf.batch frame.
+- zero-copy receive: batched WRITE regions scatter into registered
+  memory as memoryview slices of the frame payload — no per-IO staging
+  bytes (proved through the RX_PROBE seam).
+- fallback: a pre-batch peer (RPC_METHOD_NOT_FOUND) degrades to per-op
+  Buf.read/Buf.write with byte-identical results, memoized per
+  connection; the ONE_SIDED_BATCH kill switch forces the same path.
+- the ring plane rides it: `ring_no_shm` withholds the shm alias so a
+  same-host fabric exercises the cross-host transport end to end,
+  including the stale-rkey fail-closed story.
+"""
+
+import asyncio
+import itertools
+
+import pytest
+
+from t3fs.client.storage_client import StorageClient
+from t3fs.net import Client, Server, rpc_method, service
+from t3fs.net import rdma
+from t3fs.net.rdma import (
+    BATCH_STATS, BufBatchReq, BufferRegistry, RemoteBuf, batched_read,
+    batched_write,
+)
+from t3fs.net.wire import (
+    BUF_DESC, BUF_OP_READ, BUF_OP_WRITE, BUF_RES, FrameError,
+    pack_buf_descs, unpack_buf_descs,
+)
+from t3fs.storage.types import ChunkId, ReadIO
+from t3fs.testing.fabric import StorageFabric
+from t3fs.utils import serde
+from t3fs.utils.status import StatusCode, StatusError
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+# ---------------- descriptor framing ----------------
+
+def test_buf_desc_pack_unpack_roundtrip():
+    descs = [(1, 0, 4096, 7, BUF_OP_READ),
+             (9, 128, 512, (1 << 63) - 1, BUF_OP_WRITE),
+             (2, -0, 0, 0, BUF_OP_READ)]
+    blob = pack_buf_descs(descs)
+    assert len(blob) == len(descs) * BUF_DESC.size
+    assert unpack_buf_descs(blob) == descs
+    assert unpack_buf_descs(b"") == []
+
+
+def test_buf_desc_malformed_blob_fails_closed():
+    blob = pack_buf_descs([(1, 0, 8, 0, BUF_OP_READ)])
+    with pytest.raises(FrameError):
+        unpack_buf_descs(blob[:-1])      # torn descriptor
+
+
+# ---------------- rkey capability handles ----------------
+
+def test_rkey_minted_nonzero_and_slices_propagate():
+    reg = BufferRegistry()
+    h = reg.register(64)
+    assert h.rkey != 0
+    s = h.slice(8, 16).slice(4, 4)
+    assert s.rkey == h.rkey
+    # two registrations never share a capability
+    assert reg.register(64).rkey != h.rkey
+
+
+def test_stale_rkey_fails_closed_after_reregistration():
+    """The capability story: a handle held across the owner's
+    re-registration (restarted client, recycled buf_id) must fail with
+    the typed STALE_RKEY — not address the new owner's memory."""
+    reg = BufferRegistry()
+    old = reg.register(b"old registration")
+    reg.deregister(old)
+    # a restarted registry recycles ids from 1; simulate it in place
+    reg._ids = itertools.count(old.buf_id)
+    new = reg.register(b"new registration")
+    assert new.buf_id == old.buf_id and new.rkey != old.rkey
+    with pytest.raises(StatusError) as ei:
+        reg.local_view(old)
+    assert ei.value.code == int(StatusCode.STALE_RKEY)
+    # the live handle still works
+    assert bytes(reg.local_view(new)) == b"new registration"
+
+
+def test_rkey_zero_pre_rkey_peer_accepted_unchecked():
+    reg = BufferRegistry()
+    h = reg.register(b"compat")
+    legacy = RemoteBuf(h.buf_id, 0, 6)     # pre-rkey wire handle
+    assert legacy.rkey == 0
+    assert bytes(reg.local_view(legacy)) == b"compat"
+
+
+def test_deregister_releases_external_view():
+    """register_external pins the caller's buffer exported; deregister
+    must release it NOW — a bytearray arena must be resizable again the
+    moment the registration drops, not when the GC runs."""
+    reg = BufferRegistry()
+    arena = bytearray(32)
+    h = reg.register_external(arena)
+    with pytest.raises(BufferError):
+        arena.append(0)                    # exported: cannot resize
+    reg.deregister(h)
+    arena.append(0)                        # released: resizable again
+    assert len(arena) == 33
+
+
+def test_buf_metrics_exported_through_registry():
+    """The gauges `admin buf-stats` reads off the monitor: pool
+    hits/misses/live and the batch counters must be pullable from the
+    in-process metric registry and track the live objects."""
+    from t3fs.net.rdma import BufferPool, register_buf_metrics
+    from t3fs.utils import metrics as M
+
+    M.reset_registry()
+    try:
+        register_buf_metrics()
+        reg = BufferRegistry()
+        pool = BufferPool(reg, small_count=2, large_count=1)
+        h1, rel1 = pool.acquire(4096)          # miss: fresh registration
+        rel1()
+        h2, rel2 = pool.acquire(4096)          # hit: reuses the buffer
+        assert h2.buf_id == h1.buf_id
+
+        snap = {s["name"]: s for s in
+                M.Collector(reporters=[]).collect_once()
+                if s["name"].startswith("rdma.")}
+        for name in ("rdma.batch.doorbells", "rdma.batch.batched_ops",
+                     "rdma.batch.fallback_ops", "rdma.batch.batched_bytes",
+                     "rdma.batch.ops_per_doorbell",
+                     "rdma.pool.hits", "rdma.pool.misses", "rdma.pool.live"):
+            assert name in snap, name
+            assert not snap[name].get("error"), name
+        assert snap["rdma.pool.hits"]["value"] >= 1
+        assert snap["rdma.pool.misses"]["value"] >= 1
+        assert snap["rdma.pool.live"]["value"] >= 1
+        rel2()
+    finally:
+        # leave the process registry the way other suites expect it
+        M.reset_registry()
+        register_buf_metrics()
+
+
+# ---------------- Buf.batch handler: per-op codes ----------------
+
+def test_batch_handler_mixed_ops_and_per_op_errors():
+    """One frame, four descriptors: a good WRITE, a good READ, an
+    unknown buf, and a stale rkey.  Failures are per-op result codes
+    with index-aligned messages; the good ops still land."""
+    reg = BufferRegistry()
+    h = reg.register(b"\x00" * 8)
+
+    async def body():
+        descs = pack_buf_descs([
+            (h.buf_id, 0, 4, h.rkey, BUF_OP_WRITE),
+            (h.buf_id, 0, 4, h.rkey, BUF_OP_READ),
+            (777, 0, 4, 0, BUF_OP_READ),                 # unknown buf
+            (h.buf_id, 4, 4, h.rkey ^ 1, BUF_OP_READ),   # wrong rkey
+        ])
+        rsp, payload = await reg.batch(BufBatchReq(descs=descs),
+                                       b"abcd", None)
+        codes = [BUF_RES.unpack_from(rsp.results, i * BUF_RES.size)
+                 for i in range(4)]
+        assert codes == [(0, 0), (0, 4),
+                         (int(StatusCode.NOT_FOUND), 0),
+                         (int(StatusCode.STALE_RKEY), 0)]
+        assert bytes(payload) == b"abcd"     # the READ observed the WRITE
+        assert len(rsp.msgs) == 4 and rsp.msgs[0] == "" and rsp.msgs[2]
+        assert bytes(reg.local_view(h.slice(0, 4))) == b"abcd"
+    run(body())
+
+
+def test_batch_handler_rejects_payload_length_mismatch():
+    reg = BufferRegistry()
+    h = reg.register(8)
+
+    async def body():
+        descs = pack_buf_descs([(h.buf_id, 0, 4, h.rkey, BUF_OP_WRITE)])
+        with pytest.raises(StatusError) as ei:
+            await reg.batch(BufBatchReq(descs=descs), b"ab", None)
+        assert ei.value.code == int(StatusCode.INVALID_ARG)
+    run(body())
+
+
+# ---------------- doorbell coalescing over real TCP ----------------
+#
+# The driver service runs server-side and issues one-sided ops back at
+# the CLIENT's registry — the storage service's direction — so these
+# tests exercise the genuine reverse-direction batch path.
+
+@service("Driver")
+class _BatchDriver:
+    """Test service: fan out one-sided ops against the caller's
+    registered buffers in a single event-loop tick."""
+
+    @rpc_method
+    async def scatter(self, body: RemoteBuf, payload: bytes, conn):
+        """Write b'A'..'H' into 8 disjoint 1-byte regions, then read the
+        whole buffer back — all enqueued in one tick."""
+        writes = [batched_write(conn, body.slice(i, 1),
+                                bytes([ord("A") + i])) for i in range(8)]
+        reads = [batched_read(conn, body.slice(0, body.length))]
+        results = await asyncio.gather(*writes, *reads)
+        return None, bytes(results[-1])
+
+    @rpc_method
+    async def pull(self, body: RemoteBuf, payload: bytes, conn):
+        data = await batched_read(conn, body)
+        return None, bytes(data)
+
+
+async def _with_driver(fn):
+    server = Server()
+    server.add_service(_BatchDriver())
+    await server.start()
+    client = Client()
+    bufs = BufferRegistry()
+    client.add_service(bufs)
+    try:
+        await fn(server, client, bufs)
+    finally:
+        await client.close()
+        await server.stop()
+
+
+def test_batched_ops_coalesce_into_one_doorbell():
+    """8 writes + 1 read submitted in one tick on one connection ring
+    ONE doorbell: a single Buf.batch frame carries all 9 ops."""
+    async def body(server, client, bufs):
+        h = bufs.register(8)
+        before = BATCH_STATS.snapshot()
+        _, payload = await client.call(server.address, "Driver.scatter", h)
+        after = BATCH_STATS.snapshot()
+        assert payload == b"ABCDEFGH"
+        assert bytes(bufs.local_view(h)) == b"ABCDEFGH"
+        assert after["doorbells"] - before["doorbells"] == 1
+        assert after["batched_ops"] - before["batched_ops"] == 9
+        # 8 x 1B pushed + 8B pulled
+        assert after["batched_bytes"] - before["batched_bytes"] == 16
+        assert after["fallback_ops"] == before["fallback_ops"]
+    run(_with_driver(body))
+
+
+def test_prebatch_client_falls_back_per_op_byte_identical():
+    """Mixed-version interop, new server / old client: the client has no
+    Buf.batch handler, the server's first flush gets
+    RPC_METHOD_NOT_FOUND, replays per-op, and memoizes — the second
+    round never attempts a batch frame again on this connection."""
+    async def body(server, client, bufs):
+        client.dispatcher.pop("Buf.batch")     # pre-batch peer
+        h = bufs.register(8)
+        before = BATCH_STATS.snapshot()
+        _, payload = await client.call(server.address, "Driver.scatter", h)
+        mid = BATCH_STATS.snapshot()
+        assert payload == b"ABCDEFGH"          # byte-identical result
+        assert bytes(bufs.local_view(h)) == b"ABCDEFGH"
+        assert mid["fallback_ops"] - before["fallback_ops"] == 9
+        assert mid["batched_ops"] == before["batched_ops"]
+        # memoized: round two goes straight per-op, no second probe
+        _, payload = await client.call(server.address, "Driver.pull",
+                                       h.slice(0, 4))
+        after = BATCH_STATS.snapshot()
+        assert payload == b"ABCD"
+        assert after["fallback_ops"] - mid["fallback_ops"] == 1
+        assert after["doorbells"] == mid["doorbells"]
+    run(_with_driver(body))
+
+
+def test_kill_switch_forces_per_op(monkeypatch):
+    """ONE_SIDED_BATCH=0 (the A/B bench knob / old-issuer simulation):
+    every op rides the legacy per-op RPCs, byte-identical."""
+    monkeypatch.setattr(rdma, "ONE_SIDED_BATCH", False)
+
+    async def body(server, client, bufs):
+        h = bufs.register(b"per-op!!")
+        before = BATCH_STATS.snapshot()
+        _, payload = await client.call(server.address, "Driver.pull", h)
+        after = BATCH_STATS.snapshot()
+        assert payload == b"per-op!!"
+        assert after["fallback_ops"] - before["fallback_ops"] == 1
+        assert after["doorbells"] == before["doorbells"]
+    run(_with_driver(body))
+
+
+def test_zero_copy_receive_scatters_frame_views(monkeypatch):
+    """The zero-staging-copy contract: every region the batched receive
+    path scatters is a memoryview into the ONE frame payload — never a
+    per-IO bytes copy.  All regions of one flush share a buffer base."""
+    probes = []
+    monkeypatch.setattr(rdma, "RX_PROBE",
+                        lambda dst, src: probes.append(src))
+
+    async def body(server, client, bufs):
+        h = bufs.register(8)
+        _, payload = await client.call(server.address, "Driver.scatter", h)
+        assert payload == b"ABCDEFGH"
+        assert len(probes) == 8
+        assert all(isinstance(s, memoryview) for s in probes)
+        bases = {id(s.obj) for s in probes}
+        assert len(bases) == 1, "scatter sources must share one frame buffer"
+    run(_with_driver(body))
+
+
+# ---------------- the ring plane rides the batch transport ----------------
+
+async def _ring_fabric(no_shm=True):
+    fab = StorageFabric(num_nodes=3, replicas=2, num_chains=2)
+    await fab.start()
+    sc = StorageClient(lambda: fab.routing, client=fab.client)
+    sc.cfg.data_plane = "ring"
+    sc.cfg.ring_no_shm = no_shm
+    return fab, sc
+
+
+async def _ring_write_read(sc, chain_id, n=8, size=4096, seed=16):
+    import random
+    rng = random.Random(seed)
+    data = {}
+    for i in range(n):
+        cid = ChunkId(1600 + seed, i)
+        blob = bytes(rng.getrandbits(8) for _ in range(size))
+        r = await sc.write_chunk(chain_id, cid, 0, blob, size)
+        assert r.status.code == int(StatusCode.OK), r.status.message
+        data[cid] = blob
+    ios = [ReadIO(chunk_id=cid, chain_id=chain_id, offset=0,
+                  length=len(blob)) for cid, blob in data.items()]
+    results, payloads = await sc.batch_read(ios)
+    return data, results, payloads
+
+
+def test_ring_crosshost_no_shm_rides_batched_plane():
+    """ring_no_shm withholds the shm alias, so a same-host fabric
+    becomes the cross-host transport: every ring payload moves through
+    Buf.batch frames (doorbells advance, many ops per doorbell) and the
+    bytes still round-trip exactly."""
+    async def body():
+        fab, sc = await _ring_fabric(no_shm=True)
+        try:
+            before = BATCH_STATS.snapshot()
+            data, results, payloads = await _ring_write_read(sc,
+                                                             fab.chain_id)
+            after = BATCH_STATS.snapshot()
+            ring = sc._ring_state["ring"]
+            assert ring is not None and ring._sessions
+            # no session aliased: the one-sided plane carried everything
+            assert all(not aliased
+                       for _, _, aliased in ring._sessions.values())
+            for (cid, blob), r, p in zip(data.items(), results, payloads):
+                assert r.status.code == int(StatusCode.OK), r.status.message
+                assert p == blob, f"{cid}: wrong bytes over batched plane"
+            d_doorbells = after["doorbells"] - before["doorbells"]
+            d_ops = after["batched_ops"] - before["batched_ops"]
+            assert d_doorbells > 0 and d_ops > 0
+            # a whole read batch coalesces: strictly fewer doorbells
+            # than one-sided ops
+            assert d_ops > d_doorbells
+            assert after["batched_bytes"] - before["batched_bytes"] >= \
+                sum(len(b) for b in data.values())
+        finally:
+            await sc.close()
+            await fab.stop()
+    run(body())
+
+
+def test_ring_crosshost_prebatch_client_byte_identical():
+    """Mixed-version interop on the ring plane: the storage server
+    batches, the CLIENT predates Buf.batch — every payload falls back
+    to per-op Buf RPCs and the bytes are identical."""
+    async def body():
+        fab, sc = await _ring_fabric(no_shm=True)
+        fab.client.dispatcher.pop("Buf.batch", None)   # pre-batch client
+        try:
+            before = BATCH_STATS.snapshot()
+            data, results, payloads = await _ring_write_read(
+                sc, fab.chain_id, seed=17)
+            after = BATCH_STATS.snapshot()
+            for (cid, blob), r, p in zip(data.items(), results, payloads):
+                assert r.status.code == int(StatusCode.OK), r.status.message
+                assert p == blob, f"{cid}: fallback path corrupted bytes"
+            assert after["fallback_ops"] > before["fallback_ops"]
+        finally:
+            await sc.close()
+            await fab.stop()
+    run(body())
+
+
+def test_ring_crosshost_receive_is_zero_copy(monkeypatch):
+    """End to end: ring READ results pushed by the server scatter into
+    the client's registered arena as views of the batch frame payload —
+    the receive path stages no per-IO bytes."""
+    probes = []
+    monkeypatch.setattr(rdma, "RX_PROBE",
+                        lambda dst, src: probes.append(type(src)))
+
+    async def body():
+        fab, sc = await _ring_fabric(no_shm=True)
+        try:
+            data, results, payloads = await _ring_write_read(
+                sc, fab.chain_id, n=6, seed=18)
+            for (_, blob), r, p in zip(data.items(), results, payloads):
+                assert r.status.code == int(StatusCode.OK)
+                assert p == blob
+            assert probes, "no batched WRITE ever reached the arena"
+            assert all(t is memoryview for t in probes)
+        finally:
+            await sc.close()
+            await fab.stop()
+    run(body())
+
+
+def test_ring_batched_path_encodes_zero_remotebuf_structs():
+    """The descriptor discipline: after attach, a batched ring read
+    moves N one-sided ops with ZERO RemoteBuf serde encodes anywhere in
+    the process — handles ride as packed descriptors.  The same reads
+    with batching killed encode a RemoteBuf per op (which also proves
+    the counter sees what it should)."""
+    from tests.test_usrbio_ring import _count_plan_encodes
+
+    async def body():
+        fab, sc = await _ring_fabric(no_shm=True)
+        try:
+            # first round attaches (one RemoteBuf rides the attach req)
+            data, _, _ = await _ring_write_read(sc, fab.chain_id, seed=19)
+            ios = [ReadIO(chunk_id=cid, chain_id=fab.chain_id, offset=0,
+                          length=len(blob)) for cid, blob in data.items()]
+            counts = {"RemoteBuf": 0}
+            originals = _count_plan_encodes((RemoteBuf,), counts)
+            try:
+                _, payloads = await sc.batch_read(
+                    [io.clone() for io in ios])
+                assert all(p == b for p, b in zip(payloads, data.values()))
+                assert counts["RemoteBuf"] == 0, \
+                    "batched plane must not serde-encode handles per IO"
+                rdma_on = rdma.ONE_SIDED_BATCH
+                rdma.ONE_SIDED_BATCH = False
+                try:
+                    await sc.batch_read([io.clone() for io in ios])
+                finally:
+                    rdma.ONE_SIDED_BATCH = rdma_on
+                assert counts["RemoteBuf"] >= len(ios), \
+                    "per-op plane should encode a handle per Buf RPC"
+            finally:
+                for cls, enc in originals.items():
+                    serde._plan_of(cls).enc = enc
+        finally:
+            await sc.close()
+            await fab.stop()
+    run(body())
+
+
+def test_ring_stale_rkey_after_rekey_fails_typed():
+    """A storage node holding a session buf across the client's
+    re-registration must get the typed STALE_RKEY back per IO — fail
+    closed, no bytes moved into the recycled buffer — and recover once
+    the handle matches the live registration again."""
+    async def body():
+        fab, sc = await _ring_fabric(no_shm=True)
+        try:
+            data, results, _ = await _ring_write_read(sc, fab.chain_id,
+                                                      n=2, seed=20)
+            assert all(r.status.code == int(StatusCode.OK)
+                       for r in results)
+            ring = sc._ring_state["ring"]
+            buf_id = ring.arena.handle.buf_id
+            reg = sc.buf_registry
+            # simulate the arena being re-registered under the same
+            # buf_id (client restart with recycled ids): new capability,
+            # same memory — the server's memoized sess.buf is now stale
+            old_rkey = reg._rkeys[buf_id]
+            reg._rkeys[buf_id] = old_rkey ^ (1 << 40)
+            ios = [ReadIO(chunk_id=cid, chain_id=fab.chain_id, offset=0,
+                          length=len(blob)) for cid, blob in data.items()]
+            stale_results, _ = await sc.batch_read(
+                [io.clone() for io in ios])
+            assert all(r.status.code == int(StatusCode.STALE_RKEY)
+                       for r in stale_results), \
+                [r.status.code for r in stale_results]
+            # live handle again: the plane heals with no re-attach needed
+            reg._rkeys[buf_id] = old_rkey
+            ok_results, payloads = await sc.batch_read(
+                [io.clone() for io in ios])
+            assert all(r.status.code == int(StatusCode.OK)
+                       for r in ok_results)
+            assert all(p == b for p, b in zip(payloads, data.values()))
+        finally:
+            await sc.close()
+            await fab.stop()
+    run(body())

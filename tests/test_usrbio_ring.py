@@ -224,6 +224,44 @@ def test_ring_smoke_results_field_identical_to_rpc():
     run(body())
 
 
+def test_ring_smoke_crosshost_batched_transport_engaged():
+    """The cross-host CI gate (ISSUE 16): ring_no_shm withholds the shm
+    alias so every ring payload rides the batched one-sided plane —
+    bytes round-trip exactly AND the Buf.batch counters prove the
+    batched transport actually engaged (doorbells > 0, more ops than
+    doorbells, zero per-op fallbacks)."""
+    async def body():
+        from t3fs.net.rdma import BATCH_STATS
+        fab = StorageFabric(num_nodes=3, replicas=2, num_chains=2)
+        await fab.start()
+        sc = StorageClient(lambda: fab.routing, client=fab.client)
+        sc.cfg.data_plane = "ring"
+        sc.cfg.ring_no_shm = True
+        try:
+            before = BATCH_STATS.snapshot()
+            data = await _write_chunks(sc, fab.chain_id, 8, 4096, seed=6)
+            results, payloads = await sc.batch_read(
+                _read_ios(data, fab.chain_id))
+            after = BATCH_STATS.snapshot()
+            ring = sc._ring_state["ring"]
+            assert ring is not None and ring._sessions
+            assert all(not aliased
+                       for _, _, aliased in ring._sessions.values()), \
+                "ring_no_shm must keep every session un-aliased"
+            for (cid, blob), r, p in zip(data.items(), results, payloads):
+                assert r.status.code == int(StatusCode.OK), r.status.message
+                assert p == blob, f"{cid}: wrong bytes on cross-host plane"
+            d_doorbells = after["doorbells"] - before["doorbells"]
+            d_ops = after["batched_ops"] - before["batched_ops"]
+            assert d_doorbells > 0, "batched transport never engaged"
+            assert d_ops > d_doorbells, "no coalescing: 1 op per doorbell"
+            assert after["fallback_ops"] == before["fallback_ops"]
+        finally:
+            await sc.close()
+            await fab.stop()
+    run(body())
+
+
 # ---------------- zero per-IO serde ----------------
 
 def _count_plan_encodes(classes, counts):
@@ -445,6 +483,53 @@ def test_kvcache_get_many_byte_identical_on_ring():
             for k, v in zip(keys, got):
                 assert v == expected[k], f"{k!r}: wrong bytes on ring"
             assert calls["n"] > 0, "get_many never used the ring plane"
+            await tier.stop()
+        finally:
+            await sc.close()
+            await fab.stop()
+    run(body())
+
+
+def test_kvcache_get_many_rides_batched_crosshost_plane():
+    """ISSUE 16 rider contract: with the shm alias withheld
+    (ring_no_shm) the serving tier's get_many inherits the batched
+    one-sided transport through its StorageClient with ZERO call-site
+    changes — bytes identical, Buf.batch doorbells demonstrably rung."""
+    from t3fs.kvcache import KVCacheTier, KVCacheTierConfig
+    from t3fs.net.rdma import BATCH_STATS
+
+    async def body():
+        fab = StorageFabric(num_nodes=3, replicas=2, num_chains=4)
+        await fab.start()
+        sc = StorageClient(lambda: fab.routing, client=fab.client)
+        sc.cfg.data_plane = "ring"
+        sc.cfg.ring_no_shm = True
+        try:
+            tier = KVCacheTier(
+                sc, fab.chain_ids, namespace="xhostns",
+                config=KVCacheTierConfig(lanes=4, hit_sample=1,
+                                         flush_interval_s=0.005,
+                                         ledger_flush_interval_s=0.05),
+                writer_id=1)
+            await tier.start()
+            expected = {f"xh-{i}".encode():
+                        (f"val-{i}-".encode() * 150)[:768 + 29 * i]
+                        for i in range(16)}
+            for k, v in expected.items():
+                await tier.put(k, v)
+            await tier.flush()
+            before = BATCH_STATS.snapshot()
+            keys = sorted(expected)
+            got = await tier.get_many(keys)
+            after = BATCH_STATS.snapshot()
+            for k, v in zip(keys, got):
+                assert v == expected[k], \
+                    f"{k!r}: wrong bytes on the batched cross-host plane"
+            assert after["doorbells"] > before["doorbells"], \
+                "get_many never rode the batched one-sided transport"
+            ring = sc._ring_plane()
+            assert all(not aliased
+                       for _, _, aliased in ring._sessions.values())
             await tier.stop()
         finally:
             await sc.close()
