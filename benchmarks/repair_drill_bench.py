@@ -13,6 +13,12 @@ modes on IDENTICAL damage:
               ~group_size; the cross-mode ratio lands near group_size/k
               (3/8 with the defaults), under the 0.5x drill target.
 
+With --layout pm-msr the same drill A/Bs the coupled-layer MSR code
+instead: subshard mode reads every survivor's beta/alpha repair
+projection (d*beta/alpha = 0.5625x of k full chunks) while full mode
+reads k full survivor chunks — exactly what plain RS(8+2) pays — on the
+SAME damage, at the SAME 1.25x storage (no extra parity chunks).
+
 Foreground impact: reader tasks hammer first-k stripe reads throughout;
 each repair cycle snapshots their latency samples, so the JSON carries
 foreground p50/p99 per (mode, budget) cell — the paced cells show what
@@ -62,6 +68,12 @@ def parse_args(argv=None):
     ap.add_argument("--chunk-size", type=int, default=65536)
     ap.add_argument("--stripes", type=int, default=12)
     ap.add_argument("--local-group-size", type=int, default=3)
+    ap.add_argument("--layout", default="lrc-xor",
+                    choices=["lrc-xor", "pm-msr"],
+                    help="reduced-repair scheme under test: lrc-xor "
+                         "trades 1.75x storage for group-size reads; "
+                         "pm-msr keeps 1.25x storage and reads "
+                         "sub-packetized projections from all survivors")
     # one chain per node so a node kill loses at most ONE slot per stripe
     # (the single-loss case the reduced path targets); chains > slots so
     # placement rotates across stripes
@@ -98,7 +110,7 @@ async def _run(args, cluster: LocalCluster) -> dict:
     k, m, cs = args.k, args.m, args.chunk_size
     lay = ECLayout.create(k=k, m=m, chunk_size=cs,
                           chains=list(range(1, args.chains + 1)),
-                          local_scheme="lrc-xor",
+                          local_scheme=args.layout,
                           local_group_size=args.local_group_size)
     if lay.slots >= args.chains:
         raise SystemExit(f"need chains > slots={lay.slots} so placement "

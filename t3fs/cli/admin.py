@@ -21,6 +21,7 @@ import shlex
 import sys
 import time
 
+from t3fs.client.ec_client import SUPPORTED_LOCAL_SCHEMES
 from t3fs.client.meta_client import MetaClient
 from t3fs.client.mgmtd_client import MgmtdClient
 from t3fs.client.storage_client import StorageClient, StorageClientConfig
@@ -149,16 +150,20 @@ async def repair_status(ctx: AdminContext, args) -> None:
         print("no scrub schedulers have reported")
         return
     now = time.time()
+    # survivor-bytes ratio: what each rebuilt byte cost the fabric.
+    # full-k RS repair pays ~k/1, lrc-xor ~group_size/1, pm-msr 0.5625
     rows = [[r.source, f"{now - r.ts:.1f}s", r.repair_mode,
              f"{r.budget_mbps:g}" if r.budget_mbps else "off",
              r.stripes_scanned, r.shards_lost + r.shards_corrupt,
              r.repaired_shards, r.stripes_failed,
              _fmt_bytes(r.bytes_read), _fmt_bytes(r.bytes_repaired),
+             (f"{r.bytes_read / r.bytes_repaired:.2f}x"
+              if r.bytes_repaired else "-"),
              f"{r.paced_wait_s:.2f}s"]
             for r in rsp.rows]
     print(_fmt_table(rows, ["source", "age", "mode", "MB/s", "scanned",
                             "damaged", "repaired", "failed", "read",
-                            "rebuilt", "paced"]))
+                            "rebuilt", "amp", "paced"]))
 
 
 @command("lease", "current mgmtd primary lease")
@@ -630,7 +635,9 @@ async def client_sessions(ctx: AdminContext, args) -> None:
        ("--table-type", {"choices": ("cr", "ec"), "default": "cr",
                          "help": "cr = replicated chains (BIBD recovery-"
                                  "balanced), ec = single-replica shard "
-                                 "chains (rendezvous-placed)"}),
+                                 "chains (rendezvous-placed) serving "
+                                 "ECLayout stripes (local_scheme one of "
+                                 f"{SUPPORTED_LOCAL_SCHEMES})"}),
        ("--table-id", {"type": int, "default": 0,
                        "help": "chain table id (default: 1 for cr, 2 "
                                "for ec — the LocalCluster convention)"}),

@@ -27,6 +27,7 @@ from dataclasses import dataclass, field
 import numpy as np
 
 from t3fs.client.ec_codec import ECCodec
+from t3fs.ops.msr import default_msr, msr_code_id
 from t3fs.ops.rs import default_rs
 from t3fs.storage.types import ChunkId, IOResult, ReadIO, UpdateType
 from t3fs.utils import tracing
@@ -37,6 +38,16 @@ log = logging.getLogger("t3fs.client.ec")
 
 PARITY_NS = 1 << 62   # parity chunk-id namespace bit
 LOCAL_NS = 1 << 61    # local-group (LRC) parity chunk-id namespace bit
+
+# Single source of truth for ECLayout.local_scheme values.  Layout
+# validation, chunk-id namespacing (num_local_groups decides whether
+# LOCAL_NS chunks exist), and the admin `gen-chains` help text all read
+# THIS tuple, so adding a scheme cannot skew the three.
+SUPPORTED_LOCAL_SCHEMES = ("", "lrc-xor", "pm-msr")
+# The subset that adds local-group parity chunks in the LOCAL_NS
+# namespace; pm-msr keeps the plain k+m slot set (its repair savings come
+# from sub-packetization, not extra parity chunks).
+GROUP_PARITY_SCHEMES = ("lrc-xor",)
 
 
 def subshard_r(chunk_size: int, r_max: int = 4) -> int:
@@ -81,6 +92,13 @@ class ECLayout:
     # G/(k+m) extra storage.  Scalar-MDS information theory forces the
     # trade: ANY (k+m, k) MDS code needs >= k full shards' worth of bytes
     # per single-shard repair under raw reads (see docs/codec_economics.md).
+    # "pm-msr" sidesteps that bound by sub-packetizing: each shard is
+    # alpha = 2^((k+m)/2) sub-chunks of a coupled-layer MSR code
+    # (ops/msr.py), data shards stay RAW bytes (systematic — healthy
+    # first-k reads are byte-identical to plain RS), and a single lost
+    # shard rebuilds from every survivor's beta = alpha/2 selected
+    # sub-chunks: d*beta/alpha = 0.5625x of k full chunks, at the SAME
+    # 1.25x storage (no extra parity chunks — slots == k+m).
     local_scheme: str = ""
     local_group_size: int = 3
 
@@ -91,23 +109,44 @@ class ECLayout:
                 f"EC({self.k}+{self.m}"
                 f"{'+' + str(self.num_local_groups) + 'l' if self.local_scheme else ''}"
                 f") needs >= {self.slots} chains")
-        if self.local_scheme not in ("", "lrc-xor"):
-            raise make_error(StatusCode.INVALID_ARG,
-                             f"unknown local scheme {self.local_scheme!r}")
+        if self.local_scheme not in SUPPORTED_LOCAL_SCHEMES:
+            raise make_error(
+                StatusCode.INVALID_ARG,
+                f"unknown local scheme {self.local_scheme!r} "
+                f"(supported: {SUPPORTED_LOCAL_SCHEMES})")
+        if self.local_scheme == "pm-msr":
+            try:
+                code = default_msr(self.k, self.m)
+            except ValueError as e:
+                raise make_error(StatusCode.INVALID_ARG, str(e)) from e
+            if self.chunk_size % code.alpha:
+                raise make_error(
+                    StatusCode.INVALID_ARG,
+                    f"pm-msr sub-packetization needs chunk_size divisible "
+                    f"by alpha={code.alpha} (got {self.chunk_size})")
 
     @classmethod
     def create(cls, k: int = 8, m: int = 2, chunk_size: int = 1 << 20,
                chains: list[int] | None = None, local_scheme: str = "",
                local_group_size: int = 3) -> "ECLayout":
-        """Layout-creation factory: stamps the CURRENT parity format id."""
+        """Layout-creation factory: stamps the CURRENT parity format id
+        (the pm-msr coupled generator has its OWN id — its parity bytes
+        are not plain RS parity)."""
+        if local_scheme == "pm-msr":
+            try:
+                code_id = msr_code_id(k, m)
+            except ValueError as e:
+                raise make_error(StatusCode.INVALID_ARG, str(e)) from e
+        else:
+            code_id = default_rs(k, m).code_id
         return cls(k=k, m=m, chunk_size=chunk_size, chains=chains or [],
-                   code_id=default_rs(k, m).code_id,
+                   code_id=code_id,
                    local_scheme=local_scheme,
                    local_group_size=local_group_size)
 
     @property
     def num_local_groups(self) -> int:
-        if not self.local_scheme:
+        if self.local_scheme not in GROUP_PARITY_SCHEMES:
             return 0
         return -(-(self.k + self.m) // self.local_group_size)
 
@@ -306,6 +345,46 @@ class ECStorageClient:
         return await self._reconstruct(present_rows, present, want,
                                        k, m), None
 
+    async def _msr_encode_verified(self, data_shards: np.ndarray, k: int,
+                                   m: int
+                                   ) -> tuple[np.ndarray, np.ndarray | None]:
+        """pm-msr twin of _encode_verified: coupled-layer parity + fused
+        shard CRCs in one launch; numpy oracle (no fused CRC) fallback."""
+        if self.codec is not None:
+            return await self.codec.msr_encode_verified(data_shards, k, m)
+        code = default_msr(k, m)
+        return await asyncio.to_thread(code.encode_np, data_shards), None
+
+    async def _msr_decode_verified(self, present_rows: np.ndarray,
+                                   present: tuple[int, ...],
+                                   want: tuple[int, ...], k: int, m: int
+                                   ) -> tuple[np.ndarray, np.ndarray | None]:
+        """pm-msr twin of _reconstruct_verified: the multi-loss/degraded
+        full-k decode (exactly k survivor shards — never more than RS)."""
+        if self.codec is not None:
+            return await self.codec.msr_decode_verified(
+                present_rows, present, want, k, m)
+        code = default_msr(k, m)
+        return await asyncio.to_thread(
+            code.decode_np, present, present_rows, want), None
+
+    async def _msr_repair_eval(self, helper_rows: np.ndarray, f: int,
+                               k: int, m: int) -> tuple[np.ndarray, int]:
+        """One fused pm-msr projection rebuild: (d, beta_len) helper rows
+        -> (full rebuilt chunk, device CRC32C of the whole chunk)."""
+        if self.codec is not None:
+            out, crc = await self.codec.msr_repair(helper_rows, f, k, m)
+            return out, int(crc)
+        from t3fs.ops.codec import crc32c
+        code = default_msr(k, m)
+        sub = 2 * helper_rows.shape[-1] // code.alpha
+
+        def run():
+            subs = helper_rows.reshape(code.d, code.alpha // 2, sub)
+            out = code.repair_np(f, subs)
+            return out, crc32c(out.tobytes())
+        return await asyncio.to_thread(run)
+
     async def close(self) -> None:
         if self.codec is not None:
             await self.codec.close()
@@ -327,8 +406,12 @@ class ECStorageClient:
         for j in range(k):
             if lens[j]:
                 arr[j, :lens[j]] = flat[j * cs: j * cs + lens[j]]
-        layout.check_code(default_rs(k, m))
-        parity, dev_crcs = await self._encode_verified(arr, k, m)
+        if layout.local_scheme == "pm-msr":
+            layout.check_code(default_msr(k, m))
+            parity, dev_crcs = await self._msr_encode_verified(arr, k, m)
+        else:
+            layout.check_code(default_rs(k, m))
+            parity, dev_crcs = await self._encode_verified(arr, k, m)
 
         from t3fs.ops.codec import crc32c
         contents: list[bytes] = []
@@ -348,7 +431,7 @@ class ECStorageClient:
             contents.append(bytes(parity[p]))
             crcs.append(int(dev_crcs[k + p]) if dev_crcs is not None
                         else crc32c(contents[-1]))
-        if layout.local_scheme:
+        if layout.num_local_groups:
             # local XOR parities over the PADDED member buffers (consistent
             # with absent == zeros on the repair side); the all-ones repair
             # program is exactly an XOR fold + CRC, so the device path
@@ -621,7 +704,8 @@ class ECStorageClient:
         crc is the fused decode+verify step's device CRC32C of the
         full-chunk content when that step produced the shard, else None.
         Want-shards already in `have` pass through without decoding."""
-        layout.check_code(default_rs(k, m))
+        msr = layout.local_scheme == "pm-msr"
+        layout.check_code(default_msr(k, m) if msr else default_rs(k, m))
         # shards recovered directly need no decoding
         still_want = tuple(s for s in want if s not in have)
         decoded: dict[int, bytes] = {}
@@ -632,8 +716,12 @@ class ECStorageClient:
             present = tuple(sorted(s for s in have.keys()
                                    if s not in still_want)[:k])
             rows = np.stack([have[s] for s in present])
-            out, crcs = await self._reconstruct_verified(
-                rows, present, still_want, k, m)
+            if msr:
+                out, crcs = await self._msr_decode_verified(
+                    rows, present, still_want, k, m)
+            else:
+                out, crcs = await self._reconstruct_verified(
+                    rows, present, still_want, k, m)
             decoded = {s: bytes(out[i]) for i, s in enumerate(still_want)}
             if crcs is not None:
                 # fused-step layout: k survivor CRCs, then the rebuilt
@@ -653,6 +741,8 @@ class ECStorageClient:
         Without: the k+m scheduled single-row programs over the canonical
         (no-holes, no-preference) survivor pick _plan_reduced makes."""
         rows: dict[tuple[int, ...], None] = {}
+        if layout.local_scheme == "pm-msr":
+            return []   # projection schedules precompile via warmup_msr
         if layout.local_scheme:
             for members in layout.local_groups():
                 rows[(1,) * len(members)] = None
@@ -675,6 +765,12 @@ class ECStorageClient:
         if self.codec is None:
             return
         k, m, cs = layout.k, layout.m, layout.chunk_size
+        if layout.local_scheme == "pm-msr":
+            # each failed slot has its own projection schedule, so the
+            # warmup set is one fused repair step per slot + the coupled
+            # encode step (codec.warmup_msr)
+            self.codec.warmup_msr(list(range(k + m)), cs, k, m, batch_sizes)
+            return
         rows = self.hot_repair_programs(layout)
         sub = cs // subshard_r(cs)
         self.codec.warmup_repair(rows, sub, k, m, batch_sizes)
@@ -694,9 +790,21 @@ class ECStorageClient:
         holds no OTHER loss rebuilds from the group — group_size reads
         instead of k.  Without one, a SINGLE lost shard still rides the
         scheduled single-row program over k survivors: same bytes as full-k,
-        but sub-range framed (pacing quanta) and far fewer device ops."""
+        but sub-range framed (pacing quanta) and far fewer device ops.
+
+        With "pm-msr", a SINGLE lost slot reads every survivor's repair
+        projection — all d = k+m-1 helpers ship beta/alpha of a chunk each
+        (0.5625x of k full chunks); coeff 0 marks a zero-hole helper whose
+        projection is substituted as zeros without a read.  Multi-loss
+        returns None: the joint decode reads exactly k full shards, never
+        more than plain RS."""
         k, m = layout.k, layout.m
         base = k + m
+        if layout.local_scheme == "pm-msr":
+            if len(lost) > 1:
+                return None                    # multi-loss: joint decode
+            sch = default_msr(k, m).schedule(s)
+            return [(x, 0 if x in zero_shards else 1) for x in sch.helpers]
         if layout.local_scheme:
             groups = layout.local_groups()
             if s >= base:                      # lost local parity
@@ -750,6 +858,9 @@ class ECStorageClient:
         any helper read fails — the caller falls back to full-k decode."""
         from t3fs.ops.codec import crc32c_combine
         k, m, cs = layout.k, layout.m, layout.chunk_size
+        if layout.local_scheme == "pm-msr":
+            return await self._repair_msr(layout, inode, stripe, s, plan,
+                                          stats)
         if not plan:
             return bytes(cs), None             # all-holes group: zeros
         r = subshard_r(cs)
@@ -786,6 +897,60 @@ class ECStorageClient:
         for _p, sub_crc in parts[1:]:
             crc = crc32c_combine(crc, sub_crc, sub)
         return content, crc
+
+    async def _repair_msr(self, layout: ECLayout, inode: int, stripe: int,
+                          s: int, plan: list[tuple[int, int]],
+                          stats: RepairIOStats
+                          ) -> tuple[bytes, int | None] | None:
+        """Execute one pm-msr projection-repair plan: every live helper
+        ships only its beta = alpha/2 selected sub-chunks — merged into
+        contiguous (offset, length) sub-range ReadIOs on the existing
+        wire fields, no new RPCs — and the coupled-layer rebuild runs as
+        ONE fused device step (stage A/C constant folds around the
+        batched stage-B word fold, full-chunk CRC32C fused in).  Returns
+        None when any live helper read fails: the caller falls back to
+        the full-k joint decode, so a lost helper degrades to RS-cost IO,
+        never to a failed repair."""
+        k, m, cs = layout.k, layout.m, layout.chunk_size
+        code = default_msr(k, m)
+        sch = code.schedule(s)
+        sub = code.subchunk_len(cs)
+        runs = sch.read_runs()
+        live = [slot for slot, c in plan if c]     # coeff 0 == zero hole
+        ios = []
+        for slot in live:
+            cid = layout.shard_chunk(inode, stripe, slot)
+            chain = layout.shard_chain(stripe, slot)
+            for start, count in runs:
+                ios.append(ReadIO(chunk_id=cid, chain_id=chain,
+                                  offset=start * sub, length=count * sub))
+        try:
+            with tracing.span("ec.repair.msr_projection",
+                              helpers=len(live), sub_reads=len(ios)):
+                results, payloads = await self._fast.batch_read(ios)
+        except StatusError:
+            return None
+        # helper rows: ascending slot order, planes in ascending selected-
+        # plane order (the codec.msr_repair byte contract); run ri starts
+        # at selected-plane position cum[ri]
+        cum = [0]
+        for _start, count in runs:
+            cum.append(cum[-1] + count)
+        hidx = {slot: j for j, slot in enumerate(sch.helpers)}
+        bufs = np.zeros((code.d, sch.npl * sub), dtype=np.uint8)
+        for j, (res, p) in enumerate(zip(results, payloads)):
+            if res.status.code != int(StatusCode.OK):
+                return None                # helper lost too: fall back
+            # short payloads (trimmed tails / reads past the stored
+            # length) zero-pad — absent == zeros is the decode contract
+            stats.bytes_read += len(p)
+            stats.sub_reads += 1
+            hi, ri = divmod(j, len(runs))
+            off = cum[ri] * sub
+            bufs[hidx[live[hi]],
+                 off: off + len(p)] = np.frombuffer(p, dtype=np.uint8)
+        out, crc = await self._msr_repair_eval(bufs, s, k, m)
+        return bytes(out), int(crc)
 
     async def repair_chunk(self, layout: ECLayout, inode: int, stripe: int,
                            shard: int, stripe_len: int) -> IOResult:

@@ -153,6 +153,40 @@ class ECCodec:
         key = ("rep", tuple(int(c) for c in coeffs), k, m, L)
         return await self._submit(key, helper_rows)
 
+    # --- pm-msr (coupled-layer regenerating code; ops/msr.py) ---
+
+    async def msr_encode_verified(self, data_shards: np.ndarray, k: int,
+                                  m: int) -> tuple[np.ndarray, np.ndarray]:
+        """(k, L) uint8 raw data shards -> (parity (m, L) uint8,
+        crcs (k+m,) uint32) under the pm-msr coupled generator.  Data
+        shards stay raw bytes on disk (systematic), so only the parity
+        bytes differ from plain RS."""
+        L = data_shards.shape[-1]
+        return await self._submit(("mencv", k, m, L), data_shards)
+
+    async def msr_repair(self, helper_rows: np.ndarray, failed_slot: int,
+                         k: int = 8, m: int = 2
+                         ) -> tuple[np.ndarray, np.ndarray]:
+        """(d, beta_len) uint8 helper projections -> (rebuilt chunk (L,)
+        uint8, crc uint32).  helper_rows holds, for each of the d = k+m-1
+        survivors in ascending slot order, its beta selected sub-chunks
+        concatenated in ascending plane order (the byte layout the
+        projection read plan assembles); L = 2 * beta_len."""
+        beta_len = helper_rows.shape[-1]
+        key = ("mrep", int(failed_slot), k, m, 2 * beta_len)
+        return await self._submit(key, helper_rows)
+
+    async def msr_decode_verified(self, present_rows: np.ndarray,
+                                  present: tuple[int, ...],
+                                  want: tuple[int, ...], k: int, m: int
+                                  ) -> tuple[np.ndarray, np.ndarray]:
+        """(k, L) uint8 present pm-msr shards -> (rebuilt (len(want), L)
+        uint8, crcs (k + len(want),) uint32) — the multi-loss / degraded
+        full-k path (exactly k survivor shards, never more than RS)."""
+        L = present_rows.shape[-1]
+        return await self._submit(("mdecv", tuple(present), tuple(want),
+                                   k, m, L), present_rows)
+
     async def close(self) -> None:
         self._closed = True
         if self._worker is not None:
@@ -269,6 +303,12 @@ class ECCodec:
             fn = self._build_reconstruct_verified(key)
         elif key[0] == "rep":
             fn = self._build_repair(key)
+        elif key[0] == "mencv":
+            fn = self._build_msr_encode_verified(key)
+        elif key[0] == "mrep":
+            fn = self._build_msr_repair(key)
+        elif key[0] == "mdecv":
+            fn = self._build_msr_decode_verified(key)
         else:
             fn = self._build_reconstruct(key)
         self._fns[key] = fn
@@ -521,6 +561,57 @@ class ECCodec:
             return out, np.asarray(crcf(out))
         return repair_xla
 
+    def _build_msr_encode_verified(self, key: tuple) -> Callable:
+        _kind, k, m, L = key
+        from t3fs.ops.msr import default_msr
+        from t3fs.ops.msr_codec import make_msr_encode_step
+
+        step = make_msr_encode_step(default_msr(k, m), L,
+                                    interpret=bool(self._interpret),
+                                    use_pallas=bool(self._use_pallas))
+        codec = ("pallas-msr-encode" if self._use_pallas and L % 512 == 0
+                 else "xla-msr-encode")
+
+        def encode(stacked: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+            self._count(codec)
+            return step(stacked)
+        return encode
+
+    def _build_msr_repair(self, key: tuple) -> Callable:
+        """The pm-msr projection rebuild: stage A/C constant folds around
+        the two scheduled stage-B repair programs, one launch producing
+        the WHOLE rebuilt chunk plus its fused CRC32C.  Pallas word
+        kernels on 512-multiple sub-chunks; otherwise the identical
+        schedule as plain-jnp byte SWAR (the odd-length XLA fallback)."""
+        _kind, failed_slot, k, m, L = key
+        from t3fs.ops.msr import default_msr
+        from t3fs.ops.msr_codec import make_msr_repair_step
+
+        code = default_msr(k, m)
+        step = make_msr_repair_step(code, failed_slot, L,
+                                    interpret=bool(self._interpret),
+                                    use_pallas=bool(self._use_pallas))
+        sub = L // code.alpha
+        codec = ("pallas-msr-repair" if self._use_pallas and sub % 512 == 0
+                 else "xla-msr-repair")
+
+        def repair(stacked: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+            self._count(codec)
+            return step(stacked)
+        return repair
+
+    def _build_msr_decode_verified(self, key: tuple) -> Callable:
+        _kind, present, want, k, m, L = key
+        from t3fs.ops.msr import default_msr
+        from t3fs.ops.msr_codec import make_msr_decode_step
+
+        step = make_msr_decode_step(default_msr(k, m), present, want, L)
+
+        def decode(stacked: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+            self._count("xla-msr-decode")
+            return step(stacked)
+        return decode
+
     # --- decode warmup (DeviceChecksumBackend.warmup analog) ---
 
     def warmup_decode(self, patterns: list[tuple[tuple[int, ...],
@@ -600,6 +691,47 @@ class ECCodec:
                     return
                 try:
                     futs.append(self._pool.submit(one, key, nb))
+                except RuntimeError:   # pool already shut down
+                    return
+        for f in futs:
+            try:
+                f.result()
+            except CancelledError:
+                return
+
+    def warmup_msr(self, slots: list[int], L: int, k: int = 8, m: int = 2,
+                   batch_sizes: tuple[int, ...] = (1,)) -> None:
+        """Precompile the pm-msr projection-repair step for each failed
+        slot (plus the coupled encode) — warmup_repair's pm-msr twin; the
+        repair kernels are per-failed-slot, so a node loss warms exactly
+        the programs the scrub plan will run."""
+        from concurrent.futures import CancelledError
+
+        from t3fs.ops.msr import default_msr
+        from t3fs.storage.codec_backend import _enable_persistent_cache
+
+        _enable_persistent_cache()
+        code = default_msr(k, m)
+        d, beta_len = code.d, L // 2
+
+        def one(key: tuple, shape: tuple[int, ...]) -> None:
+            if self._closed:
+                return
+            try:
+                self._fn(key)(np.zeros(shape, dtype=np.uint8))
+            except Exception:
+                log.exception("EC msr warmup compile failed (key=%s)", key)
+
+        futs = []
+        jobs: list[tuple[tuple, tuple[int, ...]]] = [
+            (("mencv", k, m, L), (k, L))]
+        jobs += [(("mrep", int(f), k, m, L), (d, beta_len)) for f in slots]
+        for key, shape in jobs:
+            for nb in batch_sizes:
+                if self._closed:
+                    return
+                try:
+                    futs.append(self._pool.submit(one, key, (nb,) + shape))
                 except RuntimeError:   # pool already shut down
                     return
         for f in futs:

@@ -210,6 +210,14 @@ class RepairDriver:
         and short tails read fewer bytes than charged) — pacing must bound
         fabric load, not track it exactly."""
         cs = lay.chunk_size
+        if self.repair_mode == "subshard" and lay.local_scheme == "pm-msr":
+            from t3fs.ops.msr import default_msr
+            code = default_msr(lay.k, lay.m)
+            if len(lost) == 1:
+                # every survivor ships its beta/alpha projection: d helpers
+                # x beta sub-chunks = 0.5625x of k full chunks
+                return code.d * code.beta * cs // code.alpha
+            return lay.k * cs        # multi-loss: joint decode, exactly k
         if self.repair_mode == "subshard" and lay.local_scheme:
             groups = lay.local_groups()
             base = lay.k + lay.m

@@ -1,0 +1,305 @@
+"""pm-msr coupled-layer MSR code (ISSUE 17): construction invariants,
+differential kernel coverage, projection plans, and the cluster e2e.
+
+The repair kernels are pinned to the numpy `eval_program_np` oracle
+(repair_np runs every stage through it) across EVERY single-loss mask on
+BOTH dispatch paths — the Pallas word kernels (interpret mode on CPU)
+and the XLA byte fallback — including fused-CRC equality, plus >= 2
+multi-loss masks through the full-k decode step."""
+
+import asyncio
+import itertools
+
+import numpy as np
+import pytest
+
+from t3fs.client.ec_client import (
+    SUPPORTED_LOCAL_SCHEMES, ECLayout, ECStorageClient, RepairIOStats)
+from t3fs.ops.crc32c import crc32c_ref
+from t3fs.ops.msr import default_msr, msr_code_id
+from t3fs.ops.rs import default_rs
+from t3fs.utils.status import StatusCode, StatusError
+
+rng = np.random.default_rng(23)
+CODE = default_msr(8, 2)
+
+
+def _stored(code, L):
+    data = rng.integers(0, 256, (code.k, L), dtype=np.uint8)
+    parity = code.encode_np(data)
+    return data, np.concatenate([data, parity], axis=0)
+
+
+def _helper_rows(code, stored, f, L):
+    """(d, beta_len) helper projections in the codec byte contract:
+    ascending slot order, selected planes ascending."""
+    sch = code.schedule(f)
+    sub = L // code.alpha
+    return np.stack([
+        stored[h].reshape(code.alpha, sub)[list(sch.selected)].reshape(-1)
+        for h in sch.helpers])
+
+
+# ------------------------------------------------------- construction
+
+def test_msr_construction_invariants():
+    """alpha = 2^(n/2) sub-packetization, systematic data shards, distinct
+    parity format id, and per-slot projection schedules that read exactly
+    beta = alpha/2 planes from each of the d survivors."""
+    code = CODE
+    assert (code.n, code.d, code.alpha, code.beta) == (10, 9, 32, 16)
+    assert code.code_id == "pmmsr32-g2-raid6-g2-11d"
+    assert code.code_id != default_rs(8, 2).code_id
+    L = 2048
+    data, stored = _stored(code, L)
+    # systematic: the first k stored shards ARE the raw data bytes
+    assert np.array_equal(stored[: code.k], data)
+    for f in range(code.n):
+        sch = code.schedule(f)
+        assert len(sch.helpers) == code.d
+        assert sch.npl == code.beta
+        # the read plan covers exactly the selected planes, merged runs
+        planes = [z for start, count in sch.read_runs()
+                  for z in range(start, start + count)]
+        assert tuple(planes) == sch.selected
+    # repair_np (the all-stages eval_program_np oracle) rebuilds every
+    # slot byte-exactly from the beta-plane projections
+    sub = L // code.alpha
+    for f in range(code.n):
+        H = _helper_rows(code, stored, f, L).reshape(code.d, code.beta, sub)
+        out = code.repair_np(f, H)
+        assert out.tobytes() == stored[f].tobytes(), f
+
+
+def test_msr_mds_smoke_masks():
+    """A cross-section of 2-loss masks decodes (full sweep is slow)."""
+    CODE.verify_mds([(0, 1), (3, 9), (8, 9)])
+
+
+@pytest.mark.slow
+def test_msr_mds_all_masks():
+    CODE.verify_mds()      # all C(10,2) = 45 double-erasure masks
+
+
+# ------------------------------------------------- differential kernels
+
+@pytest.mark.parametrize("words", [False, True],
+                         ids=["xla-bytes", "pallas-words"])
+def test_msr_repair_differential_every_mask(words):
+    """Every single-loss mask, both dispatch paths, byte-identical to the
+    numpy oracle — fused full-chunk CRC32C included."""
+    from t3fs.ops.msr_codec import make_msr_repair_step
+    code = CODE
+    # words path needs sub % 512 == 0; the byte path runs an odd length
+    L = 16384 if words else 4032
+    assert L % code.alpha == 0
+    _data, stored = _stored(code, L)
+    for f in range(code.n):
+        rows = _helper_rows(code, stored, f, L)
+        step = make_msr_repair_step(code, f, L, interpret=words,
+                                    use_pallas=words)
+        out, crc = step(rows.reshape(1, code.d, -1))
+        got = bytes(np.asarray(out[0]))
+        assert got == stored[f].tobytes(), f"mask {f}"
+        assert int(np.asarray(crc)[0]) == crc32c_ref(got), f"crc mask {f}"
+
+
+@pytest.mark.parametrize("words", [False, True],
+                         ids=["xla-bytes", "pallas-words"])
+def test_msr_encode_differential(words):
+    """Device encode (coupled parity + fused shard CRCs) == encode_np."""
+    from t3fs.ops.msr_codec import make_msr_encode_step
+    code = CODE
+    L = 16384 if words else 4064
+    data, stored = _stored(code, L)
+    step = make_msr_encode_step(code, L, interpret=words, use_pallas=words)
+    parity, crcs = step(data.reshape(1, code.k, L))
+    parity, crcs = np.asarray(parity[0]), np.asarray(crcs[0])
+    assert parity.tobytes() == stored[code.k:].tobytes()
+    for s in range(code.n):
+        assert int(crcs[s]) == crc32c_ref(stored[s].tobytes()), s
+
+
+def test_msr_decode_multi_loss_differential():
+    """>= 2 multi-loss masks through the full-k decode step: byte-equal
+    to decode_np, CRCs fused for survivors AND rebuilt shards."""
+    from t3fs.ops.msr_codec import make_msr_decode_step
+    code = CODE
+    L = 2048
+    _data, stored = _stored(code, L)
+    for lost in [(0, 1), (4, 9), (8, 9)]:
+        present = tuple(s for s in range(code.n) if s not in lost)[:code.k]
+        rows = np.stack([stored[s] for s in present])
+        step = make_msr_decode_step(code, present, lost, L)
+        out, crcs = step(rows.reshape(1, code.k, L))
+        out, crcs = np.asarray(out[0]), np.asarray(crcs[0])
+        oracle = code.decode_np(present, rows, lost)
+        assert out.tobytes() == oracle.tobytes(), lost
+        for i, s in enumerate(lost):
+            assert out[i].tobytes() == stored[s].tobytes(), (lost, s)
+            assert int(crcs[code.k + i]) == crc32c_ref(
+                stored[s].tobytes()), (lost, s)
+
+
+# --------------------------------------------------- plans and layouts
+
+def _msr_layout(cs=2048, chains=12):
+    return ECLayout.create(k=8, m=2, chunk_size=cs,
+                           chains=list(range(1, chains + 1)),
+                           local_scheme="pm-msr")
+
+
+def test_msr_plan_reduced_and_multi_loss_budget():
+    """Single loss plans the d-helper projection read; multi-loss returns
+    None so the joint decode reads EXACTLY k full shards — never more
+    survivor bytes than plain RS."""
+    lay = _msr_layout()
+    plan = ECStorageClient._plan_reduced(None, lay, 3, frozenset((3,)),
+                                         frozenset(), None)
+    assert [s for s, _c in plan] == [s for s in range(10) if s != 3]
+    assert all(c == 1 for _s, c in plan)
+    # zero-hole helpers are marked coeff 0: substituted, never read
+    plan_h = ECStorageClient._plan_reduced(None, lay, 3, frozenset((3,)),
+                                           frozenset((5,)), None)
+    assert dict(plan_h)[5] == 0 and dict(plan_h)[6] == 1
+    # multi-loss: no reduced plan, joint decode caps at k reads
+    assert ECStorageClient._plan_reduced(None, lay, 1, frozenset((1, 8)),
+                                         frozenset(), None) is None
+    from t3fs.client.repair import RepairDriver
+    driver = RepairDriver(ec=None)
+    single = driver._estimate_read_bytes(lay, (3,))
+    double = driver._estimate_read_bytes(lay, (1, 8))
+    full_k = lay.k * lay.chunk_size
+    assert single == 9 * 16 * lay.chunk_size // 32   # 0.5625x of full-k
+    assert single < full_k
+    assert double <= full_k
+
+
+def test_msr_layout_validation_and_code_id():
+    """The shared scheme constant gates validation; pm-msr layouts stamp
+    the coupled-generator format id and refuse the plain-RS decoder."""
+    assert "pm-msr" in SUPPORTED_LOCAL_SCHEMES
+    lay = _msr_layout()
+    assert lay.code_id == msr_code_id(8, 2)
+    assert lay.slots == 10 and lay.num_local_groups == 0
+    with pytest.raises(StatusError) as ei:
+        lay.check_code(default_rs(8, 2))       # RS decoder on MSR parity
+    assert ei.value.status.code == int(StatusCode.EC_FORMAT_MISMATCH)
+    lay.check_code(default_msr(8, 2))
+    with pytest.raises(StatusError):
+        ECLayout.create(k=8, m=2, chunk_size=1000,     # % alpha != 0
+                        chains=list(range(1, 13)), local_scheme="pm-msr")
+    with pytest.raises(StatusError):
+        ECLayout.create(k=8, m=3, chunk_size=2048,     # m must be 2
+                        chains=list(range(1, 13)), local_scheme="pm-msr")
+    with pytest.raises(StatusError) as ei:
+        ECLayout.create(k=8, m=2, chunk_size=2048,
+                        chains=list(range(1, 13)), local_scheme="nope")
+    assert "pm-msr" in str(ei.value)           # the shared list, verbatim
+
+
+def test_msr_scrub_resolves_chunks_without_local_namespace():
+    """ScrubScheduler chunk-id inversion needs ZERO pm-msr call-site
+    changes: slots == k+m, no LOCAL_NS chunks exist."""
+    from t3fs.storage.scrub_scheduler import ScrubScheduler, ScrubStats
+    from t3fs.client.ec_client import LOCAL_NS
+    from t3fs.storage.types import ChunkId
+    lay = _msr_layout()
+    sched = ScrubScheduler.__new__(ScrubScheduler)   # registry-only use
+    sched._targets = {}
+    sched._cursor = {}
+    sched.stats = ScrubStats()
+    sched._flagged = set()
+    sched.discovery = None
+    sched._unresolved = []
+    sched.add_target("f", lay, 77, {0: 8192})
+    for slot in range(lay.slots):
+        hit = sched.resolve_chunk(lay.shard_chunk(77, 0, slot))
+        assert hit is not None and hit[1:] == (0, slot), slot
+    assert sched.resolve_chunk(ChunkId(77 | LOCAL_NS, 0)) is None
+
+
+# ------------------------------------------------------- cluster e2e
+
+def test_msr_cluster_write_repair_degraded_read(monkeypatch):
+    """Full client path on a live cluster: systematic healthy reads are
+    byte-identical to plain RS, single-loss repair reads 0.5625x of
+    full-k (data AND parity slots), 2-loss repairs read exactly k full
+    shards, degraded reads decode through the pm-msr matrix — all
+    device-CRC-verified through the fused steps."""
+    from t3fs.storage.types import ReadIO, RemoveChunksReq
+    from t3fs.testing.cluster import LocalCluster
+    K, M, CS = 8, 2, 2048
+
+    async def body():
+        cluster = LocalCluster(num_nodes=5, replicas=1, num_chains=10)
+        await cluster.start()
+        try:
+            chains = list(range(1, 11))
+            lay = ECLayout.create(k=K, m=M, chunk_size=CS, chains=chains,
+                                  local_scheme="pm-msr")
+            rsl = ECLayout.create(k=K, m=M, chunk_size=CS, chains=chains)
+            ec = ECStorageClient(cluster.sc)
+            data = rng.integers(0, 256, K * CS, dtype=np.uint8).tobytes()
+            res = await ec.write_stripe(lay, 9, 0, data)
+            assert all(r.status.code == int(StatusCode.OK) for r in res)
+            assert await ec.read_stripe(lay, 9, 0, len(data)) == data
+
+            # healthy-path unchanged: stored data chunks byte-identical
+            # to a plain-RS layout of the same data (systematic MSR)
+            res = await ec.write_stripe(rsl, 11, 0, data)
+            assert all(r.status.code == int(StatusCode.OK) for r in res)
+            for j in (0, 5):
+                _, (a, b) = await cluster.sc.batch_read([
+                    ReadIO(chunk_id=lay.data_chunk(9, 0, j),
+                           chain_id=lay.shard_chain(0, j)),
+                    ReadIO(chunk_id=rsl.data_chunk(11, 0, j),
+                           chain_id=rsl.shard_chain(0, j))])
+                assert a == b, j
+
+            routing = cluster.mgmtd.state.routing()
+
+            async def wipe(shards):
+                for sh in shards:
+                    cid = lay.shard_chunk(9, 0, sh)
+                    chain_id = lay.shard_chain(0, sh)
+                    head = routing.chains[chain_id].head()
+                    await cluster.admin.call(
+                        routing.node_address(head.node_id),
+                        "Storage.remove_chunks",
+                        RemoveChunksReq(chain_id=chain_id,
+                                        inode=cid.inode,
+                                        begin_index=cid.index,
+                                        end_index=cid.index + 1))
+
+            for lost_slot in (3, 9):      # one data slot, one parity slot
+                await wipe([lost_slot])
+                stats = RepairIOStats()
+                res = await ec.repair_stripe(lay, 9, 0, (lost_slot,),
+                                             len(data), stats=stats)
+                assert all(r.status.code == int(StatusCode.OK)
+                           for r in res)
+                assert stats.reduced_shards == 1, stats
+                assert stats.bytes_read * 16 == 9 * K * CS, stats
+                assert await ec.read_stripe(lay, 9, 0, len(data)) == data
+
+            await wipe([1, 8])            # 2-loss: joint decode, <= full-k
+            stats = RepairIOStats()
+            res = await ec.repair_stripe(lay, 9, 0, (1, 8), len(data),
+                                         stats=stats)
+            assert all(r.status.code == int(StatusCode.OK) for r in res)
+            assert stats.fallback_shards == 2, stats
+            assert stats.bytes_read <= K * CS, stats
+            assert await ec.read_stripe(lay, 9, 0, len(data)) == data
+
+            await wipe([0])               # degraded read decodes through
+            assert await ec.read_stripe(lay, 9, 0, len(data)) == data
+            counts = ec.codec.codec_counts
+            assert counts.get("xla-msr-encode", 0) >= 1, counts
+            assert counts.get("xla-msr-repair", 0) >= 2, counts
+            assert counts.get("xla-msr-decode", 0) >= 1, counts
+            await ec.close()
+        finally:
+            await cluster.stop()
+
+    asyncio.run(body())

@@ -281,6 +281,33 @@ def test_repair_drill_bench_smoke():
         assert c["bytes_repaired"] == res["lost_bytes"]
 
 
+@pytest.mark.slow
+def test_repair_drill_bench_msr_smoke():
+    """The same drill on a pm-msr layout (ISSUE 17 CI cell): projection
+    repair must move < 0.7x the survivor bytes of full-k — the analytic
+    ratio is d*beta/alpha = 0.5625 — with zero wrong bytes (the bench
+    asserts every foreground and post-repair read byte-exact) and every
+    rebuilt shard CRC'd by the fused device step (--device)."""
+    from benchmarks.repair_drill_bench import parse_args, run_bench
+
+    res = asyncio.run(run_bench(parse_args(
+        ["--layout", "pm-msr", "--stripes", "6", "--chunk-size", "16384",
+         "--readers", "1", "--warm-s", "0.2", "--budget-mbps", "-1",
+         "--device"])))
+    assert res["verified"]
+    assert res["lost_shards"] > 0
+    assert res["read_errors"] == 0
+    assert res["repair_traffic_ratio"] is not None
+    assert res["repair_traffic_ratio"] < 0.7, res["repair_traffic_ratio"]
+    cells = {(c["mode"], c["budget_mbps"]): c for c in res["cells"]}
+    assert cells[("subshard", 0.0)]["fallback_shards"] == 0
+    assert cells[("full", 0.0)]["reduced_shards"] == 0
+    for c in res["cells"]:
+        assert c["bytes_repaired"] == res["lost_bytes"]
+    counts = res["codec_stats"]["counts"]
+    assert counts.get("xla-msr-repair", 0) >= 1, counts
+
+
 # ------------------------------------------------- discovery (auto targets)
 
 def test_refresh_targets_add_update_remove_semantics():
