@@ -20,7 +20,8 @@ SAN_FILTER := -k "not device"
 .PHONY: test lint sanitize sanitize-thread sanitize-address probe \
         on-device ci ckpt-bench write-bench read-bench \
         kvcache-fleet-bench repair-drill usrbio-bench soak soak-smoke \
-        health-smoke health-bench rebalance-drill rebalance-smoke
+        health-smoke health-bench rebalance-drill rebalance-smoke \
+        kv-distributor-bench kv-distributor-smoke
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -112,6 +113,19 @@ rebalance-drill:
 # ~1 min CI-sized drill: same storm, same gates, shorter windows.
 rebalance-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m benchmarks.rebalance_drill_bench \
+		--smoke --json
+
+# KV data distributor A/B: mdtest-style metadata storm over bandwidth-
+# capped WAL volumes; static vs distributor-on vs operator-presplit,
+# plus kill/restart drills at both surgery kill-points.  Gates: steady
+# throughput >= 1.5x static, p99 <= 1.2x presplit, zero lost/wrong on
+# full read-back, monotonic map, drills converge.
+kv-distributor-bench:
+	JAX_PLATFORMS=cpu $(PY) -m benchmarks.kv_distributor_bench --json
+
+# CI-sized: correctness gates only (auto-split, read-back, drills).
+kv-distributor-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m benchmarks.kv_distributor_bench \
 		--smoke --json
 
 # Bounded TPU-tunnel probe; ALWAYS appends a dated record to

@@ -37,6 +37,9 @@ class KvMainConfig(ConfigBase):
     compress_threshold: int = citem(0, hot=False)
     monitor_address: str = citem("", hot=False)   # push metrics here
     metrics_period_s: float = citem(10.0, hot=False)
+    # tag for this node's kv.range.{reads,writes,bytes} gauges (the
+    # monitor distinguishes groups by it; "" keeps the bare names)
+    stats_group: str = citem("", hot=False)
     log: LogConfig = cobj(LogConfig)
 
 
@@ -50,6 +53,7 @@ async def serve(cfg: KvMainConfig, app: ApplicationBase) -> None:
     svc = KvService(engine, primary=(cfg.role == "primary"),
                     followers=[a for a in cfg.followers.split(",") if a],
                     client=client)
+    svc.export_load_gauges(group=cfg.stats_group)
     rpc.add_service(svc)
 
     async def start():

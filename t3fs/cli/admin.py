@@ -426,15 +426,54 @@ async def kv_publish_map(ctx: AdminContext, args) -> None:
           f"(map home {groups[0]})")
 
 
-@command("kv-map", "show the published shard map (map home group)")
-@args_(("map_home", {"nargs": "+", "help": "map-home group addresses"}))
+@command("kv-map", "show the published shard map with per-range load "
+                   "and any in-flight surgery intent")
+@args_(("map_home", {"nargs": "+", "help": "map-home group addresses"}),
+       ("--no-load", {"action": "store_true",
+                      "help": "skip the per-range Kv.range_stats pull"}))
 async def kv_map(ctx: AdminContext, args) -> None:
+    from t3fs.kv.service import KvRangeStatsReq
     from t3fs.kv.surgery import ShardAdmin
     admin = ShardAdmin(list(args.map_home), client=ctx.cli)
     m = await admin.load_map()
     print(f"shard map v{m.version}: {len(m.ranges)} ranges")
+    loads: dict = {}
+    if not args.no_load:
+        # best-effort: a group that can't answer must not hide the map
+        by_group: dict = {}
+        for r in m.ranges:
+            by_group.setdefault(tuple(r.addresses), []).append(r)
+        for group, ranges in by_group.items():
+            req = KvRangeStatsReq(begins=[r.begin for r in ranges],
+                                  ends=[r.end for r in ranges])
+            try:
+                rsp = await admin._group(list(group))._call(
+                    "Kv.range_stats", req)
+            except (StatusError, OSError) as e:
+                print(f"  ! range_stats from {','.join(group)} "
+                      f"unavailable: {e}")
+                continue
+            for i in range(len(rsp.begins)):
+                loads[(rsp.begins[i], rsp.ends[i])] = (
+                    rsp.read_ops_s[i], rsp.write_ops_s[i],
+                    rsp.read_bytes_s[i] + rsp.write_bytes_s[i],
+                    rsp.rows[i], rsp.approx_bytes[i], rsp.split_keys[i])
     for r in m.ranges:
-        print(f"  [{r.begin!r}, {r.end!r}) -> {', '.join(r.addresses)}")
+        line = f"  [{r.begin!r}, {r.end!r}) -> {', '.join(r.addresses)}"
+        st = loads.get((r.begin, r.end))
+        if st is not None:
+            ro, wo, bs, rows, ab, sk = st
+            line += (f"  {ro:.0f}r/s {wo:.0f}w/s {bs / 1e6:.2f}MB/s"
+                     f" rows={rows} ~{ab / 1e6:.2f}MB")
+            if sk:
+                line += f" split@{sk!r}"
+        print(line)
+    intent = await admin._load_intent()
+    if intent is not None:
+        print(f"in-flight {intent.kind} intent: "
+              f"[{intent.begin!r}, {intent.end!r}) "
+              f"{','.join(intent.src)} -> {','.join(intent.dst)} "
+              f"(kv-move-resume finishes it)")
 
 
 @command("kv-split", "split the shard range containing KEY in place")
@@ -460,6 +499,26 @@ async def kv_move(ctx: AdminContext, args) -> None:
     end = KEY_MAX if args.end == "MAX" else args.end.encode()
     m = await admin.move(args.begin.encode(), end, list(args.to))
     print(f"moved; map v{m.version}")
+
+
+@command("kv-merge", "merge the adjacent shard ranges spanning exactly "
+                     "[BEGIN,END) back into one")
+@args_(("begin", {"help": "left range begin (a map boundary)"}),
+       ("end", {"help": "right range end ('MAX' for keyspace end)"}),
+       ("--map-home", {"nargs": "+", "required": True,
+                       "help": "map-home group addresses"}),
+       ("--move-first", {"action": "store_true",
+                         "help": "if the halves live on different groups, "
+                                 "move the right one onto the left's "
+                                 "group first (full data move)"}))
+async def kv_merge(ctx: AdminContext, args) -> None:
+    from t3fs.kv.shard import KEY_MAX
+    from t3fs.kv.surgery import ShardAdmin
+    admin = ShardAdmin(list(args.map_home), client=ctx.cli)
+    end = KEY_MAX if args.end == "MAX" else args.end.encode()
+    m = await admin.merge(args.begin.encode(), end,
+                          move_first=args.move_first)
+    print(f"merged; map v{m.version}: {len(m.ranges)} ranges")
 
 
 @command("kv-move-resume", "finish a shard move whose driver died")

@@ -29,6 +29,7 @@ Replication protocol:
 from __future__ import annotations
 
 import asyncio
+import bisect
 import logging
 from collections import deque
 from dataclasses import dataclass, field
@@ -161,6 +162,39 @@ class KvShardLoadReq:
 
 @serde_struct
 @dataclass
+class KvRangeStatsReq:
+    """Per-range load accounting pull (kv/distributor.py).  The caller
+    (the distributor) passes ITS view of this group's ranges — the live
+    ShardMap slice — and the service rebuckets its decaying counters to
+    those bounds, so stats always align with the map the planner scores
+    against.  Empty lists keep the current bucketing."""
+    begins: list[bytes] = field(default_factory=list)
+    ends: list[bytes] = field(default_factory=list)
+    # compute rows/approx_bytes per range (an O(rows) engine scan —
+    # cheap at planner tick frequency, skippable for gauge polls)
+    include_sizes: bool = True
+
+
+@serde_struct
+@dataclass
+class KvRangeStatsRsp:
+    """Parallel lists, one entry per tracked range.  Rates are decayed
+    EWMA ops/s and bytes/s; `split_keys[i]` is the sampled median
+    accessed key (b"" = not enough samples / degenerate), so a split
+    lands where the traffic is, not at the byte midpoint."""
+    begins: list[bytes] = field(default_factory=list)
+    ends: list[bytes] = field(default_factory=list)
+    read_ops_s: list[float] = field(default_factory=list)
+    write_ops_s: list[float] = field(default_factory=list)
+    read_bytes_s: list[float] = field(default_factory=list)
+    write_bytes_s: list[float] = field(default_factory=list)
+    rows: list[int] = field(default_factory=list)
+    approx_bytes: list[int] = field(default_factory=list)
+    split_keys: list[bytes] = field(default_factory=list)
+
+
+@serde_struct
+@dataclass
 class KvPrepareReq:
     """2PC phase 1: one shard's slice of a cross-shard transaction.
 
@@ -204,6 +238,169 @@ class KvDecisionRsp:
 # user keys in t3fs are printable 4-byte tags, KeyPrefix-def analog)
 PREP_PREFIX = b"\x00t3fs2pc\x00p\x00"
 DEC_PREFIX = b"\x00t3fs2pc\x00d\x00"
+
+
+class _LoadBucket:
+    """One range's decaying load counters + split-point reservoir.
+
+    Counters decay exponentially (half-life `RangeLoadTracker.HALF_LIFE_S`)
+    so the planner sees recent load, not lifetime totals; a rate is the
+    decayed count divided by the mean window (half_life / ln 2).  The key
+    reservoir is a uniform sample of accessed keys — its median is where
+    a split would cut the TRAFFIC in half, which for a skewed hot spot is
+    nowhere near the byte midpoint (the FDB data distributor's
+    "split by sampled bandwidth" behavior)."""
+
+    __slots__ = ("begin", "end", "read_ops", "write_ops", "read_bytes",
+                 "write_bytes", "stamp", "samples", "accesses")
+
+    SAMPLE_CAP = 128
+
+    def __init__(self, begin: bytes, end: bytes, now: float):
+        self.begin = begin
+        self.end = end
+        self.read_ops = 0.0
+        self.write_ops = 0.0
+        self.read_bytes = 0.0
+        self.write_bytes = 0.0
+        self.stamp = now
+        self.samples: list[bytes] = []
+        self.accesses = 0
+
+    def decay(self, now: float, half_life_s: float) -> None:
+        dt = now - self.stamp
+        if dt <= 0:
+            return
+        f = 2.0 ** (-dt / half_life_s)
+        self.read_ops *= f
+        self.write_ops *= f
+        self.read_bytes *= f
+        self.write_bytes *= f
+        self.stamp = now
+
+    def sample(self, key: bytes) -> None:
+        import random
+        self.accesses += 1
+        if len(self.samples) < self.SAMPLE_CAP:
+            self.samples.append(key)
+        else:
+            i = random.randrange(self.accesses)
+            if i < self.SAMPLE_CAP:
+                self.samples[i] = key
+
+    def split_key(self) -> bytes:
+        """Median sampled key, or b"" when a split point can't be
+        suggested (thin sample, or every access hit one key — splitting
+        AT begin/end would make a degenerate empty range)."""
+        if len(self.samples) < 8:
+            return b""
+        ordered = sorted(self.samples)
+        mid = ordered[len(ordered) // 2]
+        if mid <= self.begin or mid >= self.end:
+            return b""
+        return mid
+
+
+class RangeLoadTracker:
+    """Per-range load accounting for one KV group (tentpole layer 1).
+
+    Buckets are keyed by range bounds that the DISTRIBUTOR supplies (its
+    live ShardMap view of this group, via Kv.range_stats) — the service
+    itself only knows its owned union, which after a map-only split is
+    still one contiguous span.  Until the first range_stats call the
+    whole keyspace is one bucket.  note_* calls are O(log ranges) and
+    allocation-free on the hot path; internal \\x00-namespace keys are
+    never tracked (surgery/2PC bookkeeping isn't user load)."""
+
+    HALF_LIFE_S = 30.0
+
+    def __init__(self):
+        import time
+        self._bounds: list[tuple[bytes, bytes]] = []
+        self._begins: list[bytes] = []
+        self.buckets: list[_LoadBucket] = []
+        self.set_bounds([(b"", b"\xff" * 17)], now=time.time())
+
+    def set_bounds(self, pairs: list[tuple[bytes, bytes]],
+                   now: float | None = None) -> None:
+        """Rebucket to new bounds.  Counters of an old bucket are split
+        among its covering new bounds proportionally to where its
+        SAMPLED keys fall (the best estimate we have of how the load
+        divides); samples re-partition exactly."""
+        import time
+        now = time.time() if now is None else now
+        pairs = sorted(set((bytes(b), bytes(e)) for b, e in pairs if b < e))
+        if pairs == self._bounds:
+            return
+        fresh = [_LoadBucket(b, e, now) for b, e in pairs]
+        begins = [b for b, _ in pairs]
+        for old in self.buckets:
+            old.decay(now, self.HALF_LIFE_S)
+            hits: dict[int, int] = {}
+            for k in old.samples:
+                i = bisect.bisect_right(begins, k) - 1
+                if 0 <= i < len(fresh) and k < fresh[i].end:
+                    hits[i] = hits.get(i, 0) + 1
+                    nb = fresh[i]
+                    if len(nb.samples) < nb.SAMPLE_CAP:
+                        nb.samples.append(k)
+            total = sum(hits.values())
+            if not total:
+                continue
+            for i, n in hits.items():
+                frac = n / total
+                nb = fresh[i]
+                nb.read_ops += old.read_ops * frac
+                nb.write_ops += old.write_ops * frac
+                nb.read_bytes += old.read_bytes * frac
+                nb.write_bytes += old.write_bytes * frac
+                nb.accesses += int(old.accesses * frac)
+        self._bounds = pairs
+        self._begins = begins
+        self.buckets = fresh
+
+    def _bucket(self, key: bytes) -> _LoadBucket | None:
+        i = bisect.bisect_right(self._begins, key) - 1
+        if 0 <= i < len(self.buckets) and key < self.buckets[i].end:
+            return self.buckets[i]
+        return None
+
+    def note_read(self, key: bytes, nbytes: int, now: float) -> None:
+        if key.startswith(b"\x00"):
+            return
+        b = self._bucket(key)
+        if b is None:
+            return
+        b.decay(now, self.HALF_LIFE_S)
+        b.read_ops += 1.0
+        b.read_bytes += nbytes
+        b.sample(key)
+
+    def note_write(self, key: bytes, nbytes: int, now: float) -> None:
+        if key.startswith(b"\x00"):
+            return
+        b = self._bucket(key)
+        if b is None:
+            return
+        b.decay(now, self.HALF_LIFE_S)
+        b.write_ops += 1.0
+        b.write_bytes += nbytes
+        b.sample(key)
+
+    def totals(self) -> tuple[float, float, float]:
+        """(read_ops_s, write_ops_s, bytes_s) across all buckets — the
+        monitor gauge surface."""
+        import math
+        import time
+        now = time.time()
+        window = self.HALF_LIFE_S / math.log(2)
+        r = w = by = 0.0
+        for b in self.buckets:
+            b.decay(now, self.HALF_LIFE_S)
+            r += b.read_ops
+            w += b.write_ops
+            by += b.read_bytes + b.write_bytes
+        return r / window, w / window, by / window
 
 
 class _Footprint:
@@ -365,6 +562,9 @@ class KvService:
         # drained source silently reverts to accepting everything.
         self._owned: list | None | str = "unloaded"
         self._frozen: tuple[bytes, bytes, float] | None | str = "unloaded"
+        # per-range load accounting (kv/distributor.py pulls it via
+        # Kv.range_stats); cheap enough to run unconditionally
+        self.load = RangeLoadTracker()
 
     def ensure_decision_gc(self) -> None:
         """Start the decision-record GC loop (primary-only duty); called at
@@ -415,11 +615,14 @@ class KvService:
         self._check_read_owned(req.keys)
         ver = req.version if req.version >= 0 \
             else self.engine.current_version()
+        import time as _time
+        now = _time.time()
         values, found = [], []
         for k in req.keys:
             v = self.engine.read_at(k, ver)
             found.append(v is not None)
             values.append(v if v is not None else b"")
+            self.load.note_read(k, len(k) + len(values[-1]), now)
         return KvReadRsp(version=ver, values=values, found=found), b""
 
     @rpc_method
@@ -429,6 +632,14 @@ class KvService:
         ver = req.version if req.version >= 0 \
             else self.engine.current_version()
         rows = self.engine.range_at(req.begin, req.end, ver, req.limit)
+        if rows:
+            import time as _time
+            # charge the scan to the range's FIRST user row (one op, the
+            # scanned bytes) — per-row op counts would make one readdir
+            # look like a thousand point reads
+            self.load.note_read(rows[0][0],
+                                sum(len(k) + len(v) for k, v in rows),
+                                _time.time())
         return KvRangeRsp(version=ver, keys=[k for k, _ in rows],
                           values=[v for _, v in rows]), b""
 
@@ -647,6 +858,64 @@ class KvService:
                     StatusCode.TXN_CONFLICT,
                     f"{hit} conflicts with prepared 2pc txn {txn_id}")
 
+    def _note_writes(self, txn: Transaction) -> None:
+        """Account a user commit's writes (called from commit/prepare
+        admission ONLY — shard_load bulk ingest and internal records are
+        surgery traffic, not load the planner should chase)."""
+        import time as _time
+        now = _time.time()
+        for k, v in txn._writes.items():
+            self.load.note_write(k, len(k) + (len(v) if v else 0), now)
+
+    @rpc_method
+    async def range_stats(self, req: KvRangeStatsReq, payload, conn):
+        """Per-range load + size report for the distributor.  Rebuckets
+        to the caller-supplied bounds (clamped: a range the map assigns
+        elsewhere just reads zero here) so rates align with the live
+        map, then reports decayed rates, sizes, and split suggestions."""
+        import math
+        import time as _time
+        self._require_primary()
+        if req.begins:
+            self.load.set_bounds(list(zip(req.begins, req.ends)))
+        now = _time.time()
+        window = RangeLoadTracker.HALF_LIFE_S / math.log(2)
+        rsp = KvRangeStatsRsp()
+        ver = self.engine.current_version()
+        for b in self.load.buckets:
+            b.decay(now, RangeLoadTracker.HALF_LIFE_S)
+            rsp.begins.append(b.begin)
+            rsp.ends.append(b.end)
+            rsp.read_ops_s.append(b.read_ops / window)
+            rsp.write_ops_s.append(b.write_ops / window)
+            rsp.read_bytes_s.append(b.read_bytes / window)
+            rsp.write_bytes_s.append(b.write_bytes / window)
+            if req.include_sizes:
+                rows = self.engine.range_at(
+                    max(b.begin, self._USER_FLOOR), b.end, ver)
+                rsp.rows.append(len(rows))
+                rsp.approx_bytes.append(
+                    sum(len(k) + len(v) for k, v in rows))
+            else:
+                rsp.rows.append(-1)
+                rsp.approx_bytes.append(-1)
+            rsp.split_keys.append(b.split_key())
+        return rsp, b""
+
+    def export_load_gauges(self, group: str = "") -> None:
+        """Register this group's load with the monitor.  The metrics
+        registry is NAME-keyed, so in-process multi-group deployments
+        (LocalCluster) pass a distinct `group` suffix; kv_main's one
+        service per process uses the bare names."""
+        from t3fs.utils.metrics import CallbackGauge
+        sfx = f".{group}" if group else ""
+        CallbackGauge(f"kv.range.reads{sfx}", lambda: self.load.totals()[0],
+                      tags={"group": group} if group else None)
+        CallbackGauge(f"kv.range.writes{sfx}", lambda: self.load.totals()[1],
+                      tags={"group": group} if group else None)
+        CallbackGauge(f"kv.range.bytes{sfx}", lambda: self.load.totals()[2],
+                      tags={"group": group} if group else None)
+
     def _txn_from_req(self, req: KvCommitReq) -> Transaction:
         txn = Transaction(self.engine, read_version=req.read_version)
         for k in req.read_keys:
@@ -806,6 +1075,7 @@ class KvService:
                 # nothing to pipeline once the reads proved valid
                 return KvCommitRsp(
                     version=self.engine.current_version()), b""
+            self._note_writes(txn)
             entry = self._enqueue_locked(txn)
         await self._await_entry(entry)
         return KvCommitRsp(version=entry.version), b""
@@ -840,6 +1110,7 @@ class KvService:
             self._check_footprints(txn)
             self._check_pipeline(txn)
             self.engine.check_conflicts(txn)
+            self._note_writes(txn)
             rec = Transaction(self.engine,
                               read_version=self.engine.current_version())
             rec._writes[PREP_PREFIX + req.txn_id.encode()] = \

@@ -56,6 +56,34 @@ from t3fs.utils.status import StatusCode, StatusError, make_error
 
 log = logging.getLogger("t3fs.kv.shard")
 
+
+class _ShardClientStats:
+    """Process-wide sharded-client observability: map refreshes were
+    invisible before — a surgery could flip the map and nothing in the
+    monitor moved.  Module-level singleton + gauges (the metrics registry
+    is name-keyed; the rdma.py idiom)."""
+
+    def __init__(self):
+        self.map_version = 0           # highest map version seen
+        self.wrong_shard_bounces = 0   # KV_WRONG_SHARD/KV_SHARD_FROZEN hits
+        self.map_refreshes = 0         # refreshes that actually changed it
+
+
+SHARD_STATS = _ShardClientStats()
+
+
+def _register_shard_gauges() -> None:
+    from t3fs.utils.metrics import CallbackGauge
+    CallbackGauge("kv.shard.map_version",
+                  lambda: SHARD_STATS.map_version)
+    CallbackGauge("kv.shard.wrong_shard_bounces",
+                  lambda: SHARD_STATS.wrong_shard_bounces)
+    CallbackGauge("kv.shard.map_refreshes",
+                  lambda: SHARD_STATS.map_refreshes)
+
+
+_register_shard_gauges()
+
 KEY_MAX = b"\xff" * 17          # beyond any real key (prefix keys are short)
 
 # map-home record: the authoritative versioned ShardMap lives in the KV
@@ -145,6 +173,7 @@ class ShardedTransaction:
         except StatusError as e:
             if e.code in (StatusCode.KV_WRONG_SHARD,
                           StatusCode.KV_SHARD_FROZEN):
+                SHARD_STATS.wrong_shard_bounces += 1
                 try:
                     await self.engine.refresh_map()
                 except Exception as re:   # map home briefly unreachable:
@@ -349,6 +378,8 @@ class ShardedKVEngine(KVEngine):
                  timeout_s: float = 15.0,
                  map_home: list[str] | None = None):
         self.map = shard_map.validate()
+        SHARD_STATS.map_version = max(SHARD_STATS.map_version,
+                                      self.map.version)
         self.client = client or Client()
         self.timeout_s = timeout_s
         # map home: addresses of the (never-moving) group holding the
@@ -379,6 +410,8 @@ class ShardedKVEngine(KVEngine):
             return False
         self.map = new.validate()
         self._rebuild_groups()
+        SHARD_STATS.map_version = max(SHARD_STATS.map_version, new.version)
+        SHARD_STATS.map_refreshes += 1
         log.info("shard map refreshed to v%d (%d ranges)",
                  new.version, len(new.ranges))
         return True

@@ -60,17 +60,28 @@ class MoveIntent:
     end: bytes = b""
     src: list[str] = field(default_factory=list)
     dst: list[str] = field(default_factory=list)
+    # "move" | "merge" — APPENDED last: serde cross-version compat is
+    # positional, and an old driver resuming a new intent must still
+    # decode the fields it knows
+    kind: str = "move"
 
 
 class ShardAdmin:
-    """Admin-side surgery driver over the map home + shard groups."""
+    """Admin-side surgery driver over the map home + shard groups.
+
+    `budget_mbps` paces the move snapshot-copy page loop with the shared
+    TokenBucketPacer discipline (waits are backpressure, never errors) so
+    a bulk move can't starve foreground metadata traffic; 0 disables."""
 
     def __init__(self, map_home: list[str], client: Client | None = None,
-                 page_rows: int = 1024, freeze_ttl_s: float = 30.0):
+                 page_rows: int = 1024, freeze_ttl_s: float = 30.0,
+                 budget_mbps: float = 0.0):
+        from t3fs.client.repair import TokenBucketPacer
         self.map_home = list(map_home)
         self.client = client or Client()
         self.page_rows = page_rows
         self.freeze_ttl_s = freeze_ttl_s
+        self.pacer = TokenBucketPacer(budget_mbps, floor_bytes=1)
         self._home = RemoteKVEngine(self.map_home, client=self.client)
 
     # --- map-home records ---
@@ -168,16 +179,126 @@ class ShardAdmin:
         await self._put_intent(None)
         return out
 
+    async def merge(self, begin: bytes, end: bytes,
+                    move_first: bool = False) -> ShardMap:
+        """Merge the two adjacent map ranges spanning EXACTLY [begin, end)
+        back into one — the inverse of split.  Same-group merges are
+        map-only (one CAS publish + an idempotent owned re-assert); when
+        the halves live on different groups the merge refuses unless
+        `move_first`, which first runs a full durable move of the right
+        half onto the left's group (its own intent lifecycle — never two
+        intents pending at once; a crash mid-move resumes as a move, and
+        the next planner tick re-notices the now-same-group merge)."""
+        m = await self.load_map()
+        span = [r for r in m.ranges if r.begin < end and r.end > begin]
+        if len(span) == 1 and (span[0].begin, span[0].end) == (begin, end):
+            return m                      # already one range: idempotent
+        if (len(span) != 2 or span[0].begin != begin
+                or span[-1].end != end):
+            raise make_error(
+                StatusCode.INVALID_ARG,
+                f"[{begin!r},{end!r}) does not span exactly two map "
+                f"ranges (map v{m.version})")
+        left, right = span
+        if sorted(left.addresses) != sorted(right.addresses):
+            if not move_first:
+                raise make_error(
+                    StatusCode.INVALID_ARG,
+                    f"halves live on different groups ({left.addresses} "
+                    f"vs {right.addresses}); pass move_first or move one")
+            await self.move(right.begin, right.end, list(left.addresses))
+            m = await self.load_map()
+        pending = await self._load_intent()
+        if pending is not None and \
+                (pending.begin, pending.end, pending.kind) != \
+                (begin, end, "merge"):
+            raise make_error(
+                StatusCode.BUSY,
+                f"another surgery ({pending.kind} "
+                f"[{pending.begin!r},{pending.end!r})) is pending; "
+                f"resume it first")
+        intent = MoveIntent(begin=begin, end=end,
+                            src=list(left.addresses),
+                            dst=list(left.addresses), kind="merge")
+        await self._put_intent(intent)
+        out = await self._drive_merge(await self.load_map(), intent)
+        await self._put_intent(None)
+        return out
+
     async def resume(self) -> ShardMap | None:
-        """Finish a move whose driver died mid-way (the chaos path); None
-        when no intent is pending."""
+        """Finish a surgery whose driver died mid-way (the chaos path);
+        None when no intent is pending."""
         intent = await self._load_intent()
         if intent is None:
             return None
         m = await self.load_map()
-        out = await self._drive(m, intent)
+        if intent.kind == "merge":
+            out = await self._drive_merge(m, intent)
+        else:
+            out = await self._drive(m, intent)
         await self._put_intent(None)
         return out
+
+    async def _drive_merge(self, m: ShardMap,
+                           intent: MoveIntent) -> ShardMap:
+        """Idempotent merge executor: every step re-derived from the map
+        just loaded.  No data moves and the owned UNION is unchanged, so
+        there is no freeze and no unavailability window — the only
+        ordered steps are the CAS map publish and an owned re-assert
+        (which a crash can skip and resume repeats harmlessly)."""
+        begin, end = intent.begin, intent.end
+        span = [r for r in m.ranges if r.begin < end and r.end > begin]
+        if len(span) == 1 and (span[0].begin, span[0].end) == (begin, end):
+            # map already merged (we crashed after publish): re-assert
+            # owned so the group's record collapses to the merged bounds
+            await self._group(span[0].addresses)._call(
+                "Kv.shard_set_owned",
+                self._owned_req(m, list(span[0].addresses)))
+            return m
+        if (len(span) != 2 or span[0].begin != begin
+                or span[-1].end != end):
+            raise make_error(
+                StatusCode.INVALID_ARG,
+                f"[{begin!r},{end!r}) is no longer two exact map ranges; "
+                f"resolve the merge intent manually (map v{m.version})")
+        left, right = span
+        if sorted(left.addresses) != sorted(right.addresses):
+            raise make_error(
+                StatusCode.INVALID_ARG,
+                f"merge halves diverged onto different groups "
+                f"({left.addresses} vs {right.addresses}); move first")
+        merged = ShardRange(begin, end, list(left.addresses))
+        new_map = ShardMap(
+            ranges=[merged if r is left else r
+                    for r in m.ranges if r is not right],
+            version=m.version + 1)
+        await self.publish_map(new_map, base_version=m.version)
+        await self._group(left.addresses)._call(
+            "Kv.shard_set_owned",
+            self._owned_req(new_map, list(left.addresses)))
+        log.info("merged [%r,%r) on %s, map v%d", begin, end,
+                 left.addresses, new_map.version)
+        return new_map
+
+    async def _paced(self, nbytes: int, src_g: RemoteKVEngine,
+                     freeze: KvShardRangeReq) -> None:
+        """Charge a copied page to the byte budget, waiting in
+        freeze-safe slices: each slice's wait is bounded well under the
+        freeze TTL and the freeze is re-extended before the next, so a
+        tight budget slows the copy down (backpressure, never an error)
+        without ever letting the source thaw mid-copy — a lapsed freeze
+        would accept writes into already-copied pages, which the map
+        flip then silently loses."""
+        if self.pacer.rate <= 0:
+            return
+        slice_bytes = max(1, int(self.pacer.rate * self.freeze_ttl_s / 4))
+        off = 0
+        while off < nbytes:
+            take = min(slice_bytes, nbytes - off)
+            await self.pacer.acquire(take)
+            off += take
+            if off < nbytes:
+                await src_g._call("Kv.shard_freeze", freeze)
 
     async def _drive(self, m: ShardMap, intent: MoveIntent) -> ShardMap:
         begin, end = intent.begin, intent.end
@@ -215,6 +336,10 @@ class ShardAdmin:
                 await src_g._call("Kv.shard_freeze", freeze)  # extend TTL
                 if len(rsp.keys) < self.page_rows:
                     break
+                await self._paced(
+                    sum(len(k) + len(v)
+                        for k, v in zip(rsp.keys, rsp.values)),
+                    src_g, freeze)
                 cursor = rsp.keys[-1] + b"\x00"
             # target's full owned list under the NEW map
             new_map = ShardMap(

@@ -25,6 +25,7 @@ import logging
 import os
 import struct
 import threading
+import time
 import zlib
 
 from t3fs.kv.engine import KVEngine, MemKVEngine, Transaction
@@ -84,11 +85,25 @@ class WalKVEngine(MemKVEngine):
     "os" leaves flushing to the page cache (durable vs process crash)."""
 
     def __init__(self, root: str, *, sync: str = "always",
-                 compact_threshold_bytes: int = 8 << 20):
+                 compact_threshold_bytes: int = 8 << 20,
+                 rate_mbps: float = 0.0):
         super().__init__()
         assert sync in ("always", "os")
         self.root = root
         self.sync = sync
+        # write-bandwidth budget (<=0 disables): WAL appends draw from a
+        # byte token bucket and SLEEP off any deficit under _io_lock, so
+        # later appends queue behind the wait — the shape of a
+        # bandwidth-capped volume (cloud disks meter MB/s per volume;
+        # a range-sharded deployment multiplies aggregate budget by
+        # adding volumes, which is what the KV distributor load-balances)
+        self.rate_mbps = rate_mbps
+        self._rate_bytes_s = rate_mbps * 1e6
+        self._rate_capacity = max(self._rate_bytes_s, 1.0)  # ~1s of burst
+        self._rate_tokens = self._rate_capacity
+        self._rate_stamp: float | None = None
+        self.rate_waits = 0
+        self.rate_waited_s = 0.0
         self.compact_threshold_bytes = compact_threshold_bytes
         os.makedirs(root, exist_ok=True)
         self.snap_path = os.path.join(root, "kv.snap")
@@ -288,6 +303,29 @@ class WalKVEngine(MemKVEngine):
         if tokens is not None and self.sync == "always":
             self._commit_phase_b(*tokens)
 
+    def _charge_rate(self, nbytes: int) -> None:
+        """Caller holds _io_lock (commit-pool thread: blocking sleep is
+        fine, the event loop never runs here).  TokenBucketPacer shape —
+        a deficit is slept off, never an error."""
+        if self._rate_bytes_s <= 0:
+            return
+        now = time.monotonic()
+        if self._rate_stamp is not None:
+            self._rate_tokens = min(
+                self._rate_capacity,
+                self._rate_tokens
+                + (now - self._rate_stamp) * self._rate_bytes_s)
+        self._rate_stamp = now
+        take = min(float(nbytes), self._rate_capacity)
+        if self._rate_tokens < take:
+            wait = (take - self._rate_tokens) / self._rate_bytes_s
+            self.rate_waits += 1
+            self.rate_waited_s += wait
+            time.sleep(wait)
+            self._rate_stamp = time.monotonic()
+            self._rate_tokens = take     # earned exactly the deficit
+        self._rate_tokens -= take
+
     def _commit_phase_a(self, txn: Transaction) -> tuple | None:
         end_pos = epoch = gen = my_version = None
         with self._io_lock:
@@ -313,6 +351,7 @@ class WalKVEngine(MemKVEngine):
                         "WAL is failed (earlier append error); "
                         "reopen the engine")
                 payload = _pack_batch(writes, clears)
+                self._charge_rate(_FRAME_HDR.size + len(payload))
                 pos = self._wal.tell()
                 try:
                     self._wal.write(_FRAME_HDR.pack(len(payload),
@@ -546,7 +585,11 @@ class WalKVEngine(MemKVEngine):
 def open_kv_engine(spec: str) -> KVEngine:
     """HybridKvEngine-style selector (HybridKvEngine.h:13-31):
       "mem"                       in-memory SSI engine (tests, single node)
-      "wal:/path[?sync=os]"       durable WAL+snapshot engine at /path
+      "wal:/path[?sync=os][&rate_mbps=N]"
+                                  durable WAL+snapshot engine at /path;
+                                  rate_mbps caps WAL append bandwidth
+                                  (a per-volume budget: appends queue
+                                  behind the token bucket)
       "remote:host:p,host:p"      replicated KvService deployment
                                   (CustomKvEngine cluster_endpoints analog)
       "shards:a:p,a:p;<hexkey>;a:p,..."
@@ -583,11 +626,14 @@ def open_kv_engine(spec: str) -> KVEngine:
     if spec.startswith("wal:"):
         rest = spec[4:]
         sync = "always"
+        rate_mbps = 0.0
         if "?" in rest:
             rest, q = rest.split("?", 1)
             for part in q.split("&"):
                 k, _, v = part.partition("=")
                 if k == "sync":
                     sync = v
-        return WalKVEngine(rest, sync=sync)
+                elif k == "rate_mbps":
+                    rate_mbps = float(v)
+        return WalKVEngine(rest, sync=sync, rate_mbps=rate_mbps)
     raise ValueError(f"unknown kv engine spec: {spec!r}")
