@@ -21,7 +21,8 @@ SAN_FILTER := -k "not device"
         on-device ci ckpt-bench write-bench read-bench \
         kvcache-fleet-bench repair-drill usrbio-bench soak soak-smoke \
         health-smoke health-bench rebalance-drill rebalance-smoke \
-        kv-distributor-bench kv-distributor-smoke
+        kv-distributor-bench kv-distributor-smoke \
+        kvcache-scale-bench kvcache-scale-smoke
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -50,12 +51,30 @@ read-bench:
 	JAX_PLATFORMS=cpu $(PY) -m benchmarks.storage_bench --read-ab \
 		--chunk-size 65536 --replicas 3 --num-ops 120
 
-# KVCache serving-tier fleet bench (ISSUE 7): 4 worker processes x 256
-# concurrent zipf sessions against one namespace, write-behind ON/OFF
-# A/B plus the GC removal-IOPS phase, one JSON blob.
+# KVCache serving-tier fleet bench (ISSUE 7, extended by ISSUE 20):
+# 6 worker processes x 512 concurrent zipf sessions against one
+# namespace, write-behind ON/OFF A/B, the GC removal-IOPS phase, and
+# the admission-plane A/B (shm arena host scope vs per-process
+# semaphores; ASSERTS the host-wide in-flight bound held), one JSON
+# blob.
 kvcache-fleet-bench:
 	JAX_PLATFORMS=cpu $(PY) -m benchmarks.kvcache_fleet_bench \
-		--procs 4 --sessions 256 --turns 2 --json
+		--procs 6 --sessions 512 --turns 2 --admit-window 64 \
+		--admission-ab --json
+
+# KVCache scale bench (ISSUE 20): >= 100k live sessions, zipf tenant
+# skew over sharded admission, ring data plane; replay-time/p99 curves
+# vs session count plus the ledger-compaction A/B with a concurrent
+# writer (gates: zero wrong bytes, zero lost keys, >= 5x faster replay
+# at equal history depth).
+kvcache-scale-bench:
+	JAX_PLATFORMS=cpu $(PY) -m benchmarks.kvcache_scale_bench --json
+
+# CI-sized: short zipf storm + one forced compaction cycle; same
+# correctness gates (zero wrong bytes, bounded replay), timing gate off.
+kvcache-scale-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m benchmarks.kvcache_scale_bench \
+		--smoke --json
 
 # Ring-vs-rpc data plane A/B (ISSUE 12): 4 KiB random reads at qd64
 # through the USRBIO shm ring, rpc batch path vs the registered-arena

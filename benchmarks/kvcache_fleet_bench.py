@@ -17,8 +17,16 @@ removal IOPS by evicting the namespace down to half its live bytes.
 The run is an A/B: write-behind ON vs OFF (same fleet, fresh namespace
 per side) — the put p50 delta is the number the tier exists for.
 
-    python -m benchmarks.kvcache_fleet_bench --procs 4 --sessions 256 \
-        --turns 2 --json            # the BENCH_e2e.json configuration
+``--admission-ab`` adds a second A/B over the admission plane: the same
+fleet with ``admit_scope=host`` (one shm token arena for every process)
+vs ``admit_scope=process`` (the historical per-process semaphores).
+The host cell ASSERTS the host-wide in-flight bound held — the arena's
+peak can never exceed the configured window; the process cell measures
+how far N private windows over-admit (time-bucketed sum of concurrent
+holders across processes).
+
+    python -m benchmarks.kvcache_fleet_bench --procs 6 --sessions 512 \
+        --turns 2 --admission-ab --json   # the BENCH_e2e.json config
     python -m benchmarks.kvcache_fleet_bench --procs 2 --sessions 8 \
         --turns 1 --prompts 16 --blocks 4 --json    # smoke (CI)
 """
@@ -32,6 +40,7 @@ import multiprocessing as mp
 import random
 import sys
 import time
+import uuid
 
 
 # ---------------- routing over process boundaries ----------------
@@ -110,23 +119,43 @@ async def _worker_async(proc_idx: int, routing_blob: dict,
     cli = Client()
     cli.add_service(BufferRegistry())
     sc = StorageClient(lambda: routing, client=cli)
+    admit_window = args.admit_window or args.sessions * 2
     cfg = KVCacheTierConfig(
         block_size=1 << (args.value_size + 256 - 1).bit_length(),
         write_behind=wb_mode, lanes=max(32, args.procs),
-        hit_sample=8, admit_window=args.sessions * 2)
+        hit_sample=8, admit_window=admit_window,
+        # class windows must not bind tighter than the namespace window
+        # under a small --admit-window, or the A/B measures the wrong cap
+        admit_class_windows=(admit_window, admit_window, admit_window),
+        admit_scope=args.admit_scope,
+        admit_group=getattr(args, "admit_group", ""))
     tier = KVCacheTier(sc, chain_ids, namespace=namespace, config=cfg,
                        writer_id=proc_idx)
     await tier.start()
     lat_get: list = []
     lat_put: list = []
     counters = {"hits": 0, "misses": 0}
+
+    # time-bucketed holder samples: the process cell's over-admission is
+    # only visible as CONCURRENT holders summed across processes
+    held_samples: list = []
+
+    async def _sample_held() -> None:
+        while True:
+            held_samples.append((time.time(), tier.admission.held_now))
+            await asyncio.sleep(0.002)
+
+    sampler = asyncio.create_task(_sample_held())
     t0 = time.perf_counter()
     await asyncio.gather(*(
         _session(tier, proc_idx * args.sessions + s, args,
                  lat_get, lat_put, counters)
         for s in range(args.sessions)))
     elapsed = time.perf_counter() - t0
+    sampler.cancel()
     stats = tier.stats()
+    adm = stats["admission"]
+    host_peak = tier.plane.host_peak(tier.admission.shard)
     await tier.stop()
     await sc.close()
     rng = random.Random(proc_idx)
@@ -140,6 +169,9 @@ async def _worker_async(proc_idx: int, routing_blob: dict,
         "coalesced": stats.get("write_behind", {}).get("coalesced", 0),
         "backpressure": stats.get("write_behind", {})
                              .get("backpressure_waits", 0),
+        "adm_scope": adm["scope"], "adm_peak_held": adm["peak_held"],
+        "adm_waits": adm["waits"], "adm_host_peak": host_peak,
+        "held_samples": held_samples[:20000],
     })
 
 
@@ -157,6 +189,22 @@ def _pctl(samples: list, q: float) -> float:
         return 0.0
     s = sorted(samples)
     return s[min(int(len(s) * q), len(s) - 1)]
+
+
+def _concurrent_held_peak(results: list, bucket_s: float = 0.01) -> int:
+    """Peak of (sum across processes of concurrent admission holders),
+    from the workers' time-bucketed samples — the honest cross-process
+    concurrency measure (summing per-proc peaks would conflate peaks
+    from different moments)."""
+    buckets: dict[int, int] = {}
+    for r in results:
+        per: dict[int, int] = {}
+        for t, held in r.get("held_samples", []):
+            b = int(t / bucket_s)
+            per[b] = max(per.get(b, 0), held)
+        for b, held in per.items():
+            buckets[b] = buckets.get(b, 0) + held
+    return max(buckets.values(), default=0)
 
 
 def _run_fleet(routing_blob, chain_ids, args, wb_mode: str,
@@ -194,7 +242,54 @@ def _run_fleet(routing_blob, chain_ids, args, wb_mode: str,
         "wall_s": round(elapsed, 2),
         "coalesced": sum(r["coalesced"] for r in results),
         "backpressure_waits": sum(r["backpressure"] for r in results),
+        "adm_scope": results[0].get("adm_scope", "process"),
+        "adm_waits": sum(r.get("adm_waits", 0) for r in results),
+        "adm_host_peak": max(r.get("adm_host_peak", 0) for r in results),
+        "adm_concurrent_held_peak": _concurrent_held_peak(results),
     }
+
+
+def _run_admission_ab(routing_blob, chain_ids, args) -> dict:
+    """Same fleet, admit_scope host vs process, small shared window.
+    Host cell: ASSERT the arena never admitted past the host-wide
+    window.  Process cell: measure how far N private windows over-admit
+    (the N× cliff this plane removes)."""
+    window = args.admit_window or 32
+    out = {"window": window, "procs": args.procs}
+    group = f"t3fs-fleet-{uuid.uuid4().hex[:12]}"
+    for scope in ("host", "process"):
+        cell_args = argparse.Namespace(**{
+            **vars(args), "admit_window": window, "admit_scope": scope,
+            "admit_group": group if scope == "host" else ""})
+        ns = f"adm-{args.seed}-{scope}"
+        cell = _run_fleet(routing_blob, chain_ids, cell_args, "on", ns)
+        out[scope] = {
+            "scope_effective": cell["adm_scope"],
+            "host_peak": cell["adm_host_peak"],
+            "concurrent_held_peak": cell["adm_concurrent_held_peak"],
+            "waits": cell["adm_waits"],
+            "put_p99_ms": cell["put_p99_ms"],
+            "get_p99_ms": cell["get_p99_ms"],
+        }
+    try:
+        from t3fs.usrbio.slots import ShmTokenArena
+        ShmTokenArena(group).unlink()
+    except Exception:
+        pass
+    host = out["host"]
+    # the tentpole's contract: N processes stay within ONE window
+    out["bound_held"] = (host["scope_effective"] == "host"
+                        and 0 < host["host_peak"] <= window)
+    out["process_over_admitted"] = (
+        out["process"]["concurrent_held_peak"] > window)
+    out["over_admission_x"] = round(
+        out["process"]["concurrent_held_peak"] / max(1, window), 2)
+    if not out["bound_held"]:
+        raise AssertionError(
+            f"host-scope admission exceeded the host-wide bound: "
+            f"peak {host['host_peak']} > window {window} "
+            f"(scope_effective={host['scope_effective']})")
+    return out
 
 
 async def _gc_phase(fab, chain_ids, args, namespace: str) -> dict:
@@ -259,6 +354,9 @@ async def run_bench(args) -> dict:
         on, off = out["fleet"]["on"], out["fleet"]["off"]
         out["put_p50_speedup"] = round(
             off["put_p50_ms"] / max(1e-9, on["put_p50_ms"]), 2)
+        if args.admission_ab:
+            out["admission"] = await loop.run_in_executor(
+                None, _run_admission_ab, blob, fab.chain_ids, args)
         return out
     finally:
         await fab.stop()
@@ -281,6 +379,13 @@ def parse_args(argv=None):
     ap.add_argument("--chains", type=int, default=8)
     ap.add_argument("--gc-batch", type=int, default=128)
     ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--admit-window", type=int, default=0,
+                    help="namespace admission window (0 = sessions*2)")
+    ap.add_argument("--admit-scope", choices=("process", "host"),
+                    default="process")
+    ap.add_argument("--admission-ab", action="store_true",
+                    help="run the host-vs-process admission A/B and "
+                         "assert the host-wide bound held")
     ap.add_argument("--json", action="store_true")
     return ap.parse_args(argv)
 

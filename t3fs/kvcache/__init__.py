@@ -1,25 +1,33 @@
 """KVCache serving tier: sessions, TTL/capacity eviction, write-behind
-batching — layered on the raw block store (t3fs/lib/kvcache.py).
+batching, ledger compaction, and a cross-process admission plane —
+layered on the raw block store (t3fs/lib/kvcache.py).
 
 See docs/kvcache.md for the design; benchmarks/kvcache_fleet_bench.py
-drives it at inference-fleet scale.
+and benchmarks/kvcache_scale_bench.py drive it at inference-fleet scale.
 """
 
+from t3fs.kvcache.admission import (
+    AdmissionConfig, AdmissionController, AdmissionPlane, resolve_plane,
+)
+from t3fs.kvcache.compact import CompactionConfig, LedgerCompactor
 from t3fs.kvcache.gc import EvictionConfig, EvictionWorker
 from t3fs.kvcache.ledger import (
-    DEFAULT_LANES, OP_DEL, OP_HIT, OP_PUT, LedgerReader, LedgerRecord,
-    LedgerTable, LedgerWriter, ledger_inode, segment_chunk,
+    DEFAULT_LANES, OP_DEL, OP_HIT, OP_PUT, LedgerCheckpoint, LedgerReader,
+    LedgerRecord, LedgerTable, LedgerWriter, checkpoint_chunk, ledger_inode,
+    read_checkpoint, segment_chunk, write_checkpoint,
 )
 from t3fs.kvcache.tier import (
-    AdmissionController, KVCacheTier, KVCacheTierConfig,
-    render_kvcache_stats,
+    KVCacheTier, KVCacheTierConfig, render_kvcache_stats,
 )
 from t3fs.kvcache.writebehind import WriteBehind, WriteBehindConfig
 
 __all__ = [
-    "AdmissionController", "DEFAULT_LANES", "EvictionConfig",
-    "EvictionWorker", "KVCacheTier", "KVCacheTierConfig", "LedgerReader",
-    "LedgerRecord", "LedgerTable", "LedgerWriter", "OP_DEL", "OP_HIT",
-    "OP_PUT", "WriteBehind", "WriteBehindConfig", "ledger_inode",
-    "render_kvcache_stats", "segment_chunk",
+    "AdmissionConfig", "AdmissionController", "AdmissionPlane",
+    "CompactionConfig", "DEFAULT_LANES", "EvictionConfig",
+    "EvictionWorker", "KVCacheTier", "KVCacheTierConfig",
+    "LedgerCheckpoint", "LedgerCompactor", "LedgerReader", "LedgerRecord",
+    "LedgerTable", "LedgerWriter", "OP_DEL", "OP_HIT", "OP_PUT",
+    "WriteBehind", "WriteBehindConfig", "checkpoint_chunk", "ledger_inode",
+    "read_checkpoint", "render_kvcache_stats", "resolve_plane",
+    "segment_chunk", "write_checkpoint",
 ]

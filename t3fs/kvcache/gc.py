@@ -23,6 +23,17 @@ replay converges without coordination.
 Removals are paced by a token bucket (``remove_rate`` removals/s,
 ``remove_burst`` bucket depth) so GC never competes with serving traffic
 for chain IOPS — the knob the reference tunes as "GC removal IOPS".
+The same ``_TokenBucket`` paces the ledger compactor's segment
+retirement (t3fs/kvcache/compact.py).
+
+GC and compaction compose without coordination: GC's DEL tombstones are
+ordinary ledger records, so a compaction pass folds them into its LWW
+replay (dead entries simply don't get re-emitted), and a tombstone GC
+appends *during* a compaction pass lands at the writer's tail — above
+every base the compactor will checkpoint — so it survives retirement.
+The crashed-GC convergence story (probe → absent → tombstone) is
+unchanged by compaction because it never depended on ledger history,
+only on the data plane's ground truth.
 """
 
 from __future__ import annotations

@@ -28,8 +28,20 @@ replay then still lists the keys, the next eviction pass probes them,
 finds them absent, and appends the tombstones — the table converges
 (idempotent recovery, exercised in tests/test_kvcache_tier.py).
 
-Segments are never compacted in this revision; the log is bounded in
-practice by eviction churn and namespaces are cheap to retire wholesale.
+Compaction (t3fs/kvcache/compact.py) bounds replay to O(live keys): a
+per-namespace **checkpoint chunk** at a reserved index records each
+lane's ``base`` seq — the first live segment.  Attach recovery starts
+its binary search at the base (absent() is only monotone from there),
+and readers jump a frontier that fell below a lane's base (the retired
+prefix's live content was re-emitted at the writer's tail before the
+base moved, so nothing is lost).  Re-emitted records carry their
+ORIGINAL ts, so replaying them twice is idempotent under the ts-ordered
+last-writer-wins table — the property every compaction crash-resume
+path leans on.
+
+Hot keys are HIT-coalesced at the writer: per-key HITs buffered within
+one flush window collapse to a single record carrying the max ts, so a
+popular prefix stops bloating the log even before compaction runs.
 """
 
 from __future__ import annotations
@@ -66,6 +78,88 @@ def ledger_inode(namespace: str) -> int:
 
 def segment_chunk(inode: int, lane: int, seq: int) -> ChunkId:
     return ChunkId(inode, (lane << 32) | seq)
+
+
+# ---------------------------------------------------------------------------
+# Compaction checkpoint: per-lane base seqs in one reserved chunk
+# ---------------------------------------------------------------------------
+
+# reserved "lane" for the checkpoint chunk — real lanes are tiny ints
+# (writer_id % lanes), so this index can never collide with a segment
+CKPT_LANE = 0xFFFFFFFF
+_CKPT_MAGIC = 0x7C3FC4D7
+_CKPT_HDR = struct.Struct("<IQII")      # magic, version, compactions, nlanes
+_CKPT_REC = struct.Struct("<II")        # lane, base
+
+
+def checkpoint_chunk(inode: int) -> ChunkId:
+    return ChunkId(inode, CKPT_LANE << 32)
+
+
+@dataclass
+class LedgerCheckpoint:
+    """What compaction has retired: lane -> first live seq (``base``).
+    Lanes absent from ``bases`` start at 0.  ``version`` increments on
+    every write; ``compactions`` counts completed compaction passes."""
+
+    version: int = 0
+    compactions: int = 0
+    bases: dict[int, int] = field(default_factory=dict)
+
+    def base(self, lane: int) -> int:
+        return self.bases.get(lane, 0)
+
+
+def pack_checkpoint(ckpt: LedgerCheckpoint) -> bytes:
+    parts = [_CKPT_HDR.pack(_CKPT_MAGIC, ckpt.version, ckpt.compactions,
+                            len(ckpt.bases))]
+    for lane in sorted(ckpt.bases):
+        parts.append(_CKPT_REC.pack(lane, ckpt.bases[lane]))
+    return b"".join(parts)
+
+
+def parse_checkpoint(blob: bytes) -> LedgerCheckpoint:
+    """Torn/foreign blobs parse to the empty checkpoint (all bases 0):
+    pre-compaction namespaces and a torn write both degrade to 'nothing
+    retired yet', which is always safe — never a fault."""
+    if len(blob) < _CKPT_HDR.size:
+        return LedgerCheckpoint()
+    magic, version, compactions, nlanes = _CKPT_HDR.unpack_from(blob)
+    if magic != _CKPT_MAGIC:
+        return LedgerCheckpoint()
+    bases: dict[int, int] = {}
+    off = _CKPT_HDR.size
+    for _ in range(nlanes):
+        if off + _CKPT_REC.size > len(blob):
+            return LedgerCheckpoint()
+        lane, base = _CKPT_REC.unpack_from(blob, off)
+        bases[lane] = base
+        off += _CKPT_REC.size
+    return LedgerCheckpoint(version, compactions, bases)
+
+
+async def read_checkpoint(store: KVCacheStore) -> LedgerCheckpoint:
+    inode = ledger_inode(store.namespace)
+    ios = [ReadIO(chunk_id=checkpoint_chunk(inode),
+                  chain_id=store.chains[0], offset=0, length=0)]
+    results, payloads = await store.client.batch_read(ios)
+    code = StatusCode(results[0].status.code)
+    if code == StatusCode.OK:
+        return parse_checkpoint(payloads[0])
+    if code == StatusCode.CHUNK_NOT_FOUND:
+        return LedgerCheckpoint()
+    raise StatusError(code, results[0].status.message)
+
+
+async def write_checkpoint(store: KVCacheStore,
+                           ckpt: LedgerCheckpoint) -> None:
+    inode = ledger_inode(store.namespace)
+    blob = pack_checkpoint(ckpt)
+    result = await store.client.write_chunk(
+        store.chains[0], checkpoint_chunk(inode), 0, blob, SEGMENT_SIZE)
+    code = StatusCode(result.status.code)
+    if code != StatusCode.OK:
+        raise StatusError(code, result.status.message)
 
 
 @dataclass(frozen=True)
@@ -115,7 +209,9 @@ class LedgerWriter:
 
     ``attach()`` recovers the lane's seq frontier after a restart by
     probing for the first absent segment (doubling + binary search on
-    header-only reads — O(log seq) RPCs, no listing)."""
+    header-only reads — O(log seq) RPCs, no listing), starting at the
+    lane's compaction base (below it, absence is not monotone: retired
+    segments leave holes)."""
 
     def __init__(self, store: KVCacheStore, writer_id: int,
                  lanes: int = DEFAULT_LANES,
@@ -129,9 +225,11 @@ class LedgerWriter:
         self.chain = store.chains[self.lane % len(store.chains)]
         self.seq: int | None = None      # assigned by attach()
         self._buf: list[LedgerRecord] = []
+        self._hits: dict[bytes, LedgerRecord] = {}   # coalesced HITs
         self._buf_bytes = _SEG_HDR.size
         self._flush_lock = asyncio.Lock()
         self.segments_flushed = 0
+        self.hits_coalesced = 0
 
     async def _absent(self, seq: int) -> bool:
         ios = [ReadIO(chunk_id=segment_chunk(self.inode, self.lane, seq),
@@ -144,16 +242,22 @@ class LedgerWriter:
             return True
         raise StatusError(code, results[0].status.message)
 
-    async def attach(self) -> int:
-        """Find the first absent seq on this lane; that's where we write.
-        No holes by construction, so absent(seq) is monotone in seq."""
-        if await self._absent(0):
-            self.seq = 0
-            return 0
-        hi = 1
-        while not await self._absent(hi):
-            hi <<= 1
-        lo = hi >> 1                     # present
+    async def attach(self, base: int | None = None) -> int:
+        """Find the first absent seq on this lane at or past ``base``;
+        that's where we write.  No holes by construction FROM THE BASE,
+        so absent(seq) is monotone there.  ``base=None`` reads the
+        namespace's compaction checkpoint (one chunk read) — callers
+        that already hold the checkpoint pass the lane's base in."""
+        if base is None:
+            base = (await read_checkpoint(self.store)).base(self.lane)
+        if await self._absent(base):
+            self.seq = base
+            return base
+        span = 1
+        while not await self._absent(base + span):
+            span <<= 1
+        lo = base + (span >> 1)          # present
+        hi = base + span
         while hi - lo > 1:
             mid = (lo + hi) // 2
             if await self._absent(mid):
@@ -166,17 +270,29 @@ class LedgerWriter:
     def append(self, op: int, key: bytes, size: int = 0,
                expiry: float = 0.0, *, ts: float) -> bool:
         """Buffer one record; returns True when the buffer crossed the
-        segment size and the caller should flush()."""
+        segment size and the caller should flush().  HITs coalesce: a
+        key already holding a buffered HIT keeps one record at the max
+        ts instead of growing the log."""
         if len(key) > 0xFFFF:
             raise make_error(StatusCode.INVALID_ARG,
                              f"ledger key {len(key)}B exceeds u16 frame")
-        self._buf.append(LedgerRecord(op, key, size, expiry, ts))
+        rec = LedgerRecord(op, key, size, expiry, ts)
+        if op == OP_HIT:
+            cur = self._hits.get(key)
+            if cur is not None:
+                self.hits_coalesced += 1
+                if ts > cur.ts:
+                    self._hits[key] = rec
+                return self._buf_bytes >= self.segment_bytes
+            self._hits[key] = rec
+        else:
+            self._buf.append(rec)
         self._buf_bytes += _REC.size + len(key)
         return self._buf_bytes >= self.segment_bytes
 
     @property
     def buffered(self) -> int:
-        return len(self._buf)
+        return len(self._buf) + len(self._hits)
 
     async def flush(self) -> int:
         """Write all buffered records as segment chunks (splitting if a
@@ -194,6 +310,11 @@ class LedgerWriter:
 
     async def _flush_locked(self) -> int:
         wrote = 0
+        if self._hits:
+            # fold the coalesced HIT window into the outgoing buffer;
+            # intra-lane order is irrelevant (replay sorts by ts)
+            self._buf.extend(self._hits.values())
+            self._hits.clear()
         while self._buf:
             batch: list[LedgerRecord] = []
             nbytes = _SEG_HDR.size
@@ -226,7 +347,13 @@ class LedgerReader:
     Each ``scan()`` batch-reads a window of segments per lane, advances
     the per-lane frontier past every present segment, and returns the
     new records.  Re-scanning is cheap: lanes with no new segments cost
-    one CHUNK_NOT_FOUND read per scan."""
+    one CHUNK_NOT_FOUND read per scan.
+
+    Every scan refreshes the compaction checkpoint first: a frontier
+    that fell below a lane's base jumps forward (the prefix it was
+    about to read is retired; its live content was re-emitted at the
+    writer's tail, which this reader has not consumed yet — nothing is
+    skipped, and re-applied duplicates are ts-idempotent)."""
 
     def __init__(self, store: KVCacheStore, lanes: int = DEFAULT_LANES,
                  window: int = 8):
@@ -236,11 +363,32 @@ class LedgerReader:
         self.inode = ledger_inode(store.namespace)
         self.frontier: dict[int, int] = {lane: 0 for lane in range(lanes)}
         self.segments_read = 0
+        self.records_scanned = 0
+        self.frontier_jumps = 0
+        self.last_checkpoint = LedgerCheckpoint()
 
     def _chain(self, lane: int) -> int:
         return self.store.chains[lane % len(self.store.chains)]
 
+    def live_segments(self) -> int:
+        """Ledger depth as this reader sees it: segments between each
+        lane's compaction base and the scanned frontier."""
+        bases = self.last_checkpoint.bases
+        return sum(max(0, f - bases.get(lane, 0))
+                   for lane, f in self.frontier.items())
+
+    async def refresh_bases(self) -> LedgerCheckpoint:
+        ckpt = await read_checkpoint(self.store)
+        self.last_checkpoint = ckpt
+        for lane in self.frontier:
+            base = ckpt.base(lane)
+            if self.frontier[lane] < base:
+                self.frontier[lane] = base
+                self.frontier_jumps += 1
+        return ckpt
+
     async def scan(self) -> list[LedgerRecord]:
+        await self.refresh_bases()
         out: list[LedgerRecord] = []
         active = set(self.frontier)
         while active:
@@ -281,6 +429,7 @@ class LedgerReader:
                 self.frontier[lane] = next_seq
                 if advanced < self.window or lane in hit_end:
                     active.discard(lane)
+        self.records_scanned += len(out)
         return out
 
 

@@ -9,9 +9,16 @@ Layers, bottom-up (each its own module, composable in tests):
   and batch to the chains off the serving path.
 - ``EvictionWorker`` (gc.py) — TTL + capacity eviction driven by ledger
   replay, paced removals, fenced against racing puts.
-- ``AdmissionController`` (here) — per-namespace in-flight windows plus
-  value-size-class windows, so one tenant's large-value burst can't
-  monopolize the shared client's channels.
+- ``LedgerCompactor`` (compact.py) — rewrites each namespace's live
+  ledger tail and retires the historical prefix, bounding replay to
+  O(live keys).
+- ``AdmissionController`` (admission.py) — per-namespace in-flight
+  windows plus value-size-class windows, so one tenant's large-value
+  burst can't monopolize the shared client's channels.  With
+  ``admit_scope = "host"`` the windows live in a shm token arena shared
+  by every process on the host, and ``admit_shards`` hashes namespaces
+  onto weighted shards so a hot tenant saturates its slice, not the
+  host.
 
 ``KVCacheTier`` wires them together: get overlays the dirty buffer
 (read-your-writes), put records PUT ledger entries only after the block
@@ -25,7 +32,6 @@ from __future__ import annotations
 
 import asyncio
 import atexit
-import bisect
 import json
 import os
 import time
@@ -33,6 +39,11 @@ import weakref
 from dataclasses import dataclass, field
 
 from t3fs.client.storage_client import StorageClient
+from t3fs.kvcache.admission import (
+    ADMIT_CLASS_BOUNDS, ADMIT_CLASS_NAMES, AdmissionConfig,
+    AdmissionController, resolve_plane,
+)
+from t3fs.kvcache.compact import CompactionConfig, LedgerCompactor
 from t3fs.kvcache.gc import EvictionConfig, EvictionWorker
 from t3fs.kvcache.ledger import (
     DEFAULT_LANES, SEGMENT_SIZE, OP_HIT, OP_PUT, LedgerReader, LedgerTable,
@@ -44,10 +55,10 @@ from t3fs.utils.metrics import (
     CallbackGauge, CountRecorder, DistributionRecorder,
 )
 
-# value-size admission classes: bounds in bytes, names aligned with the
-# read path's size classes (t3fs/net/rpcstats.py) so dashboards line up
-ADMIT_CLASS_BOUNDS = (4 << 10, 64 << 10)
-ADMIT_CLASS_NAMES = ("small", "medium", "large")
+__all__ = [
+    "ADMIT_CLASS_BOUNDS", "ADMIT_CLASS_NAMES", "AdmissionController",
+    "KVCacheTier", "KVCacheTierConfig", "render_kvcache_stats",
+]
 
 
 @dataclass
@@ -73,50 +84,19 @@ class KVCacheTierConfig:
     remove_rate: float = 2000.0
     remove_burst: int = 256
     gc_batch: int = 64
-    # admission
+    # admission (see t3fs/kvcache/admission.py for scope/shard semantics)
     admit_window: int = 128           # per-namespace in-flight ops
     admit_class_windows: tuple = (96, 48, 16)    # small/medium/large
-
-
-class AdmissionController:
-    """Two-level window: a namespace-wide in-flight cap, then a per
-    value-size-class cap inside it.  Acquisition order is fixed
-    (namespace, then class) so mixed-size waiters can't deadlock."""
-
-    def __init__(self, window: int, class_windows: tuple):
-        self._ns = asyncio.Semaphore(window)
-        self._cls = [asyncio.Semaphore(w) for w in class_windows]
-        self.waits = 0
-
-    @staticmethod
-    def size_class(nbytes: int) -> int:
-        return bisect.bisect_right(ADMIT_CLASS_BOUNDS, nbytes)
-
-    def admit(self, nbytes: int) -> "_Admit":
-        return _Admit(self, self.size_class(nbytes))
-
-
-class _Admit:
-    def __init__(self, ctl: AdmissionController, cls: int):
-        self._ctl = ctl
-        self._cls = cls
-
-    async def __aenter__(self):
-        ns, cls = self._ctl._ns, self._ctl._cls[self._cls]
-        if ns.locked() or cls.locked():
-            self._ctl.waits += 1
-        await ns.acquire()
-        try:
-            await cls.acquire()
-        except BaseException:
-            ns.release()
-            raise
-        return self
-
-    async def __aexit__(self, *exc):
-        self._ctl._cls[self._cls].release()
-        self._ctl._ns.release()
-        return False
+    admit_scope: str = "process"      # "process" | "host" (shm arena)
+    admit_group: str = ""             # shared-plane rendezvous; "" = private
+    admit_shards: int = 1
+    admit_shard_weights: tuple = ()
+    # ledger compaction (run_compaction=True in start() to enable)
+    compact_trigger_segments: int = 64
+    compact_interval_s: float = 10.0
+    compact_rate: float = 200.0       # segment removals/s
+    compact_burst: int = 64
+    compact_del_grace_s: float = 5.0
 
 
 # live tiers for the T3FS_KVCACHE_STATS exit dump
@@ -161,8 +141,14 @@ class KVCacheTier:
                                    segment_bytes=self.cfg.segment_bytes)
         self.reader = LedgerReader(self.store, lanes=self.cfg.lanes)
         self.table = LedgerTable()
-        self.admission = AdmissionController(self.cfg.admit_window,
-                                             self.cfg.admit_class_windows)
+        self.plane = resolve_plane(AdmissionConfig(
+            window=self.cfg.admit_window,
+            class_windows=tuple(self.cfg.admit_class_windows),
+            shards=max(1, self.cfg.admit_shards),
+            shard_weights=tuple(self.cfg.admit_shard_weights),
+            scope=self.cfg.admit_scope,
+            group=self.cfg.admit_group))
+        self.admission = self.plane.controller(namespace)
         self.wb: WriteBehind | None = None
         if self.cfg.write_behind == "on":
             self.wb = WriteBehind(
@@ -181,6 +167,14 @@ class KVCacheTier:
                            remove_rate=self.cfg.remove_rate,
                            remove_burst=self.cfg.remove_burst,
                            interval_s=self.cfg.gc_interval_s))
+        self.compactor = LedgerCompactor(
+            self.store, self.ledger, lanes=self.cfg.lanes,
+            config=CompactionConfig(
+                trigger_segments=self.cfg.compact_trigger_segments,
+                del_grace_s=self.cfg.compact_del_grace_s,
+                remove_rate=self.cfg.compact_rate,
+                remove_burst=self.cfg.compact_burst,
+                interval_s=self.cfg.compact_interval_s))
         self.counters = {"puts": 0, "gets": 0, "hits": 0, "misses": 0}
         self._hit_tick = 0
         self._ledger_task: asyncio.Task | None = None
@@ -193,11 +187,23 @@ class KVCacheTier:
         self._m_dirty = CallbackGauge(
             f"kvcache.{namespace}.dirty_bytes",
             lambda: self.wb.dirty_bytes if self.wb else 0, tags)
+        # ledger depth gauges: how much history a fresh reader replays
+        self._m_segments = CallbackGauge(
+            "kvcache.ledger.segments",
+            self.reader.live_segments, tags)
+        self._m_replay = CallbackGauge(
+            "kvcache.ledger.replay_records",
+            lambda: self.reader.records_scanned, tags)
+        self._m_compactions = CallbackGauge(
+            "kvcache.ledger.compactions",
+            lambda: max(self.compactor.stats["compactions"],
+                        self.reader.last_checkpoint.compactions), tags)
         _LIVE_TIERS.append(weakref.ref(self))
 
     # --- lifecycle ---
 
-    async def start(self, *, run_gc: bool = False) -> None:
+    async def start(self, *, run_gc: bool = False,
+                    run_compaction: bool = False) -> None:
         await self.ledger.attach()
         if self.wb is not None:
             await self.wb.start()
@@ -205,9 +211,12 @@ class KVCacheTier:
             self._ledger_loop(), name="t3fs-kvcache-ledger")
         if run_gc:
             await self.gc.start()
+        if run_compaction:
+            await self.compactor.start()
 
     async def stop(self) -> None:
         self._stopping = True
+        await self.compactor.stop()
         await self.gc.stop()
         if self.wb is not None:
             await self.wb.stop()
@@ -310,6 +319,9 @@ class KVCacheTier:
     async def run_gc_pass(self) -> dict:
         return await self.gc.run_pass()
 
+    async def run_compaction_pass(self, force: bool = False) -> dict:
+        return await self.compactor.run_pass(force=force)
+
     # --- observability ---
 
     def stats(self) -> dict:
@@ -321,10 +333,16 @@ class KVCacheTier:
             "hits": c["hits"], "misses": c["misses"],
             "hit_rate": round(hit_rate, 4),
             "admission_waits": self.admission.waits,
+            "admission": self.admission.stats(),
+            "admission_plane": self.plane.stats(),
             "ledger_segments_flushed": self.ledger.segments_flushed,
+            "ledger_live_segments": self.reader.live_segments(),
+            "ledger_replay_records": self.reader.records_scanned,
+            "ledger_hits_coalesced": self.ledger.hits_coalesced,
             "ledger_live_keys": len(self.table),
             "ledger_live_bytes": self.table.live_bytes,
             "gc": dict(self.gc.stats),
+            "compaction": dict(self.compactor.stats),
         }
         if self.wb is not None:
             out["write_behind"] = dict(self.wb.stats)
@@ -342,7 +360,9 @@ def render_kvcache_stats(snaps: list[dict]) -> str:
             cur = merged.setdefault(ns, {
                 "puts": 0, "gets": 0, "hits": 0, "misses": 0,
                 "dirty_bytes": 0, "removed": 0, "fence_lost": 0,
-                "live_bytes": 0, "live_keys": 0, "procs": 0})
+                "live_bytes": 0, "live_keys": 0, "procs": 0,
+                "segments": 0, "compactions": 0, "waits": 0,
+                "shard": "-", "scope": "-"})
             cur["procs"] += 1
             for k in ("puts", "gets", "hits", "misses"):
                 cur[k] += tier.get(k, 0)
@@ -355,17 +375,30 @@ def render_kvcache_stats(snaps: list[dict]) -> str:
                                     tier.get("ledger_live_bytes", 0))
             cur["live_keys"] = max(cur["live_keys"],
                                    tier.get("ledger_live_keys", 0))
+            # ledger depth is one namespace-wide fact: max across views
+            cur["segments"] = max(cur["segments"],
+                                  tier.get("ledger_live_segments", 0))
+            comp = tier.get("compaction", {})
+            cur["compactions"] = max(cur["compactions"],
+                                     comp.get("compactions", 0))
+            adm = tier.get("admission", {})
+            cur["waits"] += adm.get("waits", tier.get("admission_waits", 0))
+            cur["shard"] = str(adm.get("shard", cur["shard"]))
+            cur["scope"] = adm.get("scope", cur["scope"])
     if not merged:
         return "no kvcache stats"
     headers = ["namespace", "procs", "puts", "gets", "hit%", "dirty_B",
-               "live_keys", "live_B", "removed", "fence_lost"]
+               "live_keys", "live_B", "removed", "fence_lost",
+               "led_segs", "compactions", "shard", "scope", "adm_waits"]
     rows = []
     for ns in sorted(merged):
         m = merged[ns]
         hr = 100.0 * m["hits"] / max(1, m["hits"] + m["misses"])
         rows.append([ns, m["procs"], m["puts"], m["gets"], f"{hr:.1f}",
                      m["dirty_bytes"], m["live_keys"], m["live_bytes"],
-                     m["removed"], m["fence_lost"]])
+                     m["removed"], m["fence_lost"], m["segments"],
+                     m["compactions"], m["shard"], m["scope"],
+                     m["waits"]])
     cols = [headers] + [[str(c) for c in r] for r in rows]
     widths = [max(len(r[i]) for r in cols) for i in range(len(headers))]
     lines = ["  ".join(h.ljust(w) for h, w in zip(headers, widths))]

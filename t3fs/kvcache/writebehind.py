@@ -97,7 +97,7 @@ class WriteBehind:
         self._stopping = False
         self.stats = {"puts": 0, "coalesced": 0, "flushed": 0,
                       "flush_errors": 0, "flush_dropped": 0,
-                      "backpressure_waits": 0}
+                      "backpressure_waits": 0, "peak_dirty_bytes": 0}
 
     # --- producer side ---
 
@@ -161,6 +161,8 @@ class WriteBehind:
             self._outstanding.add(entry.seq)
             self.dirty_bytes += entry.size
             self.stats["puts"] += 1
+            self.stats["peak_dirty_bytes"] = max(
+                self.stats["peak_dirty_bytes"], self.dirty_bytes)
             self._cond.notify_all()
 
     def lookup(self, keys: list[bytes]

@@ -128,6 +128,16 @@ class KVCacheStore:
         result = await self.client.write_chunk(
             chain, cid, 0, blob, self.cfg.block_size)
         st = Status(StatusCode(result.status.code), result.status.message)
+        if st.code == StatusCode.CHUNK_STALE_UPDATE:
+            # superseded: another writer committed a NEWER update to this
+            # chunk while our (retried) write was in flight — under the
+            # cache's hash-placement that is a racing put of the same key
+            # (or a collided one), and last-writer-wins is exactly the
+            # namespace's replay semantics.  Succeeding here is
+            # indistinguishable from "mine landed, then the winner
+            # overwrote it a microsecond later".  The result carries no
+            # version for OUR update (it never committed); 0 = no fence
+            return 0
         if not st.ok:
             raise StatusError(st.code, st.message)
         return result.update_ver

@@ -121,7 +121,13 @@ class RingClient:
         if arena is None:
             arena = RingArena.create(sc.buf_registry,
                                      self.slot_size * nslots)
-            self.alloc = SlotAllocator(nslots, self.slot_size)
+            # quarantine = the one-sided discard discipline for staging
+            # slots: a timed-out op's slot must outlive any late server
+            # dereference (an aliased read writes INTO the arena with no
+            # connection involved) before it is reissued
+            self.alloc = SlotAllocator(
+                nslots, self.slot_size,
+                quarantine_s=2.0 * sc.cfg.request_timeout_s)
         else:
             # app-owned arena (wrap_iov): SQE offsets come from the app's
             # own iov bookkeeping, no staging slots here
@@ -280,6 +286,7 @@ class RingClient:
         # plan: (idx, slot | None, arena offset, capacity)
         plan: list[tuple[int, int | None, int, int]] = []
         recs: list[tuple] = []
+        settled = False
         try:
             for i in idxs:
                 io = ios[i]
@@ -320,6 +327,7 @@ class RingClient:
             try:
                 results = await self._enqueue(address, "read", blob,
                                               len(plan))
+                settled = True
             except RingUnsupported:
                 return None
             except StatusError as e:
@@ -342,9 +350,13 @@ class RingClient:
                 install(i, r, p, src)
             return leftover
         finally:
+            # an unsettled frame (timeout, cancellation, transport
+            # failure) may still be processed server-side — its reads
+            # would land bytes in these slots long after we give up, so
+            # they sit out the quarantine instead of being reissued
             for _i, slot, _off, _cap in plan:
                 if slot is not None:
-                    self.alloc.release(slot)
+                    self.alloc.release(slot, discard=not settled)
 
     # ---- StorageClient hook: one CRAQ write ----
 
@@ -362,6 +374,7 @@ class RingClient:
         if slot is None:
             raise RingUnsupported("arena full")
         off = self.alloc.offset(slot)
+        settled = False
         try:
             self.arena.write_at(off, data)
             blob = pack_ring_sqes([(
@@ -372,12 +385,16 @@ class RingClient:
             if blob is None:
                 raise RingUnsupported("field out of range")
             results = await self._enqueue(address, "write", blob, 1)
+            settled = True
             return results[0]
         finally:
             # release AFTER completion: the server consumed the payload
             # (aliased: synchronously in the handler; one-sided: over the
-            # same now-settled call) before the CQE came back
-            self.alloc.release(slot)
+            # same now-settled call) before the CQE came back.  An op
+            # that did NOT settle (timeout, cancellation) may still be
+            # pending server-side — quarantine the slot so a late
+            # dereference can't touch a newer occupant's bytes
+            self.alloc.release(slot, discard=not settled)
 
     # ---- lean path: ranges straight into an app-owned arena ----
 

@@ -66,6 +66,26 @@ def test_put_get_remove_roundtrip():
     run(body())
 
 
+def test_put_superseded_by_newer_update_is_not_an_error():
+    """A put whose (retried) update lost to a NEWER committed update on
+    the same chunk — a hot key hammered from many processes — succeeds
+    with last-writer-wins semantics instead of raising: the outcome is
+    indistinguishable from landing and being overwritten right after."""
+    from t3fs.net.wire import WireStatus
+    from t3fs.storage.types import IOResult
+    from t3fs.utils.status import StatusCode
+
+    class _StaleClient:
+        cfg = type("C", (), {"verify_checksums": False})()
+
+        async def write_chunk(self, *a, **kw):
+            return IOResult(WireStatus(int(StatusCode.CHUNK_STALE_UPDATE),
+                                       "v3 <= committed v7"))
+
+    kv = KVCacheStore(_StaleClient(), chains=[1], namespace="t")
+    assert run(kv.put(b"hot", b"v")) == 0      # no fence, but no crash
+
+
 def test_block_size_enforced():
     async def body():
         fab = StorageFabric(num_nodes=1, replicas=1)

@@ -14,6 +14,7 @@ Plus units for the shared SlotAllocator and the batched shm-ring pops.
 """
 
 import asyncio
+import time
 
 import pytest
 
@@ -81,6 +82,25 @@ def test_slot_allocator_key_binding():
     assert a.available == 2
     with pytest.raises(KeyError):
         a.release_key("k")            # binding consumed
+
+
+def test_slot_allocator_discard_quarantines_until_deadline():
+    """A discarded slot (timed-out op: the server may still dereference
+    its offset) must not be reissued until the quarantine elapses — and
+    must come back afterwards instead of leaking."""
+    a = SlotAllocator(1, 64, quarantine_s=0.05)
+    s = a.acquire()
+    a.release(s, discard=True)
+    assert a.discarded == 1 and a.quarantined == 1
+    assert a.try_acquire() is None          # not reissued inside window
+    time.sleep(0.08)
+    got = a.try_acquire()                   # reclaimed after the window
+    assert got == s and a.quarantined == 0
+    # discard with no quarantine configured degrades to a plain release
+    b = SlotAllocator(1, 64)
+    sb = b.acquire()
+    b.release(sb, discard=True)
+    assert b.try_acquire() == sb
 
 
 # ---------------- shm ring: batched pop/complete ----------------
